@@ -1,0 +1,55 @@
+import datetime
+
+from tidb_tpu.types import (
+    TypeKind,
+    common_arith_type,
+    common_compare_type,
+    merge_types,
+    parse_date,
+    parse_datetime,
+    ty_decimal,
+    ty_float,
+    ty_int,
+    ty_string,
+    ty_null,
+    decimal_round_half_up,
+)
+from tidb_tpu.types.values import days_to_date, format_date, micros_to_datetime
+
+
+def test_date_roundtrip():
+    d = parse_date("1998-09-02")
+    assert days_to_date(d) == datetime.date(1998, 9, 2)
+    assert format_date(d) == "1998-09-02"
+    assert parse_date("19980902") == d
+
+
+def test_datetime_parse():
+    us = parse_datetime("1998-09-02 12:30:15")
+    assert micros_to_datetime(us) == datetime.datetime(1998, 9, 2, 12, 30, 15)
+    assert parse_datetime("1998-09-02") == parse_date("1998-09-02") * 86_400_000_000
+
+
+def test_arith_types():
+    assert common_arith_type(ty_int(), ty_int()).kind == TypeKind.INT
+    assert common_arith_type(ty_int(), ty_float()).kind == TypeKind.FLOAT
+    t = common_arith_type(ty_decimal(10, 2), ty_int())
+    assert t.kind == TypeKind.DECIMAL and t.scale == 2
+    assert common_arith_type(ty_string(), ty_int()).kind == TypeKind.FLOAT
+
+
+def test_compare_types():
+    assert common_compare_type(ty_int(), ty_float()).kind == TypeKind.FLOAT
+    assert common_compare_type(ty_string(), ty_string()).kind == TypeKind.STRING
+    assert common_compare_type(ty_null(), ty_int()).kind == TypeKind.INT
+
+
+def test_merge_types_nullability():
+    t = merge_types(ty_int(nullable=False), ty_null())
+    assert t.kind == TypeKind.INT and t.nullable
+
+
+def test_decimal_round():
+    assert decimal_round_half_up(12345, 2) == 123
+    assert decimal_round_half_up(12350, 2) == 124  # half away from zero
+    assert decimal_round_half_up(-12350, 2) == -124

@@ -1,0 +1,32 @@
+"""tidb_tpu — a TPU-native analytical SQL execution framework.
+
+A ground-up re-design of the capabilities of TiDB's SQL layer (reference:
+Aloxaf/tidb) for TPU hardware:
+
+- columnar ``Chunk`` batches in Arrow layout (reference: util/chunk/column.go:59-67)
+- a Volcano-with-chunks root executor (reference: executor/executor.go:187-193)
+- a planner that splits physical plans into *root tasks* (host) and *cop tasks*
+  (device) behind a narrow ``Client.Send(DAGRequest) -> chunk stream`` pushdown
+  boundary (reference: kv/kv.go:197-203, planner/core/task.go:44-106)
+- the coprocessor engine itself is a JAX/XLA program over fixed-shape column
+  blocks — pjit/shard_map across a device mesh, Pallas kernels where fusion
+  isn't enough — not a port of the reference's row-at-a-time Go interpreters.
+
+Subpackage map (reference component in parens):
+
+- ``tidb_tpu.types``    scalar type system, MySQL semantics        (types/)
+- ``tidb_tpu.chunk``    columnar batches, codec                    (util/chunk)
+- ``tidb_tpu.parser``   SQL lexer/parser -> AST                    (pingcap/parser)
+- ``tidb_tpu.expr``     expression trees, vectorized eval, pushdown(expression/)
+- ``tidb_tpu.plan``     logical/physical planner, task split       (planner/)
+- ``tidb_tpu.copr``     DAG IR + device/host coprocessor engines   (mocktikv cop + TiKV copr)
+- ``tidb_tpu.exec``     root executors                             (executor/)
+- ``tidb_tpu.distsql``  request builder, fan-out, ordered merge    (distsql/, store/tikv/coprocessor.go)
+- ``tidb_tpu.store``    KV + block store, regions, MVCC, faults    (kv/, store/)
+- ``tidb_tpu.parallel`` mesh/sharding/collectives helpers          (client_batch.go &c., re-imagined)
+- ``tidb_tpu.session``  session, catalog, sysvars                  (session/, infoschema/)
+- ``tidb_tpu.ops``      jax/pallas kernels (segment reduce, compaction, hash)
+- ``tidb_tpu.utils``    memory tracking, timing, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from .column import Column
+from .chunk import Chunk, chunk_from_pylists, concat_chunks
+from .codec import encode_chunk, decode_chunk
+
+__all__ = [
+    "Column",
+    "Chunk",
+    "chunk_from_pylists",
+    "concat_chunks",
+    "encode_chunk",
+    "decode_chunk",
+]

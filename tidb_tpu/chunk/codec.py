@@ -1,0 +1,109 @@
+"""Chunk wire codec.
+
+Reference: /root/reference/util/chunk/codec.go (Arrow-chunk RPC encoding used
+when ``canUseChunkRPC``, distsql/distsql.go:147-188).  Our wire format is a
+simple length-prefixed layout: a JSON header (ftypes, row count, per-column
+flags) + raw little-endian buffers.  It exists so the distsql layer has a real
+serialization boundary (multi-host DCN transport serializes through this), and
+so fault-injection tests can corrupt/travel bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import List
+
+import numpy as np
+
+from ..types import FieldType, TypeKind
+from .chunk import Chunk
+from .column import Column
+
+_MAGIC = b"TPCH"  # tidb-tpu chunk
+_VERSION = 1
+
+
+def _col_header(c: Column) -> dict:
+    return {
+        "kind": int(c.ftype.kind),
+        "nullable": c.ftype.nullable,
+        "precision": c.ftype.precision,
+        "scale": c.ftype.scale,
+        "has_valid": c.valid is not None,
+    }
+
+
+def encode_chunk(chunk: Chunk) -> bytes:
+    parts: List[bytes] = []
+    header = {
+        "version": _VERSION,
+        "rows": chunk.num_rows,
+        "cols": [_col_header(c) for c in chunk.columns],
+    }
+    for c in chunk.columns:
+        if c.ftype.kind == TypeKind.STRING:
+            # Arrow-style varlen layout: int64 offsets (n+1) + utf-8 data buffer.
+            encs = [str(x).encode("utf-8") for x in c.data]
+            offsets = np.zeros(len(encs) + 1, dtype=np.int64)
+            np.cumsum([len(e) for e in encs], out=offsets[1:])
+            parts.append(offsets.tobytes() + b"".join(encs))
+        else:
+            parts.append(np.ascontiguousarray(c.data).tobytes())
+        if c.valid is not None:
+            parts.append(np.packbits(c.valid).tobytes())
+        else:
+            parts.append(b"")
+    hdr = json.dumps(header).encode("utf-8")
+    out = bytearray()
+    out += _MAGIC
+    out += struct.pack("<I", len(hdr))
+    out += hdr
+    for p in parts:
+        out += struct.pack("<Q", len(p))
+        out += p
+    return bytes(out)
+
+
+def decode_chunk(buf: bytes) -> Chunk:
+    assert buf[:4] == _MAGIC, "bad chunk magic"
+    off = 4
+    (hlen,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    header = json.loads(buf[off : off + hlen].decode("utf-8"))
+    off += hlen
+    rows = header["rows"]
+    cols: List[Column] = []
+
+    def read_part():
+        nonlocal off
+        (n,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        p = buf[off : off + n]
+        off += n
+        return p
+
+    for ch in header["cols"]:
+        ft = FieldType(
+            TypeKind(ch["kind"]), ch["nullable"], ch["precision"], ch["scale"]
+        )
+        raw = read_part()
+        if ft.kind == TypeKind.STRING:
+            data = np.empty(rows, dtype=object)
+            if rows:
+                off_end = (rows + 1) * 8
+                offsets = np.frombuffer(raw[:off_end], dtype=np.int64)
+                sbuf = raw[off_end:]
+                assert offsets[-1] == len(sbuf), "string column buffer mismatch"
+                for i in range(rows):
+                    data[i] = sbuf[offsets[i] : offsets[i + 1]].decode("utf-8")
+        else:
+            data = np.frombuffer(raw, dtype=ft.np_dtype).copy()
+        vraw = read_part()
+        valid = None
+        if ch["has_valid"]:
+            valid = np.unpackbits(np.frombuffer(vraw, dtype=np.uint8))[:rows].astype(
+                np.bool_
+            )
+        cols.append(Column(ft, data, valid))
+    return Chunk(cols)

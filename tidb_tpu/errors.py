@@ -1,0 +1,146 @@
+"""Error taxonomy, mirroring the reference's user-visible error classes.
+
+Reference: TiDB surfaces typed errors (parser, planner, executor, kv) with MySQL
+error codes. We keep a small hierarchy; codes follow MySQL where meaningful.
+"""
+
+from __future__ import annotations
+
+
+class TiDBTPUError(Exception):
+    """Base class for all framework errors."""
+
+    code: int = 1105  # ER_UNKNOWN_ERROR
+
+
+class ParseError(TiDBTPUError):
+    code = 1064  # ER_PARSE_ERROR
+
+    def __init__(self, msg: str, line: int = 0, col: int = 0):
+        self.line, self.col = line, col
+        loc = f" near line {line}:{col}" if line else ""
+        super().__init__(f"You have an error in your SQL syntax{loc}: {msg}")
+
+
+class PlanError(TiDBTPUError):
+    code = 1105
+
+
+class UnknownTableError(TiDBTPUError):
+    code = 1146  # ER_NO_SUCH_TABLE
+
+    def __init__(self, name: str):
+        super().__init__(f"Table '{name}' doesn't exist")
+
+
+class UnknownColumnError(TiDBTPUError):
+    code = 1054  # ER_BAD_FIELD_ERROR
+
+    def __init__(self, name: str, where: str = "field list"):
+        super().__init__(f"Unknown column '{name}' in '{where}'")
+
+
+class UnknownDatabaseError(TiDBTPUError):
+    code = 1049
+
+    def __init__(self, name: str):
+        super().__init__(f"Unknown database '{name}'")
+
+
+class TableExistsError(TiDBTPUError):
+    code = 1050
+
+    def __init__(self, name: str):
+        super().__init__(f"Table '{name}' already exists")
+
+
+class AmbiguousColumnError(TiDBTPUError):
+    code = 1052
+
+    def __init__(self, name: str):
+        super().__init__(f"Column '{name}' in field list is ambiguous")
+
+
+class TypeError_(TiDBTPUError):
+    """Type-system error (named with trailing underscore to avoid shadowing)."""
+
+    code = 1105
+
+
+class OverflowError_(TiDBTPUError):
+    code = 1264  # ER_WARN_DATA_OUT_OF_RANGE
+
+    def __init__(self, typ: str, value):
+        super().__init__(f"{typ} value is out of range: {value!r}")
+
+
+class DivisionByZeroError(TiDBTPUError):
+    code = 1365
+
+
+class ExecutorError(TiDBTPUError):
+    code = 1105
+
+
+class KVError(TiDBTPUError):
+    code = 1105
+
+
+class TxnConflictError(KVError):
+    """Write-write conflict detected at commit (optimistic 2PC)."""
+
+    code = 9007  # TiKV write conflict
+
+    def __init__(self, key=None):
+        super().__init__(f"Write conflict on key {key!r}, txn must retry")
+
+
+class TxnAbortedError(KVError):
+    code = 1105
+
+
+class LockedError(KVError):
+    """Key is locked by another in-flight transaction (Percolator lock)."""
+
+    code = 9007
+
+    def __init__(self, key=None, owner_ts: int = 0):
+        self.key, self.owner_ts = key, owner_ts
+        super().__init__(f"Key {key!r} locked by txn start_ts={owner_ts}")
+
+
+class RegionError(KVError):
+    """Stale region epoch / not leader — caller must refresh routing and retry.
+
+    Reference: store/tikv/region_request.go:281 onRegionError.
+    """
+
+    code = 9005
+
+    def __init__(self, msg: str = "stale region epoch"):
+        super().__init__(msg)
+
+
+class QueryKilledError(ExecutorError):
+    code = 1317  # ER_QUERY_INTERRUPTED
+
+    def __init__(self):
+        super().__init__("Query execution was interrupted")
+
+
+class MemoryQuotaExceededError(ExecutorError):
+    """OOM action 'cancel' — reference util/memory/action.go PanicOnExceed."""
+
+    code = 8175
+
+    def __init__(self, quota: int, used: int):
+        super().__init__(
+            f"Out Of Memory Quota! used={used} bytes, quota={quota} bytes"
+        )
+
+
+class PrivilegeError(TiDBTPUError):
+    code = 1142  # ER_TABLEACCESS_DENIED_ERROR
+
+    def __init__(self, priv: str, user: str, obj: str):
+        super().__init__(f"{priv} command denied to user '{user}' for '{obj}'")

@@ -1,0 +1,229 @@
+"""Scalar type system.
+
+Reference: /root/reference/types (FieldType, Datum, mydecimal.go, time.go).
+Design departure for TPU: every kind has a fixed-width physical representation
+so columns are dense numpy/jax arrays with separate validity bitmaps:
+
+- INT / UINT      -> int64 (uint stored in int64, flag distinguishes)
+- FLOAT           -> float64 on host, float32/bfloat16 on device where safe
+- DECIMAL(p, s)   -> scaled int64 (value * 10^s); MySQL's mydecimal replaced by
+                     fixed-point arithmetic which XLA handles natively
+- STRING          -> host: numpy object array; device: int32 dictionary codes
+- DATE            -> int32 days since epoch
+- DATETIME        -> int64 microseconds since epoch
+- BOOL            -> int64 0/1 (MySQL booleans are TINYINT)
+- NULLTYPE        -> type of bare NULL literal
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+
+class TypeKind(enum.IntEnum):
+    NULLTYPE = 0
+    INT = 1
+    UINT = 2
+    FLOAT = 3
+    DECIMAL = 4
+    STRING = 5
+    DATE = 6
+    DATETIME = 7
+    BOOL = 8
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (
+            TypeKind.INT,
+            TypeKind.UINT,
+            TypeKind.FLOAT,
+            TypeKind.DECIMAL,
+            TypeKind.BOOL,
+        )
+
+    @property
+    def is_temporal(self) -> bool:
+        return self in (TypeKind.DATE, TypeKind.DATETIME)
+
+
+# numpy physical dtype per kind (host representation).
+_NP_DTYPE = {
+    TypeKind.NULLTYPE: np.int64,
+    TypeKind.INT: np.int64,
+    TypeKind.UINT: np.int64,
+    TypeKind.FLOAT: np.float64,
+    TypeKind.DECIMAL: np.int64,
+    TypeKind.STRING: object,
+    TypeKind.DATE: np.int32,
+    TypeKind.DATETIME: np.int64,
+    TypeKind.BOOL: np.int64,
+}
+
+
+@dataclass(frozen=True)
+class FieldType:
+    kind: TypeKind
+    nullable: bool = True
+    # decimal: precision/scale.  scale is also used by DATETIME for fsp (unused
+    # in arithmetic; micros are always stored).
+    precision: int = 0
+    scale: int = 0
+
+    @property
+    def np_dtype(self):
+        return _NP_DTYPE[self.kind]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind.is_numeric
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == TypeKind.STRING
+
+    def not_null(self) -> "FieldType":
+        return replace(self, nullable=False)
+
+    def with_nullable(self, nullable: bool) -> "FieldType":
+        return replace(self, nullable=nullable)
+
+    def sql_name(self) -> str:
+        k = self.kind
+        if k == TypeKind.DECIMAL:
+            return f"DECIMAL({self.precision},{self.scale})"
+        return {
+            TypeKind.NULLTYPE: "NULL",
+            TypeKind.INT: "BIGINT",
+            TypeKind.UINT: "BIGINT UNSIGNED",
+            TypeKind.FLOAT: "DOUBLE",
+            TypeKind.STRING: "VARCHAR",
+            TypeKind.DATE: "DATE",
+            TypeKind.DATETIME: "DATETIME",
+            TypeKind.BOOL: "TINYINT",
+        }[k]
+
+    def __repr__(self):  # compact for plan dumps
+        s = self.sql_name()
+        if not self.nullable:
+            s += " NOT NULL"
+        return s
+
+
+def ty_null() -> FieldType:
+    return FieldType(TypeKind.NULLTYPE)
+
+
+def ty_bool(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.BOOL, nullable)
+
+
+def ty_int(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.INT, nullable)
+
+
+def ty_uint(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.UINT, nullable)
+
+
+def ty_float(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.FLOAT, nullable)
+
+
+def ty_decimal(precision: int = 18, scale: int = 2, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DECIMAL, nullable, precision, scale)
+
+
+def ty_string(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.STRING, nullable)
+
+
+def ty_date(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DATE, nullable)
+
+
+def ty_datetime(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DATETIME, nullable)
+
+
+def merge_types(a: FieldType, b: FieldType) -> FieldType:
+    """Result type when values of both types flow into one column (UNION /
+    CASE / COALESCE).  MySQL-ish widening lattice."""
+    if a.kind == TypeKind.NULLTYPE:
+        return b.with_nullable(True)
+    if b.kind == TypeKind.NULLTYPE:
+        return a.with_nullable(True)
+    nullable = a.nullable or b.nullable
+    if a.kind == b.kind:
+        if a.kind == TypeKind.DECIMAL:
+            scale = max(a.scale, b.scale)
+            prec = max(a.precision - a.scale, b.precision - b.scale) + scale
+            return ty_decimal(min(prec, 38), scale, nullable)
+        return a.with_nullable(nullable)
+    ka, kb = a.kind, b.kind
+    ints = (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL)
+    if ka in ints and kb in ints:
+        return ty_int(nullable)
+    if TypeKind.FLOAT in (ka, kb) or TypeKind.STRING in (ka, kb):
+        if TypeKind.STRING in (ka, kb) and not (ka.is_numeric and kb.is_numeric):
+            # string vs temporal/string mix -> string
+            if ka == TypeKind.STRING and kb == TypeKind.STRING:
+                return ty_string(nullable)
+            if ka.is_temporal or kb.is_temporal:
+                return ty_string(nullable)
+        return ty_float(nullable)
+    if TypeKind.DECIMAL in (ka, kb):
+        dec = a if ka == TypeKind.DECIMAL else b
+        if ka in ints or kb in ints:
+            return ty_decimal(max(dec.precision, 20), dec.scale, nullable)
+        return ty_float(nullable)
+    if ka.is_temporal and kb.is_temporal:
+        return ty_datetime(nullable)
+    return ty_string(nullable)
+
+
+def common_arith_type(a: FieldType, b: FieldType) -> FieldType:
+    """Type in which binary arithmetic (+,-,*) is carried out.
+
+    Reference behavior (types/field_type.go AggFieldType + expression type
+    inference): int op int -> int; anything with float/string -> float
+    (strings coerce to float in arithmetic); decimal op {int,decimal} ->
+    decimal with combined scale.
+    """
+    ka, kb = a.kind, b.kind
+    nullable = a.nullable or b.nullable
+    if ka == TypeKind.NULLTYPE or kb == TypeKind.NULLTYPE:
+        nullable = True
+    ints = (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL, TypeKind.NULLTYPE)
+    if (ka in (TypeKind.FLOAT, TypeKind.STRING) or kb in (TypeKind.FLOAT, TypeKind.STRING)
+            or ka.is_temporal or kb.is_temporal):
+        return ty_float(nullable)
+    if ka == TypeKind.DECIMAL or kb == TypeKind.DECIMAL:
+        sa = a.scale if ka == TypeKind.DECIMAL else 0
+        sb = b.scale if kb == TypeKind.DECIMAL else 0
+        return ty_decimal(38, max(sa, sb), nullable)
+    if ka in ints and kb in ints:
+        if TypeKind.UINT in (ka, kb):
+            return ty_uint(nullable)
+        return ty_int(nullable)
+    return ty_float(nullable)
+
+
+def common_compare_type(a: FieldType, b: FieldType) -> FieldType:
+    """Type in which a comparison is evaluated (both sides cast to it)."""
+    ka, kb = a.kind, b.kind
+    if ka == kb:
+        return a.with_nullable(True)
+    if ka == TypeKind.NULLTYPE:
+        return b
+    if kb == TypeKind.NULLTYPE:
+        return a
+    if ka.is_temporal and kb == TypeKind.STRING:
+        return a
+    if kb.is_temporal and ka == TypeKind.STRING:
+        return b
+    if ka == TypeKind.STRING and kb == TypeKind.STRING:
+        return ty_string()
+    return common_arith_type(a, b)

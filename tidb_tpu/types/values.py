@@ -1,0 +1,99 @@
+"""Scalar value helpers: NULL sentinel, temporal codecs, decimal rounding.
+
+Reference: /root/reference/types/time.go, mytime.go, mydecimal.go.  We store
+DATE as int32 days since 1970-01-01 and DATETIME as int64 microseconds since
+epoch; MySQL-visible formatting happens only at the result boundary.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+import numpy as np
+
+# Python-side NULL sentinel used in literal/Datum positions.  Columns carry
+# nulls in validity bitmaps, never as sentinel values in data arrays.
+NULL = None
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+
+def date_to_days(d: _dt.date) -> int:
+    return (d - _EPOCH).days
+
+
+def days_to_date(days: int) -> _dt.date:
+    return _EPOCH + _dt.timedelta(days=int(days))
+
+
+def datetime_to_micros(dt: _dt.datetime) -> int:
+    delta = dt - _dt.datetime(1970, 1, 1)
+    return delta.days * 86_400_000_000 + delta.seconds * 1_000_000 + delta.microseconds
+
+
+def micros_to_datetime(us: int) -> _dt.datetime:
+    return _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(us))
+
+
+def parse_date(s: str) -> int:
+    """'1998-09-02' -> days since epoch. MySQL also accepts 19980902 etc.;
+    we support the ISO forms used by TPC-H/SSB plus compact digits."""
+    s = s.strip()
+    if "-" in s:
+        y, m, d = s.split("-")[:3]
+        return date_to_days(_dt.date(int(y), int(m), int(d[:2])))
+    if len(s) == 8 and s.isdigit():
+        return date_to_days(_dt.date(int(s[:4]), int(s[4:6]), int(s[6:8])))
+    raise ValueError(f"bad DATE literal {s!r}")
+
+
+def parse_datetime(s: str) -> int:
+    s = s.strip().replace("T", " ")
+    if " " in s:
+        d, t = s.split(" ", 1)
+        days = parse_date(d)
+        parts = t.split(":")
+        h = int(parts[0]) if parts else 0
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        return (
+            days * 86_400_000_000
+            + h * 3_600_000_000
+            + mi * 60_000_000
+            + int(round(sec * 1_000_000))
+        )
+    return parse_date(s) * 86_400_000_000
+
+
+def format_date(days: int) -> str:
+    return days_to_date(days).isoformat()
+
+
+def format_datetime(us: int) -> str:
+    dt = micros_to_datetime(us)
+    if dt.microsecond:
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f")
+    return dt.strftime("%Y-%m-%d %H:%M:%S")
+
+
+def decimal_round_half_up(x: np.ndarray | int, ndigits_drop: int):
+    """Round scaled-int decimals by dropping `ndigits_drop` decimal digits
+    with MySQL's round-half-away-from-zero semantics.
+
+    e.g. value 12345 at scale 3 -> scale 1: decimal_round_half_up(12345, 2)
+    == 123 (12.345 -> 12.3); 12355 -> 124 (12.355 -> 12.4 -> wait: 12.36?).
+    Half-up on the dropped part: sign(x) * ((|x| + 5*10^(d-1)) // 10^d).
+    """
+    if ndigits_drop <= 0:
+        return x
+    p = 10 ** ndigits_drop
+    half = p // 2
+    if isinstance(x, np.ndarray):
+        sign = np.sign(x)
+        return sign * ((np.abs(x) + half) // p)
+    sign = -1 if x < 0 else 1
+    return sign * ((abs(x) + half) // p)
+
+
+def scale_factor(scale: int) -> int:
+    return 10 ** scale
