@@ -1,0 +1,216 @@
+"""Parser tests — statement surface + TPC-H query shapes.
+
+Reference model: pingcap/parser test suites; TPC-H text from the reference's
+cmd/explaintest/t/tpch.test (shapes re-typed, not copied).
+"""
+
+import pytest
+
+from tidb_tpu.errors import ParseError
+from tidb_tpu.parser import ast, parse, parse_one
+
+
+def test_simple_select():
+    s = parse_one("SELECT a, b+1 AS c FROM t WHERE a > 3 ORDER BY b DESC LIMIT 10")
+    assert isinstance(s, ast.SelectStmt)
+    assert len(s.fields) == 2
+    assert s.fields[1].alias == "c"
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == ">"
+    assert s.order_by[0].desc
+    assert s.limit == 10
+
+
+def test_operator_precedence():
+    s = parse_one("SELECT 1+2*3")
+    e = s.fields[0].expr
+    assert e.op == "+" and e.right.op == "*"
+    s = parse_one("SELECT a OR b AND NOT c = 1")
+    e = s.fields[0].expr
+    assert e.op == "or"
+    assert e.right.op == "and"
+
+
+def test_string_escapes():
+    s = parse_one("SELECT 'it''s', 'a\\nb', \"dq\"")
+    vals = [f.expr.value for f in s.fields]
+    assert vals == ["it's", "a\nb", "dq"]
+
+
+def test_in_between_like_null():
+    s = parse_one(
+        "SELECT * FROM t WHERE a IN (1,2,3) AND b NOT IN (4) AND c BETWEEN 1 AND 9 "
+        "AND d LIKE 'x%' AND e IS NOT NULL"
+    )
+    assert s.where is not None
+
+
+def test_join_tree():
+    s = parse_one(
+        "SELECT * FROM a JOIN b ON a.x=b.x LEFT JOIN c ON b.y=c.y, d"
+    )
+    j = s.from_clause
+    assert isinstance(j, ast.Join) and j.kind == "cross"
+    assert j.left.kind == "left"
+    assert j.left.left.kind == "inner"
+
+
+def test_subqueries():
+    s = parse_one(
+        "SELECT (SELECT MAX(x) FROM t2), a FROM (SELECT * FROM t3) sub "
+        "WHERE EXISTS (SELECT 1 FROM t4) AND a IN (SELECT b FROM t5)"
+    )
+    assert isinstance(s.fields[0].expr, ast.ScalarSubquery)
+    assert isinstance(s.from_clause, ast.SubqueryRef)
+    assert isinstance(s.where.left, ast.Exists)
+    assert isinstance(s.where.right, ast.InSubquery)
+
+
+def test_case_cast_interval():
+    s = parse_one(
+        "SELECT CASE WHEN a>0 THEN 'p' ELSE 'n' END, CAST(a AS DECIMAL(10,2)), "
+        "d + INTERVAL 3 MONTH, DATE '1995-01-01' FROM t"
+    )
+    assert isinstance(s.fields[0].expr, ast.CaseWhen)
+    c = s.fields[1].expr
+    assert isinstance(c, ast.Cast) and c.precision == 10 and c.scale == 2
+    iv = s.fields[2].expr.right
+    assert isinstance(iv, ast.Interval) and iv.unit == "month"
+    assert s.fields[3].expr.type_hint == "date"
+
+
+def test_aggregates():
+    s = parse_one("SELECT COUNT(*), COUNT(DISTINCT a), SUM(b), AVG(c) FROM t GROUP BY d HAVING SUM(b)>0")
+    assert s.fields[0].expr.name == "count"
+    assert isinstance(s.fields[0].expr.args[0], ast.Star)
+    assert s.fields[1].expr.distinct
+    assert len(s.group_by) == 1 and s.having is not None
+
+
+def test_create_table():
+    s = parse_one(
+        """CREATE TABLE IF NOT EXISTS lineitem (
+            l_orderkey BIGINT NOT NULL,
+            l_quantity DECIMAL(15,2),
+            l_comment VARCHAR(44),
+            l_shipdate DATE,
+            PRIMARY KEY (l_orderkey),
+            KEY idx_ship (l_shipdate)
+        )"""
+    )
+    assert isinstance(s, ast.CreateTableStmt)
+    assert s.if_not_exists
+    assert [c.name for c in s.columns] == [
+        "l_orderkey", "l_quantity", "l_comment", "l_shipdate"
+    ]
+    assert s.columns[0].not_null
+    assert s.columns[1].type_name == "decimal" and s.columns[1].scale == 2
+    assert len(s.indexes) == 2 and s.indexes[0].primary
+
+
+def test_insert_update_delete():
+    i = parse_one("INSERT INTO t (a,b) VALUES (1,'x'), (2,NULL)")
+    assert len(i.values) == 2
+    u = parse_one("UPDATE t SET a = a + 1 WHERE b < 3")
+    assert u.assignments[0][0] == "a"
+    d = parse_one("DELETE FROM t WHERE a = 5 LIMIT 2")
+    assert d.limit == 2
+
+
+def test_utility_statements():
+    assert isinstance(parse_one("BEGIN"), ast.BeginStmt)
+    assert isinstance(parse_one("START TRANSACTION"), ast.BeginStmt)
+    assert isinstance(parse_one("COMMIT"), ast.CommitStmt)
+    assert isinstance(parse_one("ROLLBACK"), ast.RollbackStmt)
+    assert isinstance(parse_one("USE test"), ast.UseStmt)
+    e = parse_one("EXPLAIN ANALYZE SELECT 1")
+    assert e.analyze and isinstance(e.target, ast.SelectStmt)
+    sh = parse_one("SHOW TABLES")
+    assert sh.kind == "tables"
+    st = parse_one("SET @@session.tidb_executor_concurrency = 8, GLOBAL x = 1")
+    assert st.assignments[0][0] == "tidb_executor_concurrency"
+    assert st.assignments[1][1] is True
+    an = parse_one("ANALYZE TABLE t1, t2")
+    assert len(an.tables) == 2
+
+
+def test_multi_statement():
+    stmts = parse("SELECT 1; SELECT 2;")
+    assert len(stmts) == 2
+
+
+def test_union():
+    u = parse_one("SELECT a FROM t1 UNION ALL SELECT b FROM t2 ORDER BY 1 LIMIT 5")
+    assert isinstance(u, ast.UnionStmt) and u.all and u.limit == 5
+
+
+def test_parse_error_location():
+    with pytest.raises(ParseError):
+        parse_one("SELECT FROM WHERE")
+    with pytest.raises(ParseError):
+        parse_one("SELEC 1")
+
+
+def test_tpch_q1_shape():
+    # TPC-H Q1 (re-typed shape; reference runs it in cmd/explaintest/t/tpch.test)
+    q = """
+    select l_returnflag, l_linestatus,
+        sum(l_quantity) as sum_qty,
+        sum(l_extendedprice) as sum_base_price,
+        sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+        sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+        avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+        avg(l_discount) as avg_disc, count(*) as count_order
+    from lineitem
+    where l_shipdate <= date '1998-12-01' - interval 108 day
+    group by l_returnflag, l_linestatus
+    order by l_returnflag, l_linestatus
+    """
+    s = parse_one(q)
+    assert len(s.fields) == 10
+    assert len(s.group_by) == 2
+    assert isinstance(s.where.right, ast.BinaryOp)
+
+
+def test_tpch_q3_shape():
+    q = """
+    select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+           o_orderdate, o_shippriority
+    from customer, orders, lineitem
+    where c_mktsegment = 'AUTOMOBILE' and c_custkey = o_custkey
+      and l_orderkey = o_orderkey and o_orderdate < date '1995-03-13'
+      and l_shipdate > date '1995-03-13'
+    group by l_orderkey, o_orderdate, o_shippriority
+    order by revenue desc, o_orderdate limit 10
+    """
+    s = parse_one(q)
+    assert s.limit == 10 and s.order_by[0].desc
+    j = s.from_clause
+    assert isinstance(j, ast.Join) and j.kind == "cross"
+
+
+def test_tpch_q6_shape():
+    q = """
+    select sum(l_extendedprice * l_discount) as revenue
+    from lineitem
+    where l_shipdate >= '1994-01-01'
+      and l_shipdate < date '1994-01-01' + interval '1' year
+      and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+      and l_quantity < 24
+    """
+    s = parse_one(q)
+    assert s.fields[0].alias == "revenue"
+
+
+def test_prepared_params():
+    p = parse_one("SELECT * FROM t WHERE a = ? AND b > ?")
+    refs = []
+
+    def walk(e):
+        if isinstance(e, ast.Param):
+            refs.append(e.index)
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, ast.Node):
+                walk(v)
+    walk(p.where)
+    assert refs == [0, 1]

@@ -1,0 +1,22 @@
+from .vec import Vec
+from .expression import (
+    Expression,
+    ColumnExpr,
+    Constant,
+    ScalarFunc,
+    eval_expr,
+    eval_bool_mask,
+)
+from .aggregation import AggDesc, AGG_FUNCS
+
+__all__ = [
+    "Vec",
+    "Expression",
+    "ColumnExpr",
+    "Constant",
+    "ScalarFunc",
+    "eval_expr",
+    "eval_bool_mask",
+    "AggDesc",
+    "AGG_FUNCS",
+]

@@ -1,0 +1,124 @@
+"""Aggregate function descriptors with partial/final split.
+
+Reference: expression/aggregation (AggFuncDesc, partial/final modes) and
+executor/aggfuncs (PartialResult pattern).  The partial/final split is the
+load-bearing seam for TPU pushdown: the device computes dense *partial*
+states per shard (sum/count/min/max vectors per group), the host merges
+finals — exactly how the reference splits agg between coprocessor and root
+(planner/core/task.go agg pushdown).
+
+Partial state layout per function (all fixed-width columns):
+- count   -> [count:int64]
+- sum     -> [sum:<sum type>]
+- avg     -> [sum:<sum type>, count:int64]
+- min/max -> [extreme:<arg type>]
+- first_row -> [value:<arg type>]
+Final merge combines partial states by group key; the final value derives
+from the merged state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..errors import TypeError_
+from ..types import FieldType, TypeKind, ty_decimal, ty_float, ty_int
+from .expression import Expression
+
+AGG_FUNCS = (
+    "count", "sum", "avg", "min", "max", "first_row",
+    "bit_and", "bit_or", "bit_xor", "group_concat",
+    "var_pop", "stddev_pop", "var_samp", "stddev_samp",
+)
+
+
+def sum_type(arg: FieldType) -> FieldType:
+    """Result type of SUM over arg (MySQL: int -> decimal, float -> float)."""
+    if arg.kind == TypeKind.FLOAT:
+        return ty_float()
+    if arg.kind == TypeKind.DECIMAL:
+        return ty_decimal(38, arg.scale)
+    if arg.kind in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
+        return ty_decimal(38, 0)
+    return ty_float()
+
+
+def avg_type(arg: FieldType) -> FieldType:
+    if arg.kind == TypeKind.FLOAT:
+        return ty_float()
+    if arg.kind == TypeKind.DECIMAL:
+        return ty_decimal(38, min(arg.scale + 4, 30))
+    if arg.kind in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
+        return ty_decimal(38, 4)
+    return ty_float()
+
+
+@dataclass
+class AggDesc:
+    """One aggregate in an Aggregation operator."""
+
+    name: str  # lowercase member of AGG_FUNCS
+    args: List[Expression]
+    distinct: bool = False
+    ftype: FieldType = None  # final result type
+
+    def __post_init__(self):
+        if self.name not in AGG_FUNCS:
+            raise TypeError_(f"unknown aggregate function {self.name!r}")
+        if self.ftype is None:
+            self.ftype = self.infer_type()
+
+    def infer_type(self) -> FieldType:
+        a = self.args[0].ftype if self.args else None
+        if self.name == "count":
+            return ty_int(False)
+        if self.name == "sum":
+            return sum_type(a)
+        if self.name == "avg":
+            return avg_type(a)
+        if self.name in ("min", "max", "first_row"):
+            return a.with_nullable(True)
+        if self.name in ("bit_and", "bit_or", "bit_xor"):
+            return ty_int(False)
+        if self.name == "group_concat":
+            from ..types import ty_string
+            return ty_string(True)
+        if self.name in ("var_pop", "stddev_pop", "var_samp", "stddev_samp"):
+            return ty_float(True)
+        raise TypeError_(self.name)
+
+    # --- partial state schema (for pushdown + parallel HashAgg) ---------
+    def partial_types(self) -> List[FieldType]:
+        if self.name == "count":
+            return [ty_int(False)]
+        if self.name == "sum":
+            return [sum_type(self.args[0].ftype)]
+        if self.name == "avg":
+            return [sum_type(self.args[0].ftype), ty_int(False)]
+        if self.name in ("min", "max", "first_row"):
+            return [self.args[0].ftype.with_nullable(True)]
+        if self.name in ("bit_and", "bit_or", "bit_xor"):
+            return [ty_int(False)]
+        if self.name in ("var_pop", "stddev_pop", "var_samp", "stddev_samp"):
+            # sum, sum of squares, count (in float64)
+            return [ty_float(False), ty_float(False), ty_int(False)]
+        if self.name == "group_concat":
+            from ..types import ty_string
+            return [ty_string(True)]
+        raise TypeError_(self.name)
+
+    def remap_columns(self, mapping: dict) -> "AggDesc":
+        return AggDesc(
+            self.name,
+            [a.remap_columns(mapping) for a in self.args],
+            self.distinct,
+            self.ftype,
+        )
+
+    def __str__(self):
+        inner = ", ".join(str(a) for a in self.args) or "*"
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{inner})"

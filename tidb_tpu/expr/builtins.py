@@ -1,0 +1,1505 @@
+"""Vectorized builtin functions (host/numpy path).
+
+Reference surface: expression/builtin_*_vec.go (~13.7k LoC of per-signature
+vectorized builtins dispatched via builtinFunc.vecEval*).  Here one registry
+maps a canonical lowercase name to (type-inference, vectorized impl); the impl
+runs over whole columns with numpy, with validity masks for NULL propagation.
+The device path (copr/) compiles a *subset* of these names to jax — the
+pushdown registry (expr/pushdown.py) is the eligibility gate, the analog of
+canFuncBePushed (expression/expr_to_pb.go:310).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..errors import TypeError_
+from ..types import (
+    FieldType,
+    TypeKind,
+    common_arith_type,
+    common_compare_type,
+    merge_types,
+    ty_bool,
+    ty_date,
+    ty_datetime,
+    ty_decimal,
+    ty_float,
+    ty_int,
+    ty_string,
+    ty_null,
+    ty_uint,
+)
+from ..types.values import (
+    days_to_date,
+    decimal_round_half_up,
+    format_date,
+    format_datetime,
+    micros_to_datetime,
+    parse_date,
+    parse_datetime,
+)
+from .vec import Vec, combined_valid
+
+BOOL_T = ty_bool()
+
+
+@dataclass
+class BuiltinDef:
+    name: str
+    infer: Callable  # (arg_ftypes: List[FieldType], meta: dict) -> FieldType
+    impl: Callable  # (func, args: List[Vec], n: int) -> Vec
+
+
+REGISTRY: Dict[str, BuiltinDef] = {}
+
+
+def register(name: str, infer):
+    def deco(fn):
+        REGISTRY[name] = BuiltinDef(name, infer, fn)
+        return fn
+
+    return deco
+
+
+def dispatch(func, args: List[Vec], n: int) -> Vec:
+    d = REGISTRY.get(func.name)
+    if d is None:
+        raise TypeError_(f"unknown function {func.name!r}")
+    return d.impl(func, args, n)
+
+
+def infer_ftype(name: str, arg_types: List[FieldType], meta: dict) -> FieldType:
+    d = REGISTRY.get(name)
+    if d is None:
+        raise TypeError_(f"unknown function {name!r}")
+    return d.infer(arg_types, meta)
+
+
+# ---------------------------------------------------------------------------
+# numeric conversion helpers
+# ---------------------------------------------------------------------------
+
+
+def _to_float(v: Vec) -> np.ndarray:
+    k = v.ftype.kind
+    if k == TypeKind.FLOAT:
+        return v.data
+    if k == TypeKind.DECIMAL:
+        return v.data.astype(np.float64) / (10.0 ** v.ftype.scale)
+    if k == TypeKind.STRING:
+        out = np.zeros(len(v.data), dtype=np.float64)
+        for i, s in enumerate(v.data):
+            try:
+                out[i] = float(s)
+            except (TypeError, ValueError):
+                m = re.match(r"\s*-?\d+(\.\d+)?([eE][+-]?\d+)?", str(s))
+                out[i] = float(m.group(0)) if m and m.group(0).strip() else 0.0
+        return out
+    return v.data.astype(np.float64)
+
+
+def _to_scaled_int(v: Vec, scale: int) -> np.ndarray:
+    """Value of v at decimal scale `scale` as int64."""
+    k = v.ftype.kind
+    if k == TypeKind.DECIMAL:
+        ds = scale - v.ftype.scale
+        if ds == 0:
+            return v.data
+        if ds > 0:
+            return v.data * (10 ** ds)
+        return decimal_round_half_up(v.data, -ds)
+    if k == TypeKind.FLOAT:
+        return np.round(v.data * (10.0 ** scale)).astype(np.int64)
+    return v.data.astype(np.int64) * (10 ** scale)
+
+
+def _str_data(v: Vec) -> np.ndarray:
+    if v.ftype.kind == TypeKind.STRING:
+        return v.data
+    out = np.empty(len(v.data), dtype=object)
+    k = v.ftype.kind
+    if k == TypeKind.DECIMAL:
+        s = v.ftype.scale
+        for i, x in enumerate(v.data):
+            sign = "-" if x < 0 else ""
+            ax = abs(int(x))
+            out[i] = f"{sign}{ax // 10**s}.{ax % 10**s:0{s}d}" if s else str(int(x))
+    elif k == TypeKind.DATE:
+        for i, x in enumerate(v.data):
+            out[i] = format_date(int(x))
+    elif k == TypeKind.DATETIME:
+        for i, x in enumerate(v.data):
+            out[i] = format_datetime(int(x))
+    elif k == TypeKind.FLOAT:
+        for i, x in enumerate(v.data):
+            out[i] = repr(float(x)) if x != int(x) else str(int(x))
+    else:
+        for i, x in enumerate(v.data):
+            out[i] = str(int(x))
+    return out
+
+
+def _cast_data_to(v: Vec, target: FieldType) -> np.ndarray:
+    """Physical data of v converted to target's representation (no null change)."""
+    k, tk = v.ftype.kind, target.kind
+    if k == tk and (tk != TypeKind.DECIMAL or v.ftype.scale == target.scale):
+        return v.data
+    if tk == TypeKind.FLOAT:
+        return _to_float(v)
+    if tk == TypeKind.DECIMAL:
+        if k == TypeKind.STRING:
+            f = _to_float(Vec(ty_string(), v.data, None))
+            return np.round(f * 10.0 ** target.scale).astype(np.int64)
+        return _to_scaled_int(v, target.scale)
+    if tk in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
+        if k == TypeKind.FLOAT:
+            return np.round(v.data).astype(np.int64)
+        if k == TypeKind.DECIMAL:
+            return decimal_round_half_up(v.data, v.ftype.scale)
+        if k == TypeKind.STRING:
+            return np.round(_to_float(v)).astype(np.int64)
+        return v.data.astype(np.int64)
+    if tk == TypeKind.STRING:
+        return _str_data(v)
+    if tk == TypeKind.DATE:
+        if k == TypeKind.STRING:
+            out = np.zeros(len(v.data), dtype=np.int32)
+            for i, s in enumerate(v.data):
+                try:
+                    out[i] = parse_date(str(s))
+                except (ValueError, IndexError):
+                    out[i] = 0
+            return out
+        if k == TypeKind.DATETIME:
+            return (v.data // 86_400_000_000).astype(np.int32)
+        return v.data.astype(np.int32)
+    if tk == TypeKind.DATETIME:
+        if k == TypeKind.STRING:
+            out = np.zeros(len(v.data), dtype=np.int64)
+            for i, s in enumerate(v.data):
+                try:
+                    out[i] = parse_datetime(str(s))
+                except (ValueError, IndexError):
+                    out[i] = 0
+            return out
+        if k == TypeKind.DATE:
+            return v.data.astype(np.int64) * 86_400_000_000
+        return v.data.astype(np.int64)
+    raise TypeError_(f"unsupported cast {v.ftype} -> {target}")
+
+
+def cast_vec(v: Vec, target: FieldType) -> Vec:
+    return Vec(target, _cast_data_to(v, target), v.valid)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _infer_arith(arg_types, meta):
+    return common_arith_type(arg_types[0], arg_types[1])
+
+
+def _arith(op: str):
+    def impl(func, args: List[Vec], n: int) -> Vec:
+        a, b = args
+        out_t = func.ftype
+        valid = combined_valid(a, b)
+        if out_t.kind == TypeKind.FLOAT:
+            x, y = _to_float(a), _to_float(b)
+            with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+                if op == "+":
+                    r = x + y
+                elif op == "-":
+                    r = x - y
+                elif op == "*":
+                    r = x * y
+                elif op == "/":
+                    r = x / y
+                    bad = y == 0.0
+                    if bad.any():
+                        valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
+                        r = np.where(bad, 0.0, r)
+                elif op == "%":
+                    bad = y == 0.0
+                    r = np.where(bad, 0.0, np.fmod(x, np.where(bad, 1.0, y)))
+                    if bad.any():
+                        valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
+                else:
+                    raise TypeError_(op)
+            r = np.where(np.isfinite(r), r, 0.0) if op == "/" else r
+            return Vec(out_t, r, valid)
+        if out_t.kind == TypeKind.DECIMAL:
+            sa = a.ftype.scale if a.ftype.kind == TypeKind.DECIMAL else 0
+            sb = b.ftype.scale if b.ftype.kind == TypeKind.DECIMAL else 0
+            if op in ("+", "-"):
+                s = out_t.scale
+                x, y = _to_scaled_int(a, s), _to_scaled_int(b, s)
+                r = x + y if op == "+" else x - y
+                return Vec(out_t, r, valid)
+            if op == "*":
+                # product of scaled ints is naturally at scale sa+sb
+                x = _to_scaled_int(a, sa)
+                y = _to_scaled_int(b, sb)
+                r = x * y
+                drop = sa + sb - out_t.scale
+                if drop > 0:
+                    r = decimal_round_half_up(r, drop)
+                elif drop < 0:
+                    r = r * (10 ** (-drop))
+                return Vec(out_t, r, valid)
+            if op in ("/", "%"):
+                x = _to_scaled_int(a, sa).astype(np.float64) / 10.0 ** sa
+                y = _to_scaled_int(b, sb).astype(np.float64) / 10.0 ** sb
+                bad = y == 0.0
+                if bad.any():
+                    valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
+                    y = np.where(bad, 1.0, y)
+                r = x / y if op == "/" else np.fmod(x, y)
+                return Vec(out_t, np.round(r * 10.0 ** out_t.scale).astype(np.int64), valid)
+        # integer domain
+        x = a.data.astype(np.int64) if a.ftype.kind != TypeKind.INT else a.data
+        y = b.data.astype(np.int64) if b.ftype.kind != TypeKind.INT else b.data
+        with np.errstate(over="ignore"):
+            if op == "+":
+                r = x + y
+            elif op == "-":
+                r = x - y
+            elif op == "*":
+                r = x * y
+            elif op in ("/", "div"):
+                bad = y == 0
+                safe = np.where(bad, 1, y)
+                # MySQL DIV truncates toward zero
+                q = np.abs(x) // np.abs(safe)
+                r = np.sign(x) * np.sign(safe) * q
+                if bad.any():
+                    valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
+            elif op == "%":
+                bad = y == 0
+                safe = np.where(bad, 1, y)
+                # MySQL % takes sign of dividend
+                r = np.sign(x) * (np.abs(x) % np.abs(safe))
+                if bad.any():
+                    valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
+            else:
+                raise TypeError_(op)
+        return Vec(func.ftype, r, valid)
+
+    return impl
+
+
+def _infer_mul(arg_types, meta):
+    t = common_arith_type(arg_types[0], arg_types[1])
+    if t.kind == TypeKind.DECIMAL:
+        sa = arg_types[0].scale if arg_types[0].kind == TypeKind.DECIMAL else 0
+        sb = arg_types[1].scale if arg_types[1].kind == TypeKind.DECIMAL else 0
+        return ty_decimal(38, min(sa + sb, 30), t.nullable)
+    return t
+
+
+for _op in ("+", "-", "%"):
+    register(_op, _infer_arith)(_arith(_op))
+register("*", _infer_mul)(_arith("*"))
+
+
+def _infer_truediv(arg_types, meta):
+    a, b = arg_types
+    if a.kind == TypeKind.DECIMAL or b.kind == TypeKind.DECIMAL:
+        if a.kind in (TypeKind.FLOAT, TypeKind.STRING) or b.kind in (
+            TypeKind.FLOAT, TypeKind.STRING,
+        ):
+            return ty_float()
+        sa = a.scale if a.kind == TypeKind.DECIMAL else 0
+        # MySQL: result scale = dividend scale + div_precision_increment (4)
+        return ty_decimal(38, min(sa + 4, 30))
+    if a.kind.is_numeric and b.kind.is_numeric and a.kind not in (
+        TypeKind.FLOAT,
+    ) and b.kind != TypeKind.FLOAT:
+        # int / int -> decimal scale 4 in MySQL
+        return ty_decimal(38, 4)
+    return ty_float()
+
+
+register("/", _infer_truediv)(_arith("/"))
+register("div", lambda t, m: ty_int())(_arith("div"))
+
+
+def _infer_unary_minus(arg_types, meta):
+    t = arg_types[0]
+    if t.kind in (TypeKind.FLOAT, TypeKind.DECIMAL):
+        return t
+    if t.kind == TypeKind.STRING:
+        return ty_float()
+    return ty_int(t.nullable)
+
+
+@register("unaryminus", _infer_unary_minus)
+def _unary_minus(func, args, n):
+    v = args[0]
+    if func.ftype.kind == TypeKind.FLOAT:
+        return Vec(func.ftype, -_to_float(v), v.valid)
+    return Vec(func.ftype, -v.data, v.valid)
+
+
+@register("~", lambda t, m: ty_uint())
+def _bitneg(func, args, n):
+    return Vec(func.ftype, ~args[0].data, args[0].valid)
+
+
+for _bop, _np in (("&", np.bitwise_and), ("|", np.bitwise_or), ("^", np.bitwise_xor)):
+    def _mk(npf):
+        def impl(func, args, n):
+            a, b = args
+            return Vec(
+                func.ftype,
+                npf(a.data.astype(np.int64), b.data.astype(np.int64)),
+                combined_valid(a, b),
+            )
+        return impl
+    register(_bop, lambda t, m: ty_int())(_mk(_np))
+
+for _sop in ("<<", ">>"):
+    def _mks(op):
+        def impl(func, args, n):
+            a, b = args
+            x, y = a.data.astype(np.int64), b.data.astype(np.int64)
+            y = np.clip(y, 0, 63)
+            r = np.left_shift(x, y) if op == "<<" else np.right_shift(x, y)
+            return Vec(func.ftype, r, combined_valid(a, b))
+        return impl
+    register(_sop, lambda t, m: ty_int())(_mks(_sop))
+
+
+# ---------------------------------------------------------------------------
+# comparisons
+# ---------------------------------------------------------------------------
+
+_CMP_NP = {
+    "=": lambda x, y: x == y,
+    "!=": lambda x, y: x != y,
+    "<": lambda x, y: x < y,
+    "<=": lambda x, y: x <= y,
+    ">": lambda x, y: x > y,
+    ">=": lambda x, y: x >= y,
+}
+
+
+def _compare_arrays(a: Vec, b: Vec, op: str) -> np.ndarray:
+    ct = common_compare_type(a.ftype, b.ftype)
+    if ct.kind == TypeKind.STRING:
+        x, y = _str_data(a), _str_data(b)
+        # object arrays compare elementwise with python semantics
+        r = _CMP_NP[op](x, y)
+        return np.asarray(r, dtype=np.bool_)
+    if ct.kind == TypeKind.DECIMAL:
+        s = max(
+            a.ftype.scale if a.ftype.kind == TypeKind.DECIMAL else 0,
+            b.ftype.scale if b.ftype.kind == TypeKind.DECIMAL else 0,
+        )
+        fa = a.ftype.kind in (TypeKind.FLOAT, TypeKind.STRING)
+        fb = b.ftype.kind in (TypeKind.FLOAT, TypeKind.STRING)
+        if fa or fb:
+            return _CMP_NP[op](_to_float(a), _to_float(b))
+        return _CMP_NP[op](_to_scaled_int(a, s), _to_scaled_int(b, s))
+    if ct.kind in (TypeKind.DATE, TypeKind.DATETIME):
+        ta = cast_vec(a, ct)
+        tb = cast_vec(b, ct)
+        return _CMP_NP[op](ta.data, tb.data)
+    if ct.kind == TypeKind.FLOAT:
+        return _CMP_NP[op](_to_float(a), _to_float(b))
+    return _CMP_NP[op](a.data.astype(np.int64), b.data.astype(np.int64))
+
+
+def _infer_cmp(arg_types, meta):
+    return ty_bool(arg_types[0].nullable or arg_types[1].nullable)
+
+
+def _cmp(op):
+    def impl(func, args, n):
+        a, b = args
+        r = _compare_arrays(a, b, op).astype(np.int64)
+        return Vec(BOOL_T, r, combined_valid(a, b))
+    return impl
+
+
+for _op in _CMP_NP:
+    register(_op, _infer_cmp)(_cmp(_op))
+
+
+@register("nulleq", lambda t, m: ty_bool(False))  # <=> null-safe equal
+def _nulleq(func, args, n):
+    a, b = args
+    va, vb = a.validity(), b.validity()
+    eq = _compare_arrays(a, b, "=")
+    r = np.where(va & vb, eq, va == vb)
+    return Vec(ty_bool(False), r.astype(np.int64), None)
+
+
+# ---------------------------------------------------------------------------
+# logic (three-valued)
+# ---------------------------------------------------------------------------
+
+
+def _infer_logic(arg_types, meta):
+    return ty_bool(any(t.nullable for t in arg_types))
+
+
+def _truth(v: Vec) -> np.ndarray:
+    if v.ftype.kind == TypeKind.FLOAT:
+        return v.data != 0.0
+    if v.ftype.kind == TypeKind.STRING:
+        return _to_float(v) != 0.0
+    return v.data != 0
+
+
+@register("and", _infer_logic)
+def _and(func, args, n):
+    a, b = args
+    ta, tb = _truth(a), _truth(b)
+    va, vb = a.validity(), b.validity()
+    # false if either (valid and false); null if not false and any null
+    is_false = (va & ~ta) | (vb & ~tb)
+    valid = is_false | (va & vb)
+    r = np.where(is_false, 0, 1).astype(np.int64)
+    return Vec(func.ftype, r, valid if not valid.all() else None)
+
+
+@register("or", _infer_logic)
+def _or(func, args, n):
+    a, b = args
+    ta, tb = _truth(a), _truth(b)
+    va, vb = a.validity(), b.validity()
+    is_true = (va & ta) | (vb & tb)
+    valid = is_true | (va & vb)
+    r = is_true.astype(np.int64)
+    return Vec(func.ftype, r, valid if not valid.all() else None)
+
+
+@register("xor", _infer_logic)
+def _xor(func, args, n):
+    a, b = args
+    r = (_truth(a) ^ _truth(b)).astype(np.int64)
+    return Vec(func.ftype, r, combined_valid(a, b))
+
+
+@register("not", _infer_logic)
+def _not(func, args, n):
+    v = args[0]
+    return Vec(func.ftype, (~_truth(v)).astype(np.int64), v.valid)
+
+
+@register("istrue", lambda t, m: ty_bool(False))
+def _istrue(func, args, n):
+    v = args[0]
+    r = (_truth(v) & v.validity()).astype(np.int64)
+    return Vec(ty_bool(False), r, None)
+
+
+@register("isfalse", lambda t, m: ty_bool(False))
+def _isfalse(func, args, n):
+    v = args[0]
+    r = (~_truth(v) & v.validity()).astype(np.int64)
+    return Vec(ty_bool(False), r, None)
+
+
+@register("isnull", lambda t, m: ty_bool(False))
+def _isnull(func, args, n):
+    v = args[0]
+    return Vec(ty_bool(False), (~v.validity()).astype(np.int64), None)
+
+
+@register("isnotnull", lambda t, m: ty_bool(False))
+def _isnotnull(func, args, n):
+    v = args[0]
+    return Vec(ty_bool(False), v.validity().astype(np.int64), None)
+
+
+# ---------------------------------------------------------------------------
+# IN / LIKE / control flow
+# ---------------------------------------------------------------------------
+
+
+def _infer_in(arg_types, meta):
+    return ty_bool(any(t.nullable for t in arg_types))
+
+
+@register("in", _infer_in)
+def _in(func, args, n):
+    target, items = args[0], args[1:]
+    hit = np.zeros(n, dtype=np.bool_)
+    any_null_item = np.zeros(n, dtype=np.bool_)
+    for it in items:
+        eq = _compare_arrays(target, it, "=")
+        iv = it.validity()
+        hit |= eq & iv
+        any_null_item |= ~iv
+    tv = target.validity()
+    # NULL if target null, or (no hit and some item null)
+    valid = tv & (hit | ~any_null_item)
+    return Vec(func.ftype, hit.astype(np.int64), valid if not valid.all() else None)
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> "re.Pattern":
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    # MySQL LIKE is case-insensitive for default collations
+    return re.compile("^" + "".join(out) + "$", re.IGNORECASE | re.DOTALL)
+
+
+@register("like", _infer_cmp)
+def _like(func, args, n):
+    a, b = args
+    sa = _str_data(a)
+    valid = combined_valid(a, b)
+    # compile per distinct pattern (usually constant)
+    pats: Dict[str, "re.Pattern"] = {}
+    sb = _str_data(b)
+    r = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        p = sb[i] if i < len(sb) else sb[0]
+        rx = pats.get(p)
+        if rx is None:
+            rx = pats[p] = like_to_regex(str(p))
+        r[i] = 1 if rx.match(str(sa[i])) else 0
+    return Vec(func.ftype, r, valid)
+
+
+@register("regexp", _infer_cmp)
+def _regexp(func, args, n):
+    a, b = args
+    sa, sb = _str_data(a), _str_data(b)
+    pats: Dict[str, "re.Pattern"] = {}
+    r = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        p = str(sb[i])
+        rx = pats.get(p)
+        if rx is None:
+            rx = pats[p] = re.compile(p)
+        r[i] = 1 if rx.search(str(sa[i])) else 0
+    return Vec(func.ftype, r, combined_valid(a, b))
+
+
+def _infer_if(arg_types, meta):
+    return merge_types(arg_types[1], arg_types[2])
+
+
+@register("if", _infer_if)
+def _if(func, args, n):
+    c, a, b = args
+    cond = _truth(c) & c.validity()
+    ta = cast_vec(a, func.ftype)
+    tb = cast_vec(b, func.ftype)
+    data = np.where(cond, ta.data, tb.data)
+    valid = np.where(cond, ta.validity(), tb.validity())
+    return Vec(func.ftype, data, valid if not valid.all() else None)
+
+
+def _infer_ifnull(arg_types, meta):
+    t = merge_types(arg_types[0], arg_types[1])
+    return t.with_nullable(arg_types[1].nullable)
+
+
+@register("ifnull", _infer_ifnull)
+def _ifnull(func, args, n):
+    a, b = args
+    ta, tb = cast_vec(a, func.ftype), cast_vec(b, func.ftype)
+    av = a.validity()
+    data = np.where(av, ta.data, tb.data)
+    valid = np.where(av, True, tb.validity())
+    return Vec(func.ftype, data, valid if not valid.all() else None)
+
+
+@register("nullif", lambda t, m: t[0].with_nullable(True))
+def _nullif(func, args, n):
+    a, b = args
+    eq = _compare_arrays(a, b, "=") & a.validity() & b.validity()
+    valid = a.validity() & ~eq
+    return Vec(func.ftype, _cast_data_to(a, func.ftype), valid if not valid.all() else None)
+
+
+def _infer_coalesce(arg_types, meta):
+    t = arg_types[0]
+    for u in arg_types[1:]:
+        t = merge_types(t, u)
+    return t.with_nullable(all(u.nullable for u in arg_types))
+
+
+@register("coalesce", _infer_coalesce)
+def _coalesce(func, args, n):
+    out = cast_vec(args[0], func.ftype)
+    data = out.data.copy()
+    valid = out.validity().copy()
+    for v in args[1:]:
+        tv = cast_vec(v, func.ftype)
+        need = ~valid
+        if not need.any():
+            break
+        data = np.where(need, tv.data, data)
+        valid = valid | (need & tv.validity())
+    return Vec(func.ftype, data, valid if not valid.all() else None)
+
+
+def _infer_case(arg_types, meta):
+    # args: cond1, val1, cond2, val2, ..., [else]
+    vals = [arg_types[i] for i in range(1, len(arg_types), 2)]
+    if len(arg_types) % 2 == 1:
+        vals.append(arg_types[-1])
+        nullable = any(v.nullable for v in vals)
+    else:
+        nullable = True  # missing ELSE -> NULL possible
+    t = vals[0]
+    for u in vals[1:]:
+        t = merge_types(t, u)
+    return t.with_nullable(nullable or t.nullable)
+
+
+@register("case", _infer_case)
+def _case(func, args, n):
+    has_else = len(args) % 2 == 1
+    if func.ftype.kind == TypeKind.STRING:
+        data = np.empty(n, dtype=object)
+        data[:] = ""
+    else:
+        data = np.zeros(n, dtype=func.ftype.np_dtype)
+    valid = np.zeros(n, dtype=np.bool_)
+    assigned = np.zeros(n, dtype=np.bool_)
+    pairs = range(0, len(args) - (1 if has_else else 0), 2)
+    for i in pairs:
+        cond, val = args[i], args[i + 1]
+        m = _truth(cond) & cond.validity() & ~assigned
+        if m.any():
+            tv = cast_vec(val, func.ftype)
+            data = np.where(m, tv.data, data)
+            valid = np.where(m, tv.validity(), valid)
+            assigned |= m
+    if has_else:
+        m = ~assigned
+        if m.any():
+            tv = cast_vec(args[-1], func.ftype)
+            data = np.where(m, tv.data, data)
+            valid = np.where(m, tv.validity(), valid)
+    return Vec(func.ftype, data, valid if not valid.all() else None)
+
+
+def _infer_cast(arg_types, meta):
+    return meta["target"]
+
+
+@register("cast", _infer_cast)
+def _cast(func, args, n):
+    return cast_vec(args[0], func.ftype)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+
+def _infer_same_numeric(arg_types, meta):
+    t = arg_types[0]
+    if t.kind in (TypeKind.FLOAT, TypeKind.DECIMAL, TypeKind.INT, TypeKind.UINT):
+        return t
+    return ty_float(t.nullable)
+
+
+@register("abs", _infer_same_numeric)
+def _abs(func, args, n):
+    v = args[0]
+    if func.ftype.kind == TypeKind.FLOAT and v.ftype.kind != TypeKind.FLOAT:
+        return Vec(func.ftype, np.abs(_to_float(v)), v.valid)
+    return Vec(func.ftype, np.abs(v.data), v.valid)
+
+
+def _infer_int_of(arg_types, meta):
+    t = arg_types[0]
+    return ty_int(t.nullable)
+
+
+@register("ceil", _infer_int_of)
+def _ceil(func, args, n):
+    v = args[0]
+    if v.ftype.kind == TypeKind.DECIMAL:
+        s = 10 ** v.ftype.scale
+        return Vec(func.ftype, -((-v.data) // s), v.valid)
+    return Vec(func.ftype, np.ceil(_to_float(v)).astype(np.int64), v.valid)
+
+
+REGISTRY["ceiling"] = BuiltinDef("ceiling", _infer_int_of, REGISTRY["ceil"].impl)
+
+
+@register("floor", _infer_int_of)
+def _floor(func, args, n):
+    v = args[0]
+    if v.ftype.kind == TypeKind.DECIMAL:
+        s = 10 ** v.ftype.scale
+        return Vec(func.ftype, v.data // s, v.valid)
+    return Vec(func.ftype, np.floor(_to_float(v)).astype(np.int64), v.valid)
+
+
+def _infer_round(arg_types, meta):
+    t = arg_types[0]
+    if t.kind == TypeKind.DECIMAL:
+        d = meta.get("digits", 0)
+        return ty_decimal(t.precision, min(max(d, 0), t.scale), t.nullable)
+    if t.kind == TypeKind.FLOAT:
+        return t
+    return ty_int(t.nullable)
+
+
+@register("round", _infer_round)
+def _round(func, args, n):
+    v = args[0]
+    d = int(args[1].data[0]) if len(args) > 1 and len(args[1].data) else 0
+    if v.ftype.kind == TypeKind.DECIMAL:
+        drop = v.ftype.scale - func.ftype.scale if d >= 0 else v.ftype.scale - d
+        r = decimal_round_half_up(v.data, max(drop, 0))
+        if d < 0:
+            r = r * (10 ** (-d)) * (10 ** func.ftype.scale)
+        return Vec(func.ftype, r, v.valid)
+    if v.ftype.kind == TypeKind.FLOAT:
+        x = v.data
+        p = 10.0 ** d
+        r = np.sign(x) * np.floor(np.abs(x) * p + 0.5) / p
+        return Vec(func.ftype, r, v.valid)
+    x = v.data.astype(np.int64)
+    if d >= 0:
+        return Vec(func.ftype, x, v.valid)
+    p = 10 ** (-d)
+    half = p // 2
+    r = np.sign(x) * ((np.abs(x) + half) // p) * p
+    return Vec(func.ftype, r, v.valid)
+
+
+@register("truncate", lambda t, m: t[0] if t[0].kind != TypeKind.STRING else ty_float())
+def _truncate(func, args, n):
+    v, dv = args
+    d = int(dv.data[0]) if len(dv.data) else 0
+    if v.ftype.kind == TypeKind.DECIMAL:
+        s = v.ftype.scale
+        drop = s - d if d < s else 0
+        if drop > 0:
+            p = 10 ** drop
+            r = (np.sign(v.data) * (np.abs(v.data) // p)) * p
+        else:
+            r = v.data
+        return Vec(func.ftype, r, combined_valid(v, dv))
+    if v.ftype.kind == TypeKind.FLOAT:
+        p = 10.0 ** d
+        r = np.trunc(v.data * p) / p
+        return Vec(func.ftype, r, combined_valid(v, dv))
+    x = v.data.astype(np.int64)
+    if d < 0:
+        p = 10 ** (-d)
+        x = (np.sign(x) * (np.abs(x) // p)) * p
+    return Vec(func.ftype, x, combined_valid(v, dv))
+
+
+def _float_fn(name, npf, domain=None):
+    def infer(arg_types, meta):
+        return ty_float(arg_types[0].nullable or domain is not None)
+
+    def impl(func, args, n):
+        v = args[0]
+        x = _to_float(v)
+        valid = v.valid
+        if domain is not None:
+            ok = domain(x)
+            if not ok.all():
+                valid = (valid if valid is not None else np.ones(n, bool)) & ok
+                x = np.where(ok, x, 1.0)
+        with np.errstate(all="ignore"):
+            r = npf(x)
+        return Vec(func.ftype, r, valid)
+
+    register(name, infer)(impl)
+
+
+_float_fn("sqrt", np.sqrt, lambda x: x >= 0)
+_float_fn("exp", np.exp)
+_float_fn("ln", np.log, lambda x: x > 0)
+_float_fn("log2", np.log2, lambda x: x > 0)
+_float_fn("log10", np.log10, lambda x: x > 0)
+_float_fn("sin", np.sin)
+_float_fn("cos", np.cos)
+_float_fn("tan", np.tan)
+_float_fn("asin", np.arcsin, lambda x: np.abs(x) <= 1)
+_float_fn("acos", np.arccos, lambda x: np.abs(x) <= 1)
+_float_fn("atan", np.arctan)
+_float_fn("cot", lambda x: 1.0 / np.tan(x))
+_float_fn("degrees", np.degrees)
+_float_fn("radians", np.radians)
+
+
+@register("log", lambda t, m: ty_float(True))
+def _log(func, args, n):
+    if len(args) == 1:
+        x = _to_float(args[0])
+        ok = x > 0
+        valid = args[0].validity() & ok
+        with np.errstate(all="ignore"):
+            r = np.log(np.where(ok, x, 1.0))
+        return Vec(func.ftype, r, valid if not valid.all() else None)
+    base, x = _to_float(args[0]), _to_float(args[1])
+    ok = (x > 0) & (base > 0) & (base != 1.0)
+    valid = combined_valid(*args)
+    valid = (valid if valid is not None else np.ones(n, bool)) & ok
+    with np.errstate(all="ignore"):
+        r = np.log(np.where(x > 0, x, 1.0)) / np.log(np.where(ok, base, 2.0))
+    return Vec(func.ftype, r, valid if not valid.all() else None)
+
+
+@register("pow", lambda t, m: ty_float(t[0].nullable or t[1].nullable))
+def _pow(func, args, n):
+    a, b = args
+    with np.errstate(all="ignore"):
+        r = np.power(_to_float(a), _to_float(b))
+    return Vec(func.ftype, np.nan_to_num(r), combined_valid(a, b))
+
+
+REGISTRY["power"] = REGISTRY["pow"]
+
+
+@register("mod", _infer_arith)
+def _mod(func, args, n):
+    return _arith("%")(func, args, n)
+
+
+@register("sign", lambda t, m: ty_int(t[0].nullable))
+def _sign(func, args, n):
+    v = args[0]
+    return Vec(func.ftype, np.sign(_to_float(v)).astype(np.int64), v.valid)
+
+
+@register("pi", lambda t, m: ty_float(False))
+def _pi(func, args, n):
+    return Vec(func.ftype, np.full(n, np.pi), None)
+
+
+@register("rand", lambda t, m: ty_float(False))
+def _rand(func, args, n):
+    return Vec(func.ftype, np.random.random(n), None)
+
+
+@register("crc32", lambda t, m: ty_uint(t[0].nullable))
+def _crc32(func, args, n):
+    import zlib
+
+    v = args[0]
+    s = _str_data(v)
+    r = np.fromiter(
+        (zlib.crc32(str(x).encode()) for x in s), dtype=np.int64, count=n
+    )
+    return Vec(func.ftype, r, v.valid)
+
+
+@register("greatest", lambda t, m: _infer_coalesce(t, m).with_nullable(any(x.nullable for x in t)))
+def _greatest(func, args, n):
+    vs = [cast_vec(v, func.ftype) for v in args]
+    data = vs[0].data.copy()
+    for v in vs[1:]:
+        if func.ftype.kind == TypeKind.STRING:
+            m = np.asarray(v.data > data, dtype=np.bool_)
+        else:
+            m = v.data > data
+        data = np.where(m, v.data, data)
+    return Vec(func.ftype, data, combined_valid(*args))
+
+
+@register("least", lambda t, m: _infer_coalesce(t, m).with_nullable(any(x.nullable for x in t)))
+def _least(func, args, n):
+    vs = [cast_vec(v, func.ftype) for v in args]
+    data = vs[0].data.copy()
+    for v in vs[1:]:
+        if func.ftype.kind == TypeKind.STRING:
+            m = np.asarray(v.data < data, dtype=np.bool_)
+        else:
+            m = v.data < data
+        data = np.where(m, v.data, data)
+    return Vec(func.ftype, data, combined_valid(*args))
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+
+def _str_fn(name, fn, infer=None):
+    def default_infer(arg_types, meta):
+        return ty_string(any(t.nullable for t in arg_types))
+
+    def impl(func, args, n):
+        ss = [_str_data(v) for v in args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = fn(*(s[i] for s in ss))
+        return Vec(func.ftype, out, combined_valid(*args))
+
+    register(name, infer or default_infer)(impl)
+
+
+_str_fn("lower", lambda s: str(s).lower())
+_str_fn("upper", lambda s: str(s).upper())
+REGISTRY["lcase"] = REGISTRY["lower"]
+REGISTRY["ucase"] = REGISTRY["upper"]
+_str_fn("trim", lambda s: str(s).strip())
+_str_fn("ltrim", lambda s: str(s).lstrip())
+_str_fn("rtrim", lambda s: str(s).rstrip())
+_str_fn("reverse", lambda s: str(s)[::-1])
+_str_fn("replace", lambda s, a, b: str(s).replace(str(a), str(b)))
+
+
+@register("length", lambda t, m: ty_int(t[0].nullable))
+def _length(func, args, n):
+    v = args[0]
+    s = _str_data(v)
+    r = np.fromiter((len(str(x).encode("utf-8")) for x in s), dtype=np.int64, count=n)
+    return Vec(func.ftype, r, v.valid)
+
+
+@register("char_length", lambda t, m: ty_int(t[0].nullable))
+def _char_length(func, args, n):
+    v = args[0]
+    s = _str_data(v)
+    r = np.fromiter((len(str(x)) for x in s), dtype=np.int64, count=n)
+    return Vec(func.ftype, r, v.valid)
+
+
+REGISTRY["character_length"] = REGISTRY["char_length"]
+
+
+@register("concat", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _concat(func, args, n):
+    ss = [_str_data(v) for v in args]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = "".join(str(s[i]) for s in ss)
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("concat_ws", lambda t, m: ty_string(t[0].nullable))
+def _concat_ws(func, args, n):
+    sep = _str_data(args[0])
+    ss = [_str_data(v) for v in args[1:]]
+    vals = [v.validity() for v in args[1:]]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = str(sep[i]).join(
+            str(s[i]) for s, va in zip(ss, vals) if va[i]
+        )
+    return Vec(func.ftype, out, args[0].valid)
+
+
+def _substr_py(s, pos, length=None):
+    s = str(s)
+    pos = int(pos)
+    if pos == 0:
+        return ""
+    if pos > 0:
+        start = pos - 1
+    else:
+        start = len(s) + pos
+        if start < 0:
+            return ""
+    if length is None:
+        return s[start:]
+    if length <= 0:
+        return ""
+    return s[start : start + int(length)]
+
+
+@register("substring", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _substring(func, args, n):
+    s = _str_data(args[0])
+    pos = args[1].data
+    out = np.empty(n, dtype=object)
+    if len(args) > 2:
+        ln = args[2].data
+        for i in range(n):
+            out[i] = _substr_py(s[i], pos[i], ln[i])
+    else:
+        for i in range(n):
+            out[i] = _substr_py(s[i], pos[i])
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+REGISTRY["substr"] = REGISTRY["substring"]
+REGISTRY["mid"] = REGISTRY["substring"]
+
+
+@register("left", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _left(func, args, n):
+    s = _str_data(args[0])
+    k = args[1].data
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = str(s[i])[: max(int(k[i]), 0)]
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("right", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _right(func, args, n):
+    s = _str_data(args[0])
+    k = args[1].data
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        kk = max(int(k[i]), 0)
+        out[i] = str(s[i])[-kk:] if kk else ""
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("locate", lambda t, m: ty_int(any(x.nullable for x in t)))
+def _locate(func, args, n):
+    sub = _str_data(args[0])
+    s = _str_data(args[1])
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        out[i] = str(s[i]).find(str(sub[i])) + 1
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("instr", lambda t, m: ty_int(any(x.nullable for x in t)))
+def _instr(func, args, n):
+    s = _str_data(args[0])
+    sub = _str_data(args[1])
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        out[i] = str(s[i]).find(str(sub[i])) + 1
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("ascii", lambda t, m: ty_int(t[0].nullable))
+def _ascii(func, args, n):
+    s = _str_data(args[0])
+    out = np.fromiter(
+        ((ord(str(x)[0]) if str(x) else 0) for x in s), dtype=np.int64, count=n
+    )
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("repeat", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _repeat(func, args, n):
+    s = _str_data(args[0])
+    k = args[1].data
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = str(s[i]) * max(int(k[i]), 0)
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("lpad", lambda t, m: ty_string(True))
+def _lpad(func, args, n):
+    s, ln, p = _str_data(args[0]), args[1].data, _str_data(args[2])
+    out = np.empty(n, dtype=object)
+    valid = np.ones(n, dtype=np.bool_)
+    for i in range(n):
+        target = int(ln[i])
+        x, pad = str(s[i]), str(p[i])
+        if target < 0 or (len(x) < target and not pad):
+            valid[i] = False
+            out[i] = ""
+        elif len(x) >= target:
+            out[i] = x[:target]
+        else:
+            need = target - len(x)
+            out[i] = (pad * (need // len(pad) + 1))[:need] + x
+    cv = combined_valid(*args)
+    if cv is not None:
+        valid &= cv
+    return Vec(func.ftype, out, valid if not valid.all() else None)
+
+
+@register("rpad", lambda t, m: ty_string(True))
+def _rpad(func, args, n):
+    s, ln, p = _str_data(args[0]), args[1].data, _str_data(args[2])
+    out = np.empty(n, dtype=object)
+    valid = np.ones(n, dtype=np.bool_)
+    for i in range(n):
+        target = int(ln[i])
+        x, pad = str(s[i]), str(p[i])
+        if target < 0 or (len(x) < target and not pad):
+            valid[i] = False
+            out[i] = ""
+        elif len(x) >= target:
+            out[i] = x[:target]
+        else:
+            need = target - len(x)
+            out[i] = x + (pad * (need // len(pad) + 1))[:need]
+    cv = combined_valid(*args)
+    if cv is not None:
+        valid &= cv
+    return Vec(func.ftype, out, valid if not valid.all() else None)
+
+
+@register("strcmp", lambda t, m: ty_int(any(x.nullable for x in t)))
+def _strcmp(func, args, n):
+    a, b = _str_data(args[0]), _str_data(args[1])
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        x, y = str(a[i]), str(b[i])
+        out[i] = -1 if x < y else (1 if x > y else 0)
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("space", lambda t, m: ty_string(t[0].nullable))
+def _space(func, args, n):
+    k = args[0].data
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = " " * max(int(k[i]), 0)
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("hex", lambda t, m: ty_string(t[0].nullable))
+def _hex(func, args, n):
+    v = args[0]
+    out = np.empty(n, dtype=object)
+    if v.ftype.kind == TypeKind.STRING:
+        for i in range(n):
+            out[i] = str(v.data[i]).encode("utf-8").hex().upper()
+    else:
+        for i in range(n):
+            out[i] = format(int(v.data[i]) & 0xFFFFFFFFFFFFFFFF, "X")
+    return Vec(func.ftype, out, v.valid)
+
+
+# ---------------------------------------------------------------------------
+# temporal
+# ---------------------------------------------------------------------------
+
+_US_PER = {
+    "microsecond": 1,
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": 86_400_000_000,
+    "week": 7 * 86_400_000_000,
+}
+
+
+def _as_datetime_us(v: Vec) -> np.ndarray:
+    if v.ftype.kind == TypeKind.DATETIME:
+        return v.data
+    if v.ftype.kind == TypeKind.DATE:
+        return v.data.astype(np.int64) * 86_400_000_000
+    if v.ftype.kind == TypeKind.STRING:
+        out = np.zeros(len(v.data), dtype=np.int64)
+        for i, s in enumerate(v.data):
+            try:
+                out[i] = parse_datetime(str(s))
+            except (ValueError, IndexError):
+                out[i] = 0
+        return out
+    return v.data.astype(np.int64)
+
+
+def _ymd_arrays(us: np.ndarray):
+    days = us // 86_400_000_000
+    # vectorized civil-from-days (Howard Hinnant's algorithm)
+    z = days + 719468
+    era = np.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y.astype(np.int64), m.astype(np.int64), d.astype(np.int64)
+
+
+def _infer_int_temporal(arg_types, meta):
+    return ty_int(arg_types[0].nullable)
+
+
+def _temporal_int(name, fn):
+    def impl(func, args, n):
+        us = _as_datetime_us(args[0])
+        return Vec(func.ftype, fn(us), args[0].valid)
+
+    register(name, _infer_int_temporal)(impl)
+
+
+_temporal_int("year", lambda us: _ymd_arrays(us)[0])
+_temporal_int("month", lambda us: _ymd_arrays(us)[1])
+_temporal_int("dayofmonth", lambda us: _ymd_arrays(us)[2])
+REGISTRY["day"] = REGISTRY["dayofmonth"]
+_temporal_int("hour", lambda us: (us % 86_400_000_000) // 3_600_000_000)
+_temporal_int("minute", lambda us: (us % 3_600_000_000) // 60_000_000)
+_temporal_int("second", lambda us: (us % 60_000_000) // 1_000_000)
+_temporal_int("microsecond", lambda us: us % 1_000_000)
+_temporal_int("quarter", lambda us: (_ymd_arrays(us)[1] + 2) // 3)
+# 1970-01-01 is a Thursday; MySQL DAYOFWEEK: 1=Sunday..7=Saturday
+_temporal_int("dayofweek", lambda us: ((us // 86_400_000_000) + 4) % 7 + 1)
+# WEEKDAY: 0=Monday..6=Sunday
+_temporal_int("weekday", lambda us: ((us // 86_400_000_000) + 3) % 7)
+_temporal_int("unix_timestamp", lambda us: us // 1_000_000)
+
+
+def _dayofyear(us):
+    y, m, d = _ymd_arrays(us)
+    # days since Jan 1 of the same year
+    jan1 = np.zeros(len(us), dtype=np.int64)
+    for yy in np.unique(y):
+        jan1[y == yy] = (parse_date(f"{yy:04d}-01-01"))
+    return (us // 86_400_000_000) - jan1 + 1
+
+
+_temporal_int("dayofyear", _dayofyear)
+
+
+def _week(us):
+    # MySQL default mode 0: week 0..53, Sunday-first
+    doy = _dayofyear(us)
+    dow_jan1 = ((us // 86_400_000_000) - (doy - 1) + 4) % 7 + 1  # 1=Sun
+    return (doy + (dow_jan1 - 1) - 1) // 7 + np.where(dow_jan1 == 1, 1, 0)
+
+
+register("week", _infer_int_temporal)(
+    lambda func, args, n: Vec(
+        func.ftype, _week(_as_datetime_us(args[0])), args[0].valid
+    )
+)
+
+
+@register("date", lambda t, m: ty_date(t[0].nullable))
+def _date(func, args, n):
+    us = _as_datetime_us(args[0])
+    return Vec(func.ftype, (us // 86_400_000_000).astype(np.int32), args[0].valid)
+
+
+def _infer_date_addsub(arg_types, meta):
+    t = arg_types[0]
+    unit = meta.get("unit", "day")
+    if t.kind == TypeKind.DATE and unit in ("day", "week", "month", "quarter", "year"):
+        return ty_date(t.nullable)
+    return ty_datetime(t.nullable)
+
+
+def _date_addsub(sign):
+    def impl(func, args, n):
+        v, delta = args
+        unit = func.meta.get("unit", "day")
+        amount = delta.data.astype(np.int64) * sign
+        valid = combined_valid(v, delta)
+        if unit in _US_PER:
+            us = _as_datetime_us(v) + amount * _US_PER[unit]
+        else:
+            us0 = _as_datetime_us(v)
+            y, m, d = _ymd_arrays(us0)
+            months = {"month": 1, "quarter": 3, "year": 12}[unit]
+            tot = y * 12 + (m - 1) + amount * months
+            ny, nm = tot // 12, tot % 12 + 1
+            # clamp day to month length
+            mlen = np.array(
+                [_month_len(int(a), int(b)) for a, b in zip(ny, nm)], dtype=np.int64
+            )
+            nd = np.minimum(d, mlen)
+            days = np.array(
+                [
+                    parse_date(f"{int(a):04d}-{int(b):02d}-{int(c):02d}")
+                    for a, b, c in zip(ny, nm, nd)
+                ],
+                dtype=np.int64,
+            )
+            us = days * 86_400_000_000 + (us0 % 86_400_000_000)
+        if func.ftype.kind == TypeKind.DATE:
+            return Vec(func.ftype, (us // 86_400_000_000).astype(np.int32), valid)
+        return Vec(func.ftype, us, valid)
+
+    return impl
+
+
+def _month_len(y, m):
+    if m == 2:
+        return 29 if (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)) else 28
+    return 31 if m in (1, 3, 5, 7, 8, 10, 12) else 30
+
+
+register("date_add", _infer_date_addsub)(_date_addsub(+1))
+register("date_sub", _infer_date_addsub)(_date_addsub(-1))
+REGISTRY["adddate"] = REGISTRY["date_add"]
+REGISTRY["subdate"] = REGISTRY["date_sub"]
+
+
+@register("datediff", lambda t, m: ty_int(t[0].nullable or t[1].nullable))
+def _datediff(func, args, n):
+    a = _as_datetime_us(args[0]) // 86_400_000_000
+    b = _as_datetime_us(args[1]) // 86_400_000_000
+    return Vec(func.ftype, (a - b).astype(np.int64), combined_valid(*args))
+
+
+@register("timestampdiff", lambda t, m: ty_int(True))
+def _timestampdiff(func, args, n):
+    unit = func.meta.get("unit", "day")
+    a = _as_datetime_us(args[0])
+    b = _as_datetime_us(args[1])
+    if unit in _US_PER:
+        r = (b - a) // _US_PER[unit]
+    else:
+        ya, ma, da = _ymd_arrays(a)
+        yb, mb, db = _ymd_arrays(b)
+        months = (yb - ya) * 12 + (mb - ma) - (db < da).astype(np.int64)
+        r = months // {"month": 1, "quarter": 3, "year": 12}[unit]
+    return Vec(func.ftype, r, combined_valid(*args))
+
+
+@register("now", lambda t, m: ty_datetime(False))
+def _now(func, args, n):
+    us = int(_dt.datetime.now().timestamp() * 1e6)
+    return Vec(func.ftype, np.full(n, us, dtype=np.int64), None)
+
+
+REGISTRY["current_timestamp"] = REGISTRY["now"]
+REGISTRY["sysdate"] = REGISTRY["now"]
+
+
+@register("curdate", lambda t, m: ty_date(False))
+def _curdate(func, args, n):
+    days = (_dt.date.today() - _dt.date(1970, 1, 1)).days
+    return Vec(func.ftype, np.full(n, days, dtype=np.int32), None)
+
+
+REGISTRY["current_date"] = REGISTRY["curdate"]
+
+
+@register("from_unixtime", lambda t, m: ty_datetime(t[0].nullable))
+def _from_unixtime(func, args, n):
+    v = args[0]
+    sec = _to_float(v)
+    return Vec(func.ftype, (sec * 1e6).astype(np.int64), v.valid)
+
+
+@register("date_format", lambda t, m: ty_string(any(x.nullable for x in t)))
+def _date_format(func, args, n):
+    us = _as_datetime_us(args[0])
+    fmt = _str_data(args[1])
+    out = np.empty(n, dtype=object)
+    mapping = {
+        "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%-m", "%d": "%d",
+        "%e": "%-d", "%H": "%H", "%k": "%-H", "%i": "%M", "%s": "%S",
+        "%S": "%S", "%f": "%f", "%M": "%B", "%b": "%b", "%a": "%a",
+        "%W": "%A", "%j": "%j", "%%": "%%", "%T": "%H:%M:%S",
+    }
+    for i in range(n):
+        f = str(fmt[i])
+        py = ""
+        j = 0
+        while j < len(f):
+            if f[j] == "%" and j + 1 < len(f):
+                py += mapping.get(f[j : j + 2], f[j + 1])
+                j += 2
+            else:
+                py += f[j]
+                j += 1
+        out[i] = micros_to_datetime(int(us[i])).strftime(py)
+    return Vec(func.ftype, out, combined_valid(*args))
+
+
+@register("extract", lambda t, m: ty_int(t[0].nullable))
+def _extract(func, args, n):
+    unit = func.meta.get("unit", "day")
+    impl_map = {
+        "year": lambda us: _ymd_arrays(us)[0],
+        "month": lambda us: _ymd_arrays(us)[1],
+        "day": lambda us: _ymd_arrays(us)[2],
+        "hour": lambda us: (us % 86_400_000_000) // 3_600_000_000,
+        "minute": lambda us: (us % 3_600_000_000) // 60_000_000,
+        "second": lambda us: (us % 60_000_000) // 1_000_000,
+        "quarter": lambda us: (_ymd_arrays(us)[1] + 2) // 3,
+        "week": _week,
+    }
+    us = _as_datetime_us(args[0])
+    return Vec(func.ftype, impl_map[unit](us), args[0].valid)
+
+
+@register("monthname", lambda t, m: ty_string(t[0].nullable))
+def _monthname(func, args, n):
+    us = _as_datetime_us(args[0])
+    names = [
+        "", "January", "February", "March", "April", "May", "June", "July",
+        "August", "September", "October", "November", "December",
+    ]
+    m = _ymd_arrays(us)[1]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = names[int(m[i])]
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("last_day", lambda t, m: ty_date(t[0].nullable))
+def _last_day(func, args, n):
+    us = _as_datetime_us(args[0])
+    y, m, d = _ymd_arrays(us)
+    days = np.array(
+        [
+            parse_date(f"{int(a):04d}-{int(b):02d}-{_month_len(int(a), int(b)):02d}")
+            for a, b in zip(y, m)
+        ],
+        dtype=np.int32,
+    )
+    return Vec(func.ftype, days, args[0].valid)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+@register("row", lambda t, m: t[0])
+def _row(func, args, n):
+    raise TypeError_("ROW expressions only valid inside IN")
+
+
+@register("version", lambda t, m: ty_string(False))
+def _version(func, args, n):
+    out = np.empty(n, dtype=object)
+    out[:] = "8.0.11-tidb-tpu-0.1.0"
+    return Vec(func.ftype, out, None)
+
+
+@register("database", lambda t, m: ty_string(True))
+def _database(func, args, n):
+    out = np.empty(n, dtype=object)
+    out[:] = ""
+    return Vec(func.ftype, out, np.zeros(n, dtype=np.bool_))
+
+
+@register("connection_id", lambda t, m: ty_int(False))
+def _connection_id(func, args, n):
+    return Vec(func.ftype, np.full(n, 1, dtype=np.int64), None)
+
+
+@register("found_rows", lambda t, m: ty_int(False))
+def _found_rows(func, args, n):
+    return Vec(func.ftype, np.zeros(n, dtype=np.int64), None)
+
+
+@register("sleep", lambda t, m: ty_int(False))
+def _sleep(func, args, n):
+    import time
+
+    if n:
+        time.sleep(float(max(_to_float(args[0]).max(), 0)))
+    return Vec(func.ftype, np.zeros(n, dtype=np.int64), None)

@@ -1,0 +1,159 @@
+"""Expression trees + vectorized host evaluation.
+
+Reference: expression/expression.go:81 (Expression iface), scalar_function.go
+(ScalarFunction dispatch), chunk_executor.go:78-88 (VectorizedExecute) and
+expression.go:268 (VecEvalBool with selected+null masks).
+
+Design: expressions are resolved (column refs are input *indices*, not names)
+and typed at plan time.  ``eval_expr`` runs the whole tree vectorized over a
+Chunk with numpy; the device path compiles the same tree with jax (copr/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..chunk import Chunk, Column
+from ..types import FieldType, TypeKind, ty_bool
+from .vec import Vec
+
+
+class Expression:
+    ftype: FieldType
+
+    def children(self) -> Sequence["Expression"]:
+        return ()
+
+    def eval(self, chunk: Chunk) -> Vec:
+        raise NotImplementedError
+
+    # --- structural helpers used by the planner -------------------------
+    def collect_columns(self, out: set):
+        for c in self.children():
+            c.collect_columns(out)
+
+    def remap_columns(self, mapping: dict) -> "Expression":
+        raise NotImplementedError
+
+    def is_constant(self) -> bool:
+        return all(c.is_constant() for c in self.children()) and bool(self.children())
+
+
+@dataclass
+class ColumnExpr(Expression):
+    index: int  # offset into the input chunk
+    ftype: FieldType = None
+    name: str = ""  # display name for EXPLAIN
+    unique_id: int = -1  # planner-wide stable id (pre-resolution)
+
+    def eval(self, chunk: Chunk) -> Vec:
+        return Vec.from_column(chunk.col(self.index))
+
+    def collect_columns(self, out: set):
+        out.add(self.unique_id if self.unique_id >= 0 else self.index)
+
+    def remap_columns(self, mapping: dict) -> "Expression":
+        key = self.unique_id if self.unique_id >= 0 else self.index
+        if key in mapping:
+            return ColumnExpr(mapping[key], self.ftype, self.name, self.unique_id)
+        return self
+
+    def is_constant(self) -> bool:
+        return False
+
+    def __str__(self):
+        return self.name or f"col#{self.index}"
+
+
+@dataclass
+class Constant(Expression):
+    value: object
+    ftype: FieldType = None
+
+    def eval(self, chunk: Chunk) -> Vec:
+        n = chunk.num_rows
+        return Vec.from_column(Column.constant(self.ftype, self.value, n))
+
+    def remap_columns(self, mapping: dict) -> "Expression":
+        return self
+
+    def is_constant(self) -> bool:
+        return True
+
+    def __str__(self):
+        if self.value is None:
+            return "NULL"
+        if isinstance(self.value, str):
+            return repr(self.value)
+        return str(self.value)
+
+
+@dataclass
+class ScalarFunc(Expression):
+    name: str  # lowercase canonical function name
+    args: List[Expression]
+    ftype: FieldType = None
+    # extra static payload (e.g. LIKE pattern compiled, cast target, interval unit)
+    meta: dict = field(default_factory=dict)
+
+    def children(self):
+        return self.args
+
+    def eval(self, chunk: Chunk) -> Vec:
+        from .builtins import dispatch
+        return dispatch(self, [a.eval(chunk) for a in self.args], chunk.num_rows)
+
+    def remap_columns(self, mapping: dict) -> "Expression":
+        return ScalarFunc(
+            self.name,
+            [a.remap_columns(mapping) for a in self.args],
+            self.ftype,
+            self.meta,
+        )
+
+    def __str__(self):
+        if self.name in ("+", "-", "*", "/", "=", "!=", "<", "<=", ">", ">=",
+                         "and", "or", "%", "div", "xor", "like"):
+            if len(self.args) == 2:
+                return f"({self.args[0]} {self.name} {self.args[1]})"
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+def eval_expr(e: Expression, chunk: Chunk) -> Column:
+    return e.eval(chunk).to_column()
+
+
+def eval_bool_mask(exprs: Sequence[Expression], chunk: Chunk) -> np.ndarray:
+    """Evaluate a conjunction of predicates to a bool selection mask.
+
+    NULL counts as not-selected (SQL WHERE semantics).  Reference:
+    expression.VecEvalBool (expression/expression.go:268).
+    """
+    n = chunk.num_rows
+    mask = np.ones(n, dtype=np.bool_)
+    for e in exprs:
+        v = e.eval(chunk)
+        vals = v.data
+        if v.ftype.kind == TypeKind.FLOAT:
+            truth = vals != 0.0
+        elif v.ftype.kind == TypeKind.STRING:
+            # MySQL: string in bool context -> numeric coercion; non-numeric = 0
+            truth = np.fromiter(
+                (_str_truthy(x) for x in vals), dtype=np.bool_, count=len(vals)
+            )
+        else:
+            truth = vals != 0
+        if v.valid is not None:
+            truth = truth & v.valid
+        mask &= truth
+    return mask
+
+
+def _str_truthy(s) -> bool:
+    try:
+        return float(s) != 0.0
+    except (TypeError, ValueError):
+        return False

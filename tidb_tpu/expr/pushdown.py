@@ -1,0 +1,106 @@
+"""Pushdown eligibility: which expressions may execute on the device.
+
+Reference: expression/expr_to_pb.go:310 ``canFuncBePushed`` + the
+``mysql.expr_pushdown_blacklist`` reload (executor/reload_expr_pushdown_
+blacklist.go:37-39).  The device engine (copr/) compiles a numeric/dict-code
+subset of the builtin surface with jax; anything else stays in root executors.
+
+A session-level blacklist lets users (and tests) force functions to the host,
+mirroring the reference's feature gate.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Set
+
+from ..types import TypeKind
+from .aggregation import AggDesc
+from .expression import ColumnExpr, Constant, Expression, ScalarFunc
+
+# Functions the jax engine implements over fixed-width numeric data
+# (see copr/jax_eval.py).  Strings participate only via dictionary codes:
+# =, !=, in over dict-encoded columns are rewritten to code comparisons
+# by the planner before pushdown.
+PUSHABLE_FUNCS: Set[str] = {
+    "+", "-", "*", "/", "div", "%", "unaryminus",
+    "=", "!=", "<", "<=", ">", ">=", "nulleq",
+    "and", "or", "not", "xor",
+    "isnull", "isnotnull", "istrue", "isfalse",
+    "in", "if", "ifnull", "coalesce", "case", "cast",
+    "abs", "ceil", "ceiling", "floor", "round", "truncate",
+    "sqrt", "exp", "ln", "log2", "log10", "pow", "power", "mod", "sign",
+    "sin", "cos", "tan", "atan",
+    "year", "month", "day", "dayofmonth", "quarter",
+    "date", "date_add", "date_sub", "datediff", "dayofweek", "weekday",
+    "unix_timestamp", "extract", "week", "dayofyear",
+    "&", "|", "^", "<<", ">>", "~",
+    "greatest", "least", "nullif",
+}
+
+PUSHABLE_AGGS: Set[str] = {
+    "count", "sum", "avg", "min", "max", "first_row",
+    "bit_and", "bit_or", "bit_xor",
+}
+
+# Kinds with fixed-width device representations.  STRING is device-eligible
+# only when dictionary-encoded (decided per column by the block store).
+DEVICE_KINDS = {
+    TypeKind.INT, TypeKind.UINT, TypeKind.BOOL, TypeKind.FLOAT,
+    TypeKind.DECIMAL, TypeKind.DATE, TypeKind.DATETIME,
+}
+
+
+def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
+                  dict_cols: Set[int] = frozenset()) -> bool:
+    """True if the whole expression tree can run on the device.
+
+    dict_cols: unique_ids of string columns that are dictionary-encoded in
+    the block store (equality/IN on them compiles to code comparison).
+    """
+    if isinstance(e, Constant):
+        return e.ftype.kind in DEVICE_KINDS or e.value is None or isinstance(
+            e.value, str
+        )
+    if isinstance(e, ColumnExpr):
+        if e.ftype.kind in DEVICE_KINDS:
+            return True
+        key = e.unique_id if e.unique_id >= 0 else e.index
+        return e.ftype.kind == TypeKind.STRING and key in dict_cols
+    if isinstance(e, ScalarFunc):
+        if e.name in blacklist or e.name not in PUSHABLE_FUNCS:
+            return False
+        if e.name in ("=", "!=", "in"):
+            # string comparisons only against dict-encoded columns
+            kinds = [a.ftype.kind for a in e.args]
+            if TypeKind.STRING in kinds:
+                col_args = [a for a in e.args if isinstance(a, ColumnExpr)]
+                const_args = [a for a in e.args if isinstance(a, Constant)]
+                if len(col_args) != 1 or len(const_args) != len(e.args) - 1:
+                    return False
+                c = col_args[0]
+                key = c.unique_id if c.unique_id >= 0 else c.index
+                if c.ftype.kind == TypeKind.STRING and key not in dict_cols:
+                    return False
+                return True
+        elif any(a.ftype.kind == TypeKind.STRING for a in e.args):
+            return False
+        return all(can_push_expr(a, blacklist, dict_cols) for a in e.args)
+    return False
+
+
+def can_push_agg(agg: AggDesc, blacklist: Set[str] = frozenset(),
+                 dict_cols: Set[int] = frozenset()) -> bool:
+    if agg.name not in PUSHABLE_AGGS or agg.name in blacklist:
+        return False
+    if agg.distinct:
+        return False  # distinct aggs stay serial on host (reference: aggregate.go:166)
+    if agg.name in ("min", "max", "first_row"):
+        # dict codes are order-preserving only if the dictionary is sorted;
+        # blockstore guarantees sorted dictionaries, so allow them.
+        return all(
+            a.ftype.kind in DEVICE_KINDS
+            or (isinstance(a, ColumnExpr) and (
+                (a.unique_id if a.unique_id >= 0 else a.index) in dict_cols))
+            for a in agg.args
+        )
+    return all(can_push_expr(a, blacklist, dict_cols) for a in agg.args)

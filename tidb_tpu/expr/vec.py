@@ -1,0 +1,59 @@
+"""Vec — a vectorized intermediate value during expression evaluation.
+
+The host-side analog of the reference's per-type column buffers flowing
+through VecEval* (expression/expression.go:436).  data is a dense numpy
+array; valid is None (all valid) or a bool mask (True = non-NULL).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..chunk import Column
+from ..types import FieldType, TypeKind
+
+
+class Vec:
+    __slots__ = ("ftype", "data", "valid")
+
+    def __init__(self, ftype: FieldType, data: np.ndarray, valid: Optional[np.ndarray] = None):
+        self.ftype = ftype
+        self.data = data
+        if valid is not None and bool(valid.all()):
+            valid = None
+        self.valid = valid
+
+    def __len__(self):
+        return len(self.data)
+
+    @staticmethod
+    def from_column(c: Column) -> "Vec":
+        return Vec(c.ftype, c.data, c.valid)
+
+    def to_column(self) -> Column:
+        return Column(self.ftype, self.data, self.valid)
+
+    def validity(self) -> np.ndarray:
+        if self.valid is None:
+            return np.ones(len(self.data), dtype=np.bool_)
+        return self.valid
+
+    @staticmethod
+    def all_null(ftype: FieldType, n: int) -> "Vec":
+        if ftype.kind == TypeKind.STRING:
+            data = np.empty(n, dtype=object)
+            data[:] = ""
+        else:
+            data = np.zeros(n, dtype=ftype.np_dtype)
+        return Vec(ftype, data, np.zeros(n, dtype=np.bool_))
+
+
+def combined_valid(*vecs: Vec) -> Optional[np.ndarray]:
+    """AND of input validities (standard NULL-propagation rule)."""
+    out: Optional[np.ndarray] = None
+    for v in vecs:
+        if v.valid is not None:
+            out = v.valid.copy() if out is None else (out & v.valid)
+    return out

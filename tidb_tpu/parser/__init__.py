@@ -1,0 +1,4 @@
+from .parser import parse, parse_one
+from . import ast
+
+__all__ = ["parse", "parse_one", "ast"]

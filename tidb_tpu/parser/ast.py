@@ -1,0 +1,460 @@
+"""AST node definitions.
+
+Reference model: pingcap/parser's ast package (ast.StmtNode consumed at
+session/session.go:982).  Plain dataclasses; the planner walks these.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+
+class Node:
+    pass
+
+
+class Expr(Node):
+    pass
+
+
+# ---------------- expressions ----------------
+
+
+@dataclass
+class Literal(Expr):
+    value: object  # int | float | str | bool | None
+    type_hint: str = ""  # "", "date", "datetime", "decimal"
+
+
+@dataclass
+class ColumnRef(Expr):
+    name: str
+    table: str = ""
+    db: str = ""
+
+    def __str__(self):
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class Star(Expr):
+    table: str = ""  # t.* when set
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str  # +,-,*,/,div,%,=,<,>,<=,>=,!=,and,or,like,is,is not,xor,<<,>>,&,|,^
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # -, not, ~, +
+    operand: Expr
+
+
+@dataclass
+class FuncCall(Expr):
+    name: str  # lowercase
+    args: List[Expr]
+    distinct: bool = False  # COUNT(DISTINCT x)
+
+
+@dataclass
+class CaseWhen(Expr):
+    operand: Optional[Expr]  # CASE x WHEN... vs CASE WHEN...
+    branches: List[Tuple[Expr, Expr]]
+    else_expr: Optional[Expr]
+
+
+@dataclass
+class Cast(Expr):
+    expr: Expr
+    type_name: str  # "signed", "unsigned", "char", "double", "decimal(p,s)", "date", "datetime"
+    precision: int = 0
+    scale: int = 0
+
+
+@dataclass
+class InList(Expr):
+    expr: Expr
+    items: List[Expr]
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Expr):
+    expr: Expr
+    query: "SelectStmt"
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class Exists(Expr):
+    query: "SelectStmt"
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery(Expr):
+    query: "SelectStmt"
+
+
+@dataclass
+class Interval(Expr):
+    value: Expr
+    unit: str  # day, month, year, hour, minute, second, week, quarter
+
+
+@dataclass
+class Variable(Expr):
+    name: str
+    is_global: bool = False
+    is_system: bool = False  # @@x vs @x
+
+
+@dataclass
+class Default(Expr):
+    pass
+
+
+@dataclass
+class Param(Expr):
+    """A `?` placeholder in a prepared statement."""
+
+    index: int
+
+
+# ---------------- table refs ----------------
+
+
+@dataclass
+class TableName(Node):
+    name: str
+    db: str = ""
+    alias: str = ""
+
+
+@dataclass
+class SubqueryRef(Node):
+    query: "SelectStmt"
+    alias: str
+
+
+@dataclass
+class Join(Node):
+    kind: str  # inner, left, right, cross
+    left: Node
+    right: Node
+    on: Optional[Expr] = None
+    using: List[str] = field(default_factory=list)
+
+
+# ---------------- statements ----------------
+
+
+class Stmt(Node):
+    pass
+
+
+@dataclass
+class SelectField(Node):
+    expr: Expr
+    alias: str = ""
+
+
+@dataclass
+class OrderItem(Node):
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class SelectStmt(Stmt):
+    fields: List[SelectField]
+    from_clause: Optional[Node] = None  # TableName | SubqueryRef | Join
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+    for_update: bool = False
+
+
+@dataclass
+class UnionStmt(Stmt):
+    selects: List[SelectStmt]
+    all: bool = False  # UNION ALL vs UNION (distinct)
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    type_name: str  # normalized lowercase: bigint, double, varchar, decimal, date, datetime, ...
+    precision: int = 0
+    scale: int = 0
+    not_null: bool = False
+    primary_key: bool = False
+    unique: bool = False
+    default: Optional[Expr] = None
+    auto_increment: bool = False
+
+
+@dataclass
+class IndexDef(Node):
+    name: str
+    columns: List[str]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTableStmt(Stmt):
+    table: TableName
+    columns: List[ColumnDef]
+    indexes: List[IndexDef] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt(Stmt):
+    tables: List[TableName]
+    if_exists: bool = False
+    is_view: bool = False
+
+
+@dataclass
+class TruncateTableStmt(Stmt):
+    table: TableName
+
+
+@dataclass
+class CreateIndexStmt(Stmt):
+    index_name: str
+    table: TableName
+    columns: List[str]
+    unique: bool = False
+
+
+@dataclass
+class DropIndexStmt(Stmt):
+    index_name: str
+    table: TableName
+
+
+@dataclass
+class AlterTableStmt(Stmt):
+    table: TableName
+    action: str  # add_column, drop_column, add_index, drop_index, rename, modify_column
+    column: Optional[ColumnDef] = None
+    index: Optional[IndexDef] = None
+    name: str = ""  # drop target / rename target
+
+
+@dataclass
+class RenameTableStmt(Stmt):
+    old: TableName = None
+    new: TableName = None
+
+
+@dataclass
+class CreateDatabaseStmt(Stmt):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt(Stmt):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateViewStmt(Stmt):
+    name: TableName = None
+    query: Stmt = None
+    or_replace: bool = False
+
+
+@dataclass
+class InsertStmt(Stmt):
+    table: TableName
+    columns: List[str]
+    values: List[List[Expr]] = field(default_factory=list)
+    query: Optional[Stmt] = None  # INSERT ... SELECT
+    replace: bool = False
+    ignore: bool = False
+    on_dup_update: List[Tuple[str, Expr]] = field(default_factory=list)
+
+
+@dataclass
+class UpdateStmt(Stmt):
+    table: TableName
+    assignments: List[Tuple[str, Expr]] = field(default_factory=list)
+    where: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class DeleteStmt(Stmt):
+    table: TableName
+    where: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class ExplainStmt(Stmt):
+    target: Stmt
+    analyze: bool = False
+    format: str = "row"
+
+
+@dataclass
+class TraceStmt(Stmt):
+    target: Stmt
+
+
+@dataclass
+class SetStmt(Stmt):
+    assignments: List[Tuple[str, bool, Expr]]  # (name, is_global, value)
+
+
+@dataclass
+class ShowStmt(Stmt):
+    kind: str  # tables, databases, columns, create_table, variables, index, warnings, ...
+    target: str = ""
+    db: str = ""
+    like: Optional[str] = None
+    where: Optional[Expr] = None
+    is_global: bool = False
+    full: bool = False
+
+
+@dataclass
+class UseStmt(Stmt):
+    db: str
+
+
+@dataclass
+class BeginStmt(Stmt):
+    pass
+
+
+@dataclass
+class CommitStmt(Stmt):
+    pass
+
+
+@dataclass
+class RollbackStmt(Stmt):
+    pass
+
+
+@dataclass
+class AnalyzeTableStmt(Stmt):
+    tables: List[TableName]
+
+
+@dataclass
+class LoadDataStmt(Stmt):
+    path: str
+    table: TableName
+    fields_terminated: str = "\t"
+    lines_terminated: str = "\n"
+    ignore_lines: int = 0
+
+
+@dataclass
+class PrepareStmt(Stmt):
+    name: str
+    sql: str
+
+
+@dataclass
+class ExecuteStmt(Stmt):
+    name: str
+    using: List[str] = field(default_factory=list)  # user variable names
+
+
+@dataclass
+class DeallocateStmt(Stmt):
+    name: str
+
+
+@dataclass
+class KillStmt(Stmt):
+    conn_id: int
+    query_only: bool = False
+
+
+@dataclass
+class AdminStmt(Stmt):
+    kind: str  # check_table, show_ddl, show_ddl_jobs, ...
+    tables: List[TableName] = field(default_factory=list)
+
+
+@dataclass
+class SplitRegionStmt(Stmt):
+    table: TableName = None
+    num: int = 0
+
+
+@dataclass
+class GrantStmt(Stmt):
+    privs: List[str] = field(default_factory=list)
+    level: str = "*.*"
+    user: str = ""
+
+
+@dataclass
+class RevokeStmt(Stmt):
+    privs: List[str] = field(default_factory=list)
+    level: str = "*.*"
+    user: str = ""
+
+
+@dataclass
+class CreateUserStmt(Stmt):
+    user: str = ""
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt(Stmt):
+    user: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class SetPasswordStmt(Stmt):
+    user: str = ""
+    password: str = ""
+
+
+@dataclass
+class FlushStmt(Stmt):
+    what: str = "privileges"
+
+
+@dataclass
+class DescTableStmt(Stmt):
+    table: TableName = None

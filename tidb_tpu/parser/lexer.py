@@ -1,0 +1,159 @@
+"""SQL lexer (MySQL dialect subset).
+
+Reference: the external yacc-based pingcap/parser (consumed at
+session/session.go:982).  We hand-roll: a token stream with positions for
+error messages, MySQL quoting rules (single-quoted strings with '' and \\
+escapes, backtick-quoted identifiers, # and -- comments).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+from ..errors import ParseError
+
+
+class T(enum.Enum):
+    IDENT = "IDENT"
+    QIDENT = "QIDENT"  # `quoted`
+    INT = "INT"
+    FLOAT = "FLOAT"
+    STRING = "STRING"
+    OP = "OP"
+    EOF = "EOF"
+
+
+@dataclass
+class Token:
+    kind: T
+    value: str
+    line: int
+    col: int
+
+    def __repr__(self):
+        return f"{self.kind.name}({self.value!r})"
+
+
+_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", ":=", "||", "&&", "<<", ">>"}
+_ONE_CHAR_OPS = set("+-*/%(),.;=<>!@^&|~?")
+
+
+def tokenize(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    line, col = 1, 1
+
+    def adv(k: int = 1):
+        nonlocal i, line, col
+        for _ in range(k):
+            if i < n and sql[i] == "\n":
+                line += 1
+                col = 1
+            else:
+                col += 1
+            i += 1
+
+    while i < n:
+        c = sql[i]
+        if c in " \t\r\n":
+            adv()
+            continue
+        # comments
+        if c == "#" or sql.startswith("--", i):
+            while i < n and sql[i] != "\n":
+                adv()
+            continue
+        if sql.startswith("/*", i):
+            start_line, start_col = line, col
+            adv(2)
+            while i < n and not sql.startswith("*/", i):
+                adv()
+            if i >= n:
+                raise ParseError("unterminated comment", start_line, start_col)
+            adv(2)
+            continue
+        tl, tc = line, col
+        # strings
+        if c in ("'", '"'):
+            q = c
+            adv()
+            buf = []
+            while i < n:
+                if sql[i] == "\\" and i + 1 < n:
+                    esc = sql[i + 1]
+                    buf.append(
+                        {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "\\": "\\",
+                         "'": "'", '"': '"'}.get(esc, esc)
+                    )
+                    adv(2)
+                elif sql[i] == q:
+                    if i + 1 < n and sql[i + 1] == q:  # '' escape
+                        buf.append(q)
+                        adv(2)
+                    else:
+                        break
+                else:
+                    buf.append(sql[i])
+                    adv()
+            if i >= n:
+                raise ParseError("unterminated string", tl, tc)
+            adv()  # closing quote
+            toks.append(Token(T.STRING, "".join(buf), tl, tc))
+            continue
+        # backtick identifiers
+        if c == "`":
+            adv()
+            buf = []
+            while i < n and sql[i] != "`":
+                buf.append(sql[i])
+                adv()
+            if i >= n:
+                raise ParseError("unterminated identifier", tl, tc)
+            adv()
+            toks.append(Token(T.QIDENT, "".join(buf), tl, tc))
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            isfloat = False
+            while j < n and (sql[j].isdigit() or sql[j] == "."):
+                if sql[j] == ".":
+                    if isfloat:
+                        break
+                    isfloat = True
+                j += 1
+            if j < n and sql[j] in "eE":
+                k = j + 1
+                if k < n and sql[k] in "+-":
+                    k += 1
+                if k < n and sql[k].isdigit():
+                    isfloat = True
+                    j = k
+                    while j < n and sql[j].isdigit():
+                        j += 1
+            text = sql[i:j]
+            adv(j - i)
+            toks.append(Token(T.FLOAT if isfloat else T.INT, text, tl, tc))
+            continue
+        # identifiers / keywords
+        if c.isalpha() or c == "_" or c == "$":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] in "_$"):
+                j += 1
+            text = sql[i:j]
+            adv(j - i)
+            toks.append(Token(T.IDENT, text, tl, tc))
+            continue
+        # operators
+        if sql[i : i + 2] in _TWO_CHAR_OPS:
+            toks.append(Token(T.OP, sql[i : i + 2], tl, tc))
+            adv(2)
+            continue
+        if c in _ONE_CHAR_OPS:
+            toks.append(Token(T.OP, c, tl, tc))
+            adv()
+            continue
+        raise ParseError(f"unexpected character {c!r}", line, col)
+    toks.append(Token(T.EOF, "", line, col))
+    return toks

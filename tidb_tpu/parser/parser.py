@@ -1,0 +1,1290 @@
+"""Recursive-descent SQL parser (MySQL dialect subset).
+
+Reference: external pingcap/parser (yacc).  Hand-rolled here; covers the
+statement surface the planner/executor implement — the full TPC-H/SSB query
+shapes plus DDL/DML/txn/utility statements (see SURVEY.md Appendix A).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..errors import ParseError
+from . import ast
+from .lexer import T, Token, tokenize
+
+_INTERVAL_UNITS = {
+    "microsecond", "second", "minute", "hour", "day", "week",
+    "month", "quarter", "year",
+}
+
+_TYPE_ALIASES = {
+    "int": "bigint", "integer": "bigint", "bigint": "bigint",
+    "smallint": "bigint", "tinyint": "bigint", "mediumint": "bigint",
+    "bool": "bigint", "boolean": "bigint",
+    "float": "double", "double": "double", "real": "double",
+    "decimal": "decimal", "numeric": "decimal", "dec": "decimal",
+    "varchar": "varchar", "char": "varchar", "text": "varchar",
+    "tinytext": "varchar", "mediumtext": "varchar", "longtext": "varchar",
+    "blob": "varchar", "string": "varchar",
+    "date": "date", "datetime": "datetime", "timestamp": "datetime",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.pos = 0
+        self.n_params = 0
+
+    # ---- token helpers -------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        i = min(self.pos + k, len(self.toks) - 1)
+        return self.toks[i]
+
+    def next(self) -> Token:
+        t = self.toks[self.pos]
+        if t.kind != T.EOF:
+            self.pos += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == T.IDENT and t.value.lower() in kws
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        t = self.peek()
+        if t.kind == T.IDENT and t.value.lower() == kw:
+            self.next()
+            return
+        raise ParseError(f"expected {kw.upper()}, got {t.value!r}", t.line, t.col)
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == T.OP and t.value in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        t = self.peek()
+        if t.kind == T.OP and t.value == op:
+            self.next()
+            return
+        raise ParseError(f"expected {op!r}, got {t.value!r}", t.line, t.col)
+
+    def ident(self, what: str = "identifier") -> str:
+        t = self.peek()
+        if t.kind in (T.IDENT, T.QIDENT):
+            self.next()
+            return t.value
+        raise ParseError(f"expected {what}, got {t.value!r}", t.line, t.col)
+
+    # ---- entry ---------------------------------------------------------
+    def parse_statements(self) -> List[ast.Stmt]:
+        stmts = []
+        while self.peek().kind != T.EOF:
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.parse_statement())
+            if self.peek().kind != T.EOF:
+                self.expect_op(";")
+        return stmts
+
+    def parse_statement(self) -> ast.Stmt:
+        t = self.peek()
+        if t.kind != T.IDENT and not (t.kind == T.OP and t.value == "("):
+            raise ParseError(f"unexpected {t.value!r}", t.line, t.col)
+        kw = t.value.lower() if t.kind == T.IDENT else "("
+        if kw in ("select", "("):
+            return self.parse_select_or_union()
+        method = getattr(self, f"_parse_{kw}", None)
+        if method is None:
+            raise ParseError(f"unsupported statement {t.value!r}", t.line, t.col)
+        return method()
+
+    # ---- SELECT ---------------------------------------------------------
+    def parse_select_or_union(self) -> ast.Stmt:
+        first = self.parse_select_core()
+        selects = [first]
+        all_flags = []
+        while self.at_kw("union"):
+            self.next()
+            all_flags.append(self.accept_kw("all"))
+            if not self.accept_kw("distinct"):
+                pass
+            selects.append(self.parse_select_core())
+        if len(selects) == 1:
+            sel = selects[0]
+            # trailing ORDER BY / LIMIT may already be attached
+            return sel
+        # MySQL: mixed UNION/UNION ALL — distinct wins overall if any plain UNION
+        union = ast.UnionStmt(selects=selects, all=bool(all_flags) and all(all_flags))
+        # Trailing ORDER BY / LIMIT parsed into the last branch apply to the
+        # whole union (MySQL grammar).
+        last = selects[-1]
+        if last.order_by and not union.order_by:
+            union.order_by, last.order_by = last.order_by, []
+        if last.limit is not None:
+            union.limit, union.offset = last.limit, last.offset
+            last.limit, last.offset = None, 0
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            union.order_by = self.parse_order_items()
+        if self.accept_kw("limit"):
+            union.limit, union.offset = self.parse_limit_tail()
+        return union
+
+    def parse_select_core(self) -> ast.SelectStmt:
+        # allow parenthesized select
+        if self.accept_op("("):
+            sel = self.parse_select_or_union()
+            self.expect_op(")")
+            if not isinstance(sel, ast.SelectStmt):
+                raise ParseError("nested UNION in parentheses unsupported here")
+            return sel
+        self.expect_kw("select")
+        stmt = ast.SelectStmt(fields=[])
+        stmt.distinct = self.accept_kw("distinct")
+        self.accept_kw("all")
+        # fields
+        stmt.fields.append(self.parse_select_field())
+        while self.accept_op(","):
+            stmt.fields.append(self.parse_select_field())
+        if self.accept_kw("from"):
+            stmt.from_clause = self.parse_table_refs()
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            stmt.group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                stmt.group_by.append(self.parse_expr())
+        if self.accept_kw("having"):
+            stmt.having = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.parse_order_items()
+        if self.accept_kw("limit"):
+            stmt.limit, stmt.offset = self.parse_limit_tail()
+        if self.accept_kw("for"):
+            self.expect_kw("update")
+            stmt.for_update = True
+        return stmt
+
+    def parse_select_field(self) -> ast.SelectField:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectField(ast.Star())
+        # t.* / db.t.*
+        if self.peek().kind in (T.IDENT, T.QIDENT):
+            save = self.pos
+            name1 = self.ident()
+            if self.at_op("."):
+                if self.peek(1).kind == T.OP and self.peek(1).value == "*":
+                    self.next()
+                    self.next()
+                    return ast.SelectField(ast.Star(table=name1))
+            self.pos = save
+        expr = self.parse_expr()
+        alias = ""
+        if self.accept_kw("as"):
+            alias = self.ident("alias")
+        elif self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw(
+            "from", "where", "group", "having", "order", "limit", "union", "for",
+            "inner", "left", "right", "join", "cross", "on", "using", "into",
+        ):
+            alias = self.ident()
+        return ast.SelectField(expr, alias)
+
+    def parse_order_items(self) -> List[ast.OrderItem]:
+        items = [self.parse_order_item()]
+        while self.accept_op(","):
+            items.append(self.parse_order_item())
+        return items
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        return ast.OrderItem(e, desc)
+
+    def parse_limit_tail(self) -> Tuple[int, int]:
+        t = self.peek()
+        if t.kind != T.INT:
+            raise ParseError("LIMIT expects integer", t.line, t.col)
+        self.next()
+        a = int(t.value)
+        if self.accept_op(","):
+            t2 = self.next()
+            return int(t2.value), a  # LIMIT offset, count
+        if self.accept_kw("offset"):
+            t2 = self.next()
+            return a, int(t2.value)
+        return a, 0
+
+    # ---- table refs ------------------------------------------------------
+    def parse_table_refs(self):
+        left = self.parse_table_ref()
+        while True:
+            if self.accept_op(","):
+                right = self.parse_table_ref()
+                left = ast.Join("cross", left, right)
+            elif self.at_kw("join", "inner", "cross", "left", "right", "straight_join"):
+                kind = "inner"
+                if self.accept_kw("left"):
+                    kind = "left"
+                    self.accept_kw("outer")
+                elif self.accept_kw("right"):
+                    kind = "right"
+                    self.accept_kw("outer")
+                elif self.accept_kw("cross"):
+                    kind = "cross"
+                elif self.accept_kw("inner"):
+                    kind = "inner"
+                elif self.accept_kw("straight_join"):
+                    kind = "inner"
+                self.accept_kw("join")
+                right = self.parse_table_ref()
+                join = ast.Join(kind, left, right)
+                if self.accept_kw("on"):
+                    join.on = self.parse_expr()
+                elif self.accept_kw("using"):
+                    self.expect_op("(")
+                    join.using.append(self.ident())
+                    while self.accept_op(","):
+                        join.using.append(self.ident())
+                    self.expect_op(")")
+                left = join
+            else:
+                return left
+
+    def parse_table_ref(self):
+        if self.accept_op("("):
+            if self.at_kw("select"):
+                q = self.parse_select_or_union()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.ident("subquery alias")
+                return ast.SubqueryRef(q, alias)
+            refs = self.parse_table_refs()
+            self.expect_op(")")
+            return refs
+        db = ""
+        name = self.ident("table name")
+        if self.accept_op("."):
+            db, name = name, self.ident("table name")
+        alias = ""
+        if self.accept_kw("as"):
+            alias = self.ident("alias")
+        elif self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw(
+            "where", "group", "having", "order", "limit", "union", "for", "on",
+            "inner", "left", "right", "join", "cross", "using", "set", "straight_join",
+        ):
+            alias = self.ident()
+        return ast.TableName(name, db, alias)
+
+    # ---- expressions (precedence climbing) ------------------------------
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Expr:
+        left = self.parse_xor()
+        while self.at_kw("or") or self.at_op("||"):
+            self.next()
+            left = ast.BinaryOp("or", left, self.parse_xor())
+        return left
+
+    def parse_xor(self) -> ast.Expr:
+        left = self.parse_and()
+        while self.at_kw("xor"):
+            self.next()
+            left = ast.BinaryOp("xor", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> ast.Expr:
+        left = self.parse_not()
+        while self.at_kw("and") or self.at_op("&&"):
+            self.next()
+            left = ast.BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> ast.Expr:
+        if self.accept_kw("not") or self.accept_op("!"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ast.Expr:
+        left = self.parse_bitor()
+        while True:
+            if self.at_op("=", "<", ">", "<=", ">=", "<>", "!="):
+                op = self.next().value
+                if op == "<>":
+                    op = "!="
+                left = ast.BinaryOp(op, left, self.parse_bitor())
+                continue
+            if self.at_kw("is"):
+                self.next()
+                negated = self.accept_kw("not")
+                if self.accept_kw("null"):
+                    left = ast.BinaryOp("is not" if negated else "is", left,
+                                        ast.Literal(None))
+                elif self.accept_kw("true"):
+                    left = ast.BinaryOp("is not" if negated else "is", left,
+                                        ast.Literal(True))
+                elif self.accept_kw("false"):
+                    left = ast.BinaryOp("is not" if negated else "is", left,
+                                        ast.Literal(False))
+                else:
+                    t = self.peek()
+                    raise ParseError("expected NULL/TRUE/FALSE after IS", t.line, t.col)
+                continue
+            negated = False
+            save = self.pos
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    q = self.parse_select_or_union()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+                continue
+            if self.accept_kw("like"):
+                left = ast.BinaryOp("not like" if negated else "like",
+                                    left, self.parse_bitor())
+                continue
+            if self.accept_kw("between"):
+                low = self.parse_bitor()
+                self.expect_kw("and")
+                high = self.parse_bitor()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if negated:
+                self.pos = save
+            return left
+
+    def parse_bitor(self) -> ast.Expr:
+        left = self.parse_bitand()
+        while self.at_op("|"):
+            self.next()
+            left = ast.BinaryOp("|", left, self.parse_bitand())
+        return left
+
+    def parse_bitand(self) -> ast.Expr:
+        left = self.parse_shift()
+        while self.at_op("&"):
+            self.next()
+            left = ast.BinaryOp("&", left, self.parse_shift())
+        return left
+
+    def parse_shift(self) -> ast.Expr:
+        left = self.parse_additive()
+        while self.at_op("<<", ">>"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.parse_additive())
+        return left
+
+    def parse_additive(self) -> ast.Expr:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            # date + INTERVAL n unit
+            right = self.parse_multiplicative()
+            left = ast.BinaryOp(op, left, right)
+        return left
+
+    def parse_multiplicative(self) -> ast.Expr:
+        left = self.parse_bitxor()
+        while True:
+            if self.at_op("*", "/", "%"):
+                op = self.next().value
+                left = ast.BinaryOp(op, left, self.parse_bitxor())
+            elif self.at_kw("div"):
+                self.next()
+                left = ast.BinaryOp("div", left, self.parse_bitxor())
+            elif self.at_kw("mod"):
+                self.next()
+                left = ast.BinaryOp("%", left, self.parse_bitxor())
+            else:
+                return left
+
+    def parse_bitxor(self) -> ast.Expr:
+        left = self.parse_unary()
+        while self.at_op("^"):
+            self.next()
+            left = ast.BinaryOp("^", left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> ast.Expr:
+        if self.at_op("-"):
+            self.next()
+            return ast.UnaryOp("-", self.parse_unary())
+        if self.at_op("+"):
+            self.next()
+            return self.parse_unary()
+        if self.at_op("~"):
+            self.next()
+            return ast.UnaryOp("~", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == T.INT:
+            self.next()
+            return ast.Literal(int(t.value))
+        if t.kind == T.FLOAT:
+            self.next()
+            return ast.Literal(float(t.value))
+        if t.kind == T.STRING:
+            self.next()
+            return ast.Literal(t.value)
+        if t.kind == T.OP and t.value == "(":
+            self.next()
+            if self.at_kw("select"):
+                q = self.parse_select_or_union()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.parse_expr()
+            if self.at_op(","):
+                # row expression (a, b) — only supported in IN; model as FuncCall
+                items = [e]
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.FuncCall("row", items)
+            self.expect_op(")")
+            return e
+        if t.kind == T.OP and t.value == "?":
+            self.next()
+            p = ast.Param(self.n_params)
+            self.n_params += 1
+            return p
+        if t.kind == T.OP and t.value == "@":
+            self.next()
+            if self.accept_op("@"):
+                is_global = self.accept_kw("global")
+                if is_global:
+                    self.expect_op(".")
+                else:
+                    if self.accept_kw("session"):
+                        self.expect_op(".")
+                return ast.Variable(self.ident("variable"), is_global, True)
+            return ast.Variable(self.ident("variable"), False, False)
+        if t.kind == T.QIDENT:
+            return self._parse_ident_expr()
+        if t.kind == T.IDENT:
+            kw = t.value.lower()
+            if kw == "null":
+                self.next()
+                return ast.Literal(None)
+            if kw == "true":
+                self.next()
+                return ast.Literal(True)
+            if kw == "false":
+                self.next()
+                return ast.Literal(False)
+            if kw == "case":
+                return self._parse_case()
+            if kw == "cast":
+                return self._parse_cast()
+            if kw == "exists":
+                self.next()
+                self.expect_op("(")
+                q = self.parse_select_or_union()
+                self.expect_op(")")
+                return ast.Exists(q)
+            if kw == "interval":
+                self.next()
+                v = self.parse_additive()
+                unit = self.ident("interval unit").lower()
+                if unit not in _INTERVAL_UNITS:
+                    raise ParseError(f"bad interval unit {unit!r}", t.line, t.col)
+                return ast.Interval(v, unit)
+            if kw in ("date", "time", "timestamp") and self.peek(1).kind == T.STRING:
+                self.next()
+                s = self.next().value
+                return ast.Literal(s, "datetime" if kw == "timestamp" else kw)
+            if kw == "not":
+                self.next()
+                return ast.UnaryOp("not", self.parse_not())
+            if kw == "default" and not (
+                self.peek(1).kind == T.OP and self.peek(1).value == "("
+            ):
+                self.next()
+                return ast.Default()
+            return self._parse_ident_expr()
+        raise ParseError(f"unexpected token {t.value!r} in expression", t.line, t.col)
+
+    def _parse_ident_expr(self) -> ast.Expr:
+        name1 = self.ident()
+        # function call?
+        if self.at_op("(") :
+            return self._parse_func_call(name1)
+        if self.accept_op("."):
+            name2 = self.ident("column")
+            if self.accept_op("."):
+                name3 = self.ident("column")
+                return ast.ColumnRef(name3, name2, name1)
+            return ast.ColumnRef(name2, name1)
+        return ast.ColumnRef(name1)
+
+    def _parse_func_call(self, name: str) -> ast.Expr:
+        name = name.lower()
+        self.expect_op("(")
+        distinct = False
+        args: List[ast.Expr] = []
+        if self.at_op("*") and name == "count":
+            self.next()
+            self.expect_op(")")
+            return ast.FuncCall("count", [ast.Star()])
+        if self.accept_kw("distinct"):
+            distinct = True
+        if not self.at_op(")"):
+            args.append(self.parse_expr())
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+        # EXTRACT(unit FROM x) style — not supported; substring(x FROM a FOR b):
+        if name in ("substring", "substr") and self.accept_kw("from"):
+            args.append(self.parse_expr())
+            if self.accept_kw("for"):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        return ast.FuncCall(name, args, distinct)
+
+    def _parse_case(self) -> ast.Expr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        branches = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            branches.append((cond, val))
+        else_expr = None
+        if self.accept_kw("else"):
+            else_expr = self.parse_expr()
+        self.expect_kw("end")
+        return ast.CaseWhen(operand, branches, else_expr)
+
+    def _parse_cast(self) -> ast.Expr:
+        self.expect_kw("cast")
+        self.expect_op("(")
+        e = self.parse_expr()
+        self.expect_kw("as")
+        tname = self.ident("type").lower()
+        prec = scale = 0
+        if self.accept_op("("):
+            prec = int(self.next().value)
+            if self.accept_op(","):
+                scale = int(self.next().value)
+            self.expect_op(")")
+        self.expect_op(")")
+        return ast.Cast(e, tname, prec, scale)
+
+    # ---- DDL -------------------------------------------------------------
+    def _parse_create(self) -> ast.Stmt:
+        self.expect_kw("create")
+        if self.accept_kw("database", "schema"):
+            ine = self._if_not_exists()
+            return ast.CreateDatabaseStmt(self.ident("database"), ine)
+        if self.accept_kw("unique"):
+            self.expect_kw("index")
+            return self._parse_create_index(unique=True)
+        if self.accept_kw("index"):
+            return self._parse_create_index(unique=False)
+        if self.accept_kw("or"):
+            self.expect_kw("replace")
+            self.expect_kw("view")
+            return self._parse_create_view(or_replace=True)
+        if self.accept_kw("view"):
+            return self._parse_create_view(or_replace=False)
+        if self.accept_kw("user"):
+            ine = self._if_not_exists()
+            user = self._parse_user_name()
+            password = ""
+            if self.accept_kw("identified"):
+                self.expect_kw("by")
+                password = self.next().value
+            return ast.CreateUserStmt(user, password, ine)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        table = self._parse_table_name()
+        if self.at_kw("like"):
+            self.next()
+            src = self._parse_table_name()
+            return ast.CreateTableStmt(table, [], [], ine)  # LIKE: resolved in exec
+        self.expect_op("(")
+        cols: List[ast.ColumnDef] = []
+        indexes: List[ast.IndexDef] = []
+        while True:
+            if self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                names = [self.ident()]
+                while self.accept_op(","):
+                    names.append(self.ident())
+                self.expect_op(")")
+                indexes.append(ast.IndexDef("primary", names, True, True))
+            elif self.at_kw("unique"):
+                self.next()
+                self.accept_kw("key") or self.accept_kw("index")
+                idx_name = ""
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    idx_name = self.ident()
+                self.expect_op("(")
+                names = [self.ident()]
+                while self.accept_op(","):
+                    names.append(self.ident())
+                self.expect_op(")")
+                indexes.append(ast.IndexDef(idx_name or f"uk_{names[0]}", names, True))
+            elif self.at_kw("key", "index"):
+                self.next()
+                idx_name = ""
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    idx_name = self.ident()
+                self.expect_op("(")
+                names = [self.ident()]
+                while self.accept_op(","):
+                    names.append(self.ident())
+                self.expect_op(")")
+                indexes.append(ast.IndexDef(idx_name or f"idx_{names[0]}", names))
+            elif self.at_kw("foreign", "constraint", "check"):
+                # skip constraint definitions to matching depth
+                self._skip_balanced_until_comma()
+            else:
+                cols.append(self._parse_column_def())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        # swallow table options (ENGINE=..., CHARSET=..., etc.)
+        while self.peek().kind == T.IDENT and not self.at_op(";"):
+            self.next()
+            if self.accept_op("="):
+                self.next()
+        return ast.CreateTableStmt(table, cols, indexes, ine)
+
+    def _skip_balanced_until_comma(self):
+        depth = 0
+        while True:
+            t = self.peek()
+            if t.kind == T.EOF:
+                return
+            if t.kind == T.OP:
+                if t.value == "(":
+                    depth += 1
+                elif t.value == ")":
+                    if depth == 0:
+                        return
+                    depth -= 1
+                elif t.value == "," and depth == 0:
+                    return
+            self.next()
+
+    def _parse_column_def(self) -> ast.ColumnDef:
+        name = self.ident("column name")
+        tname_raw = self.ident("type").lower()
+        tname = _TYPE_ALIASES.get(tname_raw)
+        if tname is None:
+            raise ParseError(f"unsupported column type {tname_raw!r}")
+        prec = scale = 0
+        if self.accept_op("("):
+            prec = int(self.next().value)
+            if self.accept_op(","):
+                scale = int(self.next().value)
+            self.expect_op(")")
+        col = ast.ColumnDef(name, tname, prec, scale)
+        # unsigned marker folds into bigint
+        while True:
+            if self.accept_kw("unsigned", "signed", "zerofill"):
+                continue
+            if self.accept_kw("character"):
+                self.expect_kw("set")
+                self.ident()
+                continue
+            if self.accept_kw("collate"):
+                self.ident()
+                continue
+            if self.at_kw("not"):
+                self.next()
+                self.expect_kw("null")
+                col.not_null = True
+                continue
+            if self.accept_kw("null"):
+                continue
+            if self.accept_kw("default"):
+                col.default = self.parse_unary() if not self.at_kw("null") else (
+                    self.next() and ast.Literal(None)
+                )
+                continue
+            if self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                col.primary_key = True
+                col.not_null = True
+                continue
+            if self.accept_kw("unique"):
+                self.accept_kw("key")
+                col.unique = True
+                continue
+            if self.accept_kw("auto_increment"):
+                col.auto_increment = True
+                continue
+            if self.accept_kw("comment"):
+                self.next()
+                continue
+            break
+        return col
+
+    def _parse_create_index(self, unique: bool) -> ast.CreateIndexStmt:
+        name = self.ident("index name")
+        self.expect_kw("on")
+        table = self._parse_table_name()
+        self.expect_op("(")
+        cols = [self.ident()]
+        while self.accept_op(","):
+            cols.append(self.ident())
+        self.expect_op(")")
+        return ast.CreateIndexStmt(name, table, cols, unique)
+
+    def _parse_create_view(self, or_replace: bool) -> ast.CreateViewStmt:
+        name = self._parse_table_name()
+        self.expect_kw("as")
+        q = self.parse_select_or_union()
+        return ast.CreateViewStmt(name, q, or_replace)
+
+    def _parse_table_name(self) -> ast.TableName:
+        db = ""
+        name = self.ident("table name")
+        if self.accept_op("."):
+            db, name = name, self.ident("table name")
+        return ast.TableName(name, db)
+
+    def _parse_user_name(self) -> str:
+        t = self.peek()
+        if t.kind in (T.IDENT, T.QIDENT, T.STRING):
+            self.next()
+            user = t.value
+        else:
+            raise ParseError("expected user name", t.line, t.col)
+        if self.accept_op("@"):
+            t2 = self.next()
+            user = f"{user}@{t2.value}"
+        return user
+
+    def _if_not_exists(self) -> bool:
+        if self.at_kw("if"):
+            self.next()
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def _if_exists(self) -> bool:
+        if self.at_kw("if"):
+            self.next()
+            self.expect_kw("exists")
+            return True
+        return False
+
+    def _parse_drop(self) -> ast.Stmt:
+        self.expect_kw("drop")
+        if self.accept_kw("database", "schema"):
+            ie = self._if_exists()
+            return ast.DropDatabaseStmt(self.ident("database"), ie)
+        if self.accept_kw("index"):
+            name = self.ident("index name")
+            self.expect_kw("on")
+            return ast.DropIndexStmt(name, self._parse_table_name())
+        if self.accept_kw("user"):
+            ie = self._if_exists()
+            return ast.DropUserStmt(self._parse_user_name(), ie)
+        is_view = bool(self.accept_kw("view"))
+        if not is_view:
+            self.expect_kw("table")
+        ie = self._if_exists()
+        tables = [self._parse_table_name()]
+        while self.accept_op(","):
+            tables.append(self._parse_table_name())
+        return ast.DropTableStmt(tables, ie, is_view)
+
+    def _parse_truncate(self) -> ast.Stmt:
+        self.expect_kw("truncate")
+        self.accept_kw("table")
+        return ast.TruncateTableStmt(self._parse_table_name())
+
+    def _parse_rename(self) -> ast.Stmt:
+        self.expect_kw("rename")
+        self.expect_kw("table")
+        old = self._parse_table_name()
+        self.expect_kw("to")
+        return ast.RenameTableStmt(old, self._parse_table_name())
+
+    def _parse_alter(self) -> ast.Stmt:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self._parse_table_name()
+        if self.accept_kw("add"):
+            if self.accept_kw("index", "key"):
+                idx_name = ""
+                if not self.at_op("("):
+                    idx_name = self.ident()
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                return ast.AlterTableStmt(
+                    table, "add_index",
+                    index=ast.IndexDef(idx_name or f"idx_{cols[0]}", cols),
+                )
+            if self.accept_kw("unique"):
+                self.accept_kw("index") or self.accept_kw("key")
+                idx_name = ""
+                if not self.at_op("("):
+                    idx_name = self.ident()
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                return ast.AlterTableStmt(
+                    table, "add_index",
+                    index=ast.IndexDef(idx_name or f"uk_{cols[0]}", cols, True),
+                )
+            self.accept_kw("column")
+            return ast.AlterTableStmt(table, "add_column",
+                                      column=self._parse_column_def())
+        if self.accept_kw("drop"):
+            if self.accept_kw("index", "key"):
+                return ast.AlterTableStmt(table, "drop_index", name=self.ident())
+            self.accept_kw("column")
+            return ast.AlterTableStmt(table, "drop_column", name=self.ident())
+        if self.accept_kw("modify"):
+            self.accept_kw("column")
+            return ast.AlterTableStmt(table, "modify_column",
+                                      column=self._parse_column_def())
+        if self.accept_kw("rename"):
+            self.accept_kw("to") or self.accept_kw("as")
+            return ast.AlterTableStmt(table, "rename",
+                                      name=self._parse_table_name().name)
+        t = self.peek()
+        raise ParseError(f"unsupported ALTER TABLE action {t.value!r}", t.line, t.col)
+
+    # ---- DML -------------------------------------------------------------
+    def _parse_insert(self, replace: bool = False) -> ast.InsertStmt:
+        self.next()  # insert | replace
+        ignore = self.accept_kw("ignore")
+        self.accept_kw("into")
+        table = self._parse_table_name()
+        columns: List[str] = []
+        if self.accept_op("("):
+            columns.append(self.ident())
+            while self.accept_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        stmt = ast.InsertStmt(table, columns, replace=replace, ignore=ignore)
+        if self.at_kw("select"):
+            stmt.query = self.parse_select_or_union()
+        else:
+            self.expect_kw("values") if self.at_kw("values") else self.expect_kw("value")
+            while True:
+                self.expect_op("(")
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+                stmt.values.append(row)
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("on"):
+            self.expect_kw("duplicate")
+            self.expect_kw("key")
+            self.expect_kw("update")
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                stmt.on_dup_update.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+        return stmt
+
+    def _parse_replace(self) -> ast.InsertStmt:
+        return self._parse_insert(replace=True)
+
+    def _parse_update(self) -> ast.UpdateStmt:
+        self.expect_kw("update")
+        table = self._parse_table_name()
+        if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw("set"):
+            table.alias = self.ident()
+        self.expect_kw("set")
+        assignments = []
+        while True:
+            col = self.ident("column")
+            if self.accept_op("."):
+                col = self.ident("column")
+            self.expect_op("=")
+            assignments.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        stmt = ast.UpdateStmt(table, assignments)
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.parse_order_items()
+        if self.accept_kw("limit"):
+            stmt.limit, _ = self.parse_limit_tail()
+        return stmt
+
+    def _parse_delete(self) -> ast.DeleteStmt:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self._parse_table_name()
+        stmt = ast.DeleteStmt(table)
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.parse_order_items()
+        if self.accept_kw("limit"):
+            stmt.limit, _ = self.parse_limit_tail()
+        return stmt
+
+    def _parse_load(self) -> ast.LoadDataStmt:
+        self.expect_kw("load")
+        self.expect_kw("data")
+        self.accept_kw("local")
+        self.expect_kw("infile")
+        path = self.next().value
+        self.expect_kw("into")
+        self.expect_kw("table")
+        table = self._parse_table_name()
+        stmt = ast.LoadDataStmt(path, table)
+        if self.accept_kw("fields"):
+            self.expect_kw("terminated")
+            self.expect_kw("by")
+            stmt.fields_terminated = self.next().value
+        if self.accept_kw("lines"):
+            self.expect_kw("terminated")
+            self.expect_kw("by")
+            stmt.lines_terminated = self.next().value
+        if self.accept_kw("ignore"):
+            stmt.ignore_lines = int(self.next().value)
+            self.accept_kw("lines") or self.accept_kw("rows")
+        return stmt
+
+    # ---- utility statements ---------------------------------------------
+    def _parse_explain(self) -> ast.Stmt:
+        self.expect_kw("explain")
+        analyze = self.accept_kw("analyze")
+        fmt = "row"
+        if self.accept_kw("format"):
+            self.expect_op("=")
+            fmt = self.next().value.lower()
+        return ast.ExplainStmt(self.parse_statement(), analyze, fmt)
+
+    def _parse_desc(self) -> ast.Stmt:
+        self.next()
+        if self.at_kw("select", "insert", "update", "delete"):
+            return ast.ExplainStmt(self.parse_statement())
+        return ast.DescTableStmt(self._parse_table_name())
+
+    _parse_describe = _parse_desc
+
+    def _parse_trace(self) -> ast.Stmt:
+        self.expect_kw("trace")
+        return ast.TraceStmt(self.parse_statement())
+
+    def _parse_set(self) -> ast.Stmt:
+        self.expect_kw("set")
+        if self.accept_kw("password"):
+            user = ""
+            if self.accept_kw("for"):
+                user = self._parse_user_name()
+            self.expect_op("=")
+            return ast.SetPasswordStmt(user, self.next().value)
+        if self.at_kw("transaction"):
+            # SET TRANSACTION ISOLATION LEVEL ... — accept & ignore
+            while self.peek().kind != T.EOF and not self.at_op(";"):
+                self.next()
+            return ast.SetStmt([])
+        assignments = []
+        while True:
+            is_global = False
+            if self.accept_op("@"):
+                if self.accept_op("@"):
+                    if self.accept_kw("global"):
+                        self.expect_op(".")
+                        is_global = True
+                    elif self.accept_kw("session"):
+                        self.expect_op(".")
+                name = self.ident("variable")
+            else:
+                if self.accept_kw("global"):
+                    is_global = True
+                else:
+                    self.accept_kw("session")
+                if self.accept_kw("names"):
+                    self.next()  # charset name
+                    if self.peek().kind != T.EOF and not self.at_op(";", ","):
+                        pass
+                    if not self.accept_op(","):
+                        break
+                    continue
+                name = self.ident("variable")
+            if not (self.accept_op("=") or self.accept_op(":=")):
+                t = self.peek()
+                raise ParseError("expected = in SET", t.line, t.col)
+            assignments.append((name.lower(), is_global, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        return ast.SetStmt(assignments)
+
+    def _parse_show(self) -> ast.ShowStmt:
+        self.expect_kw("show")
+        full = self.accept_kw("full")
+        is_global = self.accept_kw("global")
+        self.accept_kw("session")
+        stmt = ast.ShowStmt("", is_global=is_global, full=full)
+        if self.accept_kw("databases", "schemas"):
+            stmt.kind = "databases"
+        elif self.accept_kw("tables"):
+            stmt.kind = "tables"
+            if self.accept_kw("from", "in"):
+                stmt.db = self.ident()
+        elif self.accept_kw("columns", "fields"):
+            stmt.kind = "columns"
+            self.expect_kw("from") if self.at_kw("from") else self.expect_kw("in")
+            t = self._parse_table_name()
+            stmt.target, stmt.db = t.name, t.db
+            if self.accept_kw("from", "in"):
+                stmt.db = self.ident()
+        elif self.accept_kw("index", "indexes", "keys"):
+            stmt.kind = "index"
+            self.accept_kw("from") or self.accept_kw("in")
+            t = self._parse_table_name()
+            stmt.target, stmt.db = t.name, t.db
+        elif self.accept_kw("create"):
+            if self.accept_kw("table"):
+                stmt.kind = "create_table"
+                t = self._parse_table_name()
+                stmt.target, stmt.db = t.name, t.db
+            elif self.accept_kw("database"):
+                stmt.kind = "create_database"
+                stmt.target = self.ident()
+        elif self.accept_kw("variables"):
+            stmt.kind = "variables"
+        elif self.accept_kw("status"):
+            stmt.kind = "status"
+        elif self.accept_kw("warnings"):
+            stmt.kind = "warnings"
+        elif self.accept_kw("errors"):
+            stmt.kind = "errors"
+        elif self.accept_kw("processlist"):
+            stmt.kind = "processlist"
+        elif self.accept_kw("engines"):
+            stmt.kind = "engines"
+        elif self.accept_kw("collation"):
+            stmt.kind = "collation"
+        elif self.accept_kw("charset"):
+            stmt.kind = "charset"
+        elif self.accept_kw("character"):
+            self.expect_kw("set")
+            stmt.kind = "charset"
+        elif self.accept_kw("grants"):
+            stmt.kind = "grants"
+        elif self.accept_kw("stats_meta"):
+            stmt.kind = "stats_meta"
+        elif self.accept_kw("stats_histograms"):
+            stmt.kind = "stats_histograms"
+        elif self.accept_kw("stats_buckets"):
+            stmt.kind = "stats_buckets"
+        elif self.accept_kw("stats_healthy"):
+            stmt.kind = "stats_healthy"
+        elif self.accept_kw("analyze"):
+            self.expect_kw("status")
+            stmt.kind = "analyze_status"
+        elif self.accept_kw("table"):
+            self.expect_kw("regions")
+            stmt.kind = "regions"
+            t = self._parse_table_name()
+            stmt.target, stmt.db = t.name, t.db
+        elif self.accept_kw("bindings"):
+            stmt.kind = "bindings"
+        else:
+            t = self.peek()
+            raise ParseError(f"unsupported SHOW {t.value!r}", t.line, t.col)
+        if self.accept_kw("like"):
+            stmt.like = self.next().value
+        elif self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        return stmt
+
+    def _parse_use(self) -> ast.UseStmt:
+        self.expect_kw("use")
+        return ast.UseStmt(self.ident("database"))
+
+    def _parse_begin(self) -> ast.BeginStmt:
+        self.expect_kw("begin")
+        return ast.BeginStmt()
+
+    def _parse_start(self) -> ast.BeginStmt:
+        self.expect_kw("start")
+        self.expect_kw("transaction")
+        return ast.BeginStmt()
+
+    def _parse_commit(self) -> ast.CommitStmt:
+        self.expect_kw("commit")
+        return ast.CommitStmt()
+
+    def _parse_rollback(self) -> ast.RollbackStmt:
+        self.expect_kw("rollback")
+        return ast.RollbackStmt()
+
+    def _parse_analyze(self) -> ast.AnalyzeTableStmt:
+        self.expect_kw("analyze")
+        self.expect_kw("table")
+        tables = [self._parse_table_name()]
+        while self.accept_op(","):
+            tables.append(self._parse_table_name())
+        return ast.AnalyzeTableStmt(tables)
+
+    def _parse_prepare(self) -> ast.PrepareStmt:
+        self.expect_kw("prepare")
+        name = self.ident("statement name")
+        self.expect_kw("from")
+        return ast.PrepareStmt(name, self.next().value)
+
+    def _parse_execute(self) -> ast.ExecuteStmt:
+        self.expect_kw("execute")
+        name = self.ident("statement name")
+        using = []
+        if self.accept_kw("using"):
+            self.expect_op("@")
+            using.append(self.ident())
+            while self.accept_op(","):
+                self.expect_op("@")
+                using.append(self.ident())
+        return ast.ExecuteStmt(name, using)
+
+    def _parse_deallocate(self) -> ast.DeallocateStmt:
+        self.expect_kw("deallocate")
+        self.expect_kw("prepare")
+        return ast.DeallocateStmt(self.ident())
+
+    def _parse_kill(self) -> ast.KillStmt:
+        self.expect_kw("kill")
+        query_only = self.accept_kw("query")
+        self.accept_kw("tidb") or self.accept_kw("connection")
+        t = self.next()
+        return ast.KillStmt(int(t.value), query_only)
+
+    def _parse_admin(self) -> ast.AdminStmt:
+        self.expect_kw("admin")
+        if self.accept_kw("check"):
+            self.expect_kw("table")
+            tables = [self._parse_table_name()]
+            while self.accept_op(","):
+                tables.append(self._parse_table_name())
+            return ast.AdminStmt("check_table", tables)
+        if self.accept_kw("show"):
+            if self.accept_kw("ddl"):
+                if self.accept_kw("jobs"):
+                    return ast.AdminStmt("show_ddl_jobs")
+                return ast.AdminStmt("show_ddl")
+            if self.accept_kw("slow"):
+                while self.peek().kind != T.EOF and not self.at_op(";"):
+                    self.next()
+                return ast.AdminStmt("show_slow")
+        if self.accept_kw("checksum"):
+            self.expect_kw("table")
+            tables = [self._parse_table_name()]
+            while self.accept_op(","):
+                tables.append(self._parse_table_name())
+            return ast.AdminStmt("checksum_table", tables)
+        if self.accept_kw("recover"):
+            self.expect_kw("index")
+            tables = [self._parse_table_name()]
+            self.ident("index name")
+            return ast.AdminStmt("recover_index", tables)
+        t = self.peek()
+        raise ParseError(f"unsupported ADMIN {t.value!r}", t.line, t.col)
+
+    def _parse_split(self) -> ast.SplitRegionStmt:
+        self.expect_kw("split")
+        self.expect_kw("table")
+        table = self._parse_table_name()
+        num = 0
+        if self.accept_kw("between"):
+            # SPLIT TABLE t BETWEEN (a) AND (b) REGIONS n
+            self._skip_balanced_until_comma()
+            if self.accept_kw("and"):
+                self._skip_balanced_until_comma()
+            if self.accept_kw("regions"):
+                num = int(self.next().value)
+        elif self.accept_kw("regions"):
+            num = int(self.next().value)
+        return ast.SplitRegionStmt(table, num)
+
+    def _parse_grant(self) -> ast.GrantStmt:
+        self.expect_kw("grant")
+        privs = [self.ident().lower()]
+        while self.accept_op(","):
+            privs.append(self.ident().lower())
+        self.expect_kw("on")
+        level = ""
+        while not self.at_kw("to"):
+            level += self.next().value
+        self.expect_kw("to")
+        return ast.GrantStmt(privs, level, self._parse_user_name())
+
+    def _parse_revoke(self) -> ast.RevokeStmt:
+        self.expect_kw("revoke")
+        privs = [self.ident().lower()]
+        while self.accept_op(","):
+            privs.append(self.ident().lower())
+        self.expect_kw("on")
+        level = ""
+        while not self.at_kw("from"):
+            level += self.next().value
+        self.expect_kw("from")
+        return ast.RevokeStmt(privs, level, self._parse_user_name())
+
+    def _parse_flush(self) -> ast.FlushStmt:
+        self.expect_kw("flush")
+        what = self.ident("flush target").lower()
+        return ast.FlushStmt(what)
+
+
+def parse(sql: str) -> List[ast.Stmt]:
+    return Parser(sql).parse_statements()
+
+
+def parse_one(sql: str) -> ast.Stmt:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
