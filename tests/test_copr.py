@@ -1,0 +1,261 @@
+"""Coprocessor engine tests: CPU oracle vs JAX device engine result parity.
+
+This is the framework's north-star test pattern (SURVEY.md §4 carry-over):
+the same DAG runs on both engines and must produce identical result sets.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import concat_chunks
+from tidb_tpu.copr.aggstate import merge_partials_to_final
+from tidb_tpu.copr.ir import (
+    DAG,
+    AggregationIR,
+    LimitIR,
+    ProjectionIR,
+    SelectionIR,
+    TableScanIR,
+    TopNIR,
+)
+from tidb_tpu.expr import ColumnExpr, Constant, ScalarFunc
+from tidb_tpu.expr.aggregation import AggDesc
+from tidb_tpu.expr.builtins import infer_ftype
+from tidb_tpu.store import BlockStorage, CopRequest, KeyRange
+from tidb_tpu.types import (
+    parse_date,
+    ty_date,
+    ty_decimal,
+    ty_float,
+    ty_int,
+    ty_string,
+)
+
+N = 5000
+
+
+@pytest.fixture(scope="module")
+def storage():
+    st = BlockStorage()
+    t = st.create_table(
+        1,
+        [
+            ("k", ty_int(False)),
+            ("qty", ty_decimal(15, 2)),
+            ("price", ty_decimal(15, 2)),
+            ("disc", ty_float()),
+            ("ship", ty_date()),
+            ("flag", ty_string()),
+        ],
+    )
+    rng = np.random.default_rng(7)
+    k = np.arange(N, dtype=np.int64)
+    qty = rng.integers(100, 5000, N)  # 1.00 .. 50.00
+    price = rng.integers(10000, 100000, N)
+    disc = np.round(rng.random(N) * 0.1, 2)
+    ship = parse_date("1994-01-01") + rng.integers(0, 2000, N).astype(np.int32)
+    flag = np.array([["A", "N", "R"][i] for i in rng.integers(0, 3, N)], dtype=object)
+    # sprinkle NULLs in disc
+    disc_valid = rng.random(N) > 0.05
+    t.bulk_load_arrays([k, qty, price, disc, ship, flag],
+                       [None, None, None, disc_valid, None, None], ts=0)
+    st.regions.split_even(1, 3, N)
+    return st
+
+
+def scan_ir():
+    return TableScanIR(
+        1, [0, 1, 2, 3, 4, 5],
+        [ty_int(False), ty_decimal(15, 2), ty_decimal(15, 2), ty_float(),
+         ty_date(), ty_string()],
+    )
+
+
+def col(i, ft):
+    return ColumnExpr(i, ft)
+
+
+def fn(name, *args, meta=None):
+    meta = meta or {}
+    ft = infer_ftype(name, [a.ftype for a in args], meta)
+    return ScalarFunc(name, list(args), ft, meta)
+
+
+def run_both(storage, dag: DAG, n_keys=None, aggs=None):
+    """Run via the pushdown boundary on both engines; return row sets."""
+    results = {}
+    for engine in ("cpu", "tpu"):
+        req = CopRequest(
+            dag=dag.to_dict(), ranges=[KeyRange(1, 0, 1 << 62)],
+            ts=storage.current_ts(), engine=engine,
+        )
+        chunks = []
+        for resp in storage.get_client().send(req):
+            chunks.extend(resp.chunks)
+        if aggs is not None:
+            final = merge_partials_to_final(n_keys, aggs, chunks)
+            rows = final.to_pylist() if final is not None else []
+        else:
+            whole = concat_chunks(chunks)
+            # root-side merge of per-region partial TopN/Limit results
+            tail = dag.executors[-1]
+            if whole is not None and isinstance(tail, TopNIR):
+                from tidb_tpu.copr.cpu_engine import run_topn
+
+                whole = run_topn(tail.order_by, tail.limit, whole)
+            elif whole is not None and isinstance(tail, LimitIR):
+                whole = whole.slice(0, min(tail.limit, whole.num_rows))
+            rows = whole.to_pylist() if whole else []
+        results[engine] = rows
+    return results["cpu"], results["tpu"]
+
+
+def test_filter_parity(storage):
+    # WHERE qty < 24.00 AND disc BETWEEN 0.05 AND 0.07  (Q6 shape)
+    conds = [
+        fn("<", col(1, ty_decimal(15, 2)), Constant(2400, ty_decimal(15, 2))),
+        fn(">=", col(3, ty_float()), Constant(0.05, ty_float())),
+        fn("<=", col(3, ty_float()), Constant(0.07, ty_float())),
+    ]
+    dag = DAG([scan_ir(), SelectionIR(conds)])
+    cpu, tpu = run_both(storage, dag)
+    assert len(cpu) > 0
+    assert sorted(cpu) == sorted(tpu)
+
+
+def test_filter_on_dict_string(storage):
+    conds = [fn("=", col(5, ty_string()), Constant("R", ty_string()))]
+    dag = DAG([scan_ir(), SelectionIR(conds)])
+    cpu, tpu = run_both(storage, dag)
+    assert len(cpu) > 0 and sorted(cpu) == sorted(tpu)
+    # range predicate over sorted dictionary
+    conds2 = [fn(">=", col(5, ty_string()), Constant("N", ty_string()))]
+    dag2 = DAG([scan_ir(), SelectionIR(conds2)])
+    cpu2, tpu2 = run_both(storage, dag2)
+    assert sorted(cpu2) == sorted(tpu2)
+    assert all(r[5] in ("N", "R") for r in cpu2)
+
+
+def test_projection_parity(storage):
+    # SELECT price * (1 - disc) ... the Q1 revenue expression
+    one = Constant(1.0, ty_float())
+    rev = fn("*", col(2, ty_decimal(15, 2)), fn("-", one, col(3, ty_float())))
+    dag = DAG([scan_ir(),
+               SelectionIR([fn("<", col(0, ty_int(False)), Constant(1000, ty_int()))]),
+               ProjectionIR([col(0, ty_int(False)), rev])])
+    cpu, tpu = run_both(storage, dag)
+    assert len(cpu) == 1000
+    for (ka, va), (kb, vb) in zip(sorted(cpu), sorted(tpu)):
+        assert ka == kb
+        if va is None:
+            assert vb is None
+        else:
+            assert va == pytest.approx(vb, rel=1e-12)
+
+
+def test_scalar_agg_parity(storage):
+    aggs = [
+        AggDesc("count", []),
+        AggDesc("sum", [col(2, ty_decimal(15, 2))]),
+        AggDesc("avg", [col(1, ty_decimal(15, 2))]),
+        AggDesc("min", [col(4, ty_date())]),
+        AggDesc("max", [col(4, ty_date())]),
+        AggDesc("sum", [col(3, ty_float())]),
+    ]
+    dag = DAG([scan_ir(), AggregationIR([], aggs, mode="partial")])
+    cpu, tpu = run_both(storage, dag, n_keys=0, aggs=aggs)
+    assert len(cpu) == 1 and len(tpu) == 1
+    for a, b in zip(cpu[0], tpu[0]):
+        if isinstance(a, float):
+            assert a == pytest.approx(b, rel=1e-9)
+        else:
+            assert a == b
+
+
+def test_group_agg_parity(storage):
+    # GROUP BY flag (dict string) — Q1 shape
+    aggs = [
+        AggDesc("count", []),
+        AggDesc("sum", [col(1, ty_decimal(15, 2))]),
+        AggDesc("avg", [col(3, ty_float())]),
+        AggDesc("min", [col(2, ty_decimal(15, 2))]),
+        AggDesc("max", [col(5, ty_string())]),
+        AggDesc("first_row", [col(5, ty_string())]),
+    ]
+    gb = [col(5, ty_string())]
+    dag = DAG([scan_ir(), AggregationIR(gb, aggs, mode="partial")])
+    cpu, tpu = run_both(storage, dag, n_keys=1, aggs=aggs)
+    assert len(cpu) == 3
+    key = lambda r: r[0]
+    for a, b in zip(sorted(cpu, key=key), sorted(tpu, key=key)):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert x == pytest.approx(y, rel=1e-9)
+            else:
+                assert x == y
+
+
+def test_group_by_int_key_with_filter(storage):
+    # GROUP BY year(ship)? — not a bare column; use int key k % small via
+    # group on date column year range instead: group by ship (int32 date,
+    # card ~2000) with a filter
+    aggs = [AggDesc("count", []), AggDesc("sum", [col(2, ty_decimal(15, 2))])]
+    gb = [col(4, ty_date())]
+    conds = [fn("<", col(0, ty_int(False)), Constant(500, ty_int()))]
+    dag = DAG([scan_ir(), SelectionIR(conds),
+               AggregationIR(gb, aggs, mode="partial")])
+    cpu, tpu = run_both(storage, dag, n_keys=1, aggs=aggs)
+    assert sorted(cpu) == sorted(tpu)
+    assert sum(r[1] for r in cpu) == 500
+
+
+def test_topn_parity(storage):
+    dag = DAG([
+        scan_ir(),
+        SelectionIR([fn("=", col(5, ty_string()), Constant("A", ty_string()))]),
+        TopNIR([(col(2, ty_decimal(15, 2)), True)], 7),
+    ])
+    cpu, tpu = run_both(storage, dag)
+    assert len(cpu) == 7 and len(tpu) == 7
+    # same price ordering (ties may reorder other cols; compare sort keys)
+    assert [r[2] for r in cpu] == [r[2] for r in tpu]
+
+
+def test_limit(storage):
+    dag = DAG([scan_ir(), LimitIR(13)])
+    cpu, tpu = run_both(storage, dag)
+    assert len(cpu) == 13 and len(tpu) == 13
+
+
+def test_region_error_retry(storage):
+    from tidb_tpu.errors import RegionError
+    from tidb_tpu.store.fault import FAILPOINTS, once
+
+    FAILPOINTS.enable("copr/region_error", once(RegionError("injected")))
+    try:
+        dag = DAG([scan_ir(), LimitIR(5)])
+        req = CopRequest(dag=dag.to_dict(), ranges=[KeyRange(1, 0, 100)],
+                         ts=storage.current_ts(), engine="cpu")
+        chunks = []
+        for resp in storage.get_client().send(req):
+            chunks.extend(resp.chunks)
+        assert concat_chunks(chunks).num_rows == 5
+    finally:
+        FAILPOINTS.clear()
+
+
+def test_delta_overlay_included(storage):
+    # runs last: mutates the module-scoped fixture's data
+    txn = storage.begin()
+    t = storage.table(1)
+    h = t.alloc_handle()
+    txn.put(1, h, (999999, 100, 100, 0.5, parse_date("2001-01-01"), "Z"))
+    txn.delete(1, 0)
+    txn.commit()
+    conds = [fn(">=", col(0, ty_int(False)), Constant(0, ty_int()))]
+    dag = DAG([scan_ir(), SelectionIR(conds)])
+    cpu, tpu = run_both(storage, dag)
+    assert sorted(cpu) == sorted(tpu)
+    keys = {r[0] for r in cpu}
+    assert 999999 in keys  # delta insert visible
+    assert len([r for r in cpu if r[0] == 0]) == 0  # base row 0 deleted

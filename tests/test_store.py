@@ -1,0 +1,205 @@
+"""Storage layer tests: blocks, dictionaries, MVCC/2PC, regions, faults.
+
+Reference model: store/mockstore tests + store/tikv 2pc/lock-resolver tests.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import LockedError, RegionError, TxnConflictError
+from tidb_tpu.store import BlockStorage, KeyRange
+from tidb_tpu.store.fault import FAILPOINTS, once
+from tidb_tpu.store.txn import resolve_lock
+from tidb_tpu.types import ty_float, ty_int, ty_string
+
+
+@pytest.fixture
+def storage():
+    FAILPOINTS.clear()
+    return BlockStorage(n_stores=4)
+
+
+def make_table(storage, tid=1, n=100):
+    ts = storage.create_table(tid, [("a", ty_int()), ("b", ty_float()), ("s", ty_string())])
+    a = np.arange(n, dtype=np.int64)
+    b = np.arange(n, dtype=np.float64) * 0.5
+    s = np.array([f"v{i % 10}" for i in range(n)], dtype=object)
+    ts.bulk_load_arrays([a, b, s], ts=0)
+    return ts
+
+
+def test_bulk_load_and_read(storage):
+    t = make_table(storage)
+    chunk = t.base_chunk([0, 1, 2], 0, 5)
+    assert chunk.to_pylist()[0] == (0, 0.0, "v0")
+    assert chunk.to_pylist()[4] == (4, 2.0, "v4")
+    assert t.base_rows == 100
+
+
+def test_dictionary_sorted_and_merge(storage):
+    t = storage.create_table(9, [("s", ty_string())])
+    t.bulk_load_arrays([np.array(["b", "a", "c"], dtype=object)])
+    assert t.cols[0].dictionary == ["a", "b", "c"]
+    # codes in block must be sorted-dictionary codes
+    blk = t._blocks[0][0]
+    assert blk.tolist() == [1, 0, 2]
+    # second load with new values triggers remap
+    t.bulk_load_arrays([np.array(["aa", "z"], dtype=object)])
+    assert t.cols[0].dictionary == ["a", "aa", "b", "c", "z"]
+    chunk = t.base_chunk([0], 0, 5)
+    assert [r[0] for r in chunk.to_pylist()] == ["b", "a", "c", "aa", "z"]
+
+
+def test_column_stats(storage):
+    t = make_table(storage)
+    lo, hi, has_null = t.column_stats(0)
+    assert (lo, hi, has_null) == (0, 99, False)
+    lo, hi, _ = t.column_stats(2)  # dict column: code range
+    assert (lo, hi) == (0, 9)
+
+
+def test_txn_commit_visibility(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.put(1, t.alloc_handle(), (100, 50.0, "new"))
+    ts_before = storage.current_ts()
+    commit_ts = txn.commit()
+    assert commit_ts > txn.start_ts
+    # invisible before commit_ts, visible after
+    _, ins_before = t.delta_overlay(ts_before, 0, 1 << 62)
+    assert ins_before == {}
+    _, ins_after = t.delta_overlay(storage.current_ts(), 0, 1 << 62)
+    assert list(ins_after.values()) == [(100, 50.0, "new")]
+
+
+def test_txn_update_delete_overlay(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.put(1, 5, (5, 99.0, "upd"))  # update base row 5
+    txn.delete(1, 7)
+    txn.commit()
+    ts = storage.current_ts()
+    deleted, inserted = t.delta_overlay(ts, 0, 1 << 62)
+    assert sorted(deleted) == [5, 7]
+    assert inserted[5] == (5, 99.0, "upd")
+    assert t.read_row(7, ts) is None
+    assert t.read_row(5, ts) == (5, 99.0, "upd")
+    assert t.read_row(3, ts) == (3, 1.5, "v3")
+
+
+def test_write_conflict(storage):
+    t = make_table(storage)
+    t1 = storage.begin()
+    t2 = storage.begin()
+    t1.put(1, 3, (3, 0.0, "t1"))
+    t2.put(1, 3, (3, 0.0, "t2"))
+    t1.commit()
+    with pytest.raises((TxnConflictError, LockedError)):
+        t2.commit()
+
+
+def test_lock_blocks_reader_until_resolved(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.put(1, 3, (3, 0.0, "locked"))
+    # simulate prewrite done but commit hanging
+    keys = sorted(txn.buffer.keys())
+    primary = keys[0]
+    for tid, h in keys:
+        storage.table(tid).prewrite(h, "put", txn.buffer[(tid, h)].values,
+                                    primary, txn.start_ts, ttl_ms=0)
+    read_ts = storage.current_ts()
+    with pytest.raises(LockedError):
+        t.read_row(3, read_ts)
+    # resolver rolls the orphan txn back (primary lock still present, expired)
+    resolve_lock(storage, 1, 3)
+    assert t.read_row(3, read_ts) == (3, 1.5, "v3")
+
+
+def test_resolve_lock_rolls_forward_after_primary_commit(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    h_new = t.alloc_handle()
+    txn.put(1, 3, (3, 0.0, "A"))
+    txn.put(1, h_new, (200, 1.0, "B"))
+    keys = sorted(txn.buffer.keys())
+    primary = keys[0]
+    for tid, h in keys:
+        storage.table(tid).prewrite(h, "put", txn.buffer[(tid, h)].values,
+                                    primary, txn.start_ts, ttl_ms=0)
+    commit_ts = storage.oracle.get_timestamp()
+    t.commit(primary[1], txn.start_ts, commit_ts)  # primary committed only
+    # secondary has an orphan lock; resolver must roll it FORWARD
+    resolve_lock(storage, 1, keys[1][1])
+    ts = storage.current_ts()
+    assert t.read_row(keys[1][1], ts) is not None
+
+
+def test_rollback(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.put(1, 3, (3, 0.0, "x"))
+    txn.rollback()
+    assert t.read_row(3, storage.current_ts()) == (3, 1.5, "v3")
+
+
+def test_compact_folds_delta(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.delete(1, 0)
+    txn.put(1, 50, (50, -1.0, "upd"))
+    txn.put(1, t.alloc_handle(), (500, 5.0, "ins"))
+    txn.commit()
+    ts = storage.current_ts()
+    t.compact(ts)
+    assert t.delta == {}
+    assert t.base_rows == 100  # 100 - 1 deleted + 1 inserted
+    rows = t.base_chunk([0, 1, 2], 0, t.base_rows).to_pylist()
+    assert (500, 5.0, "ins") in rows
+    assert (0, 0.0, "v0") not in rows
+    assert (50, -1.0, "upd") in rows
+
+
+def test_regions_split_locate(storage):
+    make_table(storage)
+    storage.regions.split_even(1, 4, 100)
+    regions = storage.regions.regions_of(1)
+    assert len(regions) == 4
+    assert [r.start for r in regions] == [0, 25, 50, 75]
+    located = storage.regions.locate(KeyRange(1, 30, 80))
+    assert [(r.start, c.start, c.end) for r, c in located] == [
+        (25, 30, 50), (50, 50, 75), (75, 75, 80),
+    ]
+
+
+def test_region_epoch_error(storage):
+    make_table(storage)
+    r0 = storage.regions.regions_of(1)[0]
+    storage.regions.split_at(1, [50])
+    with pytest.raises(RegionError):
+        storage.regions.check_epoch(r0.region_id, r0.epoch, 1)
+
+
+def test_gc_drops_old_versions(storage):
+    t = make_table(storage)
+    for i in range(3):
+        txn = storage.begin()
+        txn.put(1, 5, (5, float(i), f"g{i}"))
+        txn.commit()
+    assert len(t.delta[5]) == 3
+    safepoint = storage.current_ts()
+    t.gc(safepoint)
+    assert len(t.delta[5]) == 1
+    assert t.read_row(5, storage.current_ts())[2] == "g2"
+
+
+def test_2pc_failpoint_prewrite_conflict(storage):
+    t = make_table(storage)
+    txn = storage.begin()
+    txn.put(1, 3, (3, 0.0, "x"))
+    FAILPOINTS.enable("2pc/prewrite", once(TxnConflictError((1, 3))))
+    with pytest.raises(TxnConflictError):
+        txn.commit()
+    # locks must have been cleaned up
+    assert t.locks == {}
+    FAILPOINTS.clear()

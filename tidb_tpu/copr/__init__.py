@@ -1,0 +1,27 @@
+from .ir import (
+    DAG,
+    TableScanIR,
+    SelectionIR,
+    AggregationIR,
+    TopNIR,
+    LimitIR,
+    ProjectionIR,
+    serialize_expr,
+    deserialize_expr,
+    serialize_ftype,
+    deserialize_ftype,
+)
+
+__all__ = [
+    "DAG",
+    "TableScanIR",
+    "SelectionIR",
+    "AggregationIR",
+    "TopNIR",
+    "LimitIR",
+    "ProjectionIR",
+    "serialize_expr",
+    "deserialize_expr",
+    "serialize_ftype",
+    "deserialize_ftype",
+]

@@ -1,0 +1,131 @@
+"""CPU coprocessor engine: the DAG interpreter over host chunks.
+
+Two roles (SURVEY.md §7): the correctness oracle the jax engine is diffed
+against, and the real execution path for delta rows / non-pushable regions —
+the moral successor of mocktikv's row-based DAG interpreter
+(mocktikv/cop_handler_dag.go:56-177), but columnar/vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..chunk import Chunk, Column
+from ..errors import ExecutorError
+from ..expr.expression import eval_bool_mask
+from ..expr.vec import Vec
+from . import aggstate
+from .ir import (
+    DAG,
+    AggregationIR,
+    LimitIR,
+    ProjectionIR,
+    SelectionIR,
+    TableScanIR,
+    TopNIR,
+)
+
+
+def run_dag_on_chunk(dag: DAG, chunk: Chunk) -> Chunk:
+    """Interpret the post-scan part of `dag` over one scan-output chunk."""
+    for ex in dag.executors[1:]:
+        if isinstance(ex, SelectionIR):
+            mask = eval_bool_mask(ex.conditions, chunk)
+            chunk = chunk.filter(mask)
+        elif isinstance(ex, ProjectionIR):
+            chunk = Chunk([e.eval(chunk).to_column() for e in ex.exprs])
+        elif isinstance(ex, AggregationIR):
+            chunk = _run_agg(ex, chunk)
+        elif isinstance(ex, TopNIR):
+            chunk = run_topn(ex.order_by, ex.limit, chunk)
+        elif isinstance(ex, LimitIR):
+            chunk = chunk.slice(0, min(ex.limit, chunk.num_rows))
+        else:
+            raise ExecutorError(f"cpu engine: unknown executor {ex!r}")
+    return chunk
+
+
+def _run_agg(agg_ir: AggregationIR, chunk: Chunk) -> Chunk:
+    gcols = [g.eval(chunk).to_column() for g in agg_ir.group_by]
+    if gcols:
+        gidx, keys, G = aggstate.group_indices(gcols)
+    else:
+        # scalar aggregation: one group, one output row
+        gidx, keys, G = np.zeros(chunk.num_rows, dtype=np.int64), [()], 1
+    out_cols: List[Column] = []
+    # group-key output columns (one row per group)
+    for ci, g in enumerate(agg_ir.group_by):
+        vals = [k[ci] for k in keys]
+        out_cols.append(Column.from_values(g.ftype, vals))
+    for a in agg_ir.aggs:
+        if a.distinct:
+            cols = _distinct_states(a, chunk, gidx, G)
+        else:
+            arg_vecs = [x.eval(chunk) for x in a.args]
+            cols = aggstate.partial_states(a, arg_vecs, gidx, G)
+        if agg_ir.mode == "complete":
+            out_cols.append(aggstate.finalize(a, cols))
+        else:
+            out_cols.extend(cols)
+    return Chunk(out_cols)
+
+
+def _distinct_states(a, chunk: Chunk, gidx: np.ndarray, G: int):
+    """COUNT/SUM/AVG(DISTINCT x): dedup (group, value) pairs first."""
+    arg_vecs = [x.eval(chunk) for x in a.args]
+    n = chunk.num_rows
+    seen = set()
+    keep = np.zeros(n, dtype=np.bool_)
+    cols = [v.to_column() for v in arg_vecs]
+    for i in range(n):
+        key = (int(gidx[i]),) + tuple(c.get(i) for c in cols)
+        if key not in seen:
+            seen.add(key)
+            keep[i] = True
+    sub_vecs = [Vec.from_column(c.filter(keep)) for c in cols]
+    return aggstate.partial_states(a, sub_vecs, gidx[keep], G)
+
+
+def run_topn(order_by, limit: int, chunk: Chunk) -> Chunk:
+    """Stable multi-key sort + head(limit).  NULLs sort first ascending
+    (MySQL semantics), last descending."""
+    if chunk.num_rows == 0 or limit == 0:
+        return chunk.slice(0, 0)
+    idx = sort_indices(order_by, chunk)
+    return chunk.take(idx[: limit if limit >= 0 else len(idx)])
+
+
+def sort_indices(order_by, chunk: Chunk) -> np.ndarray:
+    n = chunk.num_rows
+    keys = []  # np.lexsort takes last key as primary -> reverse order
+    for e, desc in reversed(list(order_by)):
+        v = e.eval(chunk)
+        data = v.data
+        if data.dtype == object:
+            # strings: rank via sorted unique values
+            uniq = sorted(set(str(x) for x in data))
+            rank = {s: i for i, s in enumerate(uniq)}
+            data = np.fromiter(
+                (rank[str(x)] for x in data), dtype=np.int64, count=n
+            )
+        else:
+            data = data.astype(np.float64) if data.dtype == np.float64 else data
+        valid = v.validity()
+        if desc:
+            if data.dtype == np.float64:
+                key = np.where(valid, -data, np.inf)
+            else:
+                key = np.where(valid, -data.astype(np.int64), np.iinfo(np.int64).max)
+        else:
+            if data.dtype == np.float64:
+                key = np.where(valid, data, -np.inf)
+            else:
+                key = np.where(
+                    valid, data.astype(np.int64), np.iinfo(np.int64).min
+                )
+        keys.append(key)
+    if not keys:
+        return np.arange(n)
+    return np.lexsort(keys)

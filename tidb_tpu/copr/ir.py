@@ -1,0 +1,247 @@
+"""Coprocessor DAG IR — the device-side query fragment format.
+
+Reference: tipb.DAGRequest built by planner/core/plan_to_pb.go:36-128 and
+interpreted by mocktikv/cop_handler_dag.go:151-188.  Same executor set
+(TableScan, Selection, Aggregation partial, TopN, Limit — Appendix A of
+SURVEY.md) plus an explicit Projection (the device wants projected numeric
+outputs).  JSON-serializable dicts are the wire format (the analog of the
+protobufs): the distsql layer ships them to region executors, multi-host
+ships them over DCN.
+
+Column references inside IR expressions are indices into the *scan output*
+(position in TableScanIR.columns), exactly like tipb column offsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..errors import PlanError
+from ..expr.aggregation import AggDesc
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..types import FieldType, TypeKind
+
+
+# ---- FieldType codec -------------------------------------------------------
+
+
+def serialize_ftype(ft: FieldType) -> list:
+    return [int(ft.kind), bool(ft.nullable), ft.precision, ft.scale]
+
+
+def deserialize_ftype(v: list) -> FieldType:
+    return FieldType(TypeKind(v[0]), v[1], v[2], v[3])
+
+
+# ---- Expression codec ------------------------------------------------------
+
+
+def serialize_expr(e: Expression) -> dict:
+    if isinstance(e, ColumnExpr):
+        return {"t": "col", "i": e.index, "ft": serialize_ftype(e.ftype)}
+    if isinstance(e, Constant):
+        return {"t": "const", "v": e.value, "ft": serialize_ftype(e.ftype)}
+    if isinstance(e, ScalarFunc):
+        meta = {}
+        for k, v in e.meta.items():
+            meta[k] = serialize_ftype(v) if isinstance(v, FieldType) else v
+        return {
+            "t": "func",
+            "name": e.name,
+            "args": [serialize_expr(a) for a in e.args],
+            "ft": serialize_ftype(e.ftype),
+            "meta": meta,
+        }
+    raise PlanError(f"cannot serialize expression {e!r}")
+
+
+def deserialize_expr(d: dict) -> Expression:
+    t = d["t"]
+    if t == "col":
+        return ColumnExpr(d["i"], deserialize_ftype(d["ft"]))
+    if t == "const":
+        ft = deserialize_ftype(d["ft"])
+        return Constant(d["v"], ft)
+    if t == "func":
+        meta = {}
+        for k, v in d.get("meta", {}).items():
+            meta[k] = (
+                deserialize_ftype(v)
+                if k in ("target",) and isinstance(v, list)
+                else v
+            )
+        return ScalarFunc(
+            d["name"],
+            [deserialize_expr(a) for a in d["args"]],
+            deserialize_ftype(d["ft"]),
+            meta,
+        )
+    raise PlanError(f"bad expr tag {t!r}")
+
+
+# ---- Executor IR nodes -----------------------------------------------------
+
+
+@dataclass
+class TableScanIR:
+    table_id: int
+    columns: List[int]  # store column indices, in output order
+    ftypes: List[FieldType]
+
+    def to_dict(self):
+        return {
+            "type": "table_scan",
+            "table_id": self.table_id,
+            "columns": self.columns,
+            "ftypes": [serialize_ftype(f) for f in self.ftypes],
+        }
+
+
+@dataclass
+class SelectionIR:
+    conditions: List[Expression]
+
+    def to_dict(self):
+        return {
+            "type": "selection",
+            "conditions": [serialize_expr(c) for c in self.conditions],
+        }
+
+
+@dataclass
+class ProjectionIR:
+    exprs: List[Expression]
+
+    def to_dict(self):
+        return {"type": "projection",
+                "exprs": [serialize_expr(e) for e in self.exprs]}
+
+
+@dataclass
+class AggregationIR:
+    group_by: List[Expression]
+    aggs: List[AggDesc]
+    # 'partial': emit per-shard partial states; 'complete': final values
+    mode: str = "partial"
+    stream: bool = False  # StreamAgg: input sorted by group keys
+
+    def to_dict(self):
+        return {
+            "type": "aggregation",
+            "group_by": [serialize_expr(g) for g in self.group_by],
+            "aggs": [
+                {
+                    "name": a.name,
+                    "args": [serialize_expr(x) for x in a.args],
+                    "distinct": a.distinct,
+                    "ft": serialize_ftype(a.ftype),
+                }
+                for a in self.aggs
+            ],
+            "mode": self.mode,
+            "stream": self.stream,
+        }
+
+
+@dataclass
+class TopNIR:
+    order_by: List[Tuple[Expression, bool]]  # (expr, desc)
+    limit: int
+
+    def to_dict(self):
+        return {
+            "type": "topn",
+            "order_by": [[serialize_expr(e), d] for e, d in self.order_by],
+            "limit": self.limit,
+        }
+
+
+@dataclass
+class LimitIR:
+    limit: int
+
+    def to_dict(self):
+        return {"type": "limit", "limit": self.limit}
+
+
+@dataclass
+class DAG:
+    """Linear executor chain: executors[0] is always a TableScanIR."""
+
+    executors: List
+
+    def to_dict(self) -> dict:
+        return {"executors": [e.to_dict() for e in self.executors]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "DAG":
+        out = []
+        for ed in d["executors"]:
+            t = ed["type"]
+            if t == "table_scan":
+                out.append(
+                    TableScanIR(
+                        ed["table_id"],
+                        list(ed["columns"]),
+                        [deserialize_ftype(f) for f in ed["ftypes"]],
+                    )
+                )
+            elif t == "selection":
+                out.append(
+                    SelectionIR([deserialize_expr(c) for c in ed["conditions"]])
+                )
+            elif t == "projection":
+                out.append(
+                    ProjectionIR([deserialize_expr(e) for e in ed["exprs"]])
+                )
+            elif t == "aggregation":
+                aggs = [
+                    AggDesc(
+                        a["name"],
+                        [deserialize_expr(x) for x in a["args"]],
+                        a["distinct"],
+                        deserialize_ftype(a["ft"]),
+                    )
+                    for a in ed["aggs"]
+                ]
+                out.append(
+                    AggregationIR(
+                        [deserialize_expr(g) for g in ed["group_by"]],
+                        aggs,
+                        ed.get("mode", "partial"),
+                        ed.get("stream", False),
+                    )
+                )
+            elif t == "topn":
+                out.append(
+                    TopNIR(
+                        [(deserialize_expr(e), d2) for e, d2 in ed["order_by"]],
+                        ed["limit"],
+                    )
+                )
+            elif t == "limit":
+                out.append(LimitIR(ed["limit"]))
+            else:
+                raise PlanError(f"unknown cop executor {t!r}")
+        return DAG(out)
+
+    @property
+    def scan(self) -> TableScanIR:
+        return self.executors[0]
+
+    def output_ftypes(self) -> List[FieldType]:
+        """Field types of the chunks this DAG emits (partial-agg aware)."""
+        fts = list(self.scan.ftypes)
+        for ex in self.executors[1:]:
+            if isinstance(ex, ProjectionIR):
+                fts = [e.ftype for e in ex.exprs]
+            elif isinstance(ex, AggregationIR):
+                out = [g.ftype for g in ex.group_by]
+                if ex.mode == "partial":
+                    for a in ex.aggs:
+                        out.extend(a.partial_types())
+                else:
+                    out.extend(a.ftype for a in ex.aggs)
+                fts = out
+        return fts

@@ -1,0 +1,736 @@
+"""JAX coprocessor engine: executes DAG fragments on the device.
+
+This is the component that replaces TiKV's native coprocessor (SURVEY.md
+header: "the thing we must build natively is the coprocessor execution
+engine itself").  Design:
+
+- Base rows stream through in fixed TILE-row batches (padding + row masks),
+  so every tile runs the *same* jitted XLA program — no dynamic shapes.
+- Tiles of immutable base blocks are cached on device keyed by
+  (table, base_version, column), so repeated scans never re-transfer over
+  PCIe/DCN (the block-cache role of TiKV's RocksDB cache).
+- Selection compiles the whole predicate tree into one fused elementwise
+  program (jax_eval); aggregation lowers to dense segment reductions over
+  mixed-radix group codes (ops/segment.py); TopN lowers to lax.top_k.
+- Anything non-compilable raises JaxUnsupported and the caller falls back
+  to the CPU engine — planner pushdown gating means this is rare.
+
+Multi-device: the distsql layer shards *regions* across devices with
+shard_map (parallel/); this module is the per-shard program.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import ops  # noqa: F401  (configures x64)
+import jax
+import jax.numpy as jnp
+
+from ..chunk import Chunk, Column
+from ..expr.aggregation import AggDesc
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..types import FieldType, TypeKind, ty_int
+from .ir import (
+    DAG,
+    AggregationIR,
+    LimitIR,
+    ProjectionIR,
+    SelectionIR,
+    TableScanIR,
+    TopNIR,
+    serialize_expr,
+)
+from .jax_eval import JaxUnsupported, _np_dtype_for, compile_expr
+from .aggstate import finalize as agg_finalize
+
+TILE = 1 << 20  # rows per device dispatch
+MAX_GROUPS = 1 << 16  # cap on dense group-code space
+
+
+# ---------------------------------------------------------------------------
+# dictionary rewrite: string constants -> codes
+# ---------------------------------------------------------------------------
+
+_RANGE_OPS = {"<", "<=", ">", ">="}
+
+
+def rewrite_for_dict(e: Expression, table, scan: TableScanIR) -> Expression:
+    """Rewrite string-vs-constant comparisons over dict-encoded columns into
+    integer code comparisons.  Raises JaxUnsupported for raw string use."""
+    if isinstance(e, (ColumnExpr, Constant)):
+        return e
+    assert isinstance(e, ScalarFunc)
+    name = e.name
+    if name in ("=", "!=") or name in _RANGE_OPS or name == "in":
+        col, consts, col_first = _split_col_consts(e)
+        if col is not None and col.ftype.kind == TypeKind.STRING:
+            store_ci = scan.columns[col.index]
+            if store_ci not in table.dict_encoded_cols():
+                raise JaxUnsupported("string column not dict-encoded")
+            if name in ("=", "!="):
+                code = table.encode_dict_const(store_ci, str(consts[0].value))
+                return ScalarFunc(
+                    name,
+                    [col, Constant(code, col.ftype)] if col_first
+                    else [Constant(code, col.ftype), col],
+                    e.ftype, e.meta,
+                )
+            if name == "in":
+                items = [
+                    Constant(table.encode_dict_const(store_ci, str(c.value)),
+                             col.ftype)
+                    for c in consts
+                ]
+                return ScalarFunc("in", [col] + items, e.ftype, e.meta)
+            # range op on sorted dictionary
+            op = name if col_first else _flip(name)
+            s = str(consts[0].value)
+            if op == "<":
+                bound, newop = table.dict_bound(store_ci, s, "left"), "<"
+            elif op == "<=":
+                bound, newop = table.dict_bound(store_ci, s, "right"), "<"
+            elif op == ">":
+                bound, newop = table.dict_bound(store_ci, s, "right"), ">="
+            else:  # >=
+                bound, newop = table.dict_bound(store_ci, s, "left"), ">="
+            return ScalarFunc(
+                newop, [col, Constant(bound, col.ftype)], e.ftype, e.meta
+            )
+    new_args = [rewrite_for_dict(a, table, scan) for a in e.args]
+    return ScalarFunc(e.name, new_args, e.ftype, e.meta)
+
+
+def _split_col_consts(e: ScalarFunc):
+    args = e.args
+    if isinstance(args[0], ColumnExpr) and all(
+        isinstance(a, Constant) for a in args[1:]
+    ):
+        return args[0], list(args[1:]), True
+    if len(args) == 2 and isinstance(args[1], ColumnExpr) and isinstance(
+        args[0], Constant
+    ):
+        return args[1], [args[0]], False
+    return None, [], True
+
+
+def _flip(op: str) -> str:
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+
+
+# ---------------------------------------------------------------------------
+# device block cache
+# ---------------------------------------------------------------------------
+
+
+class _DeviceCache:
+    """(table_id, base_version, store_col, tile_idx) -> (data, valid) on device."""
+
+    def __init__(self, capacity_bytes: int = 8 << 30):
+        self._cache: Dict[tuple, tuple] = {}
+        self._order: List[tuple] = []
+        self._bytes = 0
+        self.capacity = capacity_bytes
+
+    def get_tile(self, table, store_ci: int, tile_idx: int, start: int, end: int):
+        key = (table.table_id, table.base_version, store_ci, tile_idx)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        data, valid = _gather_tile(table, store_ci, start, end)
+        data = jax.device_put(data)
+        valid = jax.device_put(valid)
+        nbytes = data.nbytes + valid.nbytes
+        while self._bytes + nbytes > self.capacity and self._order:
+            old = self._order.pop(0)
+            od, ov = self._cache.pop(old)
+            self._bytes -= od.nbytes + ov.nbytes
+        self._cache[key] = (data, valid)
+        self._order.append(key)
+        self._bytes += nbytes
+        return data, valid
+
+
+def _gather_tile(table, store_ci: int, start: int, end: int):
+    """Host-side: concatenate block slices for [start,end) and pad to TILE."""
+    meta = table.cols[store_ci]
+    dt = np.int32 if meta.ftype.kind in (TypeKind.DATE, TypeKind.STRING) else (
+        np.float64 if meta.ftype.kind == TypeKind.FLOAT else np.int64
+    )
+    parts, vparts = [], []
+    for _, arrs, vals in table.iter_base_blocks([store_ci], start, end):
+        parts.append(arrs[0])
+        v = vals[0]
+        vparts.append(v if v is not None else np.ones(len(arrs[0]), np.bool_))
+    if parts:
+        data = np.concatenate(parts).astype(dt, copy=False)
+        valid = np.concatenate(vparts)
+    else:
+        data = np.zeros(0, dtype=dt)
+        valid = np.zeros(0, dtype=np.bool_)
+    n = len(data)
+    if n < TILE:
+        data = np.pad(data, (0, TILE - n))
+        valid = np.pad(valid, (0, TILE - n))
+    return data, valid
+
+
+DEVICE_CACHE = _DeviceCache()
+
+
+# ---------------------------------------------------------------------------
+# DAG analysis
+# ---------------------------------------------------------------------------
+
+
+class _Analyzed:
+    def __init__(self, dag: DAG, table):
+        self.scan: TableScanIR = dag.scan
+        self.selections: List[SelectionIR] = []
+        self.projection: Optional[ProjectionIR] = None
+        self.agg: Optional[AggregationIR] = None
+        self.topn: Optional[TopNIR] = None
+        self.limit: Optional[int] = None
+        for ex in dag.executors[1:]:
+            if isinstance(ex, SelectionIR):
+                if self.agg or self.topn or self.projection:
+                    raise JaxUnsupported("selection after agg/topn on device")
+                self.selections.append(ex)
+            elif isinstance(ex, ProjectionIR):
+                if self.agg or self.topn:
+                    raise JaxUnsupported("projection after agg/topn on device")
+                self.projection = ex
+            elif isinstance(ex, AggregationIR):
+                if self.agg or self.topn or self.projection:
+                    raise JaxUnsupported("late aggregation on device")
+                if ex.mode != "partial":
+                    raise JaxUnsupported("device agg is partial-only")
+                self.agg = ex
+            elif isinstance(ex, TopNIR):
+                if self.agg or self.topn:
+                    raise JaxUnsupported("topn after agg on device")
+                self.topn = ex
+            elif isinstance(ex, LimitIR):
+                self.limit = ex.limit if self.limit is None else min(
+                    self.limit, ex.limit
+                )
+            else:
+                raise JaxUnsupported(f"device executor {ex!r}")
+        # pushability gate (defense in depth; the planner already gates)
+        from ..expr.pushdown import can_push_agg, can_push_expr
+
+        dict_scan_idx = {
+            i for i, ci in enumerate(self.scan.columns)
+            if ci in table.dict_encoded_cols()
+        }
+        all_exprs: List[Expression] = [
+            c for s in self.selections for c in s.conditions
+        ]
+        if self.projection is not None:
+            all_exprs += self.projection.exprs
+        if self.topn is not None:
+            all_exprs += [e for e, _ in self.topn.order_by]
+        for ex2 in all_exprs:
+            if not can_push_expr(ex2, dict_cols=dict_scan_idx):
+                raise JaxUnsupported(f"expr not device-eligible: {ex2}")
+        if self.agg is not None:
+            for a in self.agg.aggs:
+                if not can_push_agg(a, dict_cols=dict_scan_idx):
+                    raise JaxUnsupported(f"agg not device-eligible: {a}")
+        # rewrite dict-encoded string constants
+        self.conds = [
+            rewrite_for_dict(c, table, self.scan)
+            for s in self.selections
+            for c in s.conditions
+        ]
+        if self.projection is not None:
+            self.proj_exprs = [
+                rewrite_for_dict(p, table, self.scan)
+                for p in self.projection.exprs
+            ]
+        else:
+            self.proj_exprs = None
+        # group-key layout for device aggregation
+        self.group_cols: List[int] = []  # scan-output indices
+        self.group_card: List[Tuple[int, int]] = []  # (lo, card) per key
+        if self.agg is not None:
+            g = 1
+            for k in self.agg.group_by:
+                if not isinstance(k, ColumnExpr):
+                    raise JaxUnsupported("device group key must be a column")
+                store_ci = self.scan.columns[k.index]
+                lo, hi, has_null = table.column_stats(store_ci)
+                if has_null:
+                    # NULL is its own group in SQL; the dense-code space has
+                    # no slot for it -> host fallback
+                    raise JaxUnsupported("NULLable group key on device")
+                if hi < lo:
+                    lo, hi = 0, 0
+                card = hi - lo + 1
+                if card <= 0 or card > MAX_GROUPS:
+                    raise JaxUnsupported("group key cardinality too large")
+                g *= card
+                if g > MAX_GROUPS:
+                    raise JaxUnsupported("combined group space too large")
+                self.group_cols.append(k.index)
+                self.group_card.append((lo, card))
+            self.num_groups = max(g, 1)
+            for a in self.agg.aggs:
+                if a.distinct:
+                    raise JaxUnsupported("distinct agg on device")
+                if a.name not in ("count", "sum", "avg", "min", "max",
+                                  "first_row"):
+                    raise JaxUnsupported(f"device agg {a.name}")
+                self.agg_args = None
+        if self.topn is not None:
+            if len(self.topn.order_by) != 1:
+                raise JaxUnsupported("device topn supports one sort key")
+
+    def needed_cols(self) -> List[int]:
+        """Scan-output col indices the device actually needs."""
+        need: set = set()
+        for c in self.conds:
+            c.collect_columns(need)
+        if self.agg is not None:
+            need.update(self.group_cols)
+            for a in self.agg.aggs:
+                for x in a.args:
+                    x.collect_columns(need)
+        if self.proj_exprs is not None:
+            for p in self.proj_exprs:
+                p.collect_columns(need)
+        if self.topn is not None:
+            self.topn.order_by[0][0].collect_columns(need)
+        return sorted(need)
+
+
+# ---------------------------------------------------------------------------
+# compiled tile programs
+# ---------------------------------------------------------------------------
+
+_COMPILED: Dict[str, object] = {}
+
+
+def _fingerprint(an: _Analyzed, kind: str) -> str:
+    payload = {
+        "kind": kind,
+        "conds": [serialize_expr(c) for c in an.conds],
+        "proj": [serialize_expr(p) for p in an.proj_exprs]
+        if an.proj_exprs is not None
+        else None,
+        "scan_ft": [int(f.kind) for f in an.scan.ftypes],
+    }
+    if an.agg is not None:
+        payload["agg"] = {
+            "keys": an.group_cols,
+            "card": an.group_card,
+            "aggs": [
+                {"name": a.name, "args": [serialize_expr(x) for x in a.args]}
+                for a in an.agg.aggs
+            ],
+        }
+    if an.topn is not None:
+        e, desc = an.topn.order_by[0]
+        payload["topn"] = {
+            "key": serialize_expr(e), "desc": desc, "k": an.topn.limit,
+        }
+    return json.dumps(payload, sort_keys=True, default=str)
+
+
+def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
+    """Returns a jitted fn(datas, valids, row_mask) -> outputs."""
+    n = TILE
+
+    def cols_env(datas, valids):
+        return {
+            ci: (datas[j], valids[j]) for j, ci in enumerate(col_order)
+        }
+
+    def selected_mask(cols, row_mask):
+        m = row_mask
+        for c in an.conds:
+            d, v = compile_expr(c, cols, n)
+            m = m & v & (d != 0)
+        return m
+
+    if kind == "filter":
+        def fn(datas, valids, row_mask):
+            cols = cols_env(datas, valids)
+            m = selected_mask(cols, row_mask)
+            outs = None
+            if an.proj_exprs is not None:
+                outs = [compile_expr(p, cols, n) for p in an.proj_exprs]
+            return m, outs
+
+        return jax.jit(fn)
+
+    if kind == "agg":
+        agg_ir = an.agg
+        G = an.num_groups
+        # static result layout: tag per agg (jit returns arrays only)
+        tags = []
+        for a in agg_ir.aggs:
+            if a.name == "count":
+                tags.append("count")
+            elif a.name in ("sum", "avg"):
+                tags.append("sumcount")
+            elif a.name in ("min", "max"):
+                tags.append("minmax")
+            else:
+                tags.append("argfirst")
+
+        def fn(datas, valids, row_mask):
+            cols = cols_env(datas, valids)
+            m = selected_mask(cols, row_mask)
+            # mixed-radix group codes (NULL keys excluded by _Analyzed)
+            gidx = jnp.zeros(n, dtype=jnp.int64)
+            stride = 1
+            for kcol, (lo, card) in zip(an.group_cols, an.group_card):
+                d, v = cols[kcol]
+                code = jnp.clip(d.astype(jnp.int64) - lo, 0, card - 1)
+                gidx = gidx + code * stride
+                m = m & v
+                stride *= card
+            gcount = ops.masked_segment_count(gidx, m, G)
+            results = []
+            for a in agg_ir.aggs:
+                if a.name == "count":
+                    if a.args:
+                        d, v = compile_expr(a.args[0], cols, n)
+                        results.append(ops.masked_segment_count(gidx, m & v, G))
+                    else:
+                        results.append(gcount)
+                    continue
+                d, v = compile_expr(a.args[0], cols, n)
+                mv = m & v
+                if a.name in ("sum", "avg"):
+                    st = a.partial_types()[0]
+                    dd = _to_state_dtype(d, a.args[0].ftype, st)
+                    results.append(
+                        (ops.masked_segment_sum(dd, gidx, mv, G),
+                         ops.masked_segment_count(gidx, mv, G))
+                    )
+                elif a.name == "min":
+                    results.append(
+                        (ops.masked_segment_min(d, gidx, mv, G),
+                         ops.masked_segment_count(gidx, mv, G))
+                    )
+                elif a.name == "max":
+                    results.append(
+                        (ops.masked_segment_max(d, gidx, mv, G),
+                         ops.masked_segment_count(gidx, mv, G))
+                    )
+                elif a.name == "first_row":
+                    results.append(ops.masked_segment_argfirst(gidx, mv, G))
+            return gcount, results
+
+        jitted = jax.jit(fn)
+
+        def wrapped(datas, valids, row_mask):
+            gcount, results = jitted(datas, valids, row_mask)
+            return gcount, list(zip(tags, results))
+
+        return wrapped
+
+    if kind == "topn":
+        key_expr, desc = an.topn.order_by[0]
+        k = min(an.topn.limit, TILE)
+
+        def fn(datas, valids, row_mask):
+            cols = cols_env(datas, valids)
+            m = selected_mask(cols, row_mask)
+            d, v = compile_expr(key_expr, cols, n)
+            # NULLs first asc / last desc: encode as -inf asc (first), -inf desc (last)
+            key = d.astype(jnp.float64)
+            if desc:
+                key = jnp.where(v, key, -jnp.inf)
+            else:
+                key = jnp.where(v, key, jnp.inf)
+                # but MySQL sorts NULLs first ascending:
+                key = jnp.where(v, key, -jnp.inf)
+            idx, cnt = ops.masked_top_k(key, m, k, desc)
+            return idx, cnt
+
+        return jax.jit(fn)
+
+    raise JaxUnsupported(kind)
+
+
+def _to_state_dtype(d, src_ft: FieldType, state_ft: FieldType):
+    if state_ft.kind == TypeKind.FLOAT:
+        if src_ft.kind == TypeKind.DECIMAL:
+            return d.astype(jnp.float64) / (10.0 ** src_ft.scale)
+        return d.astype(jnp.float64)
+    # decimal state: rescale ints
+    if src_ft.kind == TypeKind.DECIMAL:
+        ds = state_ft.scale - src_ft.scale
+        if ds > 0:
+            return d.astype(jnp.int64) * (10 ** ds)
+        return d.astype(jnp.int64)
+    return d.astype(jnp.int64) * (10 ** state_ft.scale)
+
+
+# ---------------------------------------------------------------------------
+# engine entry
+# ---------------------------------------------------------------------------
+
+
+def run_base_jax(table, dag: DAG, start: int, end: int,
+                 deleted: Sequence[int]) -> List[Chunk]:
+    """Execute `dag` over base rows [start, end) on the device; returns
+    result chunks (partial-agg rows, topn rows, or filtered rows)."""
+    an = _Analyzed(dag, table)
+    kind = "agg" if an.agg is not None else (
+        "topn" if an.topn is not None else "filter"
+    )
+    col_order = an.needed_cols()
+    fp = _fingerprint(an, kind) + f"|cols={col_order}"
+    fn = _COMPILED.get(fp)
+    if fn is None:
+        fn = _build_tile_fn(an, kind, col_order)
+        _COMPILED[fp] = fn
+
+    del_arr = np.asarray(sorted(deleted), dtype=np.int64)
+    out_chunks: List[Chunk] = []
+    agg_accum = None
+    topn_parts: List[Chunk] = []
+    remaining_limit = an.limit
+
+    for tile_start in range(start - (start % TILE) if start % TILE else start,
+                            end, TILE):
+        t0 = max(tile_start, start)
+        t1 = min(tile_start + TILE, end)
+        if t0 >= t1:
+            continue
+        tile_idx = tile_start // TILE
+        aligned = (tile_start % TILE) == 0
+        datas, valids = [], []
+        for j, ci in enumerate(col_order):
+            store_ci = an.scan.columns[ci]
+            if aligned:
+                d, v = DEVICE_CACHE.get_tile(
+                    table, store_ci, tile_idx, tile_start,
+                    min(tile_start + TILE, table.base_rows),
+                )
+            else:
+                d, v = _gather_tile(table, store_ci, t0, t1)
+            datas.append(d)
+            valids.append(v)
+        # row mask: within [t0,t1) and not deleted
+        base0 = tile_start if aligned else t0
+        nrows_valid = t1 - base0
+        row_mask = np.zeros(TILE, dtype=np.bool_)
+        row_mask[(t0 - base0):(t1 - base0)] = True
+        if len(del_arr):
+            dd = del_arr[(del_arr >= base0) & (del_arr < base0 + TILE)] - base0
+            row_mask[dd] = False
+        row_mask_j = jnp.asarray(row_mask)
+
+        if kind == "filter":
+            m, outs = fn(datas, valids, row_mask_j)
+            m = np.asarray(m)
+            sel = np.flatnonzero(m)
+            if remaining_limit is not None:
+                sel = sel[:remaining_limit]
+            if len(sel) == 0:
+                continue
+            if outs is not None:
+                cols = []
+                for (dv, vv), p in zip(outs, an.proj_exprs):
+                    cols.append(
+                        Column(p.ftype, np.asarray(dv)[sel],
+                               np.asarray(vv)[sel])
+                    )
+                chunk = Chunk(cols)
+            else:
+                chunk = _gather_rows(table, an.scan, base0, sel)
+            out_chunks.append(chunk)
+            if remaining_limit is not None:
+                remaining_limit -= chunk.num_rows
+                if remaining_limit <= 0:
+                    break
+        elif kind == "agg":
+            gcount, results = fn(datas, valids, row_mask_j)
+            agg_accum = _merge_device_agg(
+                agg_accum, np.asarray(gcount),
+                [(t, _np_tree(r)) for t, r in results],
+                table, an, base0,
+            )
+        else:  # topn
+            idx, cnt = fn(datas, valids, row_mask_j)
+            idx = np.asarray(idx)[: int(cnt)]
+            if len(idx):
+                topn_parts.append(_gather_rows(table, an.scan, base0, idx))
+
+    if kind == "agg":
+        if agg_accum is None:
+            return []
+        return [_device_agg_to_chunk(agg_accum, table, an)]
+    if kind == "topn":
+        if not topn_parts:
+            return []
+        from .cpu_engine import run_topn
+
+        merged = topn_parts[0]
+        for p in topn_parts[1:]:
+            merged = merged.append(p)
+        return [run_topn(an.topn.order_by, an.topn.limit, merged)]
+    return out_chunks
+
+
+def _np_tree(r):
+    if isinstance(r, tuple):
+        return tuple(np.asarray(x) for x in r)
+    return np.asarray(r)
+
+
+def _gather_rows(table, scan: TableScanIR, base0: int, sel: np.ndarray) -> Chunk:
+    """Host gather of scan-output rows at tile-local indices `sel`."""
+    handles = base0 + sel
+    cols = []
+    # materialize contiguous range then take (cheap enough per tile)
+    lo, hi = int(handles.min()), int(handles.max()) + 1
+    chunk = table.base_chunk(
+        [scan.columns[i] for i in range(len(scan.columns))], lo, hi
+    )
+    return chunk.take(handles - lo)
+
+
+def _merge_device_agg(accum, gcount: np.ndarray, results, table, an: _Analyzed,
+                      base0: int):
+    """Accumulate per-tile dense G-arrays into running host arrays."""
+    if accum is None:
+        accum = {"gcount": gcount.copy(), "states": []}
+        for tag, r in results:
+            if tag == "argfirst":
+                # resolve indices to values host-side now (per tile)
+                accum["states"].append(["argfirst", None, None])
+            else:
+                accum["states"].append([tag, None, None])
+    else:
+        accum["gcount"] += gcount
+    for si, (tag, r) in enumerate(results):
+        slot = accum["states"][si]
+        if tag == "count":
+            slot[1] = r if slot[1] is None else slot[1] + r
+        elif tag == "sumcount":
+            s, c = r
+            if slot[1] is None:
+                slot[1], slot[2] = s.copy(), c.copy()
+            else:
+                slot[1] += s
+                slot[2] += c
+        elif tag == "minmax":
+            v, c = r
+            if slot[1] is None:
+                slot[1], slot[2] = v.copy(), c.copy()
+            else:
+                a = an.agg.aggs[si]
+                pick = np.minimum if a.name == "min" else np.maximum
+                have_old = slot[2] > 0
+                have_new = c > 0
+                both = have_old & have_new
+                merged = np.where(both, pick(slot[1], v),
+                                  np.where(have_new, v, slot[1]))
+                slot[1] = merged
+                slot[2] += c
+        elif tag == "argfirst":
+            # r: per-group first row index in tile (TILE if none)
+            a = an.agg.aggs[si]
+            arg = a.args[0]
+            idx = r
+            have = idx < TILE
+            vals, valid = _resolve_first_values(table, an, arg, base0, idx, have)
+            if slot[1] is None:
+                slot[1], slot[2] = vals, valid
+            else:
+                need = ~slot[2] & valid
+                slot[1] = np.where(need, vals, slot[1])
+                slot[2] = slot[2] | valid
+    return accum
+
+
+def _resolve_first_values(table, an, arg, base0, idx, have):
+    sel = np.flatnonzero(have)
+    G = an.num_groups
+    st = arg.ftype
+    if st.kind == TypeKind.STRING:
+        vals = np.empty(G, dtype=object)
+        vals[:] = ""
+    else:
+        vals = np.zeros(G, dtype=st.np_dtype)
+    valid = np.zeros(G, dtype=np.bool_)
+    if len(sel):
+        rows = _gather_rows(table, an.scan, base0, idx[sel])
+        v = arg.eval(rows)
+        vals[sel] = v.data
+        valid[sel] = v.validity()
+    return vals, valid
+
+
+def _device_agg_to_chunk(accum, table, an: _Analyzed) -> Chunk:
+    """Dense per-group arrays -> partial chunk [keys..., states...] with
+    empty groups dropped (matches the CPU engine layout)."""
+    gcount = accum["gcount"]
+    present = np.flatnonzero(gcount > 0)
+    if an.agg.group_by and len(present) == 0:
+        return Chunk.empty(
+            [g.ftype for g in an.agg.group_by]
+            + [t for a in an.agg.aggs for t in a.partial_types()]
+        )
+    if not an.agg.group_by:
+        present = np.array([0], dtype=np.int64)
+    cols: List[Column] = []
+    # decode mixed-radix codes back to key values
+    code = present.copy()
+    for kcol, (lo, card), g in zip(an.group_cols, an.group_card,
+                                   an.agg.group_by):
+        vals = (code % card) + lo
+        code = code // card
+        store_ci = an.scan.columns[kcol]
+        meta = table.cols[store_ci]
+        if meta.ftype.kind == TypeKind.STRING:
+            d = meta.dictionary or []
+            obj = np.empty(len(vals), dtype=object)
+            for i, c in enumerate(vals):
+                obj[i] = d[c] if 0 <= c < len(d) else ""
+            cols.append(Column(g.ftype, obj))
+        else:
+            cols.append(Column(g.ftype, vals.astype(meta.ftype.np_dtype)))
+    for a, slot in zip(an.agg.aggs, accum["states"]):
+        tag = slot[0]
+        pts = a.partial_types()
+        if tag == "count":
+            cols.append(Column(pts[0], slot[1][present].astype(np.int64)))
+        elif tag == "sumcount":
+            s = slot[1][present]
+            c = slot[2][present]
+            sum_col = Column(pts[0], s.astype(pts[0].np_dtype), c > 0)
+            if a.name == "sum":
+                cols.append(sum_col)
+            else:
+                cols.append(sum_col)
+                cols.append(Column(pts[1], c.astype(np.int64)))
+        elif tag == "minmax":
+            v = slot[1][present]
+            c = slot[2][present]
+            arg_ft = a.args[0].ftype
+            if arg_ft.kind == TypeKind.STRING:
+                # values are dict codes; decode
+                colexpr = a.args[0]
+                store_ci = an.scan.columns[colexpr.index]
+                d = table.cols[store_ci].dictionary or []
+                obj = np.empty(len(v), dtype=object)
+                for i, cd in enumerate(v):
+                    obj[i] = d[int(cd)] if 0 <= int(cd) < len(d) else ""
+                cols.append(Column(pts[0], obj, c > 0))
+            else:
+                cols.append(
+                    Column(pts[0], v.astype(pts[0].np_dtype), c > 0)
+                )
+        elif tag == "argfirst":
+            cols.append(Column(pts[0], slot[1][present], slot[2][present]))
+    return Chunk(cols)

@@ -1,0 +1,628 @@
+"""Expression tree -> jax computation (device eval path).
+
+The device analog of expr/builtins.py: the same trees the host evaluates with
+numpy are traced into a jitted XLA program here.  Values flow as
+(data, valid) pairs of jnp arrays; dict-encoded string columns arrive as
+int32 code arrays (the planner/engine rewrites string constants to codes
+before compilation — see jax_engine.rewrite_for_dict).
+
+Everything here must be jit-traceable: no data-dependent Python control flow,
+static shapes only (TILE-padded), jnp.where instead of branching.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..types import FieldType, TypeKind, common_compare_type
+from ..types.values import parse_date, parse_datetime
+
+
+class JaxUnsupported(Exception):
+    """Raised when an expression/DAG can't run on the device; callers fall
+    back to the CPU engine (the canFuncBePushed miss path)."""
+
+
+JVal = Tuple[jnp.ndarray, jnp.ndarray]  # (data, valid)
+
+
+def _np_dtype_for(ft: FieldType):
+    if ft.kind == TypeKind.FLOAT:
+        return jnp.float64
+    if ft.kind == TypeKind.DATE:
+        return jnp.int32
+    if ft.kind == TypeKind.STRING:
+        return jnp.int32  # dictionary codes
+    return jnp.int64
+
+
+def compile_expr(e: Expression, cols: Dict[int, JVal], n: int) -> JVal:
+    if isinstance(e, ColumnExpr):
+        if e.index not in cols:
+            raise JaxUnsupported(f"column {e.index} not device-resident")
+        return cols[e.index]
+    if isinstance(e, Constant):
+        return _const(e, n)
+    if isinstance(e, ScalarFunc):
+        fn = _FUNCS.get(e.name)
+        if fn is None:
+            raise JaxUnsupported(f"function {e.name} not device-compilable")
+        args = [compile_expr(a, cols, n) for a in e.args]
+        return fn(e, args, n)
+    raise JaxUnsupported(f"expression {e!r}")
+
+
+def _const(e: Constant, n: int) -> JVal:
+    ft = e.ftype
+    if e.value is None:
+        return (
+            jnp.zeros(n, dtype=_np_dtype_for(ft)),
+            jnp.zeros(n, dtype=jnp.bool_),
+        )
+    v = e.value
+    if ft.kind == TypeKind.STRING:
+        if not isinstance(v, (int,)):
+            raise JaxUnsupported("raw string constant on device")
+        # dictionary code constant (rewritten)
+        return jnp.full(n, v, dtype=jnp.int32), jnp.ones(n, dtype=jnp.bool_)
+    if ft.kind == TypeKind.DATE and isinstance(v, str):
+        v = parse_date(v)
+    if ft.kind == TypeKind.DATETIME and isinstance(v, str):
+        v = parse_datetime(v)
+    return (
+        jnp.full(n, v, dtype=_np_dtype_for(ft)),
+        jnp.ones(n, dtype=jnp.bool_),
+    )
+
+
+def _to_f64(v: JVal, ft: FieldType) -> jnp.ndarray:
+    d = v[0]
+    if ft.kind == TypeKind.DECIMAL:
+        return d.astype(jnp.float64) / (10.0 ** ft.scale)
+    return d.astype(jnp.float64)
+
+
+def _to_scaled(v: JVal, ft: FieldType, scale: int) -> jnp.ndarray:
+    d = v[0]
+    if ft.kind == TypeKind.DECIMAL:
+        ds = scale - ft.scale
+        if ds == 0:
+            return d.astype(jnp.int64)
+        if ds > 0:
+            return d.astype(jnp.int64) * (10 ** ds)
+        p = 10 ** (-ds)
+        ad = jnp.abs(d.astype(jnp.int64))
+        return jnp.sign(d).astype(jnp.int64) * ((ad + p // 2) // p)
+    if ft.kind == TypeKind.FLOAT:
+        return jnp.round(d * (10.0 ** scale)).astype(jnp.int64)
+    return d.astype(jnp.int64) * (10 ** scale)
+
+
+_FUNCS: Dict[str, Callable] = {}
+
+
+def _reg(*names):
+    def deco(fn):
+        for nm in names:
+            _FUNCS[nm] = fn
+        return fn
+
+    return deco
+
+
+def _both_valid(a: JVal, b: JVal) -> jnp.ndarray:
+    return a[1] & b[1]
+
+
+# ---- arithmetic ------------------------------------------------------------
+
+
+@_reg("+", "-", "*", "/", "div", "%")
+def _arith(e: ScalarFunc, args, n):
+    op = e.name
+    a, b = args
+    fa, fb = e.args[0].ftype, e.args[1].ftype
+    out = e.ftype
+    valid = _both_valid(a, b)
+    if out.kind == TypeKind.FLOAT:
+        x, y = _to_f64(a, fa), _to_f64(b, fb)
+        if op == "+":
+            r = x + y
+        elif op == "-":
+            r = x - y
+        elif op == "*":
+            r = x * y
+        elif op == "/":
+            bad = y == 0.0
+            r = x / jnp.where(bad, 1.0, y)
+            valid = valid & ~bad
+        elif op == "%":
+            bad = y == 0.0
+            r = jnp.fmod(x, jnp.where(bad, 1.0, y))
+            valid = valid & ~bad
+        else:
+            raise JaxUnsupported("float div")
+        return r, valid
+    if out.kind == TypeKind.DECIMAL:
+        sa = fa.scale if fa.kind == TypeKind.DECIMAL else 0
+        sb = fb.scale if fb.kind == TypeKind.DECIMAL else 0
+        if op in ("+", "-"):
+            s = out.scale
+            x, y = _to_scaled(a, fa, s), _to_scaled(b, fb, s)
+            return (x + y if op == "+" else x - y), valid
+        if op == "*":
+            x, y = _to_scaled(a, fa, sa), _to_scaled(b, fb, sb)
+            r = x * y
+            drop = sa + sb - out.scale
+            if drop > 0:
+                p = 10 ** drop
+                r = jnp.sign(r) * ((jnp.abs(r) + p // 2) // p)
+            elif drop < 0:
+                r = r * (10 ** (-drop))
+            return r, valid
+        if op == "/":
+            x = _to_f64(a, fa)
+            y = _to_f64(b, fb)
+            bad = y == 0.0
+            r = x / jnp.where(bad, 1.0, y)
+            valid = valid & ~bad
+            return jnp.round(r * 10.0 ** out.scale).astype(jnp.int64), valid
+        raise JaxUnsupported(f"decimal {op}")
+    # int domain
+    x, y = a[0].astype(jnp.int64), b[0].astype(jnp.int64)
+    if op == "+":
+        r = x + y
+    elif op == "-":
+        r = x - y
+    elif op == "*":
+        r = x * y
+    elif op in ("div", "/"):
+        bad = y == 0
+        safe = jnp.where(bad, 1, y)
+        r = jnp.sign(x) * jnp.sign(safe) * (jnp.abs(x) // jnp.abs(safe))
+        valid = valid & ~bad
+    elif op == "%":
+        bad = y == 0
+        safe = jnp.where(bad, 1, y)
+        r = jnp.sign(x) * (jnp.abs(x) % jnp.abs(safe))
+        valid = valid & ~bad
+    else:
+        raise JaxUnsupported(op)
+    return r, valid
+
+
+@_reg("unaryminus")
+def _neg(e, args, n):
+    v = args[0]
+    if e.ftype.kind == TypeKind.FLOAT:
+        return -_to_f64(v, e.args[0].ftype), v[1]
+    return -v[0], v[1]
+
+
+# ---- comparisons -----------------------------------------------------------
+
+
+@_reg("=", "!=", "<", "<=", ">", ">=")
+def _cmp(e, args, n):
+    a, b = args
+    fa, fb = e.args[0].ftype, e.args[1].ftype
+    ct = common_compare_type(fa, fb)
+    if ct.kind == TypeKind.STRING:
+        # both sides must be dictionary codes (int32) by now
+        x, y = a[0].astype(jnp.int64), b[0].astype(jnp.int64)
+    elif ct.kind == TypeKind.DECIMAL:
+        s = max(
+            fa.scale if fa.kind == TypeKind.DECIMAL else 0,
+            fb.scale if fb.kind == TypeKind.DECIMAL else 0,
+        )
+        if TypeKind.FLOAT in (fa.kind, fb.kind):
+            x, y = _to_f64(a, fa), _to_f64(b, fb)
+        else:
+            x, y = _to_scaled(a, fa, s), _to_scaled(b, fb, s)
+    elif ct.kind == TypeKind.FLOAT:
+        x, y = _to_f64(a, fa), _to_f64(b, fb)
+    elif ct.kind in (TypeKind.DATE, TypeKind.DATETIME):
+        x = _temporal_to(ct.kind, a, fa)
+        y = _temporal_to(ct.kind, b, fb)
+    else:
+        x, y = a[0].astype(jnp.int64), b[0].astype(jnp.int64)
+    op = e.name
+    r = {
+        "=": lambda: x == y,
+        "!=": lambda: x != y,
+        "<": lambda: x < y,
+        "<=": lambda: x <= y,
+        ">": lambda: x > y,
+        ">=": lambda: x >= y,
+    }[op]()
+    return r.astype(jnp.int64), _both_valid(a, b)
+
+
+def _temporal_to(kind, v: JVal, ft: FieldType):
+    d = v[0]
+    if kind == TypeKind.DATE:
+        if ft.kind == TypeKind.DATETIME:
+            return (d // 86_400_000_000).astype(jnp.int64)
+        return d.astype(jnp.int64)
+    if ft.kind == TypeKind.DATE:
+        return d.astype(jnp.int64) * 86_400_000_000
+    return d.astype(jnp.int64)
+
+
+# ---- logic -----------------------------------------------------------------
+
+
+def _truth(v: JVal) -> jnp.ndarray:
+    return v[0] != 0
+
+
+@_reg("and")
+def _and(e, args, n):
+    a, b = args
+    ta, tb = _truth(a), _truth(b)
+    is_false = (a[1] & ~ta) | (b[1] & ~tb)
+    valid = is_false | (a[1] & b[1])
+    return jnp.where(is_false, 0, 1).astype(jnp.int64), valid
+
+
+@_reg("or")
+def _or(e, args, n):
+    a, b = args
+    is_true = (a[1] & _truth(a)) | (b[1] & _truth(b))
+    valid = is_true | (a[1] & b[1])
+    return is_true.astype(jnp.int64), valid
+
+
+@_reg("xor")
+def _xor(e, args, n):
+    a, b = args
+    return (_truth(a) ^ _truth(b)).astype(jnp.int64), _both_valid(a, b)
+
+
+@_reg("not")
+def _not(e, args, n):
+    v = args[0]
+    return (~_truth(v)).astype(jnp.int64), v[1]
+
+
+@_reg("isnull")
+def _isnull(e, args, n):
+    v = args[0]
+    return (~v[1]).astype(jnp.int64), jnp.ones(n, dtype=jnp.bool_)
+
+
+@_reg("isnotnull")
+def _isnotnull(e, args, n):
+    v = args[0]
+    return v[1].astype(jnp.int64), jnp.ones(n, dtype=jnp.bool_)
+
+
+@_reg("istrue")
+def _istrue(e, args, n):
+    v = args[0]
+    return (_truth(v) & v[1]).astype(jnp.int64), jnp.ones(n, dtype=jnp.bool_)
+
+
+@_reg("isfalse")
+def _isfalse(e, args, n):
+    v = args[0]
+    return (~_truth(v) & v[1]).astype(jnp.int64), jnp.ones(n, dtype=jnp.bool_)
+
+
+@_reg("in")
+def _in(e, args, n):
+    target = args[0]
+    hit = jnp.zeros(n, dtype=jnp.bool_)
+    any_null_item = jnp.zeros(n, dtype=jnp.bool_)
+    ft = e.args[0].ftype
+    for it_expr, it in zip(e.args[1:], args[1:]):
+        sub = ScalarFunc("=", [e.args[0], it_expr],
+                         e.ftype)
+        eq, _ = _cmp(sub, [target, it], n)
+        hit = hit | ((eq != 0) & it[1])
+        any_null_item = any_null_item | ~it[1]
+    valid = target[1] & (hit | ~any_null_item)
+    return hit.astype(jnp.int64), valid
+
+
+# ---- control ---------------------------------------------------------------
+
+
+def _cast_to(v: JVal, src: FieldType, dst: FieldType) -> JVal:
+    k, tk = src.kind, dst.kind
+    d, valid = v
+    if tk == TypeKind.FLOAT:
+        return _to_f64(v, src), valid
+    if tk == TypeKind.DECIMAL:
+        return _to_scaled(v, src, dst.scale), valid
+    if tk in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
+        if k == TypeKind.FLOAT:
+            return jnp.round(d).astype(jnp.int64), valid
+        if k == TypeKind.DECIMAL:
+            p = 10 ** src.scale
+            ad = jnp.abs(d.astype(jnp.int64))
+            return jnp.sign(d).astype(jnp.int64) * ((ad + p // 2) // p), valid
+        return d.astype(jnp.int64), valid
+    if tk == TypeKind.DATE:
+        if k == TypeKind.DATETIME:
+            return (d // 86_400_000_000).astype(jnp.int32), valid
+        return d.astype(jnp.int32), valid
+    if tk == TypeKind.DATETIME:
+        if k == TypeKind.DATE:
+            return d.astype(jnp.int64) * 86_400_000_000, valid
+        return d.astype(jnp.int64), valid
+    raise JaxUnsupported(f"device cast {src} -> {dst}")
+
+
+@_reg("cast")
+def _cast(e, args, n):
+    return _cast_to(args[0], e.args[0].ftype, e.ftype)
+
+
+@_reg("if")
+def _if(e, args, n):
+    c, a, b = args
+    cond = _truth(c) & c[1]
+    ta = _cast_to(a, e.args[1].ftype, e.ftype)
+    tb = _cast_to(b, e.args[2].ftype, e.ftype)
+    return jnp.where(cond, ta[0], tb[0]), jnp.where(cond, ta[1], tb[1])
+
+
+@_reg("ifnull")
+def _ifnull(e, args, n):
+    a, b = args
+    ta = _cast_to(a, e.args[0].ftype, e.ftype)
+    tb = _cast_to(b, e.args[1].ftype, e.ftype)
+    return jnp.where(a[1], ta[0], tb[0]), jnp.where(a[1], True, tb[1])
+
+
+@_reg("nullif")
+def _nullif(e, args, n):
+    a, b = args
+    sub = ScalarFunc("=", [e.args[0], e.args[1]], e.ftype)
+    eq, _ = _cmp(sub, [a, b], n)
+    cond = (eq != 0) & a[1] & b[1]
+    ta = _cast_to(a, e.args[0].ftype, e.ftype)
+    return ta[0], a[1] & ~cond
+
+
+@_reg("coalesce")
+def _coalesce(e, args, n):
+    data, valid = _cast_to(args[0], e.args[0].ftype, e.ftype)
+    for i, v in enumerate(args[1:], start=1):
+        tv = _cast_to(v, e.args[i].ftype, e.ftype)
+        need = ~valid
+        data = jnp.where(need, tv[0], data)
+        valid = valid | (need & tv[1])
+    return data, valid
+
+
+@_reg("case")
+def _case(e, args, n):
+    has_else = len(args) % 2 == 1
+    dt = _np_dtype_for(e.ftype)
+    data = jnp.zeros(n, dtype=dt)
+    valid = jnp.zeros(n, dtype=jnp.bool_)
+    assigned = jnp.zeros(n, dtype=jnp.bool_)
+    for i in range(0, len(args) - (1 if has_else else 0), 2):
+        cond, val = args[i], args[i + 1]
+        m = _truth(cond) & cond[1] & ~assigned
+        tv = _cast_to(val, e.args[i + 1].ftype, e.ftype)
+        data = jnp.where(m, tv[0], data)
+        valid = jnp.where(m, tv[1], valid)
+        assigned = assigned | m
+    if has_else:
+        m = ~assigned
+        tv = _cast_to(args[-1], e.args[-1].ftype, e.ftype)
+        data = jnp.where(m, tv[0], data)
+        valid = jnp.where(m, tv[1], valid)
+    return data, valid
+
+
+@_reg("greatest", "least")
+def _extremes(e, args, n):
+    is_max = e.name == "greatest"
+    data, valid = _cast_to(args[0], e.args[0].ftype, e.ftype)
+    for i, v in enumerate(args[1:], start=1):
+        tv = _cast_to(v, e.args[i].ftype, e.ftype)
+        m = tv[0] > data if is_max else tv[0] < data
+        data = jnp.where(m, tv[0], data)
+        valid = valid & tv[1]
+    return data, valid
+
+
+# ---- math ------------------------------------------------------------------
+
+
+@_reg("abs")
+def _abs(e, args, n):
+    v = args[0]
+    if e.ftype.kind == TypeKind.FLOAT and e.args[0].ftype.kind != TypeKind.FLOAT:
+        return jnp.abs(_to_f64(v, e.args[0].ftype)), v[1]
+    return jnp.abs(v[0]), v[1]
+
+
+@_reg("floor", "ceil", "ceiling")
+def _floor_ceil(e, args, n):
+    v = args[0]
+    ft = e.args[0].ftype
+    if ft.kind == TypeKind.DECIMAL:
+        s = 10 ** ft.scale
+        d = v[0].astype(jnp.int64)
+        r = d // s if e.name == "floor" else -((-d) // s)
+        return r, v[1]
+    x = _to_f64(v, ft)
+    r = jnp.floor(x) if e.name == "floor" else jnp.ceil(x)
+    return r.astype(jnp.int64), v[1]
+
+
+@_reg("round")
+def _round(e, args, n):
+    v = args[0]
+    ft = e.args[0].ftype
+    d = int(e.args[1].value) if len(e.args) > 1 else 0
+    if ft.kind == TypeKind.DECIMAL:
+        drop = ft.scale - e.ftype.scale if d >= 0 else ft.scale - d
+        x = v[0].astype(jnp.int64)
+        if drop > 0:
+            p = 10 ** drop
+            x = jnp.sign(x) * ((jnp.abs(x) + p // 2) // p)
+        if d < 0:
+            x = x * (10 ** (-d)) * (10 ** e.ftype.scale)
+        return x, v[1]
+    if ft.kind == TypeKind.FLOAT:
+        x = v[0]
+        p = 10.0 ** d
+        return jnp.sign(x) * jnp.floor(jnp.abs(x) * p + 0.5) / p, v[1]
+    x = v[0].astype(jnp.int64)
+    if d < 0:
+        p = 10 ** (-d)
+        x = jnp.sign(x) * ((jnp.abs(x) + p // 2) // p) * p
+    return x, v[1]
+
+
+def _sfloat(name, jf, domain=None):
+    @_reg(name)
+    def impl(e, args, n, _jf=jf, _domain=domain):
+        v = args[0]
+        x = _to_f64(v, e.args[0].ftype)
+        valid = v[1]
+        if _domain is not None:
+            ok = _domain(x)
+            valid = valid & ok
+            x = jnp.where(ok, x, 1.0)
+        return _jf(x), valid
+    return impl
+
+
+_sfloat("sqrt", jnp.sqrt, lambda x: x >= 0)
+_sfloat("exp", jnp.exp)
+_sfloat("ln", jnp.log, lambda x: x > 0)
+_sfloat("log2", jnp.log2, lambda x: x > 0)
+_sfloat("log10", jnp.log10, lambda x: x > 0)
+_sfloat("sin", jnp.sin)
+_sfloat("cos", jnp.cos)
+_sfloat("tan", jnp.tan)
+_sfloat("atan", jnp.arctan)
+
+
+@_reg("pow", "power")
+def _pow(e, args, n):
+    a, b = args
+    x = _to_f64(a, e.args[0].ftype)
+    y = _to_f64(b, e.args[1].ftype)
+    return jnp.power(x, y), _both_valid(a, b)
+
+
+@_reg("sign")
+def _sign(e, args, n):
+    v = args[0]
+    return jnp.sign(_to_f64(v, e.args[0].ftype)).astype(jnp.int64), v[1]
+
+
+@_reg("mod")
+def _mod(e, args, n):
+    e2 = ScalarFunc("%", e.args, e.ftype, e.meta)
+    return _arith(e2, args, n)
+
+
+# ---- temporal --------------------------------------------------------------
+
+
+def _as_us(v: JVal, ft: FieldType) -> jnp.ndarray:
+    if ft.kind == TypeKind.DATE:
+        return v[0].astype(jnp.int64) * 86_400_000_000
+    return v[0].astype(jnp.int64)
+
+
+def _civil(us: jnp.ndarray):
+    days = us // 86_400_000_000
+    z = days + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+@_reg("year")
+def _year(e, args, n):
+    return _civil(_as_us(args[0], e.args[0].ftype))[0], args[0][1]
+
+
+@_reg("month")
+def _month(e, args, n):
+    return _civil(_as_us(args[0], e.args[0].ftype))[1], args[0][1]
+
+
+@_reg("day", "dayofmonth")
+def _day(e, args, n):
+    return _civil(_as_us(args[0], e.args[0].ftype))[2], args[0][1]
+
+
+@_reg("quarter")
+def _quarter(e, args, n):
+    m = _civil(_as_us(args[0], e.args[0].ftype))[1]
+    return (m + 2) // 3, args[0][1]
+
+
+@_reg("dayofweek")
+def _dayofweek(e, args, n):
+    us = _as_us(args[0], e.args[0].ftype)
+    return ((us // 86_400_000_000) + 4) % 7 + 1, args[0][1]
+
+
+@_reg("weekday")
+def _weekday(e, args, n):
+    us = _as_us(args[0], e.args[0].ftype)
+    return ((us // 86_400_000_000) + 3) % 7, args[0][1]
+
+
+@_reg("unix_timestamp")
+def _unix_ts(e, args, n):
+    return _as_us(args[0], e.args[0].ftype) // 1_000_000, args[0][1]
+
+
+@_reg("date")
+def _datefn(e, args, n):
+    us = _as_us(args[0], e.args[0].ftype)
+    return (us // 86_400_000_000).astype(jnp.int32), args[0][1]
+
+
+@_reg("datediff")
+def _datediff(e, args, n):
+    a = _as_us(args[0], e.args[0].ftype) // 86_400_000_000
+    b = _as_us(args[1], e.args[1].ftype) // 86_400_000_000
+    return a - b, _both_valid(args[0], args[1])
+
+
+_US_PER = {
+    "microsecond": 1,
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": 86_400_000_000,
+    "week": 7 * 86_400_000_000,
+}
+
+
+@_reg("date_add", "date_sub")
+def _date_addsub(e, args, n):
+    unit = e.meta.get("unit", "day")
+    if unit not in _US_PER:
+        raise JaxUnsupported(f"device date_{e.name} unit {unit}")
+    sign = 1 if e.name == "date_add" else -1
+    v, delta = args
+    us = _as_us(v, e.args[0].ftype) + sign * delta[0].astype(jnp.int64) * _US_PER[unit]
+    valid = _both_valid(v, delta)
+    if e.ftype.kind == TypeKind.DATE:
+        return (us // 86_400_000_000).astype(jnp.int32), valid
+    return us, valid

@@ -1,0 +1,29 @@
+"""Device kernels (jax).
+
+Importing this package configures jax for the framework:
+- x64 enabled: SQL semantics need int64 handles/sums and float64 agg
+  accumulation (XLA emulates 64-bit on TPU; elementwise hot loops below keep
+  32-bit types where safe and widen only at the reduction boundary).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .segment import (  # noqa: E402
+    masked_segment_sum,
+    masked_segment_count,
+    masked_segment_min,
+    masked_segment_max,
+    masked_segment_argfirst,
+)
+from .topk import masked_top_k  # noqa: E402
+
+__all__ = [
+    "masked_segment_sum",
+    "masked_segment_count",
+    "masked_segment_min",
+    "masked_segment_max",
+    "masked_segment_argfirst",
+    "masked_top_k",
+]

@@ -1,0 +1,56 @@
+"""Segmented reductions with selection masks.
+
+The TPU-native replacement for the reference's hash-aggregation inner loops
+(executor/aggregate.go partial workers; mocktikv row-at-a-time aggregation):
+group codes are dense ints, so partial aggregation is a segment reduction —
+an operation XLA compiles to efficient scatter/one-hot-matmul kernels on the
+MXU instead of a hash table.  Reference pattern: "partial aggregates"
+two-phase split (planner/core/task.go agg pushdown; DrJAX mapreduce
+primitives, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_segment_sum(data, gidx, mask, num_segments: int):
+    """sum of data[i] into segment gidx[i] where mask[i]."""
+    zero = jnp.zeros((), dtype=data.dtype)
+    contrib = jnp.where(mask, data, zero)
+    return jax.ops.segment_sum(contrib, gidx, num_segments=num_segments)
+
+
+def masked_segment_count(gidx, mask, num_segments: int):
+    return jax.ops.segment_sum(
+        mask.astype(jnp.int64), gidx, num_segments=num_segments
+    )
+
+
+def masked_segment_min(data, gidx, mask, num_segments: int):
+    big = _extreme(data.dtype, True)
+    contrib = jnp.where(mask, data, big)
+    return jax.ops.segment_min(contrib, gidx, num_segments=num_segments)
+
+
+def masked_segment_max(data, gidx, mask, num_segments: int):
+    small = _extreme(data.dtype, False)
+    contrib = jnp.where(mask, data, small)
+    return jax.ops.segment_max(contrib, gidx, num_segments=num_segments)
+
+
+def masked_segment_argfirst(gidx, mask, num_segments: int):
+    """Index of the first masked row per segment (for FIRST_ROW);
+    num_rows (= len(gidx)) where the segment is empty."""
+    n = gidx.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    contrib = jnp.where(mask, idx, n)
+    return jax.ops.segment_min(contrib, gidx, num_segments=num_segments)
+
+
+def _extreme(dtype, want_max: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if want_max else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if want_max else info.min, dtype=dtype)

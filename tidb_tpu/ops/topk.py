@@ -1,0 +1,31 @@
+"""Masked top-k for TopN pushdown.
+
+Reference: TopN coprocessor executor (mocktikv/topn.go).  On device: build a
+single sortable key per row, mask invalid rows to -inf, lax.top_k, return
+flat row indices for the host to gather.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_top_k(key, mask, k: int, descending: bool):
+    """Return (indices, count) of the top/bottom-k masked rows by `key`.
+
+    key: float64/int64 [n]; mask: bool [n].  Ties broken by row index
+    (ascending) for deterministic results.
+    """
+    kf = key.astype(jnp.float64)
+    if not descending:
+        kf = -kf
+    neg_inf = jnp.array(-jnp.inf, dtype=jnp.float64)
+    kf = jnp.where(mask, kf, neg_inf)
+    # tie-break on row index: subtract tiny monotonic epsilon
+    n = key.shape[0]
+    idxf = jnp.arange(n, dtype=jnp.float64)
+    kf = kf - idxf * 1e-18
+    _, idx = jax.lax.top_k(kf, k)
+    valid_count = jnp.minimum(mask.sum(), k)
+    return idx, valid_count

@@ -1,0 +1,27 @@
+from .kv import (
+    CopRequest,
+    CopResponse,
+    KeyRange,
+    Storage,
+    StoreClient,
+)
+from .oracle import Oracle, compose_ts, extract_physical
+from .blockstore import TableStore, BLOCK_SIZE
+from .regions import Region, RegionManager
+from .storage import BlockStorage
+
+__all__ = [
+    "CopRequest",
+    "CopResponse",
+    "KeyRange",
+    "Storage",
+    "StoreClient",
+    "Oracle",
+    "compose_ts",
+    "extract_physical",
+    "TableStore",
+    "BLOCK_SIZE",
+    "Region",
+    "RegionManager",
+    "BlockStorage",
+]

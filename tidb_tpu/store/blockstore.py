@@ -1,0 +1,457 @@
+"""Columnar block store: the TPU-native storage engine for one table.
+
+This is the component the reference does NOT contain (TiKV's storage engine,
+in Rust, outside the repo) and which we must build natively (SURVEY.md header
+note).  Design:
+
+- **Base**: immutable fixed-capacity column blocks (numpy; BLOCK_SIZE rows)
+  with implicit handles [0..base_rows).  Fixed shapes are what XLA wants:
+  a scan stacks blocks into [n_blocks, BLOCK_SIZE] device arrays with
+  row-validity masks, so every block compiles to the same program.
+- **Strings** are dictionary-encoded at load with a *sorted* dictionary
+  (order-preserving: code comparisons = string comparisons), codes int32.
+- **Delta**: an MVCC row store (handle -> version chain) for DML after load,
+  with Percolator locks — the moral equivalent of TiDB's membuffer+TiKV MVCC
+  (kv/memdb + mocktikv/mvcc_leveldb.go).  Scans overlay delta on base like
+  UnionScan (executor/union_scan.go) merges txn buffer over snapshot.
+- **compact()** merges committed delta into new base blocks (delta-merge,
+  the TiFlash idea) and rebuilds dictionaries sorted.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..chunk import Chunk, Column
+from ..errors import KVError, LockedError, TxnConflictError
+from ..types import FieldType, TypeKind
+
+BLOCK_SIZE = 1 << 16  # 65536 rows per block
+
+
+@dataclass
+class ColumnMeta:
+    name: str
+    ftype: FieldType
+    # sorted dictionary for string columns (base blocks store int32 codes)
+    dictionary: Optional[List[str]] = None
+
+
+@dataclass
+class Lock:
+    start_ts: int
+    primary: Tuple[int, int]  # (table_id, handle)
+    op: str  # 'put' | 'del' | 'lock'
+    values: Optional[tuple]
+    ttl_ms: int = 3000
+
+
+@dataclass
+class Version:
+    commit_ts: int
+    start_ts: int
+    op: str  # 'put' | 'del'
+    values: Optional[tuple]  # full row tuple for 'put'
+
+
+class TableStore:
+    def __init__(self, table_id: int, columns: List[Tuple[str, FieldType]]):
+        self.table_id = table_id
+        self.cols: List[ColumnMeta] = [ColumnMeta(n, t) for n, t in columns]
+        self.base_rows = 0
+        # per column: list of numpy blocks + validity blocks
+        self._blocks: List[List[np.ndarray]] = [[] for _ in self.cols]
+        self._valids: List[List[Optional[np.ndarray]]] = [[] for _ in self.cols]
+        self.base_ts = 0  # commit_ts of the base snapshot
+        # delta: handle -> ascending-commit_ts version chain
+        self.delta: Dict[int, List[Version]] = {}
+        self.locks: Dict[int, Lock] = {}
+        self.next_handle = 0
+        self._mu = threading.RLock()
+        # bumped on bulk load / compact: device caches key on this
+        self.base_version = 0
+        self._col_stats: Dict[int, Tuple[int, int, bool]] = {}
+
+    # ------------------------------------------------------------------
+    # schema helpers
+    # ------------------------------------------------------------------
+    @property
+    def n_cols(self) -> int:
+        return len(self.cols)
+
+    def col_index(self, name: str) -> int:
+        for i, c in enumerate(self.cols):
+            if c.name == name:
+                return i
+        raise KVError(f"no column {name!r} in table {self.table_id}")
+
+    def ftypes(self) -> List[FieldType]:
+        return [c.ftype for c in self.cols]
+
+    def dict_encoded_cols(self) -> set:
+        return {
+            i for i, c in enumerate(self.cols) if c.dictionary is not None
+        }
+
+    def encode_dict_const(self, col_idx: int, s: str) -> int:
+        """String constant -> dictionary code; -1 if absent (matches nothing,
+        but keeps comparisons well-defined because codes are >= 0)."""
+        d = self.cols[col_idx].dictionary
+        if d is None:
+            raise KVError("column not dict-encoded")
+        j = bisect.bisect_left(d, s)
+        if j < len(d) and d[j] == s:
+            return j
+        return -1
+    def dict_bound(self, col_idx: int, s: str, side: str) -> int:
+        """Code bound for range predicates on sorted dictionaries:
+        side='left' -> first code with value >= s; 'right' -> first > s."""
+        d = self.cols[col_idx].dictionary
+        return (bisect.bisect_left if side == "left" else bisect.bisect_right)(d, s)
+
+    # ------------------------------------------------------------------
+    # bulk load (build base blocks)
+    # ------------------------------------------------------------------
+    def bulk_load_arrays(self, arrays: Sequence[np.ndarray],
+                         valids: Optional[Sequence[Optional[np.ndarray]]] = None,
+                         ts: int = 0):
+        """Append columnar data to base.  String columns take object arrays
+        and are dictionary-encoded here."""
+        with self._mu:
+            n = len(arrays[0])
+            assert all(len(a) == n for a in arrays), "ragged load"
+            for ci, (meta, arr) in enumerate(zip(self.cols, arrays)):
+                valid = valids[ci] if valids else None
+                if meta.ftype.kind == TypeKind.STRING:
+                    codes, dictionary = _dict_encode_merge(
+                        arr, meta.dictionary, self._blocks[ci]
+                    )
+                    meta.dictionary = dictionary
+                    arr = codes
+                else:
+                    arr = np.ascontiguousarray(arr, dtype=meta.ftype.np_dtype)
+                self._append_blocks(ci, arr, valid)
+            self.base_rows += n
+            self.next_handle = max(self.next_handle, self.base_rows)
+            self.base_ts = max(self.base_ts, ts)
+            self.base_version += 1
+            self._col_stats.clear()
+
+    def _append_blocks(self, ci: int, arr: np.ndarray, valid: Optional[np.ndarray]):
+        blocks, valids = self._blocks[ci], self._valids[ci]
+        off = 0
+        n = len(arr)
+        # fill the last partial block first
+        if blocks and len(blocks[-1]) < BLOCK_SIZE:
+            space = BLOCK_SIZE - len(blocks[-1])
+            take = min(space, n)
+            blocks[-1] = np.concatenate([blocks[-1], arr[:take]])
+            if valids[-1] is not None or (valid is not None and not valid[:take].all()):
+                old_v = (
+                    valids[-1]
+                    if valids[-1] is not None
+                    else np.ones(len(blocks[-1]) - take, dtype=np.bool_)
+                )
+                new_v = (
+                    valid[:take]
+                    if valid is not None
+                    else np.ones(take, dtype=np.bool_)
+                )
+                valids[-1] = np.concatenate([old_v, new_v])
+            off = take
+        while off < n:
+            take = min(BLOCK_SIZE, n - off)
+            blocks.append(np.ascontiguousarray(arr[off : off + take]))
+            v = None
+            if valid is not None and not valid[off : off + take].all():
+                v = valid[off : off + take].copy()
+            valids.append(v)
+            off += take
+
+    # ------------------------------------------------------------------
+    # base block access (device scan path)
+    # ------------------------------------------------------------------
+    def iter_base_blocks(
+        self, col_idx: Sequence[int], start: int, end: int
+    ) -> Iterator[Tuple[int, List[np.ndarray], List[Optional[np.ndarray]]]]:
+        """Yield (handle_offset, [col arrays], [col valids]) for each base
+        block slice intersecting [start, end)."""
+        end = min(end, self.base_rows)
+        if start >= end:
+            return
+        b0, b1 = start // BLOCK_SIZE, (end - 1) // BLOCK_SIZE
+        for b in range(b0, b1 + 1):
+            lo = max(start - b * BLOCK_SIZE, 0)
+            hi = min(end - b * BLOCK_SIZE, BLOCK_SIZE)
+            arrs, vals = [], []
+            for ci in col_idx:
+                blk = self._blocks[ci][b]
+                arrs.append(blk[lo:hi])
+                v = self._valids[ci][b]
+                vals.append(v[lo:hi] if v is not None else None)
+            yield b * BLOCK_SIZE + lo, arrs, vals
+
+    def base_chunk(self, col_idx: Sequence[int], start: int, end: int,
+                   decode_strings: bool = True) -> Chunk:
+        """Materialize base rows [start, end) as a host Chunk."""
+        cols: List[Column] = []
+        parts: List[List[np.ndarray]] = [[] for _ in col_idx]
+        vparts: List[List[np.ndarray]] = [[] for _ in col_idx]
+        any_rows = False
+        for off, arrs, vals in self.iter_base_blocks(col_idx, start, end):
+            any_rows = True
+            for i, (a, v) in enumerate(zip(arrs, vals)):
+                parts[i].append(a)
+                vparts[i].append(
+                    v if v is not None else np.ones(len(a), dtype=np.bool_)
+                )
+        for i, ci in enumerate(col_idx):
+            meta = self.cols[ci]
+            if not any_rows:
+                cols.append(Column.from_values(meta.ftype, []))
+                continue
+            data = np.concatenate(parts[i])
+            valid = np.concatenate(vparts[i])
+            if meta.ftype.kind == TypeKind.STRING and decode_strings:
+                d = meta.dictionary or []
+                obj = np.empty(len(data), dtype=object)
+                for j in range(len(data)):
+                    obj[j] = d[data[j]] if 0 <= data[j] < len(d) else ""
+                data = obj
+            cols.append(Column(meta.ftype, data, None if valid.all() else valid))
+        return Chunk(cols)
+
+    # ------------------------------------------------------------------
+    # MVCC delta (Percolator)
+    # ------------------------------------------------------------------
+    def prewrite(self, handle: int, op: str, values: Optional[tuple],
+                 primary: Tuple[int, int], start_ts: int, ttl_ms: int = 3000):
+        with self._mu:
+            lk = self.locks.get(handle)
+            if lk is not None and lk.start_ts != start_ts:
+                raise LockedError((self.table_id, handle), lk.start_ts)
+            chain = self.delta.get(handle)
+            if chain and chain[-1].commit_ts > start_ts:
+                raise TxnConflictError((self.table_id, handle))
+            self.locks[handle] = Lock(start_ts, primary, op, values, ttl_ms)
+
+    def commit(self, handle: int, start_ts: int, commit_ts: int):
+        with self._mu:
+            lk = self.locks.get(handle)
+            if lk is None or lk.start_ts != start_ts:
+                # already committed (idempotent) or rolled back
+                chain = self.delta.get(handle, [])
+                for v in reversed(chain):
+                    if v.start_ts == start_ts:
+                        return
+                raise TxnConflictError((self.table_id, handle))
+            del self.locks[handle]
+            if lk.op == "lock":
+                return
+            self.delta.setdefault(handle, []).append(
+                Version(commit_ts, start_ts, lk.op, lk.values)
+            )
+
+    def rollback(self, handle: int, start_ts: int):
+        with self._mu:
+            lk = self.locks.get(handle)
+            if lk is not None and lk.start_ts == start_ts:
+                del self.locks[handle]
+
+    def check_lock(self, handle: int, read_ts: int) -> Optional[Lock]:
+        lk = self.locks.get(handle)
+        if lk is not None and lk.start_ts <= read_ts and lk.op != "lock":
+            return lk
+        return None
+
+    def visible_version(self, handle: int, ts: int) -> Optional[Version]:
+        chain = self.delta.get(handle)
+        if not chain:
+            return None
+        for v in reversed(chain):
+            if v.commit_ts <= ts:
+                return v
+        return None
+
+    def read_row(self, handle: int, ts: int,
+                 resolve_locks: bool = True) -> Optional[tuple]:
+        """Point read at snapshot ts (None = not found)."""
+        with self._mu:
+            lk = self.check_lock(handle, ts)
+            if lk is not None:
+                raise LockedError((self.table_id, handle), lk.start_ts)
+            v = self.visible_version(handle, ts)
+            if v is not None:
+                return v.values if v.op == "put" else None
+            if handle < self.base_rows and self.base_ts <= ts:
+                return tuple(
+                    self.base_chunk(range(self.n_cols), handle, handle + 1).row(0)
+                )
+            return None
+
+    def delta_overlay(self, ts: int, start: int, end: int):
+        """(deleted_base_handles, inserted_rows{handle: values}) visible at ts.
+
+        A 'put' on a base handle counts as delete+insert (update)."""
+        deleted: List[int] = []
+        inserted: Dict[int, tuple] = {}
+        with self._mu:
+            for h, chain in self.delta.items():
+                if not (start <= h < end):
+                    continue
+                lk = self.check_lock(h, ts)
+                if lk is not None:
+                    raise LockedError((self.table_id, h), lk.start_ts)
+                v = None
+                for ver in reversed(chain):
+                    if ver.commit_ts <= ts:
+                        v = ver
+                        break
+                if v is None:
+                    continue
+                if h < self.base_rows:
+                    deleted.append(h)
+                if v.op == "put":
+                    inserted[h] = v.values
+        return deleted, inserted
+
+    def alloc_handle(self) -> int:
+        with self._mu:
+            h = self.next_handle
+            self.next_handle += 1
+            return h
+
+    # ------------------------------------------------------------------
+    # delta-merge compaction
+    # ------------------------------------------------------------------
+    def compact(self, ts: int):
+        """Fold delta (committed, visible at ts) into fresh base blocks."""
+        with self._mu:
+            if any(self.locks):
+                raise KVError("cannot compact with live locks")
+            deleted, inserted = self.delta_overlay(ts, 0, 1 << 62)
+            del_set = set(deleted)
+            chunk = self.base_chunk(range(self.n_cols), 0, self.base_rows)
+            keep = np.ones(self.base_rows, dtype=np.bool_)
+            for h in del_set:
+                keep[h] = False
+            base = chunk.filter(keep) if self.base_rows else chunk
+            extra_rows = [inserted[h] for h in sorted(inserted)]
+            arrays, valids = [], []
+            for ci, meta in enumerate(self.cols):
+                col = base.col(ci)
+                data = col.data
+                valid = col.validity()
+                if extra_rows:
+                    ev = [r[ci] for r in extra_rows]
+                    evalid = np.array([x is not None for x in ev], dtype=np.bool_)
+                    if meta.ftype.kind == TypeKind.STRING:
+                        earr = np.empty(len(ev), dtype=object)
+                        for j, x in enumerate(ev):
+                            earr[j] = x if x is not None else ""
+                    else:
+                        earr = np.zeros(len(ev), dtype=meta.ftype.np_dtype)
+                        for j, x in enumerate(ev):
+                            if x is not None:
+                                earr[j] = x
+                    data = np.concatenate([data, earr])
+                    valid = np.concatenate([valid, evalid])
+                arrays.append(data)
+                valids.append(valid)
+            # rebuild
+            self._blocks = [[] for _ in self.cols]
+            self._valids = [[] for _ in self.cols]
+            for meta in self.cols:
+                meta.dictionary = None
+            self.base_rows = 0
+            self.delta.clear()
+            self.bulk_load_arrays(arrays, valids, ts)
+            self.next_handle = self.base_rows
+
+    def gc(self, safepoint: int):
+        """Drop versions no reader at ts >= safepoint can see.
+
+        Reference: store/tikv/gcworker (gc_worker.go:213-289)."""
+        with self._mu:
+            for h in list(self.delta):
+                chain = self.delta[h]
+                # keep the newest version <= safepoint plus all > safepoint
+                keep_from = 0
+                for i, v in enumerate(chain):
+                    if v.commit_ts <= safepoint:
+                        keep_from = i
+                self.delta[h] = chain[keep_from:]
+
+    def column_stats(self, ci: int) -> Tuple[int, int, bool]:
+        """(min, max, has_null) over base blocks for numeric/dict columns.
+        Used by the device engine to bound group-code spaces and by the
+        planner for range estimation.  Cached per base_version."""
+        cached = self._col_stats.get(ci)
+        if cached is not None:
+            return cached
+        meta = self.cols[ci]
+        lo, hi, has_null = 0, -1, False
+        if meta.ftype.kind == TypeKind.STRING:
+            lo, hi = 0, len(meta.dictionary or []) - 1
+            for v in self._valids[ci]:
+                if v is not None and not v.all():
+                    has_null = True
+                    break
+        else:
+            first = True
+            for blk, v in zip(self._blocks[ci], self._valids[ci]):
+                if v is None:
+                    vals = blk
+                else:
+                    if not v.all():
+                        has_null = True
+                    vals = blk[v]
+                if len(vals) == 0:
+                    continue
+                bmin, bmax = int(vals.min()), int(np.ceil(float(vals.max())))
+                if first:
+                    lo, hi, first = bmin, bmax, False
+                else:
+                    lo, hi = min(lo, bmin), max(hi, bmax)
+        out = (lo, hi, has_null)
+        self._col_stats[ci] = out
+        return out
+
+    def nbytes(self) -> int:
+        total = 0
+        for blocks in self._blocks:
+            for b in blocks:
+                total += b.nbytes if b.dtype != object else len(b) * 8
+        return total
+
+
+def _dict_encode_merge(arr: np.ndarray, old_dict: Optional[List[str]],
+                       existing_blocks: List[np.ndarray]):
+    """Encode object-array strings; if a dictionary already exists and new
+    values appear, rebuild the dictionary sorted and remap existing blocks
+    in place (keeps code order == string order)."""
+    values = sorted(set(str(x) for x in arr))
+    if old_dict is None:
+        dictionary = values
+        lookup = {s: i for i, s in enumerate(dictionary)}
+        codes = np.fromiter(
+            (lookup[str(x)] for x in arr), dtype=np.int32, count=len(arr)
+        )
+        return codes, dictionary
+    merged = sorted(set(old_dict) | set(values))
+    if merged != old_dict:
+        remap = np.array(
+            [merged.index(s) for s in old_dict], dtype=np.int32
+        ) if old_dict else np.zeros(0, np.int32)
+        for i, blk in enumerate(existing_blocks):
+            existing_blocks[i] = remap[blk]
+    lookup = {s: i for i, s in enumerate(merged)}
+    codes = np.fromiter(
+        (lookup[str(x)] for x in arr), dtype=np.int32, count=len(arr)
+    )
+    return codes, merged

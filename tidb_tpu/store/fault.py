@@ -1,0 +1,61 @@
+"""Fault-injection hooks for the storage layer.
+
+Reference: three mechanisms in the reference (SURVEY.md §5): failpoint
+injections, kv.InjectedStore error wrappers (kv/fault_injection.go:22-80),
+and mocktikv cluster manipulation / WithHijackClient.  Here a single hook
+registry the fake backend consults; tests arm/disarm named failpoints.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+class FailpointRegistry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._points: Dict[str, Callable] = {}
+
+    def enable(self, name: str, action: Callable):
+        """action() is invoked at the site; raise inside it to inject an
+        error, return to no-op.  It may count calls to fire once, etc."""
+        with self._mu:
+            self._points[name] = action
+
+    def disable(self, name: str):
+        with self._mu:
+            self._points.pop(name, None)
+
+    def clear(self):
+        with self._mu:
+            self._points.clear()
+
+    def hit(self, name: str, **ctx):
+        with self._mu:
+            action = self._points.get(name)
+        if action is not None:
+            action(**ctx)
+
+
+# process-global registry (tests reset via clear())
+FAILPOINTS = FailpointRegistry()
+
+
+def once(exc: Exception) -> Callable:
+    """Helper: raise `exc` on first hit only (stale-epoch style transients)."""
+    state = {"fired": False}
+
+    def action(**ctx):
+        if not state["fired"]:
+            state["fired"] = True
+            raise exc
+
+    return action
+
+
+def always(exc: Exception) -> Callable:
+    def action(**ctx):
+        raise exc
+
+    return action

@@ -1,0 +1,93 @@
+"""KV / coprocessor abstraction layer.
+
+Reference: kv/kv.go — Storage (:324), Snapshot (:304), Client (:197),
+Request (:245), Response (:295).  The seams kept verbatim (they are
+transport-agnostic and proven); the *content* differs: a "key" is a
+(table_id, handle) pair, a scan range is a handle range, and the request
+payload is our DAG IR instead of tipb protobufs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+# A row key addresses (table_id, handle).  Index keys address
+# (table_id, index_id, encoded_value, handle).
+RowKey = Tuple[int, int]
+
+
+@dataclass(frozen=True)
+class KeyRange:
+    """Half-open handle range [start, end) within one table."""
+
+    table_id: int
+    start: int
+    end: int
+
+    def intersect(self, other: "KeyRange") -> Optional["KeyRange"]:
+        if self.table_id != other.table_id:
+            return None
+        s, e = max(self.start, other.start), min(self.end, other.end)
+        if s >= e:
+            return None
+        return KeyRange(self.table_id, s, e)
+
+
+@dataclass
+class CopRequest:
+    """A coprocessor request: run `dag` over `ranges` at snapshot `ts`.
+
+    Reference: kv.Request (kv/kv.go:245) + tipb.DAGRequest.  Fields kept:
+    concurrency, keep_order, streaming, target engine routing.
+    """
+
+    dag: dict  # serialized DAG IR (copr/ir.py)
+    ranges: List[KeyRange]
+    ts: int
+    concurrency: int = 8
+    keep_order: bool = False
+    streaming: bool = False
+    # "tpu" | "cpu" — per-request engine routing, the analog of
+    # kv.StoreType TiKV/TiFlash (kv/kv.go:222-232)
+    engine: str = "tpu"
+
+
+@dataclass
+class CopResponse:
+    """One region's (or one batch's) worth of results."""
+
+    chunks: List = field(default_factory=list)  # list[Chunk]
+    exec_summary: dict = field(default_factory=dict)
+
+
+class StoreClient:
+    """Narrow pushdown boundary: Send(CopRequest) -> iterator of CopResponse.
+
+    Reference: kv.Client (kv/kv.go:197-203).
+    """
+
+    def send(self, req: CopRequest) -> Iterator[CopResponse]:
+        raise NotImplementedError
+
+    def is_request_supported(self, req: CopRequest) -> bool:
+        return True
+
+
+class Storage:
+    """Storage = catalog of table stores + txn entry points + cop client.
+
+    Reference: kv.Storage (kv/kv.go:324).
+    """
+
+    def begin(self, start_ts: Optional[int] = None):
+        raise NotImplementedError
+
+    def snapshot(self, ts: int):
+        raise NotImplementedError
+
+    def get_client(self) -> StoreClient:
+        raise NotImplementedError
+
+    def current_ts(self) -> int:
+        raise NotImplementedError

@@ -1,0 +1,45 @@
+"""Timestamp oracle.
+
+Reference: store/tikv/oracle/oracle.go:22-40 — TSO as physical_ms<<18 |
+logical, with futures from PD.  Here a process-local monotonic oracle; the
+multi-host story replaces this with a host-0-owned service over DCN.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_LOGICAL_BITS = 18
+
+
+def compose_ts(physical_ms: int, logical: int) -> int:
+    return (physical_ms << _LOGICAL_BITS) | logical
+
+
+def extract_physical(ts: int) -> int:
+    return ts >> _LOGICAL_BITS
+
+
+class Oracle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_physical = 0
+        self._logical = 0
+
+    def get_timestamp(self) -> int:
+        with self._lock:
+            phys = int(time.time() * 1000)
+            if phys <= self._last_physical:
+                phys = self._last_physical
+                self._logical += 1
+                if self._logical >= (1 << _LOGICAL_BITS):
+                    phys += 1
+                    self._logical = 0
+            else:
+                self._logical = 0
+            self._last_physical = phys
+            return compose_ts(phys, self._logical)
+
+    def is_expired(self, lock_ts: int, ttl_ms: int) -> bool:
+        return int(time.time() * 1000) >= extract_physical(lock_ts) + ttl_ms

@@ -1,0 +1,125 @@
+"""Range-sharded regions with epochs.
+
+Reference: store/tikv/region_cache.go (region->leader map, invalidation),
+mocktikv/cluster.go:70-412 (simulated multi-region topology with splits,
+SplitTable used by tests to create genuine multi-region scans).
+
+A Region covers a half-open handle range of one table.  Regions are the
+fan-out unit for coprocessor requests; on TPU they map to shard groups of
+the device mesh.  Epochs let fault-injection tests exercise the stale-routing
+retry loop exactly like the reference (region_request.go:281 onRegionError).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import RegionError
+from .kv import KeyRange
+
+
+@dataclass
+class Region:
+    region_id: int
+    table_id: int
+    start: int  # inclusive handle
+    end: int  # exclusive handle (1<<62 = +inf)
+    epoch: int = 1
+    leader_store: int = 0
+
+    def range(self) -> KeyRange:
+        return KeyRange(self.table_id, self.start, self.end)
+
+
+INF = 1 << 62
+
+
+class RegionManager:
+    def __init__(self, n_stores: int = 1):
+        self.n_stores = n_stores
+        self._next_id = 1
+        self._mu = threading.RLock()
+        # table_id -> list[Region] sorted by start, covering [0, INF)
+        self._by_table: Dict[int, List[Region]] = {}
+
+    def _new_region(self, table_id: int, start: int, end: int) -> Region:
+        r = Region(self._next_id, table_id, start, end,
+                   leader_store=self._next_id % self.n_stores)
+        self._next_id += 1
+        return r
+
+    def bootstrap_table(self, table_id: int):
+        with self._mu:
+            if table_id not in self._by_table:
+                self._by_table[table_id] = [self._new_region(table_id, 0, INF)]
+
+    def drop_table(self, table_id: int):
+        with self._mu:
+            self._by_table.pop(table_id, None)
+
+    def regions_of(self, table_id: int) -> List[Region]:
+        """Snapshot of routing info (copies — a caller's view can go stale,
+        which is exactly what the epoch-check/retry path exercises)."""
+        with self._mu:
+            self.bootstrap_table(table_id)
+            return [replace(r) for r in self._by_table[table_id]]
+
+    def split_at(self, table_id: int, handles: List[int]):
+        """Split so that each handle in `handles` starts a new region."""
+        with self._mu:
+            self.bootstrap_table(table_id)
+            regions = self._by_table[table_id]
+            for h in sorted(set(handles)):
+                idx = self._locate_idx(regions, h)
+                r = regions[idx]
+                if r.start == h:
+                    continue
+                left = self._new_region(table_id, r.start, h)
+                r.start = h
+                r.epoch += 1
+                regions.insert(idx, left)
+
+    def split_even(self, table_id: int, n: int, total_rows: int):
+        """Split [0,total_rows) into n regions (mocktikv SplitTable analog,
+        cluster.go:394-412)."""
+        if n <= 1 or total_rows <= 0:
+            return
+        step = max(total_rows // n, 1)
+        self.split_at(table_id, [i * step for i in range(1, n)])
+
+    def merge_all(self, table_id: int):
+        with self._mu:
+            if table_id in self._by_table:
+                self._by_table[table_id] = [self._new_region(table_id, 0, INF)]
+
+    @staticmethod
+    def _locate_idx(regions: List[Region], handle: int) -> int:
+        starts = [r.start for r in regions]
+        return max(bisect.bisect_right(starts, handle) - 1, 0)
+
+    def locate(self, krange: KeyRange) -> List[Tuple[Region, KeyRange]]:
+        """Split one key range across the regions covering it."""
+        out = []
+        with self._mu:
+            self.bootstrap_table(krange.table_id)
+            for r in self._by_table[krange.table_id]:
+                clipped = r.range().intersect(krange)
+                if clipped is not None:
+                    out.append((replace(r), clipped))
+        return out
+
+    def check_epoch(self, region_id: int, epoch: int, table_id: int):
+        """Raise RegionError if the caller's routing info is stale
+        (the reference's ErrRegionEpochNotMatch path)."""
+        with self._mu:
+            for r in self._by_table.get(table_id, []):
+                if r.region_id == region_id:
+                    if r.epoch != epoch:
+                        raise RegionError(
+                            f"region {region_id} epoch {epoch} != {r.epoch}"
+                        )
+                    return
+            raise RegionError(f"region {region_id} not found")

@@ -1,0 +1,130 @@
+"""BlockStorage: the in-process storage service (catalog of table stores +
+regions + oracle + coprocessor client).
+
+Reference: the kv.Storage implementations — tikvStore (store/tikv/kv.go:130)
+and the test-critical NewMockTikvStore (store/mockstore/tikv.go:100).  One
+class serves both roles here: it IS the real storage engine (blocks live in
+host RAM, compute on TPU) and it IS the deterministic test backend (regions,
+epochs, failpoints).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import KVError, RegionError
+from ..types import FieldType
+from .blockstore import TableStore
+from .fault import FAILPOINTS
+from .kv import CopRequest, CopResponse, KeyRange, Storage, StoreClient
+from .oracle import Oracle
+from .regions import RegionManager
+from .txn import Transaction
+
+
+class BlockStorage(Storage):
+    def __init__(self, n_stores: int = 1):
+        self.oracle = Oracle()
+        self.regions = RegionManager(n_stores=n_stores)
+        self._tables: Dict[int, TableStore] = {}
+        self._mu = threading.RLock()
+        self._client = CoprClient(self)
+
+    # ---- catalog -------------------------------------------------------
+    def create_table(self, table_id: int, columns: List[Tuple[str, FieldType]]) -> TableStore:
+        with self._mu:
+            if table_id in self._tables:
+                raise KVError(f"table {table_id} exists in storage")
+            ts = TableStore(table_id, columns)
+            self._tables[table_id] = ts
+            self.regions.bootstrap_table(table_id)
+            return ts
+
+    def drop_table(self, table_id: int):
+        with self._mu:
+            self._tables.pop(table_id, None)
+            self.regions.drop_table(table_id)
+
+    def table(self, table_id: int) -> TableStore:
+        t = self._tables.get(table_id)
+        if t is None:
+            raise KVError(f"no storage for table {table_id}")
+        return t
+
+    def has_table(self, table_id: int) -> bool:
+        return table_id in self._tables
+
+    # ---- kv.Storage interface ------------------------------------------
+    def begin(self, start_ts: Optional[int] = None, pessimistic: bool = False) -> Transaction:
+        return Transaction(
+            self, start_ts or self.oracle.get_timestamp(), pessimistic
+        )
+
+    def current_ts(self) -> int:
+        return self.oracle.get_timestamp()
+
+    def get_client(self) -> "CoprClient":
+        return self._client
+
+
+class CoprClient(StoreClient):
+    """The pushdown boundary implementation: fan a CopRequest out per region
+    and run the DAG on the chosen engine.
+
+    Reference: store/tikv/coprocessor.go CopClient.Send (:57) +
+    buildCopTasks (:220) + the worker loop (:391-560).  The retry-on-
+    region-error loop lives here (region_request.go:74-161 analog).
+    """
+
+    def __init__(self, storage: BlockStorage):
+        self.storage = storage
+
+    def send(self, req: CopRequest):
+        # late imports: copr depends on chunk/expr only
+        from ..copr.engine import run_dag_on_region
+
+        tasks = []  # (region, clipped ranges)
+        for kr in req.ranges:
+            for region, clipped in self.storage.regions.locate(kr):
+                tasks.append((region, clipped))
+        # order by handle range start for keep_order
+        tasks.sort(key=lambda t: (t[1].table_id, t[1].start))
+        for region, clipped in tasks:
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    FAILPOINTS.hit(
+                        "copr/region_error",
+                        region_id=region.region_id,
+                        attempt=attempts,
+                    )
+                    self.storage.regions.check_epoch(
+                        region.region_id, region.epoch, clipped.table_id
+                    )
+                    resp = run_dag_on_region(
+                        self.storage, req, region, clipped
+                    )
+                    yield resp
+                    break
+                except RegionError:
+                    if attempts > 10:
+                        raise
+                    # refresh routing: re-locate the clipped range
+                    sub = self.storage.regions.locate(clipped)
+                    if len(sub) == 1:
+                        region, clipped = sub[0]
+                        continue
+                    # range now spans several regions: recurse via fresh send
+                    subreq = CopRequest(
+                        dag=req.dag,
+                        ranges=[c for _, c in sub],
+                        ts=req.ts,
+                        concurrency=req.concurrency,
+                        keep_order=req.keep_order,
+                        streaming=req.streaming,
+                        engine=req.engine,
+                    )
+                    yield from self.send(subreq)
+                    break

@@ -1,0 +1,140 @@
+"""Transactions: optimistic 2PC over the block stores.
+
+Reference: store/tikv/2pc.go — Percolator prewrite/commit with keys grouped
+per region (appendBatchBySize :1226), primary-first commit (:999,:866),
+TTL'd locks; optimistic conflict surfaces as retryable error
+(session retry loop lives in the session layer, session.go:635).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ..errors import KVError, LockedError, TxnConflictError
+from .fault import FAILPOINTS
+
+RowKey = Tuple[int, int]  # (table_id, handle)
+
+
+@dataclass
+class Mutation:
+    op: str  # 'put' | 'del' | 'lock'
+    values: Optional[tuple]
+
+
+class Transaction:
+    def __init__(self, storage, start_ts: int, pessimistic: bool = False):
+        self.storage = storage
+        self.start_ts = start_ts
+        self.pessimistic = pessimistic
+        self.buffer: Dict[RowKey, Mutation] = {}
+        self._locked: set = set()
+        self.committed = False
+        self.rolled_back = False
+
+    # ---- buffered writes (membuffer analog, kv/memdb) ------------------
+    def put(self, table_id: int, handle: int, values: tuple):
+        self.buffer[(table_id, handle)] = Mutation("put", values)
+
+    def delete(self, table_id: int, handle: int):
+        self.buffer[(table_id, handle)] = Mutation("del", None)
+
+    def get(self, table_id: int, handle: int) -> Optional[tuple]:
+        m = self.buffer.get((table_id, handle))
+        if m is not None:
+            return m.values if m.op == "put" else None
+        return self.storage.table(table_id).read_row(handle, self.start_ts)
+
+    def lock_keys(self, *keys: RowKey, ttl_ms: int = 3000):
+        """Pessimistic locks taken during execution (2pc.go:668)."""
+        if not keys:
+            return
+        primary = keys[0]
+        for tid, h in keys:
+            self.storage.table(tid).prewrite(
+                h, "lock", None, primary, self.start_ts, ttl_ms
+            )
+            self._locked.add((tid, h))
+
+    # ---- 2PC -----------------------------------------------------------
+    def commit(self) -> int:
+        if self.committed or self.rolled_back:
+            raise KVError("txn already finished")
+        if not self.buffer and not self._locked:
+            self.committed = True
+            return self.start_ts
+        keys = sorted(self.buffer.keys())
+        if not keys:  # lock-only txn
+            for tid, h in self._locked:
+                self.storage.table(tid).rollback(h, self.start_ts)
+            self.committed = True
+            return self.start_ts
+        primary = keys[0]
+        # release pessimistic-only locks that have no mutation (they are
+        # upgraded in place when a mutation exists)
+        for tid, h in self._locked - set(keys):
+            self.storage.table(tid).rollback(h, self.start_ts)
+        # phase 1: prewrite all keys (primary first), grouped per region
+        prewritten = []
+        try:
+            for tid, h in keys:
+                FAILPOINTS.hit("2pc/prewrite", table_id=tid, handle=h)
+                m = self.buffer[(tid, h)]
+                store = self.storage.table(tid)
+                if (tid, h) in self._locked:
+                    store.rollback(h, self.start_ts)  # upgrade pessimistic lock
+                store.prewrite(h, m.op, m.values, primary, self.start_ts)
+                prewritten.append((tid, h))
+        except (LockedError, TxnConflictError):
+            for tid, h in prewritten:
+                self.storage.table(tid).rollback(h, self.start_ts)
+            self.rolled_back = True
+            raise
+        commit_ts = self.storage.oracle.get_timestamp()
+        FAILPOINTS.hit("2pc/before_commit_primary", start_ts=self.start_ts)
+        # phase 2: commit primary; after that the txn is decided
+        self.storage.table(primary[0]).commit(primary[1], self.start_ts, commit_ts)
+        for tid, h in keys:
+            if (tid, h) == primary:
+                continue
+            FAILPOINTS.hit("2pc/commit_secondary", table_id=tid, handle=h)
+            self.storage.table(tid).commit(h, self.start_ts, commit_ts)
+        self.committed = True
+        return commit_ts
+
+    def rollback(self):
+        if self.committed:
+            raise KVError("txn already committed")
+        for tid, h in set(self.buffer.keys()) | self._locked:
+            self.storage.table(tid).rollback(h, self.start_ts)
+        self.buffer.clear()
+        self.rolled_back = True
+
+
+def resolve_lock(storage, table_id: int, handle: int, ttl_expired_only: bool = True):
+    """Resolve an orphan lock by consulting its primary (lock_resolver.go).
+
+    If the primary committed, roll the secondary forward; if the primary
+    lock is gone (rolled back), roll the secondary back."""
+    store = storage.table(table_id)
+    lk = store.locks.get(handle)
+    if lk is None:
+        return
+    if ttl_expired_only and not storage.oracle.is_expired(lk.start_ts, lk.ttl_ms):
+        raise LockedError((table_id, handle), lk.start_ts)
+    ptid, ph = lk.primary
+    pstore = storage.table(ptid)
+    plk = pstore.locks.get(ph)
+    if plk is not None and plk.start_ts == lk.start_ts:
+        # primary still locked and expired -> roll back the whole txn
+        pstore.rollback(ph, lk.start_ts)
+        store.rollback(handle, lk.start_ts)
+        return
+    # primary decided: find its commit_ts
+    for v in reversed(pstore.delta.get(ph, [])):
+        if v.start_ts == lk.start_ts:
+            store.commit(handle, lk.start_ts, v.commit_ts)
+            return
+    store.rollback(handle, lk.start_ts)
