@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Driver benchmark: TPC-H Q1/Q6-shaped aggregation on the coprocessor path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value       = TPC-H Q1 rows/sec through the TPU(jax) engine end-to-end
+              (SQL -> planner -> distsql fan-out -> device partial agg ->
+              root final merge), the BASELINE.json headline metric.
+vs_baseline = speedup of the TPU engine over the same framework's CPU
+              (numpy oracle) engine — the stand-in for the reference's
+              8-vCPU mocktikv path until a Go toolchain target exists.
+
+Env knobs: BENCH_ROWS (default 4M), BENCH_ITERS (default 3),
+BENCH_REGIONS (default 8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tidb_tpu.session import Domain  # noqa: E402
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
+ITERS = int(os.environ.get("BENCH_ITERS", 3))
+REGIONS = int(os.environ.get("BENCH_REGIONS", 8))
+
+Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity), sum(l_extendedprice),
+       sum(l_extendedprice * (1 - l_discount)),
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+       avg(l_quantity), avg(l_extendedprice), avg(l_discount),
+       count(*)
+from lineitem
+where l_shipdate <= '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+Q6 = """
+select sum(l_extendedprice * l_discount)
+from lineitem
+where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24
+"""
+
+
+def build_lineitem(domain: Domain, n: int):
+    s = domain.new_session()
+    s.execute(
+        "create table lineitem ("
+        " l_orderkey bigint, l_quantity decimal(15,2),"
+        " l_extendedprice double, l_discount double, l_tax double,"
+        " l_returnflag varchar(1), l_linestatus varchar(1),"
+        " l_shipdate date)"
+    )
+    t = domain.catalog.info_schema().table("test", "lineitem")
+    store = domain.storage.table(t.id)
+    rng = np.random.default_rng(7)
+    from tidb_tpu.types.values import parse_date
+
+    base = parse_date("1992-01-01")
+    span = parse_date("1998-12-01") - base
+    flags = np.array(["A", "N", "R"], dtype=object)
+    status = np.array(["F", "O"], dtype=object)
+    CHUNK = 1 << 21
+    for s0 in range(0, n, CHUNK):
+        m = min(CHUNK, n - s0)
+        arrays = [
+            rng.integers(1, n // 4 + 2, m, dtype=np.int64),     # orderkey
+            rng.integers(100, 5100, m, dtype=np.int64),          # qty (scaled .2)
+            rng.uniform(900.0, 105000.0, m),                     # extendedprice
+            np.round(rng.uniform(0.0, 0.1, m), 2),               # discount
+            np.round(rng.uniform(0.0, 0.08, m), 2),              # tax
+            flags[rng.integers(0, 3, m)],                        # returnflag
+            status[rng.integers(0, 2, m)],                       # linestatus
+            (base + rng.integers(0, span, m)).astype(np.int32),  # shipdate
+        ]
+        store.bulk_load_arrays(arrays, ts=domain.storage.current_ts())
+    domain.storage.regions.split_even(t.id, REGIONS, store.base_rows)
+    return s
+
+
+def bench_query(sess, sql: str, engine: str) -> float:
+    sess.execute(f"set tidb_use_tpu = {'1' if engine == 'tpu' else '0'}")
+    sess.query(sql)  # warmup (device transfer + XLA compile)
+    best = float("inf")
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        sess.query(sql)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    domain = Domain()
+    sess = build_lineitem(domain, N_ROWS)
+
+    q1_tpu = bench_query(sess, Q1, "tpu")
+    q6_tpu = bench_query(sess, Q6, "tpu")
+    # CPU-engine baseline on a subsample to bound wall time, scaled
+    cpu_rows = min(N_ROWS, 1_000_000)
+    if cpu_rows < N_ROWS:
+        d2 = Domain()
+        s2 = build_lineitem(d2, cpu_rows)
+    else:
+        d2, s2 = domain, sess
+    q1_cpu = bench_query(s2, Q1, "cpu") * (N_ROWS / cpu_rows)
+    q6_cpu = bench_query(s2, Q6, "cpu") * (N_ROWS / cpu_rows)
+
+    value = N_ROWS / q1_tpu
+    out = {
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(q1_cpu / q1_tpu, 3),
+        "detail": {
+            "rows": N_ROWS,
+            "q1_tpu_s": round(q1_tpu, 4),
+            "q1_cpu_est_s": round(q1_cpu, 4),
+            "q6_tpu_rows_per_sec": round(N_ROWS / q6_tpu, 1),
+            "q6_speedup": round(q6_cpu / q6_tpu, 3),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,337 @@
+"""End-to-end SQL tests through the session layer.
+
+Mirrors the reference's dominant test tier: full stack in-process against
+the embedded store (testkit.MustQuery().Check() style, SURVEY.md §4).
+"""
+
+import pytest
+
+from tidb_tpu.errors import KVError, TiDBTPUError
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def sess():
+    d = Domain()
+    return d.new_session()
+
+
+@pytest.fixture()
+def tsess(sess):
+    sess.execute("create table t (a bigint, b double, c varchar(20))")
+    sess.execute(
+        "insert into t values (1, 1.5, 'x'), (2, 2.5, 'y'), "
+        "(3, 3.5, 'x'), (null, 9.0, 'z')"
+    )
+    return sess
+
+
+def q(sess, sql):
+    return sess.query(sql)
+
+
+class TestBasic:
+    def test_select_all(self, tsess):
+        assert q(tsess, "select * from t") == [
+            (1, 1.5, "x"), (2, 2.5, "y"), (3, 3.5, "x"), (None, 9.0, "z")
+        ]
+
+    def test_where_arith(self, tsess):
+        assert q(tsess, "select a+1, b*2 from t where a >= 2") == [
+            (3, 5.0), (4, 7.0)
+        ]
+
+    def test_group_agg(self, tsess):
+        assert q(tsess, "select c, count(*), sum(b) from t "
+                        "where a is not null group by c order by c") == [
+            ("x", 2, 5.0), ("y", 1, 2.5)
+        ]
+
+    def test_scalar_agg(self, tsess):
+        assert q(tsess, "select count(*), count(a), avg(b) from t") == [
+            (4, 3, 4.125)
+        ]
+
+    def test_scalar_agg_empty(self, tsess):
+        assert q(tsess, "select count(*), sum(a), min(b) from t "
+                        "where a > 100") == [(0, None, None)]
+
+    def test_order_limit(self, tsess):
+        assert q(tsess, "select a from t where a is not null "
+                        "order by a desc limit 2") == [(3,), (2,)]
+
+    def test_distinct(self, tsess):
+        assert q(tsess, "select distinct c from t order by c") == [
+            ("x",), ("y",), ("z",)
+        ]
+
+    def test_select_no_table(self, sess):
+        assert q(sess, "select 1+1") == [(2,)]
+
+    def test_case_when(self, tsess):
+        rows = q(tsess, "select a, case when a >= 2 then 'big' else 'small' "
+                        "end from t where a is not null order by a")
+        assert rows == [(1, "small"), (2, "big"), (3, "big")]
+
+    def test_having(self, tsess):
+        assert q(tsess, "select c, count(*) as n from t group by c "
+                        "having n > 1") == [("x", 2)]
+
+    def test_alias_order(self, tsess):
+        assert q(tsess, "select a*10 as x from t where a is not null "
+                        "order by x desc") == [(30,), (20,), (10,)]
+
+
+class TestJoins:
+    @pytest.fixture()
+    def jsess(self, sess):
+        sess.execute("create table t1 (a bigint, b varchar(10))")
+        sess.execute("create table t2 (a bigint, v double)")
+        sess.execute("insert into t1 values (1,'p'),(2,'q'),(3,'r')")
+        sess.execute(
+            "insert into t2 values (1,10.0),(1,11.0),(3,30.0),(4,40.0)"
+        )
+        return sess
+
+    def test_inner(self, jsess):
+        assert q(jsess, "select t1.a, t2.v from t1 join t2 on t1.a = t2.a "
+                        "order by t1.a, t2.v") == [
+            (1, 10.0), (1, 11.0), (3, 30.0)
+        ]
+
+    def test_left(self, jsess):
+        assert q(jsess, "select t1.a, t2.v from t1 left join t2 "
+                        "on t1.a = t2.a order by t1.a, t2.v") == [
+            (1, 10.0), (1, 11.0), (2, None), (3, 30.0)
+        ]
+
+    def test_right(self, jsess):
+        rows = q(jsess, "select t1.a, t2.a from t1 right join t2 "
+                        "on t1.a = t2.a order by t2.a, t1.a")
+        assert rows == [(1, 1), (1, 1), (3, 3), (None, 4)]
+
+    def test_semi_in(self, jsess):
+        assert q(jsess, "select a from t1 where a in (select a from t2) "
+                        "order by a") == [(1,), (3,)]
+
+    def test_anti_in(self, jsess):
+        assert q(jsess, "select a from t1 where a not in "
+                        "(select a from t2) order by a") == [(2,)]
+
+    def test_join_where(self, jsess):
+        assert q(jsess, "select t1.a, t2.v from t1, t2 "
+                        "where t1.a = t2.a and t2.v > 10 "
+                        "order by t2.v") == [(1, 11.0), (3, 30.0)]
+
+    def test_self_join_alias(self, jsess):
+        rows = q(jsess, "select x.a, y.v from t2 x join t2 y "
+                        "on x.a = y.a where x.v = 10 order by y.v")
+        assert rows == [(1, 10.0), (1, 11.0)]
+
+    def test_scalar_subquery(self, jsess):
+        assert q(jsess, "select a from t1 where a > "
+                        "(select min(a) from t2) order by a") == [(2,), (3,)]
+
+    def test_cross_join(self, jsess):
+        assert q(jsess, "select count(*) from t1, t2") == [(12,)]
+
+
+class TestDML:
+    def test_update_delete(self, tsess):
+        tsess.execute("update t set b = b + 1 where a = 1")
+        assert q(tsess, "select b from t where a = 1") == [(2.5,)]
+        rs = tsess.execute("delete from t where a is null")[0]
+        assert rs.affected_rows == 1
+        assert q(tsess, "select count(*) from t") == [(3,)]
+
+    def test_insert_select(self, tsess):
+        tsess.execute("create table t2 (a bigint, b double, c varchar(20))")
+        tsess.execute("insert into t2 select * from t where a is not null")
+        assert q(tsess, "select count(*) from t2") == [(3,)]
+
+    def test_txn_commit_rollback(self, tsess):
+        tsess.execute("begin")
+        tsess.execute("insert into t values (10, 0.0, 'tx')")
+        assert q(tsess, "select count(*) from t") == [(5,)]
+        tsess.execute("rollback")
+        assert q(tsess, "select count(*) from t") == [(4,)]
+        tsess.execute("begin")
+        tsess.execute("insert into t values (11, 0.0, 'tx2')")
+        tsess.execute("commit")
+        assert q(tsess, "select count(*) from t") == [(5,)]
+
+    def test_txn_isolation(self, tsess):
+        s2 = tsess.domain.new_session()
+        tsess.execute("begin")
+        tsess.execute("insert into t values (42, 0.0, 'mine')")
+        # other session must not see uncommitted rows
+        assert q(s2, "select count(*) from t") == [(4,)]
+        tsess.execute("commit")
+        assert q(s2, "select count(*) from t") == [(5,)]
+
+    def test_write_conflict_autocommit_retries(self, tsess):
+        s2 = tsess.domain.new_session()
+        tsess.execute("update t set b = 100 where a = 1")
+        s2.execute("update t set b = 200 where a = 1")
+        assert q(tsess, "select b from t where a = 1") == [(200.0,)]
+
+    def test_replace_unique(self, sess):
+        sess.execute("create table u (id bigint primary key, v double)")
+        sess.execute("insert into u values (1, 1.0), (2, 2.0)")
+        with pytest.raises(KVError):
+            sess.execute("insert into u values (1, 99.0)")
+        sess.execute("replace into u values (1, 99.0)")
+        assert q(sess, "select v from u where id = 1") == [(99.0,)]
+
+    def test_insert_on_dup(self, sess):
+        sess.execute("create table u (id bigint primary key, v bigint)")
+        sess.execute("insert into u values (1, 1)")
+        sess.execute("insert into u values (1, 5) on duplicate key update "
+                     "v = v + 10")
+        assert q(sess, "select v from u") == [(11,)]
+
+    def test_auto_increment(self, sess):
+        sess.execute(
+            "create table ai (id bigint primary key auto_increment, "
+            "v varchar(5))"
+        )
+        sess.execute("insert into ai (v) values ('a'), ('b')")
+        assert q(sess, "select id, v from ai order by id") == [
+            (1, "a"), (2, "b")
+        ]
+
+
+class TestDDL:
+    def test_create_drop(self, sess):
+        sess.execute("create table d1 (a bigint)")
+        sess.execute("insert into d1 values (1)")
+        sess.execute("drop table d1")
+        with pytest.raises(TiDBTPUError):
+            q(sess, "select * from d1")
+
+    def test_truncate(self, tsess):
+        tsess.execute("truncate table t")
+        assert q(tsess, "select count(*) from t") == [(0,)]
+
+    def test_add_drop_column(self, tsess):
+        tsess.execute("alter table t add column d bigint default 7")
+        assert q(tsess, "select d from t where a = 1") == [(7,)]
+        tsess.execute("alter table t drop column b")
+        assert q(tsess, "select * from t where a = 1") == [(1, "x", 7)]
+
+    def test_rename(self, tsess):
+        tsess.execute("rename table t to t9")
+        assert q(tsess, "select count(*) from t9") == [(4,)]
+
+    def test_view(self, tsess):
+        tsess.execute("create view v1 as select c, sum(b) as s from t "
+                      "group by c")
+        assert q(tsess, "select * from v1 order by c") == [
+            ("x", 5.0), ("y", 2.5), ("z", 9.0)
+        ]
+
+    def test_create_index_unique_violation(self, tsess):
+        with pytest.raises(KVError):
+            tsess.execute("create unique index ux on t (c)")
+        tsess.execute("create index ix on t (c)")
+        assert any(r[2] == "ix" for r in q(tsess, "show index from t"))
+
+    def test_ddl_jobs_history(self, tsess):
+        rows = q(tsess, "admin show ddl jobs")
+        assert any(r[1] == "create_table" for r in rows)
+
+    def test_show_create_table(self, tsess):
+        rows = q(tsess, "show create table t")
+        assert "CREATE TABLE `t`" in rows[0][1]
+
+
+class TestShow:
+    def test_show_tables_databases(self, tsess):
+        assert ("t",) in q(tsess, "show tables")
+        assert ("test",) in q(tsess, "show databases")
+
+    def test_desc(self, tsess):
+        rows = q(tsess, "desc t")
+        assert rows[0][0] == "a"
+
+    def test_set_show_variables(self, sess):
+        sess.execute("set tidb_distsql_scan_concurrency = 4")
+        allv = dict(q(sess, "show variables like 'tidb_distsql%'"))
+        assert allv["tidb_distsql_scan_concurrency"] == "4"
+
+    def test_use_unknown_db(self, sess):
+        with pytest.raises(TiDBTPUError):
+            sess.execute("use nosuchdb")
+
+    def test_show_regions_and_split(self, tsess):
+        rs = tsess.execute("split table t regions 4")[0]
+        assert rs.rows[0][0] >= 2
+        rows = q(tsess, "show table regions t")
+        assert len(rows) == rs.rows[0][0]
+        # a multi-region scan still returns every row exactly once
+        assert q(tsess, "select count(*) from t") == [(4,)]
+
+
+class TestExplain:
+    def test_pushdown_plan_shape(self, tsess):
+        rows = q(tsess, "explain select c, sum(b) from t group by c")
+        tasks = [r[1] for r in rows]
+        assert "cop[tpu]" in tasks  # partial agg pushed to device
+        names = "".join(r[0] for r in rows)
+        assert "HashAgg" in names and "TableReader" in names
+
+    def test_selection_pushdown(self, tsess):
+        rows = q(tsess, "explain select a from t where b > 2.0")
+        cop = [r for r in rows if r[1] == "cop[tpu]"]
+        assert any("Selection" in r[0] for r in cop)
+
+    def test_explain_analyze(self, tsess):
+        rows = q(tsess, "explain analyze select count(*) from t")
+        assert rows and len(rows[0]) == 4
+
+
+class TestUnionAndSubquery:
+    def test_union_all(self, tsess):
+        rows = q(tsess, "select a from t where a = 1 union all "
+                        "select a from t where a = 1")
+        assert rows == [(1,), (1,)]
+
+    def test_union_distinct(self, tsess):
+        rows = q(tsess, "select a from t where a = 1 union "
+                        "select a from t where a = 1")
+        assert rows == [(1,)]
+
+    def test_from_subquery(self, tsess):
+        rows = q(tsess, "select s.c, s.n from (select c, count(*) as n "
+                        "from t group by c) s order by s.c")
+        assert rows == [("x", 2), ("y", 1), ("z", 1)]
+
+    def test_exists(self, tsess):
+        assert q(tsess, "select count(*) from t where exists "
+                        "(select 1 from t)") == [(4,)]
+
+
+class TestPrepared:
+    def test_prepare_execute(self, tsess):
+        tsess.execute("prepare s1 from 'select a from t where a = 2'")
+        assert tsess.execute("execute s1")[-1].rows == [(2,)]
+        tsess.execute("deallocate prepare s1")
+
+
+class TestEngineParity:
+    """cpu oracle vs tpu(jax) engine must agree (SURVEY.md north star)."""
+
+    QUERIES = [
+        "select count(*), sum(a), min(b), max(b) from t",
+        "select c, count(*), avg(b) from t group by c order by c",
+        "select a, b from t where b > 2 and a is not null order by a",
+        "select a from t order by b desc limit 2",
+    ]
+
+    def test_parity(self, tsess):
+        for sql in self.QUERIES:
+            tsess.execute("set tidb_use_tpu = 1")
+            tpu_rows = q(tsess, sql)
+            tsess.execute("set tidb_use_tpu = 0")
+            cpu_rows = q(tsess, sql)
+            assert tpu_rows == cpu_rows, sql

@@ -1,0 +1,13 @@
+from .catalog import Catalog, DDLJob, InfoSchema
+from .schema import (
+    STATE_PUBLIC,
+    ColumnInfo,
+    DBInfo,
+    IndexInfo,
+    TableInfo,
+)
+
+__all__ = [
+    "Catalog", "DDLJob", "InfoSchema", "ColumnInfo", "DBInfo", "IndexInfo",
+    "TableInfo", "STATE_PUBLIC",
+]

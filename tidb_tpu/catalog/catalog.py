@@ -1,0 +1,436 @@
+"""Catalog: the schema authority (meta + infoschema + DDL executor).
+
+Reference mapping:
+- `meta/` (schema metadata in KV, GenGlobalID, SchemaVersion) -> Catalog's
+  id allocator + version counter + to_json/from_json persistence.
+- `infoschema/` (immutable schema snapshot per version, builder applying
+  diffs) -> InfoSchema frozen view handed to sessions; a new snapshot per
+  DDL (schema lease convergence collapses to instant refresh in-process).
+- `ddl/` (online schema change via job queue + owner worker,
+  ddl_worker.go:362,500) -> synchronous job execution here, with the same
+  F1 state ladder recorded per object and a DDL-job history list.  The
+  multi-step ladder matters when other nodes cache old versions; in-process
+  every session sees the new snapshot atomically, so jobs run all steps
+  eagerly while still recording them (tested + surfaced in ADMIN SHOW DDL).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..errors import (
+    KVError,
+    TableExistsError,
+    UnknownDatabaseError,
+    UnknownTableError,
+    PlanError,
+)
+from ..types import FieldType, TypeKind
+from .schema import (
+    STATE_DELETE_ONLY,
+    STATE_NONE,
+    STATE_PUBLIC,
+    STATE_WRITE_ONLY,
+    STATE_WRITE_REORG,
+    ColumnInfo,
+    DBInfo,
+    IndexInfo,
+    TableInfo,
+)
+
+
+@dataclass
+class DDLJob:
+    id: int
+    typ: str  # create_table, add_index, ...
+    db: str
+    table: str
+    state: str = "done"  # queued|running|done|cancelled|rollback
+    schema_version: int = 0
+    start_time: float = 0.0
+    states_walked: List[str] = field(default_factory=list)
+    error: str = ""
+
+
+class InfoSchema:
+    """Immutable schema snapshot at one schema version.
+
+    Reference: infoschema.InfoSchema (infoschema/infoschema.go); sessions
+    hold one for the duration of a statement/txn and the commit-time schema
+    check compares versions (2pc.go:1151-1155).
+    """
+
+    def __init__(self, version: int, dbs: Dict[str, DBInfo]):
+        self.version = version
+        self._dbs = dbs
+        self._by_id: Dict[int, TableInfo] = {}
+        for db in dbs.values():
+            for t in db.tables.values():
+                self._by_id[t.id] = t
+
+    def schema_names(self) -> List[str]:
+        return sorted(db.name for db in self._dbs.values())
+
+    def has_schema(self, name: str) -> bool:
+        return name.lower() in self._dbs
+
+    def schema(self, name: str) -> DBInfo:
+        db = self._dbs.get(name.lower())
+        if db is None:
+            raise UnknownDatabaseError(name)
+        return db
+
+    def tables(self, db: str) -> List[TableInfo]:
+        return sorted(self.schema(db).tables.values(), key=lambda t: t.name)
+
+    def table(self, db: str, name: str) -> TableInfo:
+        t = self.schema(db).tables.get(name.lower())
+        if t is None:
+            raise UnknownTableError(f"{db}.{name}")
+        return t
+
+    def has_table(self, db: str, name: str) -> bool:
+        d = self._dbs.get(db.lower())
+        return d is not None and name.lower() in d.tables
+
+    def table_by_id(self, tid: int) -> Optional[TableInfo]:
+        return self._by_id.get(tid)
+
+
+class Catalog:
+    def __init__(self, storage):
+        self.storage = storage
+        self._mu = threading.RLock()
+        self._dbs: Dict[str, DBInfo] = {}
+        self._next_id = 100
+        self.schema_version = 0
+        self.jobs: List[DDLJob] = []
+        self._snapshot: Optional[InfoSchema] = None
+
+    # ------------------------------------------------------------------
+    # id / version bookkeeping (meta.GenGlobalID / SchemaVersion analog)
+    # ------------------------------------------------------------------
+    def gen_id(self) -> int:
+        with self._mu:
+            self._next_id += 1
+            return self._next_id
+
+    def _bump(self):
+        self.schema_version += 1
+        self._snapshot = None
+
+    def info_schema(self) -> InfoSchema:
+        with self._mu:
+            if self._snapshot is None:
+                # deep-ish copy not needed: TableInfos are replaced, not
+                # mutated, by DDL ops below
+                self._snapshot = InfoSchema(self.schema_version, dict(self._dbs))
+            return self._snapshot
+
+    def _record(self, job: DDLJob):
+        job.schema_version = self.schema_version
+        job.start_time = time.time()
+        self.jobs.append(job)
+
+    # ------------------------------------------------------------------
+    # databases
+    # ------------------------------------------------------------------
+    def create_database(self, name: str, if_not_exists: bool = False):
+        with self._mu:
+            key = name.lower()
+            if key in self._dbs:
+                if if_not_exists:
+                    return
+                raise KVError(f"database {name!r} exists")
+            self._dbs[key] = DBInfo(self.gen_id(), name)
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "create_schema", name, ""))
+
+    def drop_database(self, name: str, if_exists: bool = False):
+        with self._mu:
+            key = name.lower()
+            db = self._dbs.get(key)
+            if db is None:
+                if if_exists:
+                    return
+                raise UnknownDatabaseError(name)
+            for t in db.tables.values():
+                if not t.is_view:
+                    self.storage.drop_table(t.id)
+            del self._dbs[key]
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "drop_schema", name, ""))
+
+    # ------------------------------------------------------------------
+    # tables
+    # ------------------------------------------------------------------
+    def create_table(self, db: str, info: TableInfo,
+                     if_not_exists: bool = False) -> TableInfo:
+        with self._mu:
+            d = self._dbs.get(db.lower())
+            if d is None:
+                raise UnknownDatabaseError(db)
+            if info.name.lower() in d.tables:
+                if if_not_exists:
+                    return d.tables[info.name.lower()]
+                raise TableExistsError(f"{db}.{info.name}")
+            if info.id == 0:
+                info.id = self.gen_id()
+            for i, c in enumerate(info.columns):
+                c.offset = i
+            d.tables[info.name.lower()] = info
+            if not info.is_view:
+                self.storage.create_table(info.id, info.storage_columns())
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "create_table", db, info.name))
+            return info
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False,
+                   view_only: bool = False):
+        with self._mu:
+            d = self._dbs.get(db.lower())
+            t = d.tables.get(name.lower()) if d else None
+            if t is None or (view_only and not t.is_view):
+                if if_exists:
+                    return
+                raise UnknownTableError(f"{db}.{name}")
+            del d.tables[name.lower()]
+            if not t.is_view:
+                self.storage.drop_table(t.id)
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "drop_table", db, name))
+
+    def truncate_table(self, db: str, name: str):
+        """Drop + recreate with a fresh table id (ddl_api.go TruncateTable)."""
+        with self._mu:
+            t = self.info_schema().table(db, name)
+            d = self._dbs[db.lower()]
+            self.storage.drop_table(t.id)
+            new = TableInfo(
+                self.gen_id(), t.name, t.columns, t.indexes, t.pk_is_handle, 1
+            )
+            d.tables[name.lower()] = new
+            self.storage.create_table(new.id, new.storage_columns())
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "truncate_table", db, name))
+
+    def rename_table(self, db: str, old: str, new: str):
+        with self._mu:
+            d = self._dbs.get(db.lower())
+            if d is None:
+                raise UnknownDatabaseError(db)
+            t = d.tables.get(old.lower())
+            if t is None:
+                raise UnknownTableError(f"{db}.{old}")
+            if new.lower() in d.tables:
+                raise TableExistsError(f"{db}.{new}")
+            del d.tables[old.lower()]
+            t2 = TableInfo(t.id, new, t.columns, t.indexes, t.pk_is_handle,
+                           t.auto_inc_id)
+            d.tables[new.lower()] = t2
+            self._bump()
+            self._record(DDLJob(self.gen_id(), "rename_table", db, new))
+
+    # ------------------------------------------------------------------
+    # columns (add/drop rebuild storage blocks; the reference reorganizes
+    # lazily via row-format versioning — columnar blocks make the eager
+    # rebuild the natural choice, and it doubles as delta-merge compaction)
+    # ------------------------------------------------------------------
+    def add_column(self, db: str, table: str, col: ColumnInfo):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            if t.find_column(col.name) is not None:
+                raise KVError(f"column {col.name!r} exists")
+            job = DDLJob(self.gen_id(), "add_column", db, table)
+            job.states_walked = [STATE_NONE, STATE_DELETE_ONLY,
+                                 STATE_WRITE_ONLY, STATE_PUBLIC]
+            col.offset = len(t.columns)
+            col.state = STATE_PUBLIC
+            new_cols = t.columns + [col]
+            default = col.default if col.has_default else None
+            self._rebuild_storage(t, new_cols, add_default=(col, default))
+            self._replace_table(db, table, t, columns=new_cols)
+            self._record(job)
+
+    def drop_column(self, db: str, table: str, name: str):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            col = t.find_column(name)
+            if col is None:
+                raise KVError(f"no column {name!r}")
+            if len(t.public_columns()) == 1:
+                raise KVError("cannot drop the only column")
+            job = DDLJob(self.gen_id(), "drop_column", db, table)
+            job.states_walked = [STATE_PUBLIC, STATE_WRITE_ONLY,
+                                 STATE_DELETE_ONLY, STATE_NONE]
+            new_cols = [c for c in t.columns if c is not col]
+            for i, c in enumerate(new_cols):
+                c.offset = i
+            new_idx = [ix for ix in t.indexes
+                       if col.name.lower() not in [c.lower() for c in ix.columns]]
+            self._rebuild_storage(t, new_cols, drop=col.name)
+            self._replace_table(db, table, t, columns=new_cols, indexes=new_idx)
+            self._record(job)
+
+    def modify_column(self, db: str, table: str, col: ColumnInfo):
+        """Change column type (lossy conversions surface as errors)."""
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            old = t.find_column(col.name)
+            if old is None:
+                raise KVError(f"no column {col.name!r}")
+            col.offset = old.offset
+            new_cols = list(t.columns)
+            new_cols[old.offset] = col
+            self._rebuild_storage(t, new_cols, retype=(old.offset, col.ftype))
+            self._replace_table(db, table, t, columns=new_cols)
+            self._record(DDLJob(self.gen_id(), "modify_column", db, table))
+
+    # ------------------------------------------------------------------
+    # indexes.  write-reorg backfill (ddl/index.go) collapses to metadata:
+    # our indexes are materialized lazily from blocks (store side), so
+    # "backfill" = first build; the state ladder is still recorded.
+    # ------------------------------------------------------------------
+    def create_index(self, db: str, table: str, name: str,
+                     columns: List[str], unique: bool = False,
+                     primary: bool = False):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            if t.find_index(name) is not None:
+                raise KVError(f"index {name!r} exists")
+            for c in columns:
+                if t.find_column(c) is None:
+                    raise KVError(f"no column {c!r} for index {name!r}")
+            job = DDLJob(self.gen_id(), "add_index", db, table)
+            job.states_walked = [STATE_NONE, STATE_DELETE_ONLY,
+                                 STATE_WRITE_ONLY, STATE_WRITE_REORG,
+                                 STATE_PUBLIC]
+            ix = IndexInfo(self.gen_id(), name, columns, unique, primary)
+            if unique:
+                self._check_unique(t, columns, name)
+            self._replace_table(db, table, t, indexes=t.indexes + [ix])
+            self._record(job)
+
+    def drop_index(self, db: str, table: str, name: str):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            ix = t.find_index(name)
+            if ix is None:
+                raise KVError(f"no index {name!r}")
+            self._replace_table(
+                db, table, t, indexes=[i for i in t.indexes if i is not ix]
+            )
+            self._record(DDLJob(self.gen_id(), "drop_index", db, table))
+
+    def _check_unique(self, t: TableInfo, columns: List[str], name: str):
+        store = self.storage.table(t.id)
+        offs = t.col_offsets(columns)
+        ts = self.storage.current_ts()
+        chunk = store.base_chunk(offs, 0, store.base_rows)
+        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+        seen = set()
+        dele = set(deleted)
+        for h in range(chunk.num_rows):
+            if h in dele:
+                continue
+            key = chunk.row(h)
+            if None in key:
+                continue  # NULLs never collide (MySQL unique semantics)
+            if key in seen:
+                raise KVError(f"duplicate entry for unique index {name!r}")
+            seen.add(key)
+        for row in inserted.values():
+            key = tuple(row[o] for o in offs)
+            if None in key:
+                continue
+            if key in seen:
+                raise KVError(f"duplicate entry for unique index {name!r}")
+            seen.add(key)
+
+    # ------------------------------------------------------------------
+    def _replace_table(self, db: str, table: str, t: TableInfo, **overrides):
+        d = self._dbs[db.lower()]
+        new = TableInfo(
+            t.id, t.name,
+            overrides.get("columns", t.columns),
+            overrides.get("indexes", t.indexes),
+            t.pk_is_handle, t.auto_inc_id, t.comment, t.is_view, t.view_select,
+        )
+        d.tables[table.lower()] = new
+        self._bump()
+
+    def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
+                         add_default=None, drop: str = None, retype=None):
+        """Rewrite the TableStore for a column-layout change.  Committed
+        delta folds in (compact), so the new store is base-only."""
+        store = self.storage.table(t.id)
+        ts = self.storage.current_ts()
+        store.compact(ts)
+        old_names = [c.name for c in t.columns]
+        chunk = store.base_chunk(range(store.n_cols), 0, store.base_rows)
+        n = chunk.num_rows
+        arrays, valids = [], []
+        for c in new_cols:
+            if add_default is not None and c is add_default[0]:
+                default = add_default[1]
+                ft = c.ftype
+                if ft.kind == TypeKind.STRING:
+                    arr = np.full(n, "" if default is None else str(default),
+                                  dtype=object)
+                else:
+                    arr = np.full(n, 0 if default is None else default,
+                                  dtype=ft.np_dtype)
+                valid = np.full(n, default is not None, dtype=np.bool_)
+            else:
+                oi = old_names.index(c.name)
+                col = chunk.col(oi)
+                arr, valid = col.data, col.validity()
+                if retype is not None and oi == retype[0]:
+                    arr = _convert_array(arr, valid, t.columns[oi].ftype,
+                                         retype[1])
+            arrays.append(arr)
+            valids.append(valid)
+        self.storage.drop_table(t.id)
+        new_store = self.storage.create_table(
+            t.id, [(c.name, c.ftype) for c in new_cols]
+        )
+        if n:
+            new_store.bulk_load_arrays(arrays, valids, ts)
+
+    # ------------------------------------------------------------------
+    # persistence (checkpoint/resume story, SURVEY.md §5)
+    # ------------------------------------------------------------------
+    def to_json(self) -> str:
+        with self._mu:
+            return json.dumps({
+                "version": self.schema_version,
+                "next_id": self._next_id,
+                "dbs": {k: d.to_dict() for k, d in self._dbs.items()},
+            })
+
+    def load_json(self, blob: str):
+        with self._mu:
+            d = json.loads(blob)
+            self.schema_version = d["version"]
+            self._next_id = d["next_id"]
+            self._dbs = {k: DBInfo.from_dict(v) for k, v in d["dbs"].items()}
+            self._snapshot = None
+            for db in self._dbs.values():
+                for t in db.tables.values():
+                    if not t.is_view and not self.storage.has_table(t.id):
+                        self.storage.create_table(t.id, t.storage_columns())
+
+
+def _convert_array(arr, valid, old_ft: FieldType, new_ft: FieldType):
+    from ..chunk import Column
+    from ..expr.builtins import cast_vec
+    from ..expr.vec import Vec
+
+    v = Vec(old_ft, arr, np.asarray(valid))
+    return cast_vec(v, new_ft).data

@@ -1,0 +1,167 @@
+"""Schema objects: column / index / table / database metadata.
+
+Reference: pingcap/parser's model package (model.TableInfo et al.) as consumed
+by infoschema (infoschema/tables.go) and ddl (ddl/ddl_api.go).  Kept
+JSON-serializable so the whole catalog can be checkpointed and reloaded
+("all state reconstructible from the host store", SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..types import FieldType, TypeKind
+
+
+# F1 online-schema-change states (ddl_worker.go:466-469).  Columns/indexes
+# move through the ladder one schema version at a time so concurrent readers
+# at most one version behind stay correct.
+STATE_NONE = "none"
+STATE_DELETE_ONLY = "delete-only"
+STATE_WRITE_ONLY = "write-only"
+STATE_WRITE_REORG = "write-reorg"
+STATE_PUBLIC = "public"
+
+
+@dataclass
+class ColumnInfo:
+    name: str
+    ftype: FieldType
+    offset: int = 0
+    default: object = None  # python literal; None + not has_default -> NULL
+    has_default: bool = False
+    auto_increment: bool = False
+    primary_key: bool = False
+    state: str = STATE_PUBLIC
+    comment: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ftype": [int(self.ftype.kind), self.ftype.nullable,
+                      self.ftype.precision, self.ftype.scale],
+            "offset": self.offset,
+            "default": self.default,
+            "has_default": self.has_default,
+            "auto_increment": self.auto_increment,
+            "primary_key": self.primary_key,
+            "state": self.state,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ColumnInfo":
+        k, nl, p, s = d["ftype"]
+        return ColumnInfo(
+            d["name"], FieldType(TypeKind(k), nl, p, s), d["offset"],
+            d["default"], d["has_default"], d["auto_increment"],
+            d["primary_key"], d.get("state", STATE_PUBLIC),
+        )
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    columns: List[str]
+    unique: bool = False
+    primary: bool = False
+    state: str = STATE_PUBLIC
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "name": self.name, "columns": list(self.columns),
+            "unique": self.unique, "primary": self.primary, "state": self.state,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "IndexInfo":
+        return IndexInfo(d["id"], d["name"], list(d["columns"]),
+                         d["unique"], d["primary"], d.get("state", STATE_PUBLIC))
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: List[ColumnInfo]
+    indexes: List[IndexInfo] = field(default_factory=list)
+    # offset of the integer PK column used as row handle, or -1.  Mirrors
+    # TiDB's PKIsHandle (int primary key == row key).
+    pk_is_handle: int = -1
+    auto_inc_id: int = 1
+    comment: str = ""
+    is_view: bool = False
+    view_select: str = ""  # original SELECT text for views
+
+    def public_columns(self) -> List[ColumnInfo]:
+        return [c for c in self.columns if c.state == STATE_PUBLIC]
+
+    def writable_columns(self) -> List[ColumnInfo]:
+        return [
+            c for c in self.columns
+            if c.state in (STATE_PUBLIC, STATE_WRITE_ONLY, STATE_WRITE_REORG)
+        ]
+
+    def find_column(self, name: str) -> Optional[ColumnInfo]:
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        return None
+
+    def find_index(self, name: str) -> Optional[IndexInfo]:
+        lname = name.lower()
+        for ix in self.indexes:
+            if ix.name.lower() == lname:
+                return ix
+        return None
+
+    def col_offsets(self, names: List[str]) -> List[int]:
+        return [self.find_column(n).offset for n in names]
+
+    def storage_columns(self) -> List[Tuple[str, FieldType]]:
+        """(name, ftype) pairs in storage layout order."""
+        return [(c.name, c.ftype) for c in self.columns]
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "name": self.name,
+            "columns": [c.to_dict() for c in self.columns],
+            "indexes": [i.to_dict() for i in self.indexes],
+            "pk_is_handle": self.pk_is_handle,
+            "auto_inc_id": self.auto_inc_id,
+            "is_view": self.is_view,
+            "view_select": self.view_select,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TableInfo":
+        return TableInfo(
+            d["id"], d["name"],
+            [ColumnInfo.from_dict(c) for c in d["columns"]],
+            [IndexInfo.from_dict(i) for i in d["indexes"]],
+            d.get("pk_is_handle", -1), d.get("auto_inc_id", 1),
+            is_view=d.get("is_view", False),
+            view_select=d.get("view_select", ""),
+        )
+
+
+@dataclass
+class DBInfo:
+    id: int
+    name: str
+    tables: dict = field(default_factory=dict)  # lower name -> TableInfo
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "name": self.name,
+            "tables": {k: t.to_dict() for k, t in self.tables.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "DBInfo":
+        return DBInfo(
+            d["id"], d["name"],
+            {k: TableInfo.from_dict(t) for k, t in d["tables"].items()},
+        )

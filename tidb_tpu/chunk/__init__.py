@@ -1,10 +1,11 @@
 from .column import Column
-from .chunk import Chunk, chunk_from_pylists, concat_chunks
+from .chunk import Chunk, DEFAULT_CHUNK_SIZE, chunk_from_pylists, concat_chunks
 from .codec import encode_chunk, decode_chunk
 
 __all__ = [
     "Column",
     "Chunk",
+    "DEFAULT_CHUNK_SIZE",
     "chunk_from_pylists",
     "concat_chunks",
     "encode_chunk",

@@ -261,6 +261,9 @@ class _Analyzed:
             for k in self.agg.group_by:
                 if not isinstance(k, ColumnExpr):
                     raise JaxUnsupported("device group key must be a column")
+                if k.ftype.kind == TypeKind.FLOAT:
+                    # dense int codes would truncate: 1.2 and 1.4 collapse
+                    raise JaxUnsupported("float group key on device")
                 store_ci = self.scan.columns[k.index]
                 lo, hi, has_null = table.column_stats(store_ci)
                 if has_null:
@@ -443,14 +446,13 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
             cols = cols_env(datas, valids)
             m = selected_mask(cols, row_mask)
             d, v = compile_expr(key_expr, cols, n)
-            # NULLs first asc / last desc: encode as -inf asc (first), -inf desc (last)
+            # MySQL NULL order: first ascending, last descending.  The
+            # sentinel must stay distinguishable from masked-out rows
+            # (masked_top_k uses -inf for those), so NULLs get a finite
+            # extreme: -MAX asc (sorts first), -MAX desc (sorts last but
+            # still beats masked rows).
             key = d.astype(jnp.float64)
-            if desc:
-                key = jnp.where(v, key, -jnp.inf)
-            else:
-                key = jnp.where(v, key, jnp.inf)
-                # but MySQL sorts NULLs first ascending:
-                key = jnp.where(v, key, -jnp.inf)
+            key = jnp.where(v, key, -1.7e308)
             idx, cnt = ops.masked_top_k(key, m, k, desc)
             return idx, cnt
 
@@ -588,15 +590,9 @@ def _np_tree(r):
 
 
 def _gather_rows(table, scan: TableScanIR, base0: int, sel: np.ndarray) -> Chunk:
-    """Host gather of scan-output rows at tile-local indices `sel`."""
-    handles = base0 + sel
-    cols = []
-    # materialize contiguous range then take (cheap enough per tile)
-    lo, hi = int(handles.min()), int(handles.max()) + 1
-    chunk = table.base_chunk(
-        [scan.columns[i] for i in range(len(scan.columns))], lo, hi
-    )
-    return chunk.take(handles - lo)
+    """Host gather of scan-output rows at tile-local indices `sel` —
+    per-block sparse gather, not a contiguous-span materialization."""
+    return table.gather_chunk(list(scan.columns), base0 + sel)
 
 
 def _merge_device_agg(accum, gcount: np.ndarray, results, table, an: _Analyzed,

@@ -288,6 +288,42 @@ def _not(e, args, n):
     return (~_truth(v)).astype(jnp.int64), v[1]
 
 
+@_reg("&", "|", "^", "<<", ">>")
+def _bitops(e, args, n):
+    a, b = args
+    x, y = a[0].astype(jnp.int64), b[0].astype(jnp.int64)
+    op = e.name
+    if op == "&":
+        r = x & y
+    elif op == "|":
+        r = x | y
+    elif op == "^":
+        r = x ^ y
+    elif op == "<<":
+        sh = jnp.clip(y, 0, 63)
+        r = jnp.where((y < 0) | (y > 63), 0, x << sh)
+    else:
+        sh = jnp.clip(y, 0, 63)
+        r = jnp.where((y < 0) | (y > 63), 0, x >> sh)
+    return r, _both_valid(a, b)
+
+
+@_reg("~")
+def _bitneg(e, args, n):
+    v = args[0]
+    return ~v[0].astype(jnp.int64), v[1]
+
+
+@_reg("nulleq")
+def _nulleq(e, args, n):
+    a, b = args
+    sub = ScalarFunc("=", [e.args[0], e.args[1]], e.ftype)
+    eq, _ = _cmp(sub, [a, b], n)
+    both_null = ~a[1] & ~b[1]
+    r = both_null | ((eq != 0) & a[1] & b[1])
+    return r.astype(jnp.int64), jnp.ones(n, dtype=jnp.bool_)
+
+
 @_reg("isnull")
 def _isnull(e, args, n):
     v = args[0]

@@ -1,0 +1,3 @@
+from .select import RequestBuilder, SelectResult, select_dag
+
+__all__ = ["RequestBuilder", "SelectResult", "select_dag"]

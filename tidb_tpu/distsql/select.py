@@ -1,0 +1,195 @@
+"""distsql: the distributed-query layer between root executors and the
+pushdown boundary.
+
+Reference: distsql/request_builder.go:34 (RequestBuilder), distsql/distsql.go:33
+(Select), distsql/select_result.go:43 (SelectResult.Next) and the copIterator
+worker pool (store/tikv/coprocessor.go:391-560).  The data-parallel scan
+fan-out: key ranges split per region into tasks, executed by a bounded worker
+pool, results streamed back with optional order preservation (KeepOrder /
+sendRate) — DP over storage shards.
+
+Here the worker pool is a ThreadPoolExecutor (workers block on numpy/JAX which
+release the GIL); per-region results are queued and yielded in task order when
+keep_order, else completion order.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+from ..chunk import Chunk
+from ..copr.ir import DAG
+from ..store.kv import CopRequest, KeyRange
+
+
+@dataclass
+class RequestBuilder:
+    """Fluent builder mirroring distsql.RequestBuilder."""
+
+    dag: Optional[dict] = None
+    ranges: List[KeyRange] = field(default_factory=list)
+    ts: int = 0
+    concurrency: int = 8
+    keep_order: bool = False
+    streaming: bool = False
+    engine: str = "tpu"
+
+    def set_dag(self, dag: DAG) -> "RequestBuilder":
+        self.dag = dag.to_dict()
+        return self
+
+    def set_ranges(self, ranges: List[KeyRange]) -> "RequestBuilder":
+        self.ranges = ranges
+        return self
+
+    def set_ts(self, ts: int) -> "RequestBuilder":
+        self.ts = ts
+        return self
+
+    def set_concurrency(self, n: int) -> "RequestBuilder":
+        self.concurrency = max(1, n)
+        return self
+
+    def set_keep_order(self, keep: bool) -> "RequestBuilder":
+        self.keep_order = keep
+        return self
+
+    def set_engine(self, engine: str) -> "RequestBuilder":
+        self.engine = engine
+        return self
+
+    def build(self) -> CopRequest:
+        assert self.dag is not None and self.ranges, "incomplete request"
+        return CopRequest(
+            dag=self.dag, ranges=self.ranges, ts=self.ts,
+            concurrency=self.concurrency, keep_order=self.keep_order,
+            streaming=self.streaming, engine=self.engine,
+        )
+
+
+_DONE = object()
+
+
+class SelectResult:
+    """Streaming chunk iterator over the fan-out (select_result.go:43).
+
+    Pull API: next_chunk() -> Chunk | None.  Close() cancels outstanding
+    work.  Exec summaries accumulate for EXPLAIN ANALYZE.
+    """
+
+    def __init__(self, storage, req: CopRequest):
+        self.storage = storage
+        self.req = req
+        self._chunks: "queue.Queue" = queue.Queue(maxsize=max(4, req.concurrency * 2))
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._pending: List[Chunk] = []
+        self._rows_returned = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # ---- producer side -------------------------------------------------
+    def _run(self):
+        client = self.storage.get_client()
+        try:
+            # split ranges per region up front: each task is one region's clip
+            tasks = []
+            for kr in self.req.ranges:
+                for region, clipped in self.storage.regions.locate(kr):
+                    tasks.append(clipped)
+            if not tasks:
+                self._chunks.put(_DONE)
+                return
+            n_workers = min(self.req.concurrency, len(tasks))
+
+            def run_task(clip: KeyRange) -> List[Chunk]:
+                sub = CopRequest(
+                    dag=self.req.dag, ranges=[clip], ts=self.req.ts,
+                    concurrency=1, keep_order=self.req.keep_order,
+                    streaming=self.req.streaming, engine=self.req.engine,
+                )
+                out: List[Chunk] = []
+                for resp in client.send(sub):
+                    out.extend(resp.chunks)
+                return out
+
+            if n_workers == 1:
+                for clip in tasks:
+                    if self._closed:
+                        return
+                    for c in run_task(clip):
+                        self._chunks.put(c)
+            else:
+                with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                    futures = [pool.submit(run_task, t) for t in tasks]
+                    if self.req.keep_order:
+                        # task submission order == handle order (locate is
+                        # sorted); yield in that order
+                        for f in futures:
+                            if self._closed:
+                                return
+                            for c in f.result():
+                                self._chunks.put(c)
+                    else:
+                        from concurrent.futures import as_completed
+
+                        for f in as_completed(futures):
+                            if self._closed:
+                                return
+                            for c in f.result():
+                                self._chunks.put(c)
+            self._chunks.put(_DONE)
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+            self._chunks.put(_DONE)
+
+    # ---- consumer side -------------------------------------------------
+    def next_chunk(self) -> Optional[Chunk]:
+        if self._closed:
+            return None
+        item = self._chunks.get()
+        if item is _DONE:
+            if self._err is not None:
+                err, self._err = self._err, None
+                self._closed = True
+                raise err
+            self._closed = True
+            return None
+        self._rows_returned += item.num_rows
+        return item
+
+    def __iter__(self) -> Iterator[Chunk]:
+        while True:
+            c = self.next_chunk()
+            if c is None:
+                return
+            yield c
+
+    def close(self):
+        self._closed = True
+        # drain so the producer unblocks
+        try:
+            while True:
+                self._chunks.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def select_dag(storage, dag: DAG, ranges: List[KeyRange], ts: int,
+               concurrency: int = 8, keep_order: bool = False,
+               engine: str = "tpu") -> SelectResult:
+    req = (
+        RequestBuilder()
+        .set_dag(dag)
+        .set_ranges(ranges)
+        .set_ts(ts)
+        .set_concurrency(concurrency)
+        .set_keep_order(keep_order)
+        .set_engine(engine)
+        .build()
+    )
+    return SelectResult(storage, req)

@@ -1,0 +1,23 @@
+from .base import ExecContext, Executor, OperatorStats, collect_all
+from .aggregate import HashAggExec, StreamAggExec
+from .dml import DeleteExec, InsertExec, LoadDataExec, UpdateExec
+from .join import HashJoinExec, MergeJoinExec, NestedLoopApplyExec
+from .readers import PointGetExec, TableReaderExec, UnionScanExec
+from .simple import (
+    LimitExec,
+    MaxOneRowExec,
+    ProjectionExec,
+    SelectionExec,
+    TableDualExec,
+    UnionExec,
+)
+from .sort import SortExec, TopNExec
+
+__all__ = [
+    "ExecContext", "Executor", "OperatorStats", "collect_all",
+    "HashAggExec", "StreamAggExec", "HashJoinExec", "MergeJoinExec",
+    "NestedLoopApplyExec", "PointGetExec", "TableReaderExec", "UnionScanExec",
+    "LimitExec", "MaxOneRowExec", "ProjectionExec", "SelectionExec",
+    "TableDualExec", "UnionExec", "SortExec", "TopNExec",
+    "InsertExec", "UpdateExec", "DeleteExec", "LoadDataExec",
+]

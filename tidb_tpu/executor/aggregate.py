@@ -1,0 +1,150 @@
+"""Aggregation executors: HashAgg (final/complete) and StreamAgg.
+
+Reference: executor/aggregate.go (HashAggExec, parallel partial/final worker
+graph :101-169, serial fallback for distinct :166) and aggfuncs/ (PartialResult
+pattern).  The TPU-first shape: the device computes dense *partial* states per
+shard (copr/jax_engine segment-reduce); the root HashAgg here only merges
+partial-state rows and finalizes — the same partial/final split the reference
+uses between coprocessor and root (planner/core/task.go agg pushdown).
+
+Modes:
+- partial_input=True  — child streams [group-keys..., partial-states...] rows
+  (from cop partial agg); merge + finalize.
+- partial_input=False — child streams raw rows; per-chunk partial states are
+  computed host-side then merged (distinct aggs force whole-input buffering).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..chunk import Chunk, Column, concat_chunks
+from ..copr import aggstate
+from ..copr.cpu_engine import _run_agg  # shared host agg kernel
+from ..copr.ir import AggregationIR
+from ..expr.aggregation import AggDesc
+from ..expr.expression import Expression
+from .base import ExecContext, Executor
+
+
+class HashAggExec(Executor):
+    def __init__(self, ctx, child: Executor, group_by: List[Expression],
+                 aggs: List[AggDesc], partial_input: bool,
+                 plan_id: int = -1):
+        ftypes = [g.ftype for g in group_by] + [a.ftype for a in aggs]
+        super().__init__(ctx, ftypes, [child], plan_id)
+        self.group_by = group_by
+        self.aggs = aggs
+        self.partial_input = partial_input
+        self._result: Optional[List[Chunk]] = None
+        self._pos = 0
+
+    def _open(self):
+        self._result = None
+        self._pos = 0
+
+    def _compute(self) -> List[Chunk]:
+        chunks = self.drain_child()
+        n_keys = len(self.group_by)
+        if self.partial_input:
+            final = aggstate.merge_partials_to_final(n_keys, self.aggs, chunks)
+        else:
+            has_distinct = any(a.distinct for a in self.aggs)
+            if has_distinct:
+                whole = concat_chunks(chunks)
+                if whole is None:
+                    final = None
+                else:
+                    ir = AggregationIR(self.group_by, self.aggs, mode="complete")
+                    final = _run_agg(ir, whole)
+                    if n_keys == 0 and whole.num_rows == 0:
+                        final = None
+            else:
+                # chunk-wise partials, then one merge — bounded eval memory
+                ir = AggregationIR(self.group_by, self.aggs, mode="partial")
+                partials = [
+                    _run_agg(ir, c) for c in chunks if c.num_rows > 0
+                ]
+                final = aggstate.merge_partials_to_final(
+                    n_keys, self.aggs, partials
+                )
+        if final is None:
+            if n_keys == 0:
+                return [aggstate.empty_final_row(self.aggs)]
+            return []
+        return list(final.split(self.ctx.chunk_size))
+
+    def _next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._compute()
+        if self._pos >= len(self._result):
+            return None
+        c = self._result[self._pos]
+        self._pos += 1
+        return c
+
+
+class StreamAggExec(Executor):
+    """Aggregation over input sorted by group keys: bounded state (only the
+    open group's accumulator is live between chunks).
+
+    Reference: executor/aggregate.go StreamAggExec."""
+
+    def __init__(self, ctx, child: Executor, group_by: List[Expression],
+                 aggs: List[AggDesc], partial_input: bool = False,
+                 plan_id: int = -1):
+        ftypes = [g.ftype for g in group_by] + [a.ftype for a in aggs]
+        super().__init__(ctx, ftypes, [child], plan_id)
+        self.group_by = group_by
+        self.aggs = aggs
+        self.partial_input = partial_input
+        self._open_partial: Optional[Chunk] = None  # pending group rows
+        self._done = False
+
+    def _open(self):
+        self._open_partial = None
+        self._done = False
+
+    def _next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        n_keys = len(self.group_by)
+        while True:
+            c = self.child().next()
+            if c is None:
+                self._done = True
+                if self._open_partial is not None:
+                    out = aggstate.merge_partials_to_final(
+                        n_keys, self.aggs, [self._open_partial]
+                    )
+                    self._open_partial = None
+                    return out
+                if n_keys == 0:
+                    return aggstate.empty_final_row(self.aggs)
+                return None
+            if c.num_rows == 0:
+                continue
+            if self.partial_input:
+                part = c
+            else:
+                ir = AggregationIR(self.group_by, self.aggs, mode="partial")
+                part = _run_agg(ir, c)
+            if self._open_partial is not None:
+                part = self._open_partial.append(part)
+            if part.num_rows <= 1 or n_keys == 0:
+                self._open_partial = part
+                continue
+            # emit all fully-closed groups; hold back the last (still open)
+            last_key = part.row(part.num_rows - 1)[:n_keys]
+            closed_mask = np.array(
+                [part.row(i)[:n_keys] != last_key for i in range(part.num_rows)],
+                dtype=np.bool_,
+            )
+            closed = part.filter(closed_mask)
+            self._open_partial = part.filter(~closed_mask)
+            if closed.num_rows:
+                return aggstate.merge_partials_to_final(
+                    n_keys, self.aggs, [closed]
+                )

@@ -1,0 +1,160 @@
+"""Root executor framework: Volcano-with-chunks.
+
+Reference: executor/executor.go:177-212 — `Executor` iface Open/Next(chunk)/
+Close plus the Next wrapper that checks the kill flag, records per-operator
+runtime stats (rows/loops/duration) for EXPLAIN ANALYZE, and traces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..chunk import Chunk, DEFAULT_CHUNK_SIZE
+from ..errors import QueryKilledError
+from ..types import FieldType
+
+
+@dataclass
+class OperatorStats:
+    rows: int = 0
+    loops: int = 0
+    time_ns: int = 0
+
+    def record(self, rows: int, dur_ns: int):
+        self.rows += rows
+        self.loops += 1
+        self.time_ns += dur_ns
+
+
+class ExecContext:
+    """Per-statement execution context (stmtctx.StatementContext analog).
+
+    Carries the storage handle, the session's txn (or read-ts for autocommit
+    reads), tuning vars, the kill flag and the runtime-stats collector.
+    """
+
+    def __init__(self, storage, infoschema=None, sess_vars=None, txn=None,
+                 read_ts: int = 0):
+        self.storage = storage
+        self.infoschema = infoschema
+        self.vars = sess_vars
+        self.txn = txn
+        self.read_ts = read_ts
+        self.killed = False
+        self.warnings: List[str] = []
+        self.stats: Dict[int, OperatorStats] = {}
+        self.affected_rows = 0
+        self.last_insert_id = 0
+        self.found_rows = 0
+
+    # tuning knobs with reference defaults (sessionctx/variable/tidb_vars.go)
+    @property
+    def chunk_size(self) -> int:
+        return self.vars.get_int("tidb_max_chunk_size") if self.vars else DEFAULT_CHUNK_SIZE
+
+    @property
+    def distsql_concurrency(self) -> int:
+        return self.vars.get_int("tidb_distsql_scan_concurrency") if self.vars else 8
+
+    @property
+    def engine(self) -> str:
+        if self.vars and not self.vars.get_bool("tidb_use_tpu"):
+            return "cpu"
+        return "tpu"
+
+    def check_killed(self):
+        if self.killed:
+            raise QueryKilledError()
+
+    def op_stats(self, plan_id: int) -> OperatorStats:
+        st = self.stats.get(plan_id)
+        if st is None:
+            st = self.stats[plan_id] = OperatorStats()
+        return st
+
+    def snapshot_ts(self) -> int:
+        if self.txn is not None:
+            return self.txn.start_ts
+        return self.read_ts
+
+
+class Executor:
+    """Base executor.  Subclasses implement _open/_next/_close; next() wraps
+    with kill-check + stats (executor.go:196-212)."""
+
+    def __init__(self, ctx: ExecContext, ftypes: List[FieldType],
+                 children: Optional[List["Executor"]] = None, plan_id: int = -1):
+        self.ctx = ctx
+        self.ftypes = ftypes
+        self.children = children or []
+        self.plan_id = plan_id
+        self._opened = False
+
+    # ---- public API ----------------------------------------------------
+    def open(self):
+        for c in self.children:
+            c.open()
+        self._open()
+        self._opened = True
+
+    def next(self) -> Optional[Chunk]:
+        """Return the next chunk, or None when exhausted."""
+        self.ctx.check_killed()
+        t0 = time.perf_counter_ns()
+        chunk = self._next()
+        dur = time.perf_counter_ns() - t0
+        if self.plan_id >= 0:
+            self.ctx.op_stats(self.plan_id).record(
+                chunk.num_rows if chunk is not None else 0, dur
+            )
+        return chunk
+
+    def close(self):
+        self._close()
+        for c in self.children:
+            c.close()
+        self._opened = False
+
+    # ---- subclass hooks ------------------------------------------------
+    def _open(self):
+        pass
+
+    def _next(self) -> Optional[Chunk]:
+        raise NotImplementedError
+
+    def _close(self):
+        pass
+
+    # ---- helpers -------------------------------------------------------
+    def child(self, i: int = 0) -> "Executor":
+        return self.children[i]
+
+    def drain_child(self, i: int = 0) -> List[Chunk]:
+        """Pull the child to exhaustion (blocking materialization)."""
+        out = []
+        while True:
+            c = self.children[i].next()
+            if c is None:
+                return out
+            if c.num_rows:
+                out.append(c)
+
+    def empty_chunk(self) -> Chunk:
+        return Chunk.empty(self.ftypes)
+
+
+def collect_all(exe: Executor) -> List[Chunk]:
+    """Open/drain/close an executor tree (statement driver helper)."""
+    exe.open()
+    try:
+        out = []
+        while True:
+            c = exe.next()
+            if c is None:
+                return out
+            if c.num_rows:
+                out.append(c)
+    finally:
+        exe.close()

@@ -1,0 +1,190 @@
+"""Reader executors: the bridge from root execution to the pushdown boundary.
+
+Reference: executor/table_reader.go:93-155 (TableReader builds kv.Request from
+ranges+DAG and consumes SelectResult), executor/point_get.go:87 (PointGet
+bypasses distsql entirely), executor/union_scan.go + mem_reader.go (merging
+the txn's uncommitted buffer over snapshot reads).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..catalog import TableInfo
+from ..chunk import Chunk, Column
+from ..copr.ir import DAG
+from ..distsql import SelectResult, select_dag
+from ..expr.expression import Expression, eval_bool_mask
+from ..store.kv import KeyRange
+from ..store.regions import INF
+from .base import ExecContext, Executor
+
+
+class TableReaderExec(Executor):
+    """Fan a DAG out over the table's regions; stream result chunks."""
+
+    def __init__(self, ctx: ExecContext, dag: DAG, ranges: List[KeyRange],
+                 ftypes, keep_order: bool = False, plan_id: int = -1):
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.dag = dag
+        self.ranges = ranges
+        self.keep_order = keep_order
+        self._result: Optional[SelectResult] = None
+
+    def _open(self):
+        self._result = select_dag(
+            self.ctx.storage, self.dag, self.ranges, self.ctx.snapshot_ts(),
+            concurrency=self.ctx.distsql_concurrency,
+            keep_order=self.keep_order, engine=self.ctx.engine,
+        )
+
+    def _next(self) -> Optional[Chunk]:
+        return self._result.next_chunk()
+
+    def _close(self):
+        if self._result is not None:
+            self._result.close()
+            self._result = None
+
+
+class PointGetExec(Executor):
+    """Single-handle read, no distsql, no plan search (point_get.go:87)."""
+
+    def __init__(self, ctx: ExecContext, table: TableInfo, handle: int,
+                 col_offsets: List[int], plan_id: int = -1):
+        ftypes = [table.columns[o].ftype for o in col_offsets]
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.table = table
+        self.handle = handle
+        self.col_offsets = col_offsets
+        self._done = False
+
+    def _open(self):
+        self._done = False
+
+    def _next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        txn = self.ctx.txn
+        if txn is not None:
+            row = txn.get(self.table.id, self.handle)
+        else:
+            store = self.ctx.storage.table(self.table.id)
+            row = store.read_row(self.handle, self.ctx.snapshot_ts())
+        if row is None:
+            return self.empty_chunk()
+        vals = [row[o] for o in self.col_offsets]
+        return Chunk([
+            Column.from_values(ft, [v])
+            for ft, v in zip(self.ftypes, vals)
+        ])
+
+
+class UnionScanExec(Executor):
+    """Scan that sees the session txn's uncommitted writes.
+
+    Used instead of TableReaderExec when the current txn has dirty rows for
+    the table (executor/union_scan.go).  Reads base+committed delta through
+    the store, overlays the txn buffer, emits (handle?, cols...) chunks and
+    applies residual conditions host-side.  Pushdown is disabled on dirty
+    tables by the planner, so the DAG here is scan-only semantics.
+    """
+
+    def __init__(self, ctx: ExecContext, table: TableInfo,
+                 col_offsets: List[int], conditions: List[Expression],
+                 with_handle: bool = False, ranges: Optional[List[KeyRange]] = None,
+                 plan_id: int = -1):
+        from ..types import ty_int
+
+        ftypes = [table.columns[o].ftype for o in col_offsets]
+        if with_handle:
+            ftypes = [ty_int(False)] + ftypes
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.table = table
+        self.col_offsets = col_offsets
+        self.conditions = conditions
+        self.with_handle = with_handle
+        self.ranges = ranges or [KeyRange(table.id, 0, INF)]
+        self._batches: Optional[List[Chunk]] = None
+        self._pos = 0
+
+    def _open(self):
+        self._batches = None
+        self._pos = 0
+
+    def _build(self) -> List[Chunk]:
+        store = self.ctx.storage.table(self.table.id)
+        ts = self.ctx.snapshot_ts()
+        txn = self.ctx.txn
+        out: List[Chunk] = []
+        buffer = {}
+        if txn is not None:
+            for (tid, h), m in txn.buffer.items():
+                if tid == self.table.id:
+                    buffer[h] = m
+        for kr in self.ranges:
+            start, end = kr.start, min(kr.end, INF)
+            deleted, inserted = store.delta_overlay(ts, start, end)
+            dele = set(deleted)
+            # base rows in chunks
+            base_end = min(end, store.base_rows)
+            CH = 1 << 16
+            for t0 in range(start, max(base_end, start), CH):
+                t1 = min(t0 + CH, base_end)
+                if t0 >= t1:
+                    break
+                chunk = store.base_chunk(self.col_offsets, t0, t1)
+                handles = np.arange(t0, t1, dtype=np.int64)
+                keep = np.ones(t1 - t0, dtype=np.bool_)
+                for h in dele:
+                    if t0 <= h < t1:
+                        keep[h - t0] = False
+                for h in buffer:
+                    if t0 <= h < t1:
+                        keep[h - t0] = False  # overridden by txn buffer
+                chunk, handles = chunk.filter(keep), handles[keep]
+                out.append(self._finish_chunk(chunk, handles))
+            # committed-delta inserts + txn buffer rows, as one tail chunk
+            rows, handles = [], []
+            for h in sorted(set(inserted) | set(buffer)):
+                if not (start <= h < end):
+                    continue
+                if h in buffer:
+                    m = buffer[h]
+                    if m.op == "put":
+                        rows.append(tuple(m.values[o] for o in self.col_offsets))
+                        handles.append(h)
+                elif h >= store.base_rows:  # base inserts already filtered
+                    rows.append(tuple(inserted[h][o] for o in self.col_offsets))
+                    handles.append(h)
+            if rows:
+                cols = []
+                base_fts = self.ftypes[1:] if self.with_handle else self.ftypes
+                for i, ft in enumerate(base_fts):
+                    cols.append(Column.from_values(ft, [r[i] for r in rows]))
+                out.append(self._finish_chunk(
+                    Chunk(cols), np.asarray(handles, dtype=np.int64)
+                ))
+        return [c for c in out if c.num_rows]
+
+    def _finish_chunk(self, chunk: Chunk, handles: np.ndarray) -> Chunk:
+        if self.conditions:
+            mask = eval_bool_mask(self.conditions, chunk)
+            chunk, handles = chunk.filter(mask), handles[mask]
+        if self.with_handle:
+            from ..types import ty_int
+
+            return Chunk([Column(ty_int(False), handles)] + chunk.columns)
+        return chunk
+
+    def _next(self) -> Optional[Chunk]:
+        if self._batches is None:
+            self._batches = self._build()
+        if self._pos >= len(self._batches):
+            return None
+        c = self._batches[self._pos]
+        self._pos += 1
+        return c

@@ -1,0 +1,178 @@
+"""Simple root operators: Selection, Projection, Limit, TableDual, MaxOneRow,
+Union.
+
+Reference: executor/executor.go (SelectionExec, LimitExec, TableDualExec,
+MaxOneRowExec, UnionExec :1275), executor/projection.go.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..chunk import Chunk, Column
+from ..errors import ExecutorError
+from ..expr.builtins import cast_vec
+from ..expr.expression import Expression, eval_bool_mask
+from ..expr.vec import Vec
+from .base import ExecContext, Executor
+
+
+class SelectionExec(Executor):
+    def __init__(self, ctx, child: Executor, conditions: List[Expression],
+                 plan_id: int = -1):
+        super().__init__(ctx, child.ftypes, [child], plan_id)
+        self.conditions = conditions
+
+    def _next(self) -> Optional[Chunk]:
+        while True:
+            c = self.child().next()
+            if c is None:
+                return None
+            if c.num_rows == 0:
+                continue
+            mask = eval_bool_mask(self.conditions, c)
+            out = c.filter(mask)
+            if out.num_rows:
+                return out
+
+
+class ProjectionExec(Executor):
+    def __init__(self, ctx, child: Executor, exprs: List[Expression],
+                 plan_id: int = -1):
+        super().__init__(ctx, [e.ftype for e in exprs], [child], plan_id)
+        self.exprs = exprs
+
+    def _next(self) -> Optional[Chunk]:
+        c = self.child().next()
+        if c is None:
+            return None
+        cols = []
+        for e, ft in zip(self.exprs, self.ftypes):
+            v = e.eval(c)
+            if v.ftype.kind != ft.kind or v.ftype.scale != ft.scale:
+                v = cast_vec(v, ft)
+            cols.append(v.to_column())
+        return Chunk(cols)
+
+
+class LimitExec(Executor):
+    def __init__(self, ctx, child: Executor, limit: int, offset: int = 0,
+                 plan_id: int = -1):
+        super().__init__(ctx, child.ftypes, [child], plan_id)
+        self.limit = limit
+        self.offset = offset
+        self._skipped = 0
+        self._returned = 0
+
+    def _open(self):
+        self._skipped = 0
+        self._returned = 0
+
+    def _next(self) -> Optional[Chunk]:
+        while self._returned < self.limit:
+            c = self.child().next()
+            if c is None:
+                return None
+            if self._skipped < self.offset:
+                skip = min(self.offset - self._skipped, c.num_rows)
+                self._skipped += skip
+                c = c.slice(skip, c.num_rows)
+            if c.num_rows == 0:
+                continue
+            take = min(self.limit - self._returned, c.num_rows)
+            self._returned += take
+            return c.slice(0, take)
+        return None
+
+
+class TableDualExec(Executor):
+    """Zero or one row with no source table (SELECT 1)."""
+
+    def __init__(self, ctx, ftypes, row_count: int = 1, plan_id: int = -1):
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.row_count = row_count
+        self._done = False
+
+    def _open(self):
+        self._done = False
+
+    def _next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        if self.row_count == 0:
+            return None
+        import numpy as np
+
+        from ..types import ty_int
+
+        fts = self.ftypes or [ty_int(False)]  # dummy col so parents see rows
+        cols = [Column(ft, np.zeros(self.row_count, dtype=ft.np_dtype)
+                       if ft.np_dtype is not object
+                       else np.full(self.row_count, "", dtype=object))
+                for ft in fts]
+        return Chunk(cols)
+
+
+class MaxOneRowExec(Executor):
+    """Guard for scalar subqueries: error if the child yields > 1 row;
+    pad with a NULL row if it yields none."""
+
+    def __init__(self, ctx, child: Executor, plan_id: int = -1):
+        super().__init__(ctx, child.ftypes, [child], plan_id)
+        self._done = False
+
+    def _open(self):
+        self._done = False
+
+    def _next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        rows: Optional[Chunk] = None
+        while True:
+            c = self.child().next()
+            if c is None:
+                break
+            if c.num_rows == 0:
+                continue
+            if rows is not None or c.num_rows > 1:
+                raise ExecutorError("subquery returns more than 1 row")
+            rows = c
+        if rows is None:
+            return Chunk([Column.nulls(ft, 1) for ft in self.ftypes])
+        return rows
+
+
+class UnionExec(Executor):
+    """UNION ALL: concatenate children streams (executor.go:1275 runs them
+    concurrently; sequential here — each child already fans out)."""
+
+    def __init__(self, ctx, children: List[Executor], ftypes,
+                 plan_id: int = -1):
+        super().__init__(ctx, ftypes, children, plan_id)
+        self._cur = 0
+
+    def _open(self):
+        self._cur = 0
+
+    def _next(self) -> Optional[Chunk]:
+        while self._cur < len(self.children):
+            c = self.children[self._cur].next()
+            if c is None:
+                self._cur += 1
+                continue
+            if c.num_rows == 0:
+                continue
+            return self._coerce(c)
+        return None
+
+    def _coerce(self, c: Chunk) -> Chunk:
+        cols = []
+        for i, ft in enumerate(self.ftypes):
+            col = c.col(i)
+            if col.ftype.kind != ft.kind or col.ftype.scale != ft.scale:
+                cols.append(cast_vec(Vec.from_column(col), ft).to_column())
+            else:
+                cols.append(col)
+        return Chunk(cols)

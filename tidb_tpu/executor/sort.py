@@ -1,0 +1,91 @@
+"""Sort and TopN executors.
+
+Reference: executor/sort.go (SortExec with rowContainer, TopN heap).  Sort
+materializes the child, computes a lexsort permutation (vectorized), streams
+out permuted chunks.  TopN keeps a bounded buffer: after every appended chunk
+the buffer re-truncates to `limit+offset` rows, so memory stays O(limit).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..chunk import Chunk, concat_chunks
+from ..copr.cpu_engine import run_topn, sort_indices
+from ..expr.expression import Expression
+from .base import ExecContext, Executor
+
+
+class SortExec(Executor):
+    def __init__(self, ctx, child: Executor,
+                 order_by: List[Tuple[Expression, bool]], plan_id: int = -1):
+        super().__init__(ctx, child.ftypes, [child], plan_id)
+        self.order_by = order_by
+        self._sorted: Optional[Chunk] = None
+        self._off = 0
+
+    def _open(self):
+        self._sorted = None
+        self._off = 0
+
+    def _next(self) -> Optional[Chunk]:
+        if self._sorted is None:
+            whole = concat_chunks(self.drain_child())
+            if whole is None or whole.num_rows == 0:
+                self._sorted = self.empty_chunk()
+            else:
+                idx = sort_indices(self.order_by, whole)
+                self._sorted = whole.take(idx)
+        if self._off >= self._sorted.num_rows:
+            return None
+        chunk = self._sorted.slice(
+            self._off, min(self._off + self.ctx.chunk_size,
+                           self._sorted.num_rows)
+        )
+        self._off += chunk.num_rows
+        return chunk
+
+
+class TopNExec(Executor):
+    def __init__(self, ctx, child: Executor,
+                 order_by: List[Tuple[Expression, bool]], limit: int,
+                 offset: int = 0, plan_id: int = -1):
+        super().__init__(ctx, child.ftypes, [child], plan_id)
+        self.order_by = order_by
+        self.limit = limit
+        self.offset = offset
+        self._result: Optional[Chunk] = None
+        self._off = 0
+
+    def _open(self):
+        self._result = None
+        self._off = 0
+
+    def _next(self) -> Optional[Chunk]:
+        if self._result is None:
+            k = self.limit + self.offset
+            buf: Optional[Chunk] = None
+            while True:
+                c = self.child().next()
+                if c is None:
+                    break
+                if c.num_rows == 0:
+                    continue
+                buf = c if buf is None else buf.append(c)
+                if buf.num_rows > 4 * max(k, 256):
+                    buf = run_topn(self.order_by, k, buf)
+            if buf is None:
+                self._result = self.empty_chunk()
+            else:
+                top = run_topn(self.order_by, k, buf)
+                self._result = top.slice(
+                    min(self.offset, top.num_rows), top.num_rows
+                )
+        if self._off >= self._result.num_rows:
+            return None
+        chunk = self._result.slice(
+            self._off, min(self._off + self.ctx.chunk_size,
+                           self._result.num_rows)
+        )
+        self._off += chunk.num_rows
+        return chunk

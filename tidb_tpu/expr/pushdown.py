@@ -27,12 +27,12 @@ PUSHABLE_FUNCS: Set[str] = {
     "and", "or", "not", "xor",
     "isnull", "isnotnull", "istrue", "isfalse",
     "in", "if", "ifnull", "coalesce", "case", "cast",
-    "abs", "ceil", "ceiling", "floor", "round", "truncate",
+    "abs", "ceil", "ceiling", "floor", "round",
     "sqrt", "exp", "ln", "log2", "log10", "pow", "power", "mod", "sign",
     "sin", "cos", "tan", "atan",
     "year", "month", "day", "dayofmonth", "quarter",
     "date", "date_add", "date_sub", "datediff", "dayofweek", "weekday",
-    "unix_timestamp", "extract", "week", "dayofyear",
+    "unix_timestamp",
     "&", "|", "^", "<<", ">>", "~",
     "greatest", "least", "nullif",
 }
@@ -69,8 +69,10 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
     if isinstance(e, ScalarFunc):
         if e.name in blacklist or e.name not in PUSHABLE_FUNCS:
             return False
-        if e.name in ("=", "!=", "in"):
-            # string comparisons only against dict-encoded columns
+        if e.name in ("=", "!=", "in", "<", "<=", ">", ">="):
+            # string comparisons only against dict-encoded columns; range
+            # ops work because dictionaries are sorted (code order ==
+            # string order; jax_engine.rewrite_for_dict maps const bounds)
             kinds = [a.ftype.kind for a in e.args]
             if TypeKind.STRING in kinds:
                 col_args = [a for a in e.args if isinstance(a, ColumnExpr)]

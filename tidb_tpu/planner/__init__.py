@@ -1,0 +1,10 @@
+from .build import PlanBuilder
+from .columns import Schema, SchemaCol, next_uid
+from .optimizer import finish_plan, plan_statement
+from .physical import PhysicalContext, PhysicalPlan, explain_text
+
+__all__ = [
+    "PlanBuilder", "Schema", "SchemaCol", "next_uid",
+    "plan_statement", "finish_plan", "PhysicalContext", "PhysicalPlan",
+    "explain_text",
+]

@@ -1,0 +1,736 @@
+"""AST statement -> logical plan.
+
+Reference: planner/core/planbuilder.go (PlanBuilder.Build) +
+logical_plan_builder.go (buildSelect/buildJoin/buildAggregation) +
+expression_rewriter.go (subquery rewrites to semi-joins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog import InfoSchema, TableInfo
+from ..chunk import Chunk, Column
+from ..errors import PlanError, UnknownColumnError
+from ..expr.aggregation import AGG_FUNCS, AggDesc
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..parser import ast
+from ..types import merge_types, ty_int
+from .columns import Schema, SchemaCol, next_uid
+from .expr_build import (
+    CorrelatedColumn,
+    ExprBuilder,
+    fold_constant,
+    literal_to_constant,
+    split_and,
+)
+from .logical import (
+    LogicalAggregation,
+    LogicalDataSource,
+    LogicalDual,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalMaxOneRow,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalSelection,
+    LogicalSort,
+    LogicalTopN,
+    LogicalUnion,
+)
+
+DEFAULT_MARKER = object()  # DEFAULT keyword in INSERT values
+
+
+# ---------------------------------------------------------------------------
+# DML plan containers (root-task only; built into executors directly)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class InsertPlan:
+    db: str
+    table: TableInfo
+    col_offsets: List[int]
+    rows: Optional[List[list]] = None
+    select_plan: Optional[LogicalPlan] = None
+    replace: bool = False
+    ignore: bool = False
+    on_dup_update: List[Tuple[int, Expression]] = dc_field(default_factory=list)
+
+
+@dataclass
+class UpdatePlan:
+    db: str
+    table: TableInfo
+    assignments: List[Tuple[int, Expression]]  # positions over full row
+    conditions: List[Expression]  # positions over full row
+
+
+@dataclass
+class DeletePlan:
+    db: str
+    table: TableInfo
+    conditions: List[Expression]
+
+
+@dataclass
+class LoadDataPlan:
+    db: str
+    table: TableInfo
+    path: str
+    fields_terminated: str
+    ignore_lines: int
+
+
+class PlanBuilder:
+    def __init__(self, infoschema: InfoSchema, current_db: str = "",
+                 exec_subplan: Optional[Callable] = None,
+                 param_values: Optional[list] = None):
+        self.infoschema = infoschema
+        self.current_db = current_db
+        self.exec_subplan = exec_subplan  # fn(LogicalPlan) -> List[tuple]
+        self.param_values = param_values
+
+    # ------------------------------------------------------------------
+    def build(self, stmt: ast.Stmt):
+        if isinstance(stmt, ast.SelectStmt):
+            return self.build_select(stmt)
+        if isinstance(stmt, ast.UnionStmt):
+            return self.build_union(stmt)
+        if isinstance(stmt, ast.InsertStmt):
+            return self.build_insert(stmt)
+        if isinstance(stmt, ast.UpdateStmt):
+            return self.build_update(stmt)
+        if isinstance(stmt, ast.DeleteStmt):
+            return self.build_delete(stmt)
+        if isinstance(stmt, ast.LoadDataStmt):
+            t = self._table_info(stmt.table)
+            return LoadDataPlan(
+                stmt.table.db or self.current_db, t, stmt.path,
+                stmt.fields_terminated, stmt.ignore_lines,
+            )
+        raise PlanError(f"no plan for {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+    # FROM clause
+    # ------------------------------------------------------------------
+    def _table_info(self, tn: ast.TableName) -> TableInfo:
+        db = tn.db or self.current_db
+        if not db:
+            raise PlanError("no database selected")
+        return self.infoschema.table(db, tn.name)
+
+    def build_from(self, node, outer: List[Schema]) -> LogicalPlan:
+        if node is None:
+            return LogicalDual(Schema([]), 1)
+        if isinstance(node, ast.TableName):
+            t = self._table_info(node)
+            if t.is_view:
+                sel = t.view_select
+                if isinstance(sel, str):
+                    from ..parser import parse_one
+
+                    sel = parse_one(sel)
+                sub = (self.build_union(sel, outer)
+                       if isinstance(sel, ast.UnionStmt)
+                       else self.build_select(sel, outer))
+                alias = node.alias or node.name
+                return _aliased(sub, alias)
+            alias = node.alias or node.name
+            cols = [
+                SchemaCol(next_uid(), c.name, c.ftype, alias, c.name, c.offset)
+                for c in t.public_columns()
+            ]
+            return LogicalDataSource(node.db or self.current_db, t, alias,
+                                     Schema(cols))
+        if isinstance(node, ast.SubqueryRef):
+            sub = self.build_select(node.query, outer) \
+                if isinstance(node.query, ast.SelectStmt) \
+                else self.build_union(node.query, outer)
+            return _aliased(sub, node.alias)
+        if isinstance(node, ast.Join):
+            return self.build_join(node, outer)
+        raise PlanError(f"unsupported FROM node {type(node).__name__}")
+
+    def build_join(self, node: ast.Join, outer: List[Schema]) -> LogicalPlan:
+        left = self.build_from(node.left, outer)
+        right = self.build_from(node.right, outer)
+        kind = {"inner": "inner", "cross": "inner", "left": "left_outer",
+                "right": "right_outer"}[node.kind]
+        if kind == "right_outer":
+            # normalize: RIGHT JOIN a b == LEFT JOIN b a; a projection
+            # below restores the user-visible column order
+            left, right = right, left
+            kind = "left_outer"
+        merged = Schema(
+            left.schema.cols
+            + ([_nullable(c) for c in right.schema.cols]
+               if kind == "left_outer" else right.schema.cols)
+        )
+        eq, other = [], []
+        conds: List[Expression] = []
+        eb = ExprBuilder(merged, outer_schemas=outer,
+                         param_values=self.param_values,
+                         subquery_handler=self._mk_subquery_handler(merged, outer))
+        if node.using:
+            for name in node.using:
+                lc = left.schema.resolve(name)
+                rc = right.schema.resolve(name)
+                eq.append((lc.to_expr(), rc.to_expr()))
+        elif node.on is not None:
+            for conj in split_and(node.on):
+                conds.append(eb.build(conj))
+        left_uids = set(left.schema.uids())
+        right_uids = set(right.schema.uids())
+        for c in conds:
+            pair = _as_eq_key(c, left_uids, right_uids)
+            if pair is not None:
+                eq.append(pair)
+            else:
+                other.append(c)
+        if node.kind == "right":
+            # schema order: original left (now the null-extended right child)
+            # first; a projection restores the user-visible column order
+            out_schema = Schema(
+                list(merged.cols[len(left.schema.cols):])
+                + list(merged.cols[:len(left.schema.cols)])
+            )
+            j = LogicalJoin(left, right, kind, eq, other, merged)
+            exprs = [c.to_expr() for c in out_schema.cols]
+            return LogicalProjection(j, exprs, out_schema)
+        return LogicalJoin(left, right, kind, eq, other, merged)
+
+    # ------------------------------------------------------------------
+    # subqueries (expression_rewriter.go handleInSubquery/buildSemiApply)
+    # ------------------------------------------------------------------
+    def _mk_subquery_handler(self, schema: Schema, outer: List[Schema]):
+        def handler(query, kind, negated, operand):
+            if kind == "scalar":
+                sub = self.build_select(query, [schema] + outer)
+                if len(sub.schema) != 1:
+                    raise PlanError("scalar subquery must return one column")
+                rows = self._eval_subplan(sub)
+                if len(rows) > 1:
+                    raise PlanError("subquery returns more than 1 row")
+                v = rows[0][0] if rows else None
+                ft = sub.schema.col(0).ftype.with_nullable(True)
+                return Constant(v, ft)
+            raise PlanError(
+                "IN/EXISTS subquery allowed only as a top-level WHERE conjunct"
+            )
+
+        return handler
+
+    def _eval_subplan(self, plan: LogicalPlan) -> List[tuple]:
+        if self.exec_subplan is None:
+            raise PlanError("subquery execution not available in this context")
+        return self.exec_subplan(plan)
+
+    # ------------------------------------------------------------------
+    # SELECT
+    # ------------------------------------------------------------------
+    def build_select(self, sel: ast.SelectStmt,
+                     outer: Optional[List[Schema]] = None) -> LogicalPlan:
+        outer = outer or []
+        p = self.build_from(sel.from_clause, outer)
+        from_schema = p.schema
+
+        # ---- WHERE (with IN/EXISTS conjuncts becoming semi-joins) -----
+        if sel.where is not None:
+            p = self._build_filter(p, sel.where, outer)
+
+        # ---- expand stars into field list -----------------------------
+        fields: List[ast.SelectField] = []
+        for f in sel.fields:
+            if isinstance(f.expr, ast.Star):
+                cols = (
+                    [c for c in p.schema.cols
+                     if not f.expr.table
+                     or c.table.lower() == f.expr.table.lower()]
+                )
+                if not cols:
+                    raise PlanError(f"bad *: {f.expr.table}")
+                for c in cols:
+                    ref = ast.ColumnRef(c.name, c.table)
+                    fields.append(ast.SelectField(ref, c.display or c.name))
+            else:
+                fields.append(f)
+
+        # ---- aggregate detection --------------------------------------
+        has_agg = bool(sel.group_by) or any(
+            _contains_agg(f.expr) for f in fields
+        ) or (sel.having is not None and _contains_agg(sel.having)) or any(
+            _contains_agg(it.expr) for it in sel.order_by
+        )
+
+        aggs: List[AggDesc] = []
+        agg_uid_of: dict = {}
+
+        def agg_collector(name, args, distinct):
+            key = (name, tuple(str(a) for a in args), distinct)
+            if key in agg_uid_of:
+                uid, ft = agg_uid_of[key]
+                return ColumnExpr(-1, ft, f"{name}(..)", uid)
+            desc = AggDesc(name, args, distinct)
+            uid = next_uid()
+            aggs.append(desc)
+            agg_uid_of[key] = (uid, desc.ftype)
+            return ColumnExpr(-1, desc.ftype, str(desc), uid)
+
+        sub_handler = self._mk_subquery_handler(p.schema, outer)
+        eb = ExprBuilder(p.schema, agg_collector if has_agg else None,
+                         sub_handler, outer, self.param_values)
+
+        field_exprs: List[Expression] = []
+        field_names: List[str] = []
+        for f in fields:
+            e = eb.build(f.expr)
+            field_exprs.append(e)
+            field_names.append(f.alias or _display_name(f.expr))
+
+        if has_agg:
+            # ---- GROUP BY ---------------------------------------------
+            group_exprs: List[Expression] = []
+            geb = ExprBuilder(from_schema, None, sub_handler, outer,
+                              self.param_values)
+            for g in sel.group_by:
+                if isinstance(g, ast.Literal) and isinstance(g.value, int):
+                    idx = g.value - 1
+                    if not (0 <= idx < len(field_exprs)):
+                        raise PlanError(f"GROUP BY position {g.value}")
+                    group_exprs.append(field_exprs[idx])
+                elif isinstance(g, ast.ColumnRef) and \
+                        from_schema.try_resolve(g.name, g.table) is None:
+                    # alias reference
+                    if g.name.lower() not in [n.lower() for n in field_names]:
+                        raise UnknownColumnError(g.name)
+                    i = [n.lower() for n in field_names].index(g.name.lower())
+                    group_exprs.append(field_exprs[i])
+                else:
+                    group_exprs.append(geb.build(g))
+
+            # group outputs keep the uid of bare columns so later refs hit
+            group_uids: List[int] = []
+            group_schema_cols: List[SchemaCol] = []
+            group_key_strs = {}
+            for ge in group_exprs:
+                if isinstance(ge, ColumnExpr) and ge.unique_id >= 0:
+                    uid = ge.unique_id
+                    name = ge.name
+                else:
+                    uid = next_uid()
+                    name = str(ge)
+                group_uids.append(uid)
+                group_key_strs[str(ge)] = (uid, ge.ftype)
+                group_schema_cols.append(
+                    SchemaCol(uid, name, ge.ftype, "", name)
+                )
+
+            def patch(e: Expression) -> Expression:
+                # rewrite post-agg exprs onto the agg output schema
+                if isinstance(e, ColumnExpr):
+                    if e.unique_id in group_uids or \
+                            e.unique_id in [u for u, _ in agg_uid_of.values()]:
+                        return e
+                    # bare column outside GROUP BY -> first_row (TiDB
+                    # behavior without ONLY_FULL_GROUP_BY)
+                    return agg_collector("first_row", [e], False)
+                key = str(e)
+                if key in group_key_strs:
+                    uid, ft = group_key_strs[key]
+                    return ColumnExpr(-1, ft, key, uid)
+                if isinstance(e, ScalarFunc):
+                    return ScalarFunc(e.name, [patch(a) for a in e.args],
+                                      e.ftype, e.meta)
+                return e
+
+            field_exprs = [patch(e) for e in field_exprs]
+
+            amap = {n.lower(): e for n, e in zip(field_names, field_exprs)}
+            having_conds: List[Expression] = []
+            if sel.having is not None:
+                heb = ExprBuilder(p.schema, agg_collector, sub_handler,
+                                  outer, self.param_values,
+                                  alias_fields=amap)
+                for conj in split_and(sel.having):
+                    having_conds.append(patch(heb.build(conj)))
+
+            order_items = self._build_order(sel.order_by, field_names,
+                                            field_exprs, p.schema,
+                                            ExprBuilder(p.schema, agg_collector,
+                                                        sub_handler, outer,
+                                                        self.param_values,
+                                                        alias_fields=amap))
+            order_items = [(patch(e), d) for e, d in order_items]
+
+            agg_schema = Schema(
+                group_schema_cols + [
+                    SchemaCol(agg_uid_of[k][0], str(a), a.ftype, "", str(a))
+                    for k, a in zip(list(agg_uid_of.keys()), aggs)
+                ]
+            )
+            # NOTE: agg_uid_of insertion order == aggs order (both appended
+            # together), so the zip above lines up.
+            p = LogicalAggregation(p, group_exprs, aggs, agg_schema)
+            if having_conds:
+                p = LogicalSelection(p, having_conds)
+        else:
+            amap = {n.lower(): e for n, e in zip(field_names, field_exprs)}
+            if sel.having is not None:
+                heb = ExprBuilder(p.schema, None, sub_handler, outer,
+                                  self.param_values, alias_fields=amap)
+                conds = [heb.build(c) for c in split_and(sel.having)]
+                p = LogicalSelection(p, conds)
+            order_items = self._build_order(
+                sel.order_by, field_names, field_exprs, p.schema,
+                ExprBuilder(p.schema, None, sub_handler, outer,
+                            self.param_values, alias_fields=amap))
+
+        # ---- ORDER BY placement ---------------------------------------
+        if order_items and not sel.distinct:
+            if sel.limit is not None:
+                p = LogicalTopN(p, order_items, sel.limit, sel.offset)
+            else:
+                p = LogicalSort(p, order_items)
+
+        # ---- projection -----------------------------------------------
+        proj_cols = [
+            SchemaCol(next_uid(), name, e.ftype, "", name)
+            for name, e in zip(field_names, field_exprs)
+        ]
+        p = LogicalProjection(p, field_exprs, Schema(proj_cols))
+
+        # ---- DISTINCT --------------------------------------------------
+        if sel.distinct:
+            group = [c.to_expr() for c in proj_cols]
+            p = LogicalAggregation(p, group, [], Schema(proj_cols))
+            if order_items:
+                # items must reference select outputs; re-resolve by string
+                remapped = []
+                str_to_col = {str(e): c for e, c in zip(field_exprs, proj_cols)}
+                for e, d in order_items:
+                    c = str_to_col.get(str(e))
+                    if c is None:
+                        raise PlanError(
+                            "ORDER BY with DISTINCT must use select columns"
+                        )
+                    remapped.append((c.to_expr(), d))
+                if sel.limit is not None:
+                    p = LogicalTopN(p, remapped, sel.limit, sel.offset)
+                else:
+                    p = LogicalSort(p, remapped)
+            if sel.limit is not None and not order_items:
+                p = LogicalLimit(p, sel.limit, sel.offset)
+        elif sel.limit is not None and not order_items:
+            p = LogicalLimit(p, sel.limit, sel.offset)
+
+        return p
+
+    def _build_order(self, order_by, field_names, field_exprs, schema,
+                     eb: ExprBuilder):
+        items = []
+        names = [n.lower() for n in field_names]
+        for it in order_by:
+            e = it.expr
+            if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                idx = e.value - 1
+                if not (0 <= idx < len(field_exprs)):
+                    raise PlanError(f"ORDER BY position {e.value}")
+                items.append((field_exprs[idx], it.desc))
+                continue
+            if isinstance(e, ast.ColumnRef) and not e.table \
+                    and schema.try_resolve(e.name) is None \
+                    and e.name.lower() in names:
+                items.append((field_exprs[names.index(e.name.lower())],
+                              it.desc))
+                continue
+            items.append((eb.build(e), it.desc))
+        return items
+
+    def _build_filter(self, p: LogicalPlan, where, outer) -> LogicalPlan:
+        conds: List[Expression] = []
+        for conj in split_and(where):
+            neg = False
+            node = conj
+            if isinstance(node, ast.UnaryOp) and node.op == "not":
+                if isinstance(node.operand, (ast.Exists, ast.InSubquery)):
+                    neg, node = True, node.operand
+            if isinstance(node, ast.InSubquery):
+                p = self._semi_join(p, node.query, node.expr,
+                                    node.negated or neg, outer)
+                continue
+            if isinstance(node, ast.Exists):
+                p = self._exists_join(p, node.query, node.negated or neg,
+                                      outer)
+                continue
+            eb = ExprBuilder(p.schema, None,
+                             self._mk_subquery_handler(p.schema, outer),
+                             outer, self.param_values)
+            conds.append(eb.build(conj))
+        if conds:
+            p = LogicalSelection(p, conds)
+        return p
+
+    def _semi_join(self, p: LogicalPlan, query, operand, negated: bool,
+                   outer) -> LogicalPlan:
+        sub = self.build_select(query, [p.schema] + outer)
+        if len(sub.schema) != 1:
+            raise PlanError("IN subquery must return one column")
+        eb = ExprBuilder(p.schema, None, None, outer, self.param_values)
+        left_key = eb.build(operand)
+        right_key = sub.schema.col(0).to_expr()
+        kind = "anti_semi" if negated else "semi"
+        return LogicalJoin(p, sub, kind, [(left_key, right_key)], [],
+                           p.schema)
+
+    def _exists_join(self, p: LogicalPlan, query, negated: bool,
+                     outer) -> LogicalPlan:
+        sub = self.build_select(query, [p.schema] + outer)
+        kind = "anti_semi" if negated else "semi"
+        return LogicalJoin(p, sub, kind, [], [], p.schema)
+
+    # ------------------------------------------------------------------
+    # UNION
+    # ------------------------------------------------------------------
+    def build_union(self, u: ast.UnionStmt,
+                    outer: Optional[List[Schema]] = None) -> LogicalPlan:
+        children = [self.build_select(s, outer) for s in u.selects]
+        width = len(children[0].schema)
+        for c in children[1:]:
+            if len(c.schema) != width:
+                raise PlanError("UNION columns differ")
+        cols = []
+        for i in range(width):
+            ft = children[0].schema.col(i).ftype
+            for c in children[1:]:
+                ft = merge_types(ft, c.schema.col(i).ftype)
+            first = children[0].schema.col(i)
+            cols.append(SchemaCol(next_uid(), first.name, ft, "",
+                                  first.display or first.name))
+        p: LogicalPlan = LogicalUnion(children, Schema(cols))
+        if not u.all:
+            group = [c.to_expr() for c in cols]
+            p = LogicalAggregation(p, group, [], Schema(cols))
+        if u.order_by:
+            names = [c.name.lower() for c in cols]
+            items = []
+            for it in u.order_by:
+                e = it.expr
+                if isinstance(e, ast.Literal) and isinstance(e.value, int):
+                    items.append((cols[e.value - 1].to_expr(), it.desc))
+                elif isinstance(e, ast.ColumnRef) and e.name.lower() in names:
+                    items.append(
+                        (cols[names.index(e.name.lower())].to_expr(), it.desc)
+                    )
+                else:
+                    raise PlanError("UNION ORDER BY must use output columns")
+            if u.limit is not None:
+                p = LogicalTopN(p, items, u.limit, u.offset)
+            else:
+                p = LogicalSort(p, items)
+        if u.limit is not None and not u.order_by:
+            p = LogicalLimit(p, u.limit, u.offset)
+        return p
+
+    # ------------------------------------------------------------------
+    # DML
+    # ------------------------------------------------------------------
+    def build_insert(self, st: ast.InsertStmt) -> InsertPlan:
+        t = self._table_info(st.table)
+        if st.columns:
+            offsets = []
+            for name in st.columns:
+                c = t.find_column(name)
+                if c is None:
+                    raise UnknownColumnError(name)
+                offsets.append(c.offset)
+        else:
+            offsets = [c.offset for c in t.public_columns()]
+        plan = InsertPlan(st.table.db or self.current_db, t, offsets,
+                          replace=st.replace, ignore=st.ignore)
+        if st.query is not None:
+            sub = self.build(st.query)
+            if len(sub.schema) != len(offsets):
+                raise PlanError("INSERT ... SELECT column count mismatch")
+            plan.select_plan = sub
+        else:
+            eb = ExprBuilder(Schema([]), None, None, [], self.param_values)
+            rows = []
+            for vals in st.values:
+                if len(vals) != len(offsets):
+                    raise PlanError("INSERT value count mismatch")
+                row = []
+                for v, off in zip(vals, offsets):
+                    if isinstance(v, ast.Default):
+                        row.append(DEFAULT_MARKER)
+                        continue
+                    e = eb.build(v)
+                    row.append(_eval_const(e))
+                rows.append(row)
+            plan.rows = rows
+        if st.on_dup_update:
+            # schema: old row cols then VALUES() pseudo-cols (renamed so an
+            # unqualified ref never collides with the real column)
+            cols = [
+                SchemaCol(next_uid(), c.name, c.ftype, "", c.name, c.offset)
+                for c in t.columns
+            ]
+            vcols = [
+                SchemaCol(next_uid(), f"__values__{c.name}", c.ftype, "",
+                          c.name, len(t.columns) + c.offset)
+                for c in t.columns
+            ]
+            sch = Schema(cols + vcols)
+            eb2 = ExprBuilder(sch, None, None, [], self.param_values)
+            for name, vexpr in st.on_dup_update:
+                c = t.find_column(name)
+                if c is None:
+                    raise UnknownColumnError(name)
+                e = eb2.build(_rewrite_values_fn(vexpr))
+                e = e.remap_columns({sc.uid: i for i, sc in enumerate(sch.cols)})
+                plan.on_dup_update.append((c.offset, e))
+        return plan
+
+    def _full_row_schema(self, t: TableInfo) -> Schema:
+        return Schema([
+            SchemaCol(next_uid(), c.name, c.ftype, t.name, c.name, c.offset)
+            for c in t.columns
+        ])
+
+    def build_update(self, st: ast.UpdateStmt) -> UpdatePlan:
+        t = self._table_info(st.table)
+        sch = self._full_row_schema(t)
+        pos = {sc.uid: i for i, sc in enumerate(sch.cols)}
+        eb = ExprBuilder(sch, None, None, [], self.param_values)
+        assigns = []
+        for name, vexpr in st.assignments:
+            c = t.find_column(name)
+            if c is None:
+                raise UnknownColumnError(name)
+            e = eb.build(vexpr).remap_columns(pos)
+            assigns.append((c.offset, e))
+        conds = []
+        if st.where is not None:
+            for conj in split_and(st.where):
+                conds.append(eb.build(conj).remap_columns(pos))
+        return UpdatePlan(st.table.db or self.current_db, t, assigns, conds)
+
+    def build_delete(self, st: ast.DeleteStmt) -> DeletePlan:
+        t = self._table_info(st.table)
+        sch = self._full_row_schema(t)
+        pos = {sc.uid: i for i, sc in enumerate(sch.cols)}
+        eb = ExprBuilder(sch, None, None, [], self.param_values)
+        conds = []
+        if st.where is not None:
+            for conj in split_and(st.where):
+                conds.append(eb.build(conj).remap_columns(pos))
+        return DeletePlan(st.table.db or self.current_db, t, conds)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _aliased(sub: LogicalPlan, alias: str) -> LogicalPlan:
+    sub.schema = sub.schema.with_table(alias)
+    return sub
+
+
+def _nullable(c: SchemaCol) -> SchemaCol:
+    from dataclasses import replace
+
+    return replace(c, ftype=c.ftype.with_nullable(True))
+
+
+def _as_eq_key(e: Expression, left_uids, right_uids):
+    """cond of shape left_col = right_col (either orientation)."""
+    if isinstance(e, ScalarFunc) and e.name == "=" and len(e.args) == 2:
+        a, b = e.args
+        ua = _root_uids(a)
+        ub = _root_uids(b)
+        if ua and ub:
+            if ua <= left_uids and ub <= right_uids:
+                return (a, b)
+            if ua <= right_uids and ub <= left_uids:
+                return (b, a)
+    return None
+
+
+def _root_uids(e: Expression) -> set:
+    out: set = set()
+    e.collect_columns(out)
+    return out
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name.lower() in AGG_FUNCS:
+            return True
+        return any(_contains_agg(a) for a in e.args
+                   if isinstance(a, ast.Expr))
+    for attr in ("left", "right", "operand", "expr", "low", "high",
+                 "else_expr", "value"):
+        v = getattr(e, attr, None)
+        if isinstance(v, ast.Expr) and _contains_agg(v):
+            return True
+    if isinstance(e, ast.CaseWhen):
+        for w, t in e.branches:
+            if _contains_agg(w) or _contains_agg(t):
+                return True
+    if isinstance(e, ast.InList):
+        return any(_contains_agg(x) for x in e.items)
+    if isinstance(e, ast.FuncCall):
+        return any(_contains_agg(a) for a in e.args)
+    return False
+
+
+def _display_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.ColumnRef):
+        return e.name
+    if isinstance(e, ast.Literal):
+        return str(e.value)
+    if isinstance(e, ast.FuncCall):
+        inner = ", ".join(_display_name(a) for a in e.args)
+        return f"{e.name}({inner})"
+    if isinstance(e, ast.BinaryOp):
+        return f"{_display_name(e.left)} {e.op} {_display_name(e.right)}"
+    return type(e).__name__.lower()
+
+
+def _rewrite_values_fn(e: ast.Expr) -> ast.Expr:
+    """VALUES(col) inside ON DUPLICATE KEY UPDATE -> pseudo-col ref."""
+    if isinstance(e, ast.FuncCall) and e.name.lower() == "values" \
+            and len(e.args) == 1 and isinstance(e.args[0], ast.ColumnRef):
+        return ast.ColumnRef(f"__values__{e.args[0].name}")
+    if isinstance(e, ast.BinaryOp):
+        return ast.BinaryOp(e.op, _rewrite_values_fn(e.left),
+                            _rewrite_values_fn(e.right))
+    if isinstance(e, ast.FuncCall):
+        return ast.FuncCall(e.name, [_rewrite_values_fn(a) for a in e.args],
+                            e.distinct)
+    return e
+
+
+def _eval_const(e: Expression):
+    e = fold_constant(e)
+    if isinstance(e, Constant):
+        v = e.value
+        from ..types import TypeKind
+
+        if v is not None and e.ftype.kind == TypeKind.DECIMAL:
+            return v / (10 ** e.ftype.scale)
+        return v
+    # non-foldable (now(), rand()): evaluate over a 1-row dual
+    dual = Chunk([Column.from_values(ty_int(False), [0])])
+    v = e.eval(dual)
+    if v.valid is not None and not bool(v.valid[0]):
+        return None
+    x = v.data[0]
+    if isinstance(x, np.generic):
+        x = x.item()
+    return x

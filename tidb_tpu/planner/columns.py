@@ -1,0 +1,93 @@
+"""Planner schemas: named, uniquely-identified output columns per plan node.
+
+Reference: expression.Schema / expression.Column with UniqueID (expression/
+schema.go, column.go) — unique ids survive through the plan tree so rules can
+track a column across projections; positional resolution happens only when
+physical executors are built.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from ..errors import AmbiguousColumnError, UnknownColumnError
+from ..expr.expression import ColumnExpr
+from ..types import FieldType
+
+_uid_counter = itertools.count(1)
+
+
+def next_uid() -> int:
+    return next(_uid_counter)
+
+
+@dataclass(frozen=True)
+class SchemaCol:
+    uid: int
+    name: str  # column name (lowercase for resolution; display kept separate)
+    ftype: FieldType
+    table: str = ""  # qualifier (table alias) for resolution
+    display: str = ""  # header text
+    store_offset: int = -1  # offset in the backing TableStore (DataSource only)
+
+    def to_expr(self) -> ColumnExpr:
+        return ColumnExpr(-1, self.ftype, self.display or self.name, self.uid)
+
+
+class Schema:
+    def __init__(self, cols: List[SchemaCol]):
+        self.cols = cols
+
+    def __len__(self):
+        return len(self.cols)
+
+    def __iter__(self):
+        return iter(self.cols)
+
+    def col(self, i: int) -> SchemaCol:
+        return self.cols[i]
+
+    def ftypes(self) -> List[FieldType]:
+        return [c.ftype for c in self.cols]
+
+    def uids(self) -> List[int]:
+        return [c.uid for c in self.cols]
+
+    def headers(self) -> List[str]:
+        return [c.display or c.name for c in self.cols]
+
+    def index_of_uid(self, uid: int) -> int:
+        for i, c in enumerate(self.cols):
+            if c.uid == uid:
+                return i
+        return -1
+
+    def position_map(self) -> dict:
+        """uid -> positional index, for Expression.remap_columns."""
+        return {c.uid: i for i, c in enumerate(self.cols)}
+
+    def resolve(self, name: str, table: str = "") -> SchemaCol:
+        lname, ltable = name.lower(), table.lower()
+        matches = [
+            c for c in self.cols
+            if c.name.lower() == lname and (not ltable or c.table.lower() == ltable)
+        ]
+        if not matches:
+            raise UnknownColumnError(f"{table + '.' if table else ''}{name}")
+        if len(matches) > 1 and len({c.uid for c in matches}) > 1:
+            raise AmbiguousColumnError(name)
+        return matches[0]
+
+    def try_resolve(self, name: str, table: str = "") -> Optional[SchemaCol]:
+        try:
+            return self.resolve(name, table)
+        except (UnknownColumnError, AmbiguousColumnError):
+            return None
+
+    def merge(self, other: "Schema") -> "Schema":
+        return Schema(self.cols + other.cols)
+
+    def with_table(self, alias: str) -> "Schema":
+        return Schema([replace(c, table=alias) for c in self.cols])

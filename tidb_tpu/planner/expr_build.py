@@ -1,0 +1,324 @@
+"""AST expression -> resolved, typed Expression trees.
+
+Reference: planner/core/expression_rewriter.go — name resolution against the
+child plan's schema, type inference per builtin, constant folding
+(expression/constant_fold.go), aggregate extraction, subquery hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..chunk import Chunk, Column
+from ..errors import PlanError, UnknownColumnError
+from ..expr.aggregation import AGG_FUNCS, AggDesc
+from ..expr.builtins import REGISTRY, infer_ftype
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..parser import ast
+from ..types import (
+    FieldType,
+    TypeKind,
+    ty_bool,
+    ty_date,
+    ty_datetime,
+    ty_decimal,
+    ty_float,
+    ty_int,
+    ty_null,
+    ty_string,
+    ty_uint,
+)
+from ..types.values import parse_date, parse_datetime
+from .columns import Schema
+
+_BINOP_CANON = {
+    "<>": "!=", "&&": "and", "||": "or", "<=>": "nulleq",
+}
+
+_TYPE_NAME_TO_FT = {
+    "signed": lambda p, s: ty_int(),
+    "unsigned": lambda p, s: ty_uint(),
+    "char": lambda p, s: ty_string(),
+    "binary": lambda p, s: ty_string(),
+    "double": lambda p, s: ty_float(),
+    "float": lambda p, s: ty_float(),
+    "decimal": lambda p, s: ty_decimal(p or 10, s),
+    "date": lambda p, s: ty_date(),
+    "datetime": lambda p, s: ty_datetime(),
+}
+
+
+def literal_to_constant(v, type_hint: str = "") -> Constant:
+    if v is None:
+        return Constant(None, ty_null())
+    if type_hint == "date":
+        return Constant(parse_date(str(v)), ty_date(False))
+    if type_hint in ("datetime", "timestamp"):
+        return Constant(parse_datetime(str(v)), ty_datetime(False))
+    if isinstance(v, bool):
+        return Constant(int(v), ty_int(False))
+    if isinstance(v, int):
+        return Constant(v, ty_int(False))
+    if isinstance(v, float):
+        return Constant(v, ty_float(False))
+    return Constant(str(v), ty_string(False))
+
+
+class ExprBuilder:
+    """Stateful expression rewriter bound to one input schema.
+
+    agg_collector: called for aggregate FuncCalls; returns the Expression
+    that stands for the aggregate's value (a ColumnExpr onto the agg node's
+    output).  None -> aggregates are illegal in this context.
+    subquery_handler: called for sub-SELECT expressions with
+    (query_ast, kind in {'scalar','in','exists'}, extra) -> Expression.
+    """
+
+    def __init__(self, schema: Schema,
+                 agg_collector: Optional[Callable] = None,
+                 subquery_handler: Optional[Callable] = None,
+                 outer_schemas: Optional[List[Schema]] = None,
+                 param_values: Optional[list] = None,
+                 fold_constants: bool = True,
+                 alias_fields: Optional[dict] = None):
+        self.schema = schema
+        self.agg_collector = agg_collector
+        self.subquery_handler = subquery_handler
+        self.outer_schemas = outer_schemas or []
+        self.param_values = param_values
+        self.fold = fold_constants
+        # SELECT-alias fallback scope (HAVING/ORDER BY): name -> Expression
+        self.alias_fields = alias_fields or {}
+
+    # ------------------------------------------------------------------
+    def build(self, e: ast.Expr) -> Expression:
+        out = self._build(e)
+        if self.fold:
+            out = fold_constant(out)
+        return out
+
+    def build_bool(self, e: ast.Expr) -> List[Expression]:
+        """WHERE/HAVING/ON: split top-level AND into conjuncts."""
+        conds = []
+        for sub in split_and(e):
+            conds.append(self.build(sub))
+        return conds
+
+    # ------------------------------------------------------------------
+    def _build(self, e: ast.Expr) -> Expression:
+        if isinstance(e, ast.Literal):
+            return literal_to_constant(e.value, e.type_hint)
+        if isinstance(e, ast.ColumnRef):
+            return self._column(e)
+        if isinstance(e, ast.BinaryOp):
+            return self._binop(e)
+        if isinstance(e, ast.UnaryOp):
+            return self._unop(e)
+        if isinstance(e, ast.FuncCall):
+            return self._func(e)
+        if isinstance(e, ast.CaseWhen):
+            return self._case(e)
+        if isinstance(e, ast.Cast):
+            return self._cast(e)
+        if isinstance(e, ast.InList):
+            return self._in_list(e)
+        if isinstance(e, ast.InSubquery):
+            return self._subquery(e.query, "in", negated=e.negated,
+                                  operand=e.expr)
+        if isinstance(e, ast.Between):
+            return self._between(e)
+        if isinstance(e, ast.Exists):
+            return self._subquery(e.query, "exists", negated=e.negated)
+        if isinstance(e, ast.ScalarSubquery):
+            return self._subquery(e.query, "scalar")
+        if isinstance(e, ast.Param):
+            if self.param_values is None or e.index >= len(self.param_values):
+                raise PlanError("missing parameter value")
+            return literal_to_constant(self.param_values[e.index])
+        if isinstance(e, ast.Variable):
+            raise PlanError("variable reference outside SET/session context")
+        if isinstance(e, ast.Interval):
+            raise PlanError("INTERVAL outside DATE_ADD/DATE_SUB")
+        if isinstance(e, ast.Default):
+            raise PlanError("DEFAULT outside INSERT/UPDATE")
+        raise PlanError(f"unsupported expression {type(e).__name__}")
+
+    # ------------------------------------------------------------------
+    def _column(self, e: ast.ColumnRef) -> Expression:
+        col = self.schema.try_resolve(e.name, e.table)
+        if col is not None:
+            return col.to_expr()
+        if not e.table and e.name.lower() in self.alias_fields:
+            return self.alias_fields[e.name.lower()]
+        # correlated reference into an enclosing query block
+        for sc in self.outer_schemas:
+            oc = sc.try_resolve(e.name, e.table)
+            if oc is not None:
+                raise CorrelatedColumn(oc)
+        raise UnknownColumnError(
+            f"{e.table + '.' if e.table else ''}{e.name}"
+        )
+
+    def _make_func(self, name: str, args: List[Expression],
+                   meta: Optional[dict] = None) -> ScalarFunc:
+        meta = meta or {}
+        if name not in REGISTRY:
+            raise PlanError(f"unknown function {name!r}")
+        ft = infer_ftype(name, [a.ftype for a in args], meta)
+        return ScalarFunc(name, args, ft, meta)
+
+    def _binop(self, e: ast.BinaryOp) -> Expression:
+        op = _BINOP_CANON.get(e.op, e.op)
+        if op in ("is", "is not"):
+            operand = self._build(e.left)
+            if isinstance(e.right, ast.Literal):
+                v = e.right.value
+                if v is None:
+                    return self._make_func(
+                        "isnull" if op == "is" else "isnotnull", [operand]
+                    )
+                if isinstance(v, bool):
+                    fn = "istrue" if v else "isfalse"
+                    out = self._make_func(fn, [operand])
+                    if op == "is not":
+                        out = self._make_func("not", [out])
+                    return out
+            raise PlanError("IS requires NULL/TRUE/FALSE")
+        left = self._build(e.left)
+        right = self._build(e.right)
+        return self._make_func(op, [left, right])
+
+    def _unop(self, e: ast.UnaryOp) -> Expression:
+        operand = self._build(e.operand)
+        if e.op == "+":
+            return operand
+        if e.op == "-":
+            return self._make_func("unaryminus", [operand])
+        if e.op == "not":
+            return self._make_func("not", [operand])
+        if e.op == "~":
+            return self._make_func("~", [operand])
+        raise PlanError(f"unary op {e.op!r}")
+
+    def _func(self, e: ast.FuncCall) -> Expression:
+        name = e.name.lower()
+        if name in AGG_FUNCS:
+            if self.agg_collector is None:
+                raise PlanError(f"aggregate {name}() not allowed here")
+            args = []
+            for a in e.args:
+                if isinstance(a, ast.Star):
+                    args = []
+                    break
+                args.append(self._build(a))
+            return self.agg_collector(name, args, e.distinct)
+        # date_add/date_sub: second arg is Interval
+        if name in ("date_add", "date_sub", "adddate", "subdate"):
+            canon = "date_add" if name in ("date_add", "adddate") else "date_sub"
+            base = self._build(e.args[0])
+            iv = e.args[1]
+            if isinstance(iv, ast.Interval):
+                amount = self._build(iv.value)
+                unit = iv.unit
+            else:
+                amount = self._build(iv)
+                unit = "day"
+            return self._make_func(canon, [base, amount], {"unit": unit})
+        if name == "extract":
+            iv = e.args[0]
+            unit = iv.unit if isinstance(iv, ast.Interval) else "day"
+            return self._make_func(
+                "extract", [self._build(e.args[1])], {"unit": unit}
+            )
+        args = [self._build(a) for a in e.args]
+        return self._make_func(name, args)
+
+    def _case(self, e: ast.CaseWhen) -> Expression:
+        args: List[Expression] = []
+        if e.operand is not None:
+            op = self._build(e.operand)
+            for w, t in e.branches:
+                args.append(self._make_func("=", [op, self._build(w)]))
+                args.append(self._build(t))
+        else:
+            for w, t in e.branches:
+                args.append(self._build(w))
+                args.append(self._build(t))
+        if e.else_expr is not None:
+            args.append(self._build(e.else_expr))
+        return self._make_func("case", args)
+
+    def _cast(self, e: ast.Cast) -> Expression:
+        mk = _TYPE_NAME_TO_FT.get(e.type_name.lower())
+        if mk is None:
+            raise PlanError(f"CAST target {e.type_name!r}")
+        target = mk(e.precision, e.scale)
+        arg = self._build(e.expr)
+        return self._make_func("cast", [arg],
+                               {"target": target.with_nullable(arg.ftype.nullable)})
+
+    def _in_list(self, e: ast.InList) -> Expression:
+        args = [self._build(e.expr)] + [self._build(x) for x in e.items]
+        out = self._make_func("in", args)
+        if e.negated:
+            out = self._make_func("not", [out])
+        return out
+
+    def _between(self, e: ast.Between) -> Expression:
+        x = self._build(e.expr)
+        lo = self._build(e.low)
+        hi = self._build(e.high)
+        ge = self._make_func(">=", [x, lo])
+        le = self._make_func("<=", [x, hi])
+        out = self._make_func("and", [ge, le])
+        if e.negated:
+            out = self._make_func("not", [out])
+        return out
+
+    def _subquery(self, query, kind: str, negated: bool = False,
+                  operand=None) -> Expression:
+        if self.subquery_handler is None:
+            raise PlanError("subquery not allowed in this context")
+        return self.subquery_handler(query, kind, negated, operand)
+
+
+class CorrelatedColumn(Exception):
+    """Raised when a name resolves only in an enclosing block; the caller
+    (subquery planner) catches it to build an Apply."""
+
+    def __init__(self, col):
+        self.col = col
+        super().__init__(str(col))
+
+
+def split_and(e: ast.Expr) -> List[ast.Expr]:
+    if isinstance(e, ast.BinaryOp) and e.op in ("and", "&&"):
+        return split_and(e.left) + split_and(e.right)
+    return [e]
+
+
+def fold_constant(e: Expression) -> Expression:
+    """Bottom-up constant folding (expression/constant_fold.go)."""
+    if isinstance(e, ScalarFunc):
+        e = ScalarFunc(e.name, [fold_constant(a) for a in e.args],
+                       e.ftype, e.meta)
+        if e.name in ("rand", "sleep", "now", "curdate", "version",
+                      "connection_id", "database", "found_rows", "row"):
+            return e
+        if all(isinstance(a, Constant) for a in e.args):
+            dual = Chunk([Column.from_values(ty_int(False), [0])])
+            try:
+                v = e.eval(dual)
+            except Exception:
+                return e
+            if v.valid is not None and not bool(v.valid[0]):
+                return Constant(None, e.ftype)
+            x = v.data[0]
+            if isinstance(x, np.generic):
+                x = x.item()
+            # NOTE: DECIMAL constants store the scaled-int representation,
+            # matching Column.constant / the cop IR wire format.
+            return Constant(x, e.ftype)
+    return e

@@ -1,0 +1,136 @@
+"""Logical plan nodes.
+
+Reference: planner/core/logical_plans.go (LogicalSelection, LogicalJoin,
+LogicalAggregation, DataSource, ...).  Thin dataclasses: rules rewrite the
+tree in place or rebuild nodes; every node exposes `schema` (output columns
+with stable uids) and `children`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..catalog import TableInfo
+from ..expr.aggregation import AggDesc
+from ..expr.expression import Expression
+from .columns import Schema, SchemaCol
+
+
+class LogicalPlan:
+    schema: Schema
+    children: List["LogicalPlan"]
+
+    def __init__(self, schema: Schema, children: List["LogicalPlan"]):
+        self.schema = schema
+        self.children = children
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Logical", "")
+
+
+class LogicalDataSource(LogicalPlan):
+    def __init__(self, db: str, table: TableInfo, alias: str, schema: Schema):
+        super().__init__(schema, [])
+        self.db = db
+        self.table = table
+        self.alias = alias
+        # conjuncts pushed into the scan by predicate pushdown (become the
+        # cop SelectionIR or residual root filters at physical time)
+        self.pushed_conds: List[Expression] = []
+        # handle ranges from ranger (full range when empty)
+        self.ranges = None
+
+
+class LogicalSelection(LogicalPlan):
+    def __init__(self, child: LogicalPlan, conds: List[Expression]):
+        super().__init__(child.schema, [child])
+        self.conds = conds
+
+
+class LogicalProjection(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: List[Expression],
+                 schema: Schema):
+        super().__init__(schema, [child])
+        self.exprs = exprs
+
+
+class LogicalAggregation(LogicalPlan):
+    def __init__(self, child: LogicalPlan, group_by: List[Expression],
+                 aggs: List[AggDesc], schema: Schema):
+        super().__init__(schema, [child])
+        self.group_by = group_by
+        self.aggs = aggs
+
+
+class LogicalJoin(LogicalPlan):
+    """kind: inner | left_outer | semi | anti_semi | left_outer_semi.
+    eq_conds: [(left_expr, right_expr)] equality keys; other_conds evaluated
+    over the joined row (left schema ++ right schema)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, kind: str,
+                 eq_conds: List[Tuple[Expression, Expression]],
+                 other_conds: List[Expression], schema: Schema):
+        super().__init__(schema, [left, right])
+        self.kind = kind
+        self.eq_conds = eq_conds
+        self.other_conds = other_conds
+
+
+class LogicalSort(LogicalPlan):
+    def __init__(self, child: LogicalPlan,
+                 items: List[Tuple[Expression, bool]]):
+        super().__init__(child.schema, [child])
+        self.items = items
+
+
+class LogicalTopN(LogicalPlan):
+    def __init__(self, child: LogicalPlan,
+                 items: List[Tuple[Expression, bool]], limit: int,
+                 offset: int = 0):
+        super().__init__(child.schema, [child])
+        self.items = items
+        self.limit = limit
+        self.offset = offset
+
+
+class LogicalLimit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, limit: int, offset: int = 0):
+        super().__init__(child.schema, [child])
+        self.limit = limit
+        self.offset = offset
+
+
+class LogicalUnion(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan], schema: Schema):
+        super().__init__(schema, children)
+
+
+class LogicalDual(LogicalPlan):
+    """No-table source: 1 row (SELECT 1) or 0 rows (provably-false WHERE)."""
+
+    def __init__(self, schema: Schema, row_count: int = 1):
+        super().__init__(schema, [])
+        self.row_count = row_count
+
+
+class LogicalMaxOneRow(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        super().__init__(child.schema, [child])
+
+
+class LogicalWindow(LogicalPlan):
+    def __init__(self, child: LogicalPlan, window_funcs, partition_by,
+                 order_by, frame, schema: Schema):
+        super().__init__(schema, [child])
+        self.window_funcs = window_funcs
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.frame = frame
+
+
+def walk(plan: LogicalPlan):
+    yield plan
+    for c in plan.children:
+        yield from walk(c)

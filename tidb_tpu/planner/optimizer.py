@@ -1,0 +1,42 @@
+"""Planner entry point.
+
+Reference: planner.Optimize (planner/optimize.go:42) — build logical plan,
+apply the logical rule pipeline, search/split into physical root+cop tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..catalog import InfoSchema
+from ..parser import ast
+from .build import (
+    DeletePlan,
+    InsertPlan,
+    LoadDataPlan,
+    PlanBuilder,
+    UpdatePlan,
+)
+from .logical import LogicalPlan
+from .physical import PhysicalContext, PhysicalPlan, physical_for_stmt
+from .rules import optimize_logical
+
+
+def plan_statement(stmt: ast.Stmt, infoschema: InfoSchema, current_db: str,
+                   pctx: PhysicalContext, exec_subplan=None,
+                   param_values=None) -> PhysicalPlan:
+    builder = PlanBuilder(infoschema, current_db, exec_subplan, param_values)
+    logical = builder.build(stmt)
+    return finish_plan(logical, pctx)
+
+
+def finish_plan(logical, pctx: PhysicalContext) -> PhysicalPlan:
+    if isinstance(logical, InsertPlan):
+        if logical.select_plan is not None:
+            logical.select_plan = optimize_logical(logical.select_plan)
+        return physical_for_stmt(logical, pctx)
+    if isinstance(logical, (UpdatePlan, DeletePlan, LoadDataPlan)):
+        return physical_for_stmt(logical, pctx)
+    assert isinstance(logical, LogicalPlan)
+    logical = optimize_logical(logical)
+    return physical_for_stmt(logical, pctx)

@@ -1,0 +1,767 @@
+"""Physical plans: cop/root task split + executor construction + EXPLAIN.
+
+Reference: planner/core/physical_plans.go + task.go (copTask vs rootTask, the
+cost boundary where operators either sink into the coprocessor DAG or stay in
+root executors) + plan_to_pb.go (DAG serialization) + executor/builder.go (the
+physical-plan -> executor type switch).
+
+The pushdown decision (the TPU routing) happens in `attach_*` below: a
+DataSource starts a cop task (TableScanIR [+ SelectionIR]); Aggregation/TopN/
+Limit directly above a cop task sink into the DAG when their expressions pass
+`can_push_*` (expr/pushdown.py) and the table has no dirty txn writes;
+everything else finalizes the cop task into a PhysTableReader and continues
+root-side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional, Tuple
+
+from ..catalog import TableInfo
+from ..copr.ir import (
+    DAG,
+    AggregationIR,
+    LimitIR,
+    ProjectionIR,
+    SelectionIR,
+    TableScanIR,
+    TopNIR,
+)
+from ..errors import PlanError
+from ..expr.aggregation import AggDesc
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..expr.pushdown import can_push_agg, can_push_expr
+from ..store.kv import KeyRange
+from ..store.regions import INF
+from ..types import FieldType, common_compare_type
+from .build import DeletePlan, InsertPlan, LoadDataPlan, UpdatePlan
+from .columns import Schema, SchemaCol
+from .logical import (
+    LogicalAggregation,
+    LogicalDataSource,
+    LogicalDual,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalMaxOneRow,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalSelection,
+    LogicalSort,
+    LogicalTopN,
+    LogicalUnion,
+)
+
+_plan_id_counter = [0]
+
+
+def _next_plan_id() -> int:
+    _plan_id_counter[0] += 1
+    return _plan_id_counter[0]
+
+
+class PhysicalPlan:
+    """Base physical node: knows its output schema (for positional remap),
+    builds its executor, explains itself."""
+
+    def __init__(self, schema: Schema, children: List["PhysicalPlan"]):
+        self.schema = schema
+        self.children = children
+        self.id = _next_plan_id()
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Phys", "")
+
+    def task(self) -> str:
+        return "root"
+
+    def info(self) -> str:
+        return ""
+
+    def build(self, ctx):
+        raise NotImplementedError
+
+    def explain_tree(self, indent: int = 0, lines=None) -> List[str]:
+        lines = lines if lines is not None else []
+        pad = ("  " * indent + "└─") if indent else ""
+        lines.append((f"{pad}{self.name}_{self.id}", self.task(), self.info()))
+        for c in self.children:
+            c.explain_tree(indent + 1, lines)
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# cop task: a DAG under construction (task.go copTask analog)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CopTask:
+    table: TableInfo
+    scan_cols: List[SchemaCol]  # schema cols with store offsets
+    dag_execs: List = dc_field(default_factory=list)  # IR nodes after scan
+    out_schema: Schema = None  # current output schema of the DAG
+    partial_agg: Optional[Tuple[List[Expression], List[AggDesc]]] = None
+
+    def scan_pos_map(self) -> dict:
+        return {c.uid: i for i, c in enumerate(self.scan_cols)}
+
+
+class PhysTableReader(PhysicalPlan):
+    """Root-side reader driving the cop DAG over all regions."""
+
+    def __init__(self, schema: Schema, task: CopTask, keep_order: bool,
+                 ranges: Optional[List[KeyRange]] = None):
+        super().__init__(schema, [])
+        self.cop = task
+        self.keep_order = keep_order
+        self.ranges = ranges or [KeyRange(task.table.id, 0, INF)]
+        scan = TableScanIR(
+            task.table.id,
+            [c.store_offset for c in task.scan_cols],
+            [c.ftype for c in task.scan_cols],
+        )
+        self.dag = DAG([scan] + task.dag_execs)
+
+    def task(self) -> str:
+        return "root"
+
+    def info(self) -> str:
+        parts = [f"table:{self.cop.table.name}"]
+        if self.keep_order:
+            parts.append("keep-order")
+        return ", ".join(parts)
+
+    def build(self, ctx):
+        from ..executor import TableReaderExec
+
+        return TableReaderExec(ctx, self.dag, self.ranges,
+                               self.dag.output_ftypes(),
+                               self.keep_order, self.id)
+
+    def explain_tree(self, indent: int = 0, lines=None):
+        lines = lines if lines is not None else []
+        pad = ("  " * indent + "└─") if indent else ""
+        lines.append((f"{pad}{self.name}_{self.id}", "root", self.info()))
+        for i, ex in enumerate(self.dag.executors):
+            pad2 = "  " * (indent + 1 + i) + "└─"
+            nm = type(ex).__name__.replace("IR", "")
+            info = ""
+            if isinstance(ex, TableScanIR):
+                info = f"table:{self.cop.table.name}, cols:{ex.columns}"
+            elif isinstance(ex, SelectionIR):
+                info = ", ".join(str(c) for c in ex.conditions)
+            elif isinstance(ex, AggregationIR):
+                info = (f"group:[{', '.join(map(str, ex.group_by))}] "
+                        f"aggs:[{', '.join(map(str, ex.aggs))}] {ex.mode}")
+            elif isinstance(ex, TopNIR):
+                info = f"limit:{ex.limit}"
+            elif isinstance(ex, LimitIR):
+                info = f"limit:{ex.limit}"
+            lines.append((f"{pad2}{nm}", "cop[tpu]", info))
+        return lines
+
+
+class PhysUnionScan(PhysicalPlan):
+    """Dirty-table scan merging the txn buffer (no pushdown)."""
+
+    def __init__(self, schema: Schema, table: TableInfo,
+                 conds: List[Expression]):
+        super().__init__(schema, [])
+        self.table = table
+        self.conds = conds
+
+    def info(self) -> str:
+        return f"table:{self.table.name}, dirty"
+
+    def build(self, ctx):
+        from ..executor import UnionScanExec
+
+        offsets = [c.store_offset for c in self.schema.cols]
+        pos = {c.uid: i for i, c in enumerate(self.schema.cols)}
+        conds = [c.remap_columns(pos) for c in self.conds]
+        return UnionScanExec(ctx, self.table, offsets, conds,
+                             with_handle=False, plan_id=self.id)
+
+
+class PhysSelection(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, conds: List[Expression]):
+        super().__init__(child.schema, [child])
+        self.conds = conds
+
+    def info(self) -> str:
+        return ", ".join(str(c) for c in self.conds)
+
+    def build(self, ctx):
+        from ..executor import SelectionExec
+
+        return SelectionExec(ctx, self.children[0].build(ctx), self.conds,
+                             self.id)
+
+
+class PhysProjection(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, exprs: List[Expression],
+                 schema: Schema):
+        super().__init__(schema, [child])
+        self.exprs = exprs
+
+    def info(self) -> str:
+        return ", ".join(str(e) for e in self.exprs)
+
+    def build(self, ctx):
+        from ..executor import ProjectionExec
+
+        return ProjectionExec(ctx, self.children[0].build(ctx), self.exprs,
+                              self.id)
+
+
+class PhysHashAgg(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, group_by: List[Expression],
+                 aggs: List[AggDesc], partial_input: bool, schema: Schema):
+        super().__init__(schema, [child])
+        self.group_by = group_by
+        self.aggs = aggs
+        self.partial_input = partial_input
+
+    def info(self) -> str:
+        mode = "final" if self.partial_input else "complete"
+        return (f"group:[{', '.join(map(str, self.group_by))}] "
+                f"funcs:[{', '.join(map(str, self.aggs))}] mode:{mode}")
+
+    def build(self, ctx):
+        from ..executor import HashAggExec
+
+        return HashAggExec(ctx, self.children[0].build(ctx), self.group_by,
+                           self.aggs, self.partial_input, self.id)
+
+
+class PhysStreamAgg(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, group_by, aggs, partial_input,
+                 schema: Schema):
+        super().__init__(schema, [child])
+        self.group_by = group_by
+        self.aggs = aggs
+        self.partial_input = partial_input
+
+    def info(self) -> str:
+        return (f"group:[{', '.join(map(str, self.group_by))}] "
+                f"funcs:[{', '.join(map(str, self.aggs))}]")
+
+    def build(self, ctx):
+        from ..executor import StreamAggExec
+
+        return StreamAggExec(ctx, self.children[0].build(ctx), self.group_by,
+                             self.aggs, self.partial_input, self.id)
+
+
+class PhysHashJoin(PhysicalPlan):
+    """children = [left, right] in schema order; build_right selects which
+    child is materialized into the hash table."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, kind: str,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 other_conds: List[Expression], build_right: bool,
+                 schema: Schema):
+        super().__init__(schema, [left, right])
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.other_conds = other_conds
+        self.build_right = build_right
+
+    def info(self) -> str:
+        keys = ", ".join(
+            f"{l}=={r}" for l, r in zip(self.left_keys, self.right_keys)
+        )
+        side = "build:right" if self.build_right else "build:left"
+        s = f"{self.kind} [{keys}] {side}"
+        if self.other_conds:
+            s += " other:[" + ", ".join(map(str, self.other_conds)) + "]"
+        return s
+
+    def build(self, ctx):
+        from ..executor import HashJoinExec
+
+        left = self.children[0].build(ctx)
+        right = self.children[1].build(ctx)
+        if self.build_right:
+            return HashJoinExec(ctx, right, left, self.kind,
+                                self.right_keys, self.left_keys,
+                                self.other_conds, probe_is_left=True,
+                                plan_id=self.id)
+        return HashJoinExec(ctx, left, right, self.kind,
+                            self.left_keys, self.right_keys,
+                            self.other_conds, probe_is_left=False,
+                            plan_id=self.id)
+
+
+class PhysSort(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, items):
+        super().__init__(child.schema, [child])
+        self.items = items
+
+    def info(self) -> str:
+        return ", ".join(f"{e}{' desc' if d else ''}" for e, d in self.items)
+
+    def build(self, ctx):
+        from ..executor import SortExec
+
+        return SortExec(ctx, self.children[0].build(ctx), self.items, self.id)
+
+
+class PhysTopN(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, items, limit: int, offset: int):
+        super().__init__(child.schema, [child])
+        self.items = items
+        self.limit = limit
+        self.offset = offset
+
+    def info(self) -> str:
+        keys = ", ".join(f"{e}{' desc' if d else ''}" for e, d in self.items)
+        return f"[{keys}] limit:{self.limit} offset:{self.offset}"
+
+    def build(self, ctx):
+        from ..executor import TopNExec
+
+        return TopNExec(ctx, self.children[0].build(ctx), self.items,
+                        self.limit, self.offset, self.id)
+
+
+class PhysLimit(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, limit: int, offset: int):
+        super().__init__(child.schema, [child])
+        self.limit = limit
+        self.offset = offset
+
+    def info(self) -> str:
+        return f"limit:{self.limit} offset:{self.offset}"
+
+    def build(self, ctx):
+        from ..executor import LimitExec
+
+        return LimitExec(ctx, self.children[0].build(ctx), self.limit,
+                         self.offset, self.id)
+
+
+class PhysUnion(PhysicalPlan):
+    def build(self, ctx):
+        from ..executor import UnionExec
+
+        return UnionExec(ctx, [c.build(ctx) for c in self.children],
+                         self.schema.ftypes(), self.id)
+
+
+class PhysDual(PhysicalPlan):
+    def __init__(self, schema: Schema, row_count: int):
+        super().__init__(schema, [])
+        self.row_count = row_count
+
+    def info(self) -> str:
+        return f"rows:{self.row_count}"
+
+    def build(self, ctx):
+        from ..executor import TableDualExec
+
+        return TableDualExec(ctx, self.schema.ftypes(), self.row_count,
+                             self.id)
+
+
+class PhysMaxOneRow(PhysicalPlan):
+    def build(self, ctx):
+        from ..executor import MaxOneRowExec
+
+        return MaxOneRowExec(ctx, self.children[0].build(ctx), self.id)
+
+
+# ---------------------------------------------------------------------------
+# DML physical wrappers
+# ---------------------------------------------------------------------------
+
+
+class PhysInsert(PhysicalPlan):
+    def __init__(self, plan: InsertPlan,
+                 select_phys: Optional[PhysicalPlan]):
+        super().__init__(Schema([]), [select_phys] if select_phys else [])
+        self.plan = plan
+
+    def info(self) -> str:
+        return f"table:{self.plan.table.name}"
+
+    def build(self, ctx):
+        from ..executor import InsertExec
+
+        child = self.children[0].build(ctx) if self.children else None
+        p = self.plan
+        rows = None
+        if p.rows is not None:
+            from .build import DEFAULT_MARKER
+
+            rows = []
+            for r in p.rows:
+                rows.append([
+                    (p.table.columns[off].default
+                     if v is DEFAULT_MARKER else v)
+                    for v, off in zip(r, p.col_offsets)
+                ])
+        return InsertExec(ctx, p.table, p.col_offsets, rows, child,
+                          p.replace, p.ignore, p.on_dup_update,
+                          plan_id=self.id)
+
+
+class PhysUpdate(PhysicalPlan):
+    def __init__(self, plan: UpdatePlan):
+        super().__init__(Schema([]), [])
+        self.plan = plan
+
+    def info(self) -> str:
+        return f"table:{self.plan.table.name}"
+
+    def build(self, ctx):
+        from ..executor import UnionScanExec, UpdateExec
+
+        t = self.plan.table
+        reader = UnionScanExec(
+            ctx, t, [c.offset for c in t.columns], self.plan.conditions,
+            with_handle=True, plan_id=self.id,
+        )
+        return UpdateExec(ctx, t, reader, self.plan.assignments, self.id)
+
+
+class PhysDelete(PhysicalPlan):
+    def __init__(self, plan: DeletePlan):
+        super().__init__(Schema([]), [])
+        self.plan = plan
+
+    def info(self) -> str:
+        return f"table:{self.plan.table.name}"
+
+    def build(self, ctx):
+        from ..executor import DeleteExec, UnionScanExec
+
+        t = self.plan.table
+        reader = UnionScanExec(
+            ctx, t, [c.offset for c in t.columns], self.plan.conditions,
+            with_handle=True, plan_id=self.id,
+        )
+        return DeleteExec(ctx, t, reader, self.id)
+
+
+class PhysLoadData(PhysicalPlan):
+    def __init__(self, plan: LoadDataPlan):
+        super().__init__(Schema([]), [])
+        self.plan = plan
+
+    def build(self, ctx):
+        from ..executor import LoadDataExec
+
+        p = self.plan
+        return LoadDataExec(ctx, p.table, p.path, p.fields_terminated,
+                            p.ignore_lines, self.id)
+
+
+# ---------------------------------------------------------------------------
+# logical -> physical conversion (find_best_task analog, rule-based)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PhysicalContext:
+    storage: object
+    dirty_tables: frozenset = frozenset()
+    pushdown_blacklist: frozenset = frozenset()
+    enable_pushdown: bool = True
+
+
+def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
+    if isinstance(plan, LogicalDataSource):
+        return _finish_datasource(plan, pctx)
+
+    if isinstance(plan, LogicalSelection):
+        child_l = plan.children[0]
+        if isinstance(child_l, LogicalDataSource):
+            child_l.pushed_conds.extend(plan.conds)
+            return _finish_datasource(child_l, pctx)
+        child = to_physical(child_l, pctx)
+        conds = _remap(plan.conds, child.schema)
+        return PhysSelection(child, conds)
+
+    if isinstance(plan, LogicalProjection):
+        child = to_physical(plan.children[0], pctx)
+        exprs = _remap(plan.exprs, child.schema)
+        return PhysProjection(child, exprs, plan.schema)
+
+    if isinstance(plan, LogicalAggregation):
+        return _physical_agg(plan, pctx)
+
+    if isinstance(plan, LogicalTopN):
+        return _physical_topn(plan, pctx)
+
+    if isinstance(plan, LogicalSort):
+        child = to_physical(plan.children[0], pctx)
+        items = [(e, d) for e, d in
+                 zip(_remap([e for e, _ in plan.items], child.schema),
+                     [d for _, d in plan.items])]
+        return PhysSort(child, items)
+
+    if isinstance(plan, LogicalLimit):
+        child, pushed = _try_push_limit(plan, pctx)
+        if pushed is not None:
+            return pushed
+        return PhysLimit(child, plan.limit, plan.offset)
+
+    if isinstance(plan, LogicalJoin):
+        return _physical_join(plan, pctx)
+
+    if isinstance(plan, LogicalUnion):
+        children = [to_physical(c, pctx) for c in plan.children]
+        return PhysUnion(plan.schema, children)
+
+    if isinstance(plan, LogicalDual):
+        return PhysDual(plan.schema, plan.row_count)
+
+    if isinstance(plan, LogicalMaxOneRow):
+        child = to_physical(plan.children[0], pctx)
+        return PhysMaxOneRow(child.schema, [child])
+
+    raise PlanError(f"no physical impl for {type(plan).__name__}")
+
+
+def physical_for_stmt(plan, pctx: PhysicalContext) -> PhysicalPlan:
+    """Entry covering DML containers too."""
+    if isinstance(plan, InsertPlan):
+        sub = to_physical(plan.select_plan, pctx) if plan.select_plan else None
+        return PhysInsert(plan, sub)
+    if isinstance(plan, UpdatePlan):
+        return PhysUpdate(plan)
+    if isinstance(plan, DeletePlan):
+        return PhysDelete(plan)
+    if isinstance(plan, LoadDataPlan):
+        return PhysLoadData(plan)
+    return to_physical(plan, pctx)
+
+
+# ---- datasource / cop-task assembly ---------------------------------------
+
+
+def _dict_uids(ds: LogicalDataSource, pctx: PhysicalContext) -> set:
+    store = pctx.storage.table(ds.table.id)
+    dict_cols = store.dict_encoded_cols()
+    return {c.uid for c in ds.schema.cols if c.store_offset in dict_cols}
+
+
+def _split_pushable(conds, blacklist, dict_uids):
+    push, residual = [], []
+    for c in conds:
+        (push if can_push_expr(c, blacklist, dict_uids) else residual).append(c)
+    return push, residual
+
+
+def _start_cop(ds: LogicalDataSource, pctx: PhysicalContext):
+    """Build the cop task skeleton: scan + pushable selection; return
+    (CopTask, residual_conds)."""
+    task = CopTask(ds.table, list(ds.schema.cols))
+    dirty = ds.table.id in pctx.dirty_tables
+    if dirty or not pctx.enable_pushdown:
+        return None, list(ds.pushed_conds)
+    dict_uids = _dict_uids(ds, pctx)
+    push, residual = _split_pushable(
+        ds.pushed_conds, pctx.pushdown_blacklist, dict_uids
+    )
+    if push:
+        pos = task.scan_pos_map()
+        task.dag_execs.append(
+            SelectionIR([c.remap_columns(pos) for c in push])
+        )
+    task.out_schema = Schema(task.scan_cols)
+    return task, residual
+
+
+def _finish_datasource(ds: LogicalDataSource,
+                       pctx: PhysicalContext) -> PhysicalPlan:
+    task, residual = _start_cop(ds, pctx)
+    if task is None:
+        return PhysUnionScan(ds.schema, ds.table, list(ds.pushed_conds))
+    reader = PhysTableReader(Schema(task.scan_cols), task, keep_order=False,
+                             ranges=ds.ranges)
+    out: PhysicalPlan = reader
+    if residual:
+        out = PhysSelection(reader, _remap(residual, reader.schema))
+    return out
+
+
+def _physical_agg(plan: LogicalAggregation,
+                  pctx: PhysicalContext) -> PhysicalPlan:
+    child_l = plan.children[0]
+    # direct cop-task child (DataSource or Selection(DataSource) already
+    # collapsed by rules into ds.pushed_conds)
+    if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
+        task, residual = _start_cop(child_l, pctx)
+        if task is not None and not residual and plan.aggs:
+            dict_uids = _dict_uids(child_l, pctx)
+            ok = all(
+                can_push_expr(g, pctx.pushdown_blacklist, dict_uids)
+                or _is_plain_col(g)
+                for g in plan.group_by
+            ) and all(
+                can_push_agg(a, pctx.pushdown_blacklist, dict_uids)
+                for a in plan.aggs
+            )
+            if ok:
+                pos = task.scan_pos_map()
+                gb = [g.remap_columns(pos) for g in plan.group_by]
+                aggs = [a.remap_columns(pos) for a in plan.aggs]
+                task.dag_execs.append(AggregationIR(gb, aggs, mode="partial"))
+                reader = PhysTableReader(
+                    _partial_schema(plan), task, keep_order=False,
+                    ranges=child_l.ranges,
+                )
+                # final merge positions: [keys..., states...] by position
+                n = len(plan.group_by)
+                fin_gb = [
+                    ColumnExpr(i, g.ftype, str(g), -1)
+                    for i, g in enumerate(plan.group_by)
+                ]
+                return PhysHashAgg(reader, fin_gb, plan.aggs, True,
+                                   plan.schema)
+    child = to_physical(child_l, pctx)
+    gb = _remap(plan.group_by, child.schema)
+    aggs = [a.remap_columns(child.schema.position_map()) for a in plan.aggs]
+    return PhysHashAgg(child, gb, aggs, False, plan.schema)
+
+
+def _partial_schema(plan: LogicalAggregation) -> Schema:
+    cols = []
+    from .columns import next_uid
+
+    for g in plan.group_by:
+        cols.append(SchemaCol(next_uid(), str(g), g.ftype))
+    for a in plan.aggs:
+        for j, pt in enumerate(a.partial_types()):
+            cols.append(SchemaCol(next_uid(), f"{a}#{j}", pt))
+    return Schema(cols)
+
+
+def _physical_topn(plan: LogicalTopN, pctx: PhysicalContext) -> PhysicalPlan:
+    child_l = plan.children[0]
+    k = plan.limit + plan.offset
+    if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
+        task, residual = _start_cop(child_l, pctx)
+        if task is not None and not residual:
+            dict_uids = _dict_uids(child_l, pctx)
+            if all(can_push_expr(e, pctx.pushdown_blacklist, dict_uids)
+                   or _is_plain_col(e) for e, _ in plan.items):
+                pos = task.scan_pos_map()
+                items = [(e.remap_columns(pos), d) for e, d in plan.items]
+                task.dag_execs.append(TopNIR(items, k))
+                reader = PhysTableReader(Schema(task.scan_cols), task,
+                                         keep_order=False,
+                                         ranges=child_l.ranges)
+                ritems = [(e.remap_columns(reader.schema.position_map()), d)
+                          for e, d in plan.items]
+                return PhysTopN(reader, ritems, plan.limit, plan.offset)
+    child = to_physical(child_l, pctx)
+    items = [(e, d) for e, d in
+             zip(_remap([e for e, _ in plan.items], child.schema),
+                 [d for _, d in plan.items])]
+    return PhysTopN(child, items, plan.limit, plan.offset)
+
+
+def _try_push_limit(plan: LogicalLimit, pctx: PhysicalContext):
+    child_l = plan.children[0]
+    if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
+        task, residual = _start_cop(child_l, pctx)
+        if task is not None and not residual:
+            task.dag_execs.append(LimitIR(plan.limit + plan.offset))
+            reader = PhysTableReader(Schema(task.scan_cols), task,
+                                     keep_order=False, ranges=child_l.ranges)
+            return None, PhysLimit(reader, plan.limit, plan.offset)
+    return to_physical(child_l, pctx), None
+
+
+def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
+    left = to_physical(plan.children[0], pctx)
+    right = to_physical(plan.children[1], pctx)
+    lmap = left.schema.position_map()
+    rmap = right.schema.position_map()
+    lkeys, rkeys = [], []
+    for le, re in plan.eq_conds:
+        ct = common_compare_type(le.ftype, re.ftype)
+        le2 = _maybe_cast(le.remap_columns(lmap), ct)
+        re2 = _maybe_cast(re.remap_columns(rmap), ct)
+        lkeys.append(le2)
+        rkeys.append(re2)
+    # other conds evaluate over left++right layout
+    pair_map = dict(lmap)
+    off = len(left.schema)
+    for uid, i in rmap.items():
+        pair_map[uid] = off + i
+    others = [c.remap_columns(pair_map) for c in plan.other_conds]
+    if plan.kind == "inner":
+        build_right = _est_rows(right, pctx) <= _est_rows(left, pctx)
+    else:
+        build_right = True  # outer/semi: probe must be the left side
+    if not plan.eq_conds and not plan.other_conds and \
+            plan.kind in ("semi", "anti_semi"):
+        # EXISTS with no correlation: keys empty -> every probe row matches
+        # iff build side non-empty; HashJoinExec handles empty key lists.
+        pass
+    return PhysHashJoin(left, right, plan.kind, lkeys, rkeys, others,
+                        build_right, plan.schema)
+
+
+def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
+    if isinstance(p, PhysTableReader):
+        store = pctx.storage.table(p.cop.table.id)
+        rows = store.base_rows + len(store.delta)
+        for ex in p.dag.executors[1:]:
+            if isinstance(ex, SelectionIR):
+                rows *= 0.25
+            elif isinstance(ex, (TopNIR, LimitIR)):
+                rows = min(rows, ex.limit)
+            elif isinstance(ex, AggregationIR):
+                rows = max(rows * 0.1, 1)
+        return rows
+    if isinstance(p, (PhysSelection,)):
+        return _est_rows(p.children[0], pctx) * 0.25
+    if isinstance(p, (PhysLimit, PhysTopN)):
+        return min(_est_rows(p.children[0], pctx), p.limit)
+    if isinstance(p, PhysHashAgg):
+        return max(_est_rows(p.children[0], pctx) * 0.1, 1)
+    if p.children:
+        return sum(_est_rows(c, pctx) for c in p.children)
+    return 1.0
+
+
+def _is_plain_col(e: Expression) -> bool:
+    return isinstance(e, ColumnExpr)
+
+
+def _maybe_cast(e: Expression, target: FieldType) -> Expression:
+    if e.ftype.kind == target.kind and e.ftype.scale == target.scale:
+        return e
+    return ScalarFunc("cast", [e], target.with_nullable(e.ftype.nullable),
+                      {"target": target.with_nullable(e.ftype.nullable)})
+
+
+def _remap(exprs: List[Expression], schema: Schema) -> List[Expression]:
+    pos = schema.position_map()
+    for e in exprs:
+        used: set = set()
+        e.collect_columns(used)
+        missing = used - pos.keys()
+        if missing:
+            raise PlanError(
+                f"column uid(s) {sorted(missing)} not in child schema for "
+                f"expr {e}"
+            )
+    return [e.remap_columns(pos) for e in exprs]
+
+
+def explain_text(p: PhysicalPlan) -> str:
+    lines = p.explain_tree()
+    w1 = max(len(l[0]) for l in lines) + 2
+    w2 = max(len(l[1]) for l in lines) + 2
+    return "\n".join(
+        f"{a:<{w1}}{b:<{w2}}{c}" for a, b, c in lines
+    )

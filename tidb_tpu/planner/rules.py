@@ -1,0 +1,285 @@
+"""Logical optimization rules.
+
+Reference: planner/core/optimizer.go:56-69 — the rule list applied in fixed
+order (column prune, predicate pushdown, agg/topN pushdown, projection
+elimination, ...).  Agg/topN/limit pushdown to the coprocessor happen at
+physical time here (task split); the logical rules below normalize the tree
+first.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from .columns import Schema
+from .logical import (
+    LogicalAggregation,
+    LogicalDataSource,
+    LogicalDual,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalMaxOneRow,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalSelection,
+    LogicalSort,
+    LogicalTopN,
+    LogicalUnion,
+)
+
+RULES = ("prune_columns", "push_predicates", "eliminate_projections",
+         "merge_limit_sort")
+
+
+def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+    plan = push_predicates(plan)
+    prune_columns(plan, set(plan.schema.uids()))
+    plan = eliminate_projections(plan, top=True)
+    plan = merge_limit_sort(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# column pruning (planner/core/rule_column_pruning.go)
+# ---------------------------------------------------------------------------
+
+
+def _expr_uids(exprs) -> Set[int]:
+    out: Set[int] = set()
+    for e in exprs:
+        e.collect_columns(out)
+    return out
+
+
+def prune_columns(plan: LogicalPlan, needed: Set[int]):
+    """Top-down: trim DataSource schemas to the columns actually used."""
+    if isinstance(plan, LogicalDataSource):
+        keep = [c for c in plan.schema.cols
+                if c.uid in needed or c.uid in _expr_uids(plan.pushed_conds)]
+        if not keep:
+            keep = [plan.schema.cols[0]]  # scans need >= 1 column
+        plan.schema = Schema(keep)
+        return
+    if isinstance(plan, LogicalProjection):
+        prune_columns(plan.children[0], _expr_uids(plan.exprs))
+        return
+    if isinstance(plan, LogicalSelection):
+        prune_columns(plan.children[0], needed | _expr_uids(plan.conds))
+        return
+    if isinstance(plan, LogicalAggregation):
+        req = _expr_uids(plan.group_by)
+        for a in plan.aggs:
+            req |= _expr_uids(a.args)
+        prune_columns(plan.children[0], req)
+        return
+    if isinstance(plan, LogicalJoin):
+        req = set(needed)
+        for l, r in plan.eq_conds:
+            req |= _expr_uids([l, r])
+        req |= _expr_uids(plan.other_conds)
+        for c in plan.children:
+            prune_columns(c, req)
+        # shrink the join's own schema for semi joins (schema == left child)
+        if plan.kind in ("inner", "left_outer"):
+            lcols = [c for c in plan.children[0].schema.cols]
+            rcols = [c for c in plan.children[1].schema.cols]
+            by_uid = {c.uid: c for c in plan.schema.cols}
+            cols = [by_uid.get(c.uid, c) for c in lcols + rcols]
+            plan.schema = Schema(cols)
+        return
+    if isinstance(plan, (LogicalSort, LogicalTopN)):
+        prune_columns(plan.children[0],
+                      needed | _expr_uids([e for e, _ in plan.items]))
+        return
+    if isinstance(plan, LogicalUnion):
+        # positional outputs: children keep full width
+        for c in plan.children:
+            prune_columns(c, set(c.schema.uids()))
+        return
+    for c in plan.children:
+        prune_columns(c, needed)
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown (planner/core/rule_predicate_push_down.go)
+# ---------------------------------------------------------------------------
+
+
+def push_predicates(plan: LogicalPlan) -> LogicalPlan:
+    plan, rest = _ppd(plan, [])
+    if rest:
+        plan = LogicalSelection(plan, rest)
+    return plan
+
+
+def _ppd(plan: LogicalPlan, conds: List[Expression]):
+    """Push `conds` into plan; returns (new_plan, conds that didn't sink)."""
+    if isinstance(plan, LogicalSelection):
+        child, rest = _ppd(plan.children[0], conds + plan.conds)
+        return child, rest
+
+    if isinstance(plan, LogicalDataSource):
+        plan.pushed_conds.extend(conds)
+        return plan, []
+
+    if isinstance(plan, LogicalProjection):
+        deeper, stay = [], []
+        sub = {c.uid: e for c, e in zip(plan.schema.cols, plan.exprs)}
+        for cond in conds:
+            s = _substitute(cond, sub)
+            if s is not None:
+                deeper.append(s)
+            else:
+                stay.append(cond)
+        child, rest = _ppd(plan.children[0], deeper)
+        plan.children = [child]
+        if rest:
+            plan.children = [LogicalSelection(child, rest)]
+        return plan, stay
+
+    if isinstance(plan, LogicalJoin):
+        luids = set(plan.children[0].schema.uids())
+        ruids = set(plan.children[1].schema.uids())
+        lconds, rconds, stay = [], [], []
+        for cond in conds:
+            uids = _expr_uids([cond])
+            if uids and uids <= luids:
+                lconds.append(cond)
+            elif uids and uids <= ruids and plan.kind == "inner":
+                rconds.append(cond)
+            else:
+                stay.append(cond)
+        # ON other-conds referencing only the inner side of an inner join
+        if plan.kind == "inner" and plan.other_conds:
+            keep = []
+            for cond in plan.other_conds:
+                uids = _expr_uids([cond])
+                if uids and uids <= luids:
+                    lconds.append(cond)
+                elif uids and uids <= ruids:
+                    rconds.append(cond)
+                else:
+                    keep.append(cond)
+            plan.other_conds = keep
+        lchild, lrest = _ppd(plan.children[0], lconds)
+        rchild, rrest = _ppd(plan.children[1], rconds)
+        if lrest:
+            lchild = LogicalSelection(lchild, lrest)
+        if rrest:
+            rchild = LogicalSelection(rchild, rrest)
+        plan.children = [lchild, rchild]
+        return plan, stay
+
+    if isinstance(plan, LogicalAggregation):
+        guids = set()
+        for g in plan.group_by:
+            if isinstance(g, ColumnExpr):
+                guids.add(g.unique_id)
+        deeper, stay = [], []
+        for cond in conds:
+            uids = _expr_uids([cond])
+            if uids and uids <= guids:
+                deeper.append(cond)
+            else:
+                stay.append(cond)
+        child, rest = _ppd(plan.children[0], deeper)
+        if rest:
+            child = LogicalSelection(child, rest)
+        plan.children = [child]
+        return plan, stay
+
+    if isinstance(plan, (LogicalSort,)):
+        child, rest = _ppd(plan.children[0], conds)
+        if rest:
+            child = LogicalSelection(child, rest)
+        plan.children = [child]
+        return plan, []
+
+    if isinstance(plan, (LogicalTopN, LogicalLimit, LogicalMaxOneRow,
+                         LogicalUnion, LogicalDual)):
+        # filters do not commute with limits; recurse with nothing
+        new_children = []
+        for c in plan.children:
+            nc, rest = _ppd(c, [])
+            if rest:
+                nc = LogicalSelection(nc, rest)
+            new_children.append(nc)
+        plan.children = new_children
+        return plan, conds
+
+    # default: stop
+    new_children = []
+    for c in plan.children:
+        nc, rest = _ppd(c, [])
+        if rest:
+            nc = LogicalSelection(nc, rest)
+        new_children.append(nc)
+    plan.children = new_children
+    return plan, conds
+
+
+def _substitute(cond: Expression, sub: dict) -> Optional[Expression]:
+    """Rewrite cond in terms of projection inputs; None if impossible."""
+    if isinstance(cond, ColumnExpr):
+        e = sub.get(cond.unique_id)
+        return e
+    if isinstance(cond, Constant):
+        return cond
+    if isinstance(cond, ScalarFunc):
+        args = []
+        for a in cond.args:
+            s = _substitute(a, sub)
+            if s is None:
+                return None
+            args.append(s)
+        return ScalarFunc(cond.name, args, cond.ftype, cond.meta)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# projection elimination (planner/core/rule_eliminate_projection.go)
+# ---------------------------------------------------------------------------
+
+
+def eliminate_projections(plan: LogicalPlan, top: bool = False) -> LogicalPlan:
+    plan.children = [eliminate_projections(c) for c in plan.children]
+    if isinstance(plan, LogicalProjection) and not top:
+        child = plan.children[0]
+        if len(plan.exprs) == len(child.schema) and all(
+            isinstance(e, ColumnExpr) and e.unique_id == c.uid
+            for e, c in zip(plan.exprs, child.schema.cols)
+        ):
+            # identity projection: drop it, re-labelling the child's outputs
+            # with the projection's uids/names so parent references survive
+            from dataclasses import replace
+
+            child.schema = Schema([
+                replace(ccol, uid=pcol.uid, name=pcol.name,
+                        display=pcol.display or ccol.display,
+                        table=pcol.table or ccol.table)
+                for ccol, pcol in zip(child.schema.cols, plan.schema.cols)
+            ])
+            return child
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Limit(Sort) -> TopN
+# ---------------------------------------------------------------------------
+
+
+def merge_limit_sort(plan: LogicalPlan) -> LogicalPlan:
+    plan.children = [merge_limit_sort(c) for c in plan.children]
+    if isinstance(plan, LogicalLimit) and len(plan.children) == 1:
+        c = plan.children[0]
+        if isinstance(c, LogicalSort):
+            return LogicalTopN(c.children[0], c.items, plan.limit,
+                               plan.offset)
+        if isinstance(c, LogicalProjection) and \
+                isinstance(c.children[0], LogicalSort):
+            s = c.children[0]
+            c.children = [LogicalTopN(s.children[0], s.items, plan.limit,
+                                      plan.offset)]
+            return c
+    return plan

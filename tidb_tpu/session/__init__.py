@@ -1,0 +1,5 @@
+from .domain import Domain
+from .session import ResultSet, Session
+from .vars import SessionVars
+
+__all__ = ["Domain", "Session", "ResultSet", "SessionVars"]

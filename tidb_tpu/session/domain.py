@@ -1,0 +1,59 @@
+"""Domain: the per-process singleton owning storage, catalog and globals.
+
+Reference: domain/domain.go:60 — Domain owns the infoschema cache, DDL,
+stats handle, sysvar cache, background loops.  In-process here: the catalog
+IS the schema authority (no lease/reload loop needed), globals are a dict.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..catalog import Catalog
+from ..store.storage import BlockStorage
+from .vars import SessionVars
+
+
+class Domain:
+    def __init__(self, storage: Optional[BlockStorage] = None):
+        self.storage = storage or BlockStorage()
+        self.catalog = Catalog(self.storage)
+        self.global_vars: Dict[str, str] = {}
+        self._mu = threading.RLock()
+        self._conn_counter = 0
+        self.sessions: Dict[int, object] = {}  # conn_id -> Session (weak-ish)
+        self.stmt_summary = []  # (sql, duration_s, rows) ring
+        self.slow_threshold_ms = 300
+        self.slow_queries = []
+        self._bootstrap()
+
+    def _bootstrap(self):
+        """Create system schemas (session/bootstrap.go analog)."""
+        for db in ("test", "mysql", "information_schema"):
+            if not self.catalog.info_schema().has_schema(db):
+                self.catalog.create_database(db, if_not_exists=True)
+
+    def new_session(self):
+        from .session import Session
+
+        with self._mu:
+            self._conn_counter += 1
+            s = Session(self, conn_id=self._conn_counter)
+            self.sessions[self._conn_counter] = s
+            return s
+
+    def kill(self, conn_id: int, query_only: bool = True):
+        s = self.sessions.get(conn_id)
+        if s is not None:
+            s.kill()
+
+    def record_stmt(self, sql: str, dur_s: float, rows: int):
+        with self._mu:
+            self.stmt_summary.append((sql, dur_s, rows))
+            if len(self.stmt_summary) > 1000:
+                self.stmt_summary = self.stmt_summary[-500:]
+            if dur_s * 1000 >= self.slow_threshold_ms:
+                self.slow_queries.append((sql, dur_s))
+                if len(self.slow_queries) > 100:
+                    self.slow_queries = self.slow_queries[-50:]

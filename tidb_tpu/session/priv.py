@@ -1,0 +1,49 @@
+"""Privilege statements (minimal RBAC surface).
+
+Reference: privilege/privileges (MySQL-compatible priv tables cached in
+Handle, cache.go:1037) and executor/grant.go / revoke.go / simple.go user
+management.  Round-1 scope: user registry + global grants recorded on the
+domain; enforcement hooks come with the server layer.
+"""
+
+from __future__ import annotations
+
+from ..errors import KVError
+from ..parser import ast
+
+
+def _users(domain) -> dict:
+    if not hasattr(domain, "users"):
+        domain.users = {"root@%": {"password": "", "privs": {"ALL"}}}
+    return domain.users
+
+
+def handle(session, s):
+    users = _users(session.domain)
+    if isinstance(s, ast.CreateUserStmt):
+        key = s.user
+        if key in users and not s.if_not_exists:
+            raise KVError(f"user {s.user!r} exists")
+        users.setdefault(key, {"password": s.password, "privs": set()})
+    elif isinstance(s, ast.DropUserStmt):
+        if s.user not in users and not s.if_exists:
+            raise KVError(f"user {s.user!r} does not exist")
+        users.pop(s.user, None)
+    elif isinstance(s, ast.SetPasswordStmt):
+        u = users.get(s.user)
+        if u is None:
+            raise KVError(f"user {s.user!r} does not exist")
+        u["password"] = s.password
+    elif isinstance(s, ast.GrantStmt):
+        u = users.setdefault(s.user, {"password": "", "privs": set()})
+        u["privs"].update(p.upper() for p in s.privs)
+    elif isinstance(s, ast.RevokeStmt):
+        u = users.get(s.user)
+        if u is not None:
+            for p in s.privs:
+                u["privs"].discard(p.upper())
+    elif isinstance(s, ast.FlushStmt):
+        pass
+    from .session import ResultSet
+
+    return ResultSet()

@@ -1,0 +1,685 @@
+"""Session: parse -> plan -> execute loop with txn lifecycle.
+
+Reference: session/session.go — Execute (:1065) / execute (:1078) parse+
+compile+run loop, lazy txn state machine (txn.go:41-141), commit with
+optimistic retry (:444,:635), and executor/adapter.go ExecStmt.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog import ColumnInfo, IndexInfo, TableInfo
+from ..catalog.schema import STATE_PUBLIC
+from ..errors import (
+    ExecutorError,
+    KVError,
+    PlanError,
+    TiDBTPUError,
+    TxnConflictError,
+    UnknownDatabaseError,
+)
+from ..executor import ExecContext, collect_all
+from ..parser import ast, parse
+from ..planner import (
+    PhysicalContext,
+    explain_text,
+    finish_plan,
+    plan_statement,
+)
+from ..planner.build import PlanBuilder
+from ..planner.rules import optimize_logical
+from ..types import (
+    FieldType,
+    TypeKind,
+    ty_date,
+    ty_datetime,
+    ty_decimal,
+    ty_float,
+    ty_int,
+    ty_string,
+    ty_uint,
+)
+from ..types.values import format_date, format_datetime
+from .domain import Domain
+from .vars import SYSVAR_DEFAULTS, SessionVars
+
+
+@dataclass
+class ResultSet:
+    headers: List[str] = field(default_factory=list)
+    rows: List[tuple] = field(default_factory=list)
+    affected_rows: int = 0
+    last_insert_id: int = 0
+    warnings: List[str] = field(default_factory=list)
+    is_query: bool = False
+
+    def scalar(self):
+        return self.rows[0][0] if self.rows else None
+
+
+_TYPE_MAP = {
+    "bigint": lambda p, s: ty_int(),
+    "int": lambda p, s: ty_int(),
+    "integer": lambda p, s: ty_int(),
+    "smallint": lambda p, s: ty_int(),
+    "tinyint": lambda p, s: ty_int(),
+    "bool": lambda p, s: ty_int(),
+    "boolean": lambda p, s: ty_int(),
+    "bigint unsigned": lambda p, s: ty_uint(),
+    "double": lambda p, s: ty_float(),
+    "float": lambda p, s: ty_float(),
+    "real": lambda p, s: ty_float(),
+    "decimal": lambda p, s: ty_decimal(p or 10, s),
+    "numeric": lambda p, s: ty_decimal(p or 10, s),
+    "varchar": lambda p, s: ty_string(),
+    "char": lambda p, s: ty_string(),
+    "text": lambda p, s: ty_string(),
+    "blob": lambda p, s: ty_string(),
+    "string": lambda p, s: ty_string(),
+    "date": lambda p, s: ty_date(),
+    "datetime": lambda p, s: ty_datetime(),
+    "timestamp": lambda p, s: ty_datetime(),
+}
+
+
+class Session:
+    def __init__(self, domain: Domain, conn_id: int = 0):
+        self.domain = domain
+        self.conn_id = conn_id
+        self.vars = SessionVars(domain.global_vars)
+        self.current_db = "test"
+        self._txn = None  # explicit txn (BEGIN..COMMIT)
+        self._in_txn = False
+        self._killed = False
+        self._warnings: List[str] = []
+        self._prepared: dict = {}  # name -> sql
+        self.last_exec_ctx: Optional[ExecContext] = None
+        self.last_plan = None
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def execute(self, sql: str, params: Optional[list] = None) -> List[ResultSet]:
+        out = []
+        for stmt in parse(sql):
+            t0 = time.time()
+            rs = self._execute_stmt(stmt, params)
+            dur = time.time() - t0
+            self.domain.record_stmt(sql, dur, len(rs.rows))
+            out.append(rs)
+        return out
+
+    def query(self, sql: str, params: Optional[list] = None) -> List[tuple]:
+        """Convenience: rows of the last result set."""
+        return self.execute(sql, params)[-1].rows
+
+    def kill(self):
+        self._killed = True
+        if self.last_exec_ctx is not None:
+            self.last_exec_ctx.killed = True
+
+    # ------------------------------------------------------------------
+    # txn lifecycle (lazy txn, session/txn.go:41-141)
+    # ------------------------------------------------------------------
+    def _begin_txn(self):
+        if self._txn is None:
+            self._txn = self.domain.storage.begin()
+        return self._txn
+
+    def _autocommit(self) -> bool:
+        return self.vars.get_bool("autocommit") and not self._in_txn
+
+    def commit(self):
+        if self._txn is not None:
+            txn, self._txn = self._txn, None
+            self._in_txn = False
+            txn.commit()
+        else:
+            self._in_txn = False
+
+    def rollback(self):
+        if self._txn is not None:
+            txn, self._txn = self._txn, None
+            self._in_txn = False
+            txn.rollback()
+        else:
+            self._in_txn = False
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def _execute_stmt(self, stmt: ast.Stmt, params=None) -> ResultSet:
+        self._warnings = []
+        s = stmt
+        if isinstance(s, (ast.SelectStmt, ast.UnionStmt)):
+            return self._run_query(s, params)
+        if isinstance(s, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
+                          ast.LoadDataStmt)):
+            return self._run_dml(s, params)
+        if isinstance(s, ast.ExplainStmt):
+            return self._run_explain(s)
+        if isinstance(s, ast.TraceStmt):
+            return self._run_trace(s)
+        if isinstance(s, ast.BeginStmt):
+            self._in_txn = True
+            self._begin_txn()
+            return ResultSet()
+        if isinstance(s, ast.CommitStmt):
+            self.commit()
+            return ResultSet()
+        if isinstance(s, ast.RollbackStmt):
+            self.rollback()
+            return ResultSet()
+        if isinstance(s, ast.UseStmt):
+            if not self.domain.catalog.info_schema().has_schema(s.db):
+                raise UnknownDatabaseError(s.db)
+            self.current_db = s.db
+            return ResultSet()
+        if isinstance(s, ast.SetStmt):
+            return self._run_set(s)
+        if isinstance(s, ast.ShowStmt):
+            return self._run_show(s)
+        if isinstance(s, ast.DescTableStmt):
+            return self._desc_table(s.table)
+        if isinstance(s, ast.PrepareStmt):
+            self._prepared[s.name] = s.sql
+            return ResultSet()
+        if isinstance(s, ast.ExecuteStmt):
+            sqltext = self._prepared.get(s.name)
+            if sqltext is None:
+                raise PlanError(f"unknown prepared statement {s.name!r}")
+            vals = [self.vars.user_vars.get(n) for n in s.using]
+            rss = self.execute(sqltext, vals)
+            return rss[-1]
+        if isinstance(s, ast.DeallocateStmt):
+            self._prepared.pop(s.name, None)
+            return ResultSet()
+        if isinstance(s, ast.KillStmt):
+            self.domain.kill(s.conn_id, s.query_only)
+            return ResultSet()
+        if isinstance(s, ast.AnalyzeTableStmt):
+            return self._run_analyze(s)
+        if isinstance(s, ast.SplitRegionStmt):
+            return self._run_split(s)
+        if isinstance(s, ast.AdminStmt):
+            return self._run_admin(s)
+        if isinstance(s, (ast.GrantStmt, ast.RevokeStmt, ast.CreateUserStmt,
+                          ast.DropUserStmt, ast.SetPasswordStmt,
+                          ast.FlushStmt)):
+            from . import priv
+
+            return priv.handle(self, s)
+        # ---- DDL ------------------------------------------------------
+        return self._run_ddl(s)
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    def _pctx(self) -> PhysicalContext:
+        dirty = frozenset(
+            tid for (tid, _h) in (self._txn.buffer.keys() if self._txn else ())
+        )
+        return PhysicalContext(
+            storage=self.domain.storage,
+            dirty_tables=dirty,
+            pushdown_blacklist=frozenset(),
+            enable_pushdown=self.vars.get_bool("tidb_enable_pushdown"),
+        )
+
+    def _exec_ctx(self) -> ExecContext:
+        txn = self._txn if self._in_txn or self._txn is not None else None
+        ctx = ExecContext(
+            self.domain.storage,
+            infoschema=self.domain.catalog.info_schema(),
+            sess_vars=self.vars,
+            txn=txn,
+            read_ts=self.domain.storage.current_ts() if txn is None else 0,
+        )
+        ctx.killed = self._killed
+        self.last_exec_ctx = ctx
+        return ctx
+
+    def _exec_subplan(self, logical) -> List[tuple]:
+        phys = finish_plan(logical, self._pctx())
+        ctx = self._exec_ctx()
+        chunks = collect_all(phys.build(ctx))
+        rows: List[tuple] = []
+        for c in chunks:
+            rows.extend(c.to_pylist())
+        return rows
+
+    def _plan(self, stmt, params=None):
+        return plan_statement(
+            stmt, self.domain.catalog.info_schema(), self.current_db,
+            self._pctx(), exec_subplan=self._exec_subplan,
+            param_values=params,
+        )
+
+    def _run_query(self, stmt, params=None) -> ResultSet:
+        phys = self._plan(stmt, params)
+        self.last_plan = phys
+        ctx = self._exec_ctx()
+        exe = phys.build(ctx)
+        chunks = collect_all(exe)
+        headers = phys.schema.headers() if len(phys.schema) else []
+        rows: List[tuple] = []
+        fts = phys.schema.ftypes()
+        for c in chunks:
+            for r in c.to_pylist():
+                rows.append(_format_row(r, fts))
+        return ResultSet(headers=headers, rows=rows, is_query=True,
+                         warnings=list(ctx.warnings))
+
+    def _run_dml(self, stmt, params=None) -> ResultSet:
+        retries = max(self.vars.get_int("tidb_retry_limit", 10), 0)
+        attempt = 0
+        while True:
+            attempt += 1
+            auto = self._autocommit() and self._txn is None
+            txn = self._begin_txn()
+            ctx = self._exec_ctx()
+            try:
+                phys = self._plan(stmt, params)
+                self.last_plan = phys
+                collect_all(phys.build(ctx))
+                if auto:
+                    self.commit()
+                return ResultSet(affected_rows=ctx.affected_rows,
+                                 last_insert_id=ctx.last_insert_id,
+                                 warnings=list(ctx.warnings))
+            except TxnConflictError:
+                # optimistic retry (session.go:635) — autocommit only
+                self.rollback()
+                if not auto or attempt > retries or \
+                        self.vars.get_bool("tidb_disable_txn_auto_retry"):
+                    raise
+            except Exception:
+                if auto:
+                    self.rollback()
+                raise
+
+    def _run_explain(self, s: ast.ExplainStmt) -> ResultSet:
+        if isinstance(s.target, (ast.SelectStmt, ast.UnionStmt,
+                                 ast.InsertStmt, ast.UpdateStmt,
+                                 ast.DeleteStmt)):
+            phys = self._plan(s.target)
+        else:
+            raise PlanError("EXPLAIN supports SELECT/DML only")
+        if s.analyze:
+            ctx = self._exec_ctx()
+            auto = self._autocommit() and self._txn is None and isinstance(
+                s.target, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt)
+            )
+            if auto:
+                ctx.txn = self._begin_txn()
+            collect_all(phys.build(ctx))
+            if auto:
+                self.commit()
+            rows = []
+            for nm, task, info in phys.explain_tree():
+                st = ctx.stats.get(_plan_id_of(nm))
+                extra = (f"rows:{st.rows} loops:{st.loops} "
+                         f"time:{st.time_ns/1e6:.2f}ms") if st else ""
+                rows.append((nm, task, info, extra))
+            return ResultSet(headers=["id", "task", "info", "execution info"],
+                             rows=rows, is_query=True)
+        rows = [(nm, task, info) for nm, task, info in phys.explain_tree()]
+        return ResultSet(headers=["id", "task", "info"], rows=rows,
+                         is_query=True)
+
+    def _run_trace(self, s: ast.TraceStmt) -> ResultSet:
+        t0 = time.time()
+        rs = self._execute_stmt(s.target)
+        dur = time.time() - t0
+        rows = [("session.execute", f"{dur*1e3:.3f}ms")]
+        if self.last_exec_ctx:
+            for pid, st in sorted(self.last_exec_ctx.stats.items()):
+                rows.append((f"operator#{pid}", f"{st.time_ns/1e6:.3f}ms"))
+        return ResultSet(headers=["span", "duration"], rows=rows,
+                         is_query=True)
+
+    # ------------------------------------------------------------------
+    # SET / SHOW / DESC
+    # ------------------------------------------------------------------
+    def _run_set(self, s: ast.SetStmt) -> ResultSet:
+        from ..planner.expr_build import ExprBuilder
+        from ..planner.columns import Schema
+
+        eb = ExprBuilder(Schema([]), None, None, [], None)
+        for name, is_global, vexpr in s.assignments:
+            if isinstance(vexpr, ast.Default):
+                value = SYSVAR_DEFAULTS.get(name.lower(), ("",))[0]
+            else:
+                from ..planner.build import _eval_const
+
+                value = _eval_const(eb.build(vexpr))
+            if not is_global and not self.vars.known(name) \
+                    and name.lower() not in SYSVAR_DEFAULTS:
+                # unknown non-global names are user variables (@x); the
+                # lexer strips the @ marker
+                self.vars.user_vars[name] = value
+            elif is_global:
+                self.vars.set_global(name, value)
+            else:
+                self.vars.set_session(name, value)
+        return ResultSet()
+
+    def _run_show(self, s: ast.ShowStmt) -> ResultSet:
+        import fnmatch
+
+        kind = s.kind
+        isc = self.domain.catalog.info_schema()
+
+        def like_filter(names):
+            if s.like:
+                pat = s.like.replace("%", "*").replace("_", "?")
+                return [n for n in names if fnmatch.fnmatch(n.lower(),
+                                                            pat.lower())]
+            return names
+
+        if kind == "databases":
+            names = like_filter(isc.schema_names())
+            return ResultSet(["Database"], [(n,) for n in names],
+                             is_query=True)
+        if kind == "tables":
+            db = s.db or self.current_db
+            names = like_filter([t.name for t in isc.tables(db)])
+            return ResultSet([f"Tables_in_{db}"], [(n,) for n in names],
+                             is_query=True)
+        if kind in ("columns", "full_columns"):
+            return self._desc_table(ast.TableName(s.target, s.db))
+        if kind == "create_table":
+            db = s.db or self.current_db
+            t = isc.table(db, s.target)
+            return ResultSet(["Table", "Create Table"],
+                             [(t.name, _show_create(t))], is_query=True)
+        if kind == "index":
+            db = s.db or self.current_db
+            t = isc.table(db, s.target)
+            rows = []
+            for ix in t.indexes:
+                for seq, col in enumerate(ix.columns):
+                    rows.append((t.name, 0 if ix.unique else 1, ix.name,
+                                 seq + 1, col))
+            return ResultSet(
+                ["Table", "Non_unique", "Key_name", "Seq_in_index",
+                 "Column_name"], rows, is_query=True)
+        if kind == "variables":
+            allv = self.vars.all_vars()
+            names = like_filter(sorted(allv))
+            return ResultSet(["Variable_name", "Value"],
+                             [(n, allv[n]) for n in names], is_query=True)
+        if kind == "warnings":
+            return ResultSet(["Level", "Code", "Message"],
+                             [("Warning", 0, w) for w in self._warnings],
+                             is_query=True)
+        if kind == "processlist":
+            rows = [(cid, "user", "localhost", sess.current_db, "Sleep")
+                    for cid, sess in self.domain.sessions.items()]
+            return ResultSet(["Id", "User", "Host", "db", "Command"], rows,
+                             is_query=True)
+        if kind == "regions":
+            db = s.db or self.current_db
+            t = isc.table(db, s.target)
+            regions = self.domain.storage.regions.regions_of(t.id)
+            rows = [(r.region_id, t.name, r.start,
+                     "inf" if r.end >= (1 << 62) else r.end, r.epoch,
+                     r.leader_store) for r in regions]
+            return ResultSet(
+                ["Region_id", "Table", "Start", "End", "Epoch", "Leader"],
+                rows, is_query=True)
+        if kind == "stats":
+            rows = []
+            for db in isc.schema_names():
+                for t in isc.tables(db):
+                    if t.is_view:
+                        continue
+                    store = self.domain.storage.table(t.id)
+                    rows.append((db, t.name, store.base_rows,
+                                 len(store.delta), store.nbytes()))
+            return ResultSet(
+                ["Db_name", "Table_name", "Base_rows", "Delta_rows", "Bytes"],
+                rows, is_query=True)
+        raise PlanError(f"SHOW {kind} not supported")
+
+    def _desc_table(self, tn: ast.TableName) -> ResultSet:
+        t = self.domain.catalog.info_schema().table(
+            tn.db or self.current_db, tn.name
+        )
+        rows = []
+        for c in t.public_columns():
+            key = ""
+            if c.primary_key:
+                key = "PRI"
+            elif any(ix.unique and ix.columns == [c.name] for ix in t.indexes):
+                key = "UNI"
+            elif any(c.name in ix.columns for ix in t.indexes):
+                key = "MUL"
+            rows.append((
+                c.name, c.ftype.sql_name().lower(),
+                "YES" if c.ftype.nullable else "NO", key,
+                c.default if c.has_default else None,
+                "auto_increment" if c.auto_increment else "",
+            ))
+        return ResultSet(["Field", "Type", "Null", "Key", "Default", "Extra"],
+                         rows, is_query=True)
+
+    # ------------------------------------------------------------------
+    # ANALYZE / ADMIN / SPLIT
+    # ------------------------------------------------------------------
+    def _run_analyze(self, s: ast.AnalyzeTableStmt) -> ResultSet:
+        for tn in s.tables:
+            t = self.domain.catalog.info_schema().table(
+                tn.db or self.current_db, tn.name
+            )
+            store = self.domain.storage.table(t.id)
+            for ci in range(store.n_cols):
+                store.column_stats(ci)  # warm min/max cache
+        return ResultSet()
+
+    def _run_split(self, s: ast.SplitRegionStmt) -> ResultSet:
+        t = self.domain.catalog.info_schema().table(
+            s.table.db or self.current_db, s.table.name
+        )
+        store = self.domain.storage.table(t.id)
+        self.domain.storage.regions.split_even(
+            t.id, s.num, max(store.base_rows, store.next_handle)
+        )
+        n = len(self.domain.storage.regions.regions_of(t.id))
+        return ResultSet(["TOTAL_SPLIT_REGION"], [(n,)], is_query=True)
+
+    def _run_admin(self, s: ast.AdminStmt) -> ResultSet:
+        if s.kind in ("show_ddl", "show_ddl_jobs"):
+            rows = [
+                (j.id, j.typ, j.db, j.table, j.state, j.schema_version,
+                 ",".join(j.states_walked))
+                for j in reversed(self.domain.catalog.jobs[-20:])
+            ]
+            return ResultSet(
+                ["Job_id", "Type", "Db", "Table", "State", "Schema_ver",
+                 "States"], rows, is_query=True)
+        if s.kind == "check_table":
+            for tn in s.tables:
+                t = self.domain.catalog.info_schema().table(
+                    tn.db or self.current_db, tn.name
+                )
+                self.domain.storage.table(t.id)  # existence check
+            return ResultSet()
+        raise PlanError(f"ADMIN {s.kind} not supported")
+
+    # ------------------------------------------------------------------
+    # DDL
+    # ------------------------------------------------------------------
+    def _run_ddl(self, s: ast.Stmt) -> ResultSet:
+        cat = self.domain.catalog
+        if isinstance(s, ast.CreateDatabaseStmt):
+            cat.create_database(s.name, s.if_not_exists)
+            return ResultSet()
+        if isinstance(s, ast.DropDatabaseStmt):
+            cat.drop_database(s.name, s.if_exists)
+            if self.current_db.lower() == s.name.lower():
+                self.current_db = ""
+            return ResultSet()
+        if isinstance(s, ast.CreateTableStmt):
+            info = self._table_info_from_ast(s)
+            cat.create_table(s.table.db or self.current_db, info,
+                             s.if_not_exists)
+            return ResultSet()
+        if isinstance(s, ast.DropTableStmt):
+            for tn in s.tables:
+                cat.drop_table(tn.db or self.current_db, tn.name,
+                               s.if_exists, view_only=s.is_view)
+            return ResultSet()
+        if isinstance(s, ast.TruncateTableStmt):
+            cat.truncate_table(s.table.db or self.current_db, s.table.name)
+            return ResultSet()
+        if isinstance(s, ast.RenameTableStmt):
+            cat.rename_table(s.old.db or self.current_db, s.old.name,
+                             s.new.name)
+            return ResultSet()
+        if isinstance(s, ast.CreateIndexStmt):
+            cat.create_index(s.table.db or self.current_db, s.table.name,
+                             s.index_name, s.columns, s.unique)
+            return ResultSet()
+        if isinstance(s, ast.DropIndexStmt):
+            cat.drop_index(s.table.db or self.current_db, s.table.name,
+                           s.index_name)
+            return ResultSet()
+        if isinstance(s, ast.CreateViewStmt):
+            db = s.name.db or self.current_db
+            if s.or_replace and cat.info_schema().has_table(db, s.name.name):
+                cat.drop_table(db, s.name.name, view_only=True)
+            info = TableInfo(0, s.name.name, [], is_view=True)
+            info.view_select = s.query  # parsed AST (see build_from)
+            cat.create_table(db, info)
+            return ResultSet()
+        if isinstance(s, ast.AlterTableStmt):
+            return self._run_alter(s)
+        raise PlanError(f"statement {type(s).__name__} not supported")
+
+    def _run_alter(self, s: ast.AlterTableStmt) -> ResultSet:
+        cat = self.domain.catalog
+        db = s.table.db or self.current_db
+        if s.action == "add_column":
+            cat.add_column(db, s.table.name, self._column_info(s.column))
+            return ResultSet()
+        if s.action == "drop_column":
+            cat.drop_column(db, s.table.name, s.name)
+            return ResultSet()
+        if s.action == "modify_column":
+            cat.modify_column(db, s.table.name, self._column_info(s.column))
+            return ResultSet()
+        if s.action == "add_index":
+            ix = s.index
+            cat.create_index(db, s.table.name, ix.name, ix.columns,
+                             ix.unique, ix.primary)
+            return ResultSet()
+        if s.action == "drop_index":
+            cat.drop_index(db, s.table.name, s.name)
+            return ResultSet()
+        if s.action == "rename":
+            cat.rename_table(db, s.table.name, s.name)
+            return ResultSet()
+        raise PlanError(f"ALTER {s.action} not supported")
+
+    def _column_info(self, cd: ast.ColumnDef) -> ColumnInfo:
+        mk = _TYPE_MAP.get(cd.type_name.lower())
+        if mk is None:
+            raise PlanError(f"unknown column type {cd.type_name!r}")
+        ft = mk(cd.precision, cd.scale)
+        if cd.not_null or cd.primary_key:
+            ft = ft.not_null()
+        default = None
+        has_default = False
+        if cd.default is not None:
+            from ..planner.build import _eval_const
+            from ..planner.columns import Schema
+            from ..planner.expr_build import ExprBuilder
+
+            eb = ExprBuilder(Schema([]), None, None, [], None)
+            default = _eval_const(eb.build(cd.default))
+            has_default = True
+        return ColumnInfo(cd.name, ft, 0, default, has_default,
+                          cd.auto_increment, cd.primary_key)
+
+    def _table_info_from_ast(self, s: ast.CreateTableStmt) -> TableInfo:
+        cols = [self._column_info(c) for c in s.columns]
+        info = TableInfo(0, s.table.name, cols)
+        idx_id = 1
+        for c in cols:
+            if c.primary_key:
+                info.indexes.append(
+                    IndexInfo(idx_id, "PRIMARY", [c.name], True, True)
+                )
+                idx_id += 1
+            # UNIQUE column constraint
+        for i, cd in enumerate(s.columns):
+            if cd.unique and not cd.primary_key:
+                info.indexes.append(
+                    IndexInfo(idx_id, f"uniq_{cd.name}", [cd.name], True)
+                )
+                idx_id += 1
+        for ix in s.indexes:
+            info.indexes.append(
+                IndexInfo(idx_id, ix.name or f"idx_{idx_id}",
+                          ix.columns, ix.unique, ix.primary)
+            )
+            idx_id += 1
+        return info
+
+
+# ---------------------------------------------------------------------------
+
+
+def _format_row(row: tuple, fts: List[FieldType]) -> tuple:
+    out = []
+    for v, ft in zip(row, fts):
+        if v is None:
+            out.append(None)
+        elif ft.kind == TypeKind.DECIMAL:
+            out.append(v / (10 ** ft.scale) if ft.scale else int(v))
+        elif ft.kind == TypeKind.DATE:
+            out.append(format_date(v))
+        elif ft.kind == TypeKind.DATETIME:
+            out.append(format_datetime(v))
+        elif isinstance(v, np.generic):
+            out.append(v.item())
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _plan_id_of(name: str) -> int:
+    try:
+        return int(name.rsplit("_", 1)[1])
+    except (IndexError, ValueError):
+        return -1
+
+
+def _show_create(t: TableInfo) -> str:
+    lines = []
+    for c in t.public_columns():
+        s = f"  `{c.name}` {c.ftype.sql_name().lower()}"
+        if not c.ftype.nullable:
+            s += " NOT NULL"
+        if c.has_default:
+            s += f" DEFAULT {c.default!r}"
+        if c.auto_increment:
+            s += " AUTO_INCREMENT"
+        lines.append(s)
+    for ix in t.indexes:
+        if ix.primary:
+            lines.append(f"  PRIMARY KEY (`{'`,`'.join(ix.columns)}`)")
+        elif ix.unique:
+            lines.append(
+                f"  UNIQUE KEY `{ix.name}` (`{'`,`'.join(ix.columns)}`)"
+            )
+        else:
+            lines.append(f"  KEY `{ix.name}` (`{'`,`'.join(ix.columns)}`)")
+    body = ",\n".join(lines)
+    return f"CREATE TABLE `{t.name}` (\n{body}\n)"

@@ -1,0 +1,106 @@
+"""System variables.
+
+Reference: sessionctx/variable — SessionVars with ~607 MySQL-style sysvars
+(sysvar.go:118), TiDB-specific tuning knobs incl. all parallelism degrees
+(tidb_vars.go:367-423).  A registry of defaults; sessions overlay their own
+values over the domain's globals, exactly like MySQL SESSION vs GLOBAL scope.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# name -> (default, kind)  kind in {int, bool, str, float}
+SYSVAR_DEFAULTS = {
+    "autocommit": ("1", "bool"),
+    "sql_mode": ("ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES", "str"),
+    "max_execution_time": ("0", "int"),
+    "tx_isolation": ("REPEATABLE-READ", "str"),
+    "transaction_isolation": ("REPEATABLE-READ", "str"),
+    "time_zone": ("SYSTEM", "str"),
+    "wait_timeout": ("28800", "int"),
+    "interactive_timeout": ("28800", "int"),
+    "max_allowed_packet": ("67108864", "int"),
+    "version_comment": ("tidb-tpu", "str"),
+    "character_set_client": ("utf8mb4", "str"),
+    "character_set_results": ("utf8mb4", "str"),
+    "character_set_connection": ("utf8mb4", "str"),
+    "collation_connection": ("utf8mb4_bin", "str"),
+    "lower_case_table_names": ("2", "int"),
+    # --- TiDB-style knobs (tidb_vars.go) ------------------------------
+    "tidb_max_chunk_size": ("1024", "int"),
+    "tidb_init_chunk_size": ("32", "int"),
+    "tidb_distsql_scan_concurrency": ("8", "int"),
+    "tidb_executor_concurrency": ("5", "int"),
+    "tidb_hash_join_concurrency": ("5", "int"),
+    "tidb_hashagg_partial_concurrency": ("4", "int"),
+    "tidb_hashagg_final_concurrency": ("4", "int"),
+    "tidb_projection_concurrency": ("4", "int"),
+    "tidb_index_lookup_concurrency": ("4", "int"),
+    "tidb_mem_quota_query": (str(32 << 30), "int"),
+    "tidb_retry_limit": ("10", "int"),
+    "tidb_disable_txn_auto_retry": ("0", "bool"),
+    "tidb_snapshot": ("", "str"),
+    "tidb_opt_agg_push_down": ("1", "bool"),
+    "tidb_opt_distinct_agg_push_down": ("0", "bool"),
+    # --- TPU-native knobs ---------------------------------------------
+    "tidb_use_tpu": ("1", "bool"),  # per-session engine routing (cpu|tpu)
+    "tidb_tpu_block_rows": (str(1 << 20), "int"),
+    "tidb_allow_batch_cop": ("1", "bool"),
+    "tidb_enable_pushdown": ("1", "bool"),
+}
+
+
+class SessionVars:
+    def __init__(self, globals_map: Optional[Dict[str, str]] = None):
+        self._globals = globals_map if globals_map is not None else {}
+        self._session: Dict[str, str] = {}
+        # user-defined @vars
+        self.user_vars: Dict[str, object] = {}
+
+    # ---- typed getters -------------------------------------------------
+    def get(self, name: str) -> Optional[str]:
+        name = name.lower()
+        if name in self._session:
+            return self._session[name]
+        if name in self._globals:
+            return self._globals[name]
+        d = SYSVAR_DEFAULTS.get(name)
+        return d[0] if d else None
+
+    def get_int(self, name: str, default: int = 0) -> int:
+        v = self.get(name)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    def get_bool(self, name: str) -> bool:
+        v = self.get(name)
+        return str(v).lower() in ("1", "on", "true", "yes")
+
+    # ---- setters -------------------------------------------------------
+    def set_session(self, name: str, value):
+        self._session[name.lower()] = _norm(value)
+
+    def set_global(self, name: str, value):
+        self._globals[name.lower()] = _norm(value)
+
+    def known(self, name: str) -> bool:
+        name = name.lower()
+        return (name in SYSVAR_DEFAULTS or name in self._globals
+                or name in self._session)
+
+    def all_vars(self) -> Dict[str, str]:
+        out = {k: v[0] for k, v in SYSVAR_DEFAULTS.items()}
+        out.update(self._globals)
+        out.update(self._session)
+        return out
+
+
+def _norm(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if value is None:
+        return ""
+    return str(value)

@@ -218,12 +218,36 @@ class TableStore:
             data = np.concatenate(parts[i])
             valid = np.concatenate(vparts[i])
             if meta.ftype.kind == TypeKind.STRING and decode_strings:
-                d = meta.dictionary or []
-                obj = np.empty(len(data), dtype=object)
-                for j in range(len(data)):
-                    obj[j] = d[data[j]] if 0 <= data[j] < len(d) else ""
-                data = obj
+                data = _decode_dict(data, meta.dictionary)
             cols.append(Column(meta.ftype, data, None if valid.all() else valid))
+        return Chunk(cols)
+
+    def gather_chunk(self, col_idx: Sequence[int], handles: np.ndarray,
+                     decode_strings: bool = True) -> Chunk:
+        """Gather specific base rows by handle (vectorized per block) —
+        the cheap path for sparse device-selected rows (TopN/filter)."""
+        handles = np.asarray(handles, dtype=np.int64)
+        n = len(handles)
+        blk_ids = handles // BLOCK_SIZE
+        offs = handles % BLOCK_SIZE
+        uniq_blocks = np.unique(blk_ids)
+        cols: List[Column] = []
+        for ci in col_idx:
+            meta = self.cols[ci]
+            blocks, valids = self._blocks[ci], self._valids[ci]
+            dt = blocks[0].dtype if blocks else meta.ftype.np_dtype
+            data = np.zeros(n, dtype=dt)
+            valid = np.ones(n, dtype=np.bool_)
+            for b in uniq_blocks:
+                sel = blk_ids == b
+                data[sel] = blocks[b][offs[sel]]
+                v = valids[b]
+                if v is not None:
+                    valid[sel] = v[offs[sel]]
+            if meta.ftype.kind == TypeKind.STRING and decode_strings:
+                data = _decode_dict(data, meta.dictionary)
+            cols.append(Column(meta.ftype, data,
+                               None if valid.all() else valid))
         return Chunk(cols)
 
     # ------------------------------------------------------------------
@@ -332,7 +356,7 @@ class TableStore:
     def compact(self, ts: int):
         """Fold delta (committed, visible at ts) into fresh base blocks."""
         with self._mu:
-            if any(self.locks):
+            if self.locks:
                 raise KVError("cannot compact with live locks")
             deleted, inserted = self.delta_overlay(ts, 0, 1 << 62)
             del_set = set(deleted)
@@ -413,7 +437,8 @@ class TableStore:
                     vals = blk[v]
                 if len(vals) == 0:
                     continue
-                bmin, bmax = int(vals.min()), int(np.ceil(float(vals.max())))
+                bmin = int(np.floor(float(vals.min())))
+                bmax = int(np.ceil(float(vals.max())))
                 if first:
                     lo, hi, first = bmin, bmax, False
                 else:
@@ -428,6 +453,22 @@ class TableStore:
             for b in blocks:
                 total += b.nbytes if b.dtype != object else len(b) * 8
         return total
+
+
+def _decode_dict(codes: np.ndarray, dictionary: Optional[List[str]]) -> np.ndarray:
+    """int32 codes -> object array of strings (vectorized; out-of-range -> "")."""
+    d = np.asarray(dictionary or [], dtype=object)
+    if len(d) == 0:
+        out = np.empty(len(codes), dtype=object)
+        out[:] = ""
+        return out
+    safe = np.clip(codes, 0, len(d) - 1)
+    out = d[safe]
+    bad = (codes < 0) | (codes >= len(d))
+    if bad.any():
+        out = out.copy()
+        out[bad] = ""
+    return out
 
 
 def _dict_encode_merge(arr: np.ndarray, old_dict: Optional[List[str]],
