@@ -85,7 +85,16 @@ def build_lineitem(domain: Domain, n: int):
             (base + rng.integers(0, span, m)).astype(np.int32),  # shipdate
         ]
         store.bulk_load_arrays(arrays, ts=domain.storage.current_ts())
-    domain.storage.regions.split_even(t.id, REGIONS, store.base_rows)
+    # split on device-tile boundaries so each region's scan maps 1:1 onto
+    # cached device tiles (no tile shared between regions)
+    from tidb_tpu.copr.jax_engine import TILE
+
+    n_tiles = max((store.base_rows + TILE - 1) // TILE, 1)
+    k = min(REGIONS, n_tiles)
+    if k > 1:
+        step_tiles = max(n_tiles // k, 1)
+        splits = [i * step_tiles * TILE for i in range(1, k)]
+        domain.storage.regions.split_at(t.id, splits)
     return s
 
 

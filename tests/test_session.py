@@ -275,19 +275,25 @@ class TestShow:
 class TestExplain:
     def test_pushdown_plan_shape(self, tsess):
         rows = q(tsess, "explain select c, sum(b) from t group by c")
-        tasks = [r[1] for r in rows]
+        tasks = [r[2] for r in rows]
         assert "cop[tpu]" in tasks  # partial agg pushed to device
         names = "".join(r[0] for r in rows)
         assert "HashAgg" in names and "TableReader" in names
 
     def test_selection_pushdown(self, tsess):
         rows = q(tsess, "explain select a from t where b > 2.0")
-        cop = [r for r in rows if r[1] == "cop[tpu]"]
+        cop = [r for r in rows if r[2] == "cop[tpu]"]
         assert any("Selection" in r[0] for r in cop)
 
     def test_explain_analyze(self, tsess):
         rows = q(tsess, "explain analyze select count(*) from t")
-        assert rows and len(rows[0]) == 4
+        assert rows and len(rows[0]) == 5
+
+    def test_est_rows_after_analyze(self, tsess):
+        tsess.execute("analyze table t")
+        rows = q(tsess, "explain select a from t where a > 2")
+        reader = [r for r in rows if "TableReader" in r[0]][0]
+        assert reader[1] != ""  # estRows populated from histogram
 
 
 class TestUnionAndSubquery:

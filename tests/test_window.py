@@ -1,0 +1,115 @@
+"""Window function tests (executor/window.go parity surface)."""
+
+import pytest
+
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def sess():
+    s = Domain().new_session()
+    s.execute("create table emp (dept varchar(5), name varchar(10), sal bigint)")
+    s.execute(
+        "insert into emp values ('a','x',100),('a','y',200),('a','z',200),"
+        "('b','p',50),('b','q',150)"
+    )
+    return s
+
+
+def q(s, sql):
+    return s.query(sql)
+
+
+def test_row_number_partition(sess):
+    assert q(sess, "select dept, name, row_number() over "
+                   "(partition by dept order by sal) from emp "
+                   "order by dept, sal, name") == [
+        ("a", "x", 1), ("a", "y", 2), ("a", "z", 3),
+        ("b", "p", 1), ("b", "q", 2),
+    ]
+
+
+def test_rank_dense_rank(sess):
+    assert q(sess, "select name, rank() over (order by sal), "
+                   "dense_rank() over (order by sal) from emp "
+                   "order by sal, name") == [
+        ("p", 1, 1), ("x", 2, 2), ("q", 3, 3), ("y", 4, 4), ("z", 4, 4),
+    ]
+
+
+def test_running_sum_and_partition_total(sess):
+    assert q(sess, "select dept, sal, sum(sal) over "
+                   "(partition by dept order by sal) from emp "
+                   "order by dept, sal") == [
+        ("a", 100, 100), ("a", 200, 500), ("a", 200, 500),
+        ("b", 50, 50), ("b", 150, 200),
+    ]
+    assert q(sess, "select dept, sal, sum(sal) over (partition by dept) "
+                   "from emp order by dept, sal") == [
+        ("a", 100, 500), ("a", 200, 500), ("a", 200, 500),
+        ("b", 50, 200), ("b", 150, 200),
+    ]
+
+
+def test_lead_lag(sess):
+    assert q(sess, "select name, lag(sal) over (order by sal, name), "
+                   "lead(sal, 1, 0) over (order by sal, name) from emp "
+                   "order by sal, name") == [
+        ("p", None, 100), ("x", 50, 150), ("q", 100, 200),
+        ("y", 150, 200), ("z", 200, 0),
+    ]
+
+
+def test_rows_frame(sess):
+    rows = q(sess, "select name, min(sal) over (order by sal, name "
+                   "rows between 1 preceding and 1 following) from emp "
+                   "order by sal, name")
+    assert rows == [("p", 50), ("x", 50), ("q", 100), ("y", 150), ("z", 200)]
+
+
+def test_first_value_cume_dist(sess):
+    rows = q(
+        sess,
+        "select name, first_value(name) over (partition by dept order by sal),"
+        " cume_dist() over (order by sal) from emp order by sal, name")
+    assert rows[0][1] == "p" and rows[-1][2] == 1.0
+
+
+def test_window_over_aggregate(sess):
+    assert q(sess, "select dept, max(sal), row_number() over "
+                   "(order by max(sal) desc) from emp group by dept "
+                   "order by dept") == [("a", 200, 1), ("b", 150, 2)]
+
+
+def test_ntile(sess):
+    rows = q(sess, "select name, ntile(2) over (order by sal, name) "
+                   "from emp order by sal, name")
+    assert [r[1] for r in rows] == [1, 1, 1, 2, 2]
+
+
+def test_empty_frames_at_partition_edges(sess2=None):
+    s = Domain().new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (10),(20),(30),(40)")
+    rows = q(s, "select a, sum(a) over (order by a rows between 2 preceding "
+                "and 1 preceding), count(*) over (order by a rows between "
+                "1 following and 2 following) from t order by a")
+    assert rows == [(10, None, 2), (20, 10, 2), (30, 30, 1), (40, 50, 0)]
+
+
+def test_same_named_partition_cols_do_not_collide():
+    s = Domain().new_session()
+    s.execute("create table t1 (a bigint, v bigint)")
+    s.execute("create table t2 (a bigint, k bigint)")
+    s.execute("insert into t1 values (1,1),(1,2),(2,3)")
+    s.execute("insert into t2 values (7,1),(8,2),(7,3)")
+    rows = q(s, "select t1.a, t2.a, count(*) over (partition by t1.a), "
+                "count(*) over (partition by t2.a) from t1 join t2 "
+                "on t1.v = t2.k order by t1.a, t2.a")
+    assert rows == [(1, 7, 2, 2), (1, 8, 2, 1), (2, 7, 1, 2)]
+
+
+def test_percent_rank(sess):
+    rows = q(sess, "select name, percent_rank() over (order by sal) "
+                   "from emp order by sal, name")
+    assert rows[0][1] == 0.0 and rows[-1][1] == 0.75

@@ -112,6 +112,13 @@ class Catalog:
         self.schema_version = 0
         self.jobs: List[DDLJob] = []
         self._snapshot: Optional[InfoSchema] = None
+        # optional hook: called with a table id whenever its storage is
+        # dropped/replaced (Domain wires this to StatsHandle.drop)
+        self.on_table_dropped = None
+
+    def _notify_drop(self, table_id: int):
+        if self.on_table_dropped is not None:
+            self.on_table_dropped(table_id)
 
     # ------------------------------------------------------------------
     # id / version bookkeeping (meta.GenGlobalID / SchemaVersion analog)
@@ -163,6 +170,7 @@ class Catalog:
             for t in db.tables.values():
                 if not t.is_view:
                     self.storage.drop_table(t.id)
+                    self._notify_drop(t.id)
             del self._dbs[key]
             self._bump()
             self._record(DDLJob(self.gen_id(), "drop_schema", name, ""))
@@ -203,6 +211,7 @@ class Catalog:
             del d.tables[name.lower()]
             if not t.is_view:
                 self.storage.drop_table(t.id)
+                self._notify_drop(t.id)
             self._bump()
             self._record(DDLJob(self.gen_id(), "drop_table", db, name))
 
@@ -212,6 +221,7 @@ class Catalog:
             t = self.info_schema().table(db, name)
             d = self._dbs[db.lower()]
             self.storage.drop_table(t.id)
+            self._notify_drop(t.id)
             new = TableInfo(
                 self.gen_id(), t.name, t.columns, t.indexes, t.pk_is_handle, 1
             )
@@ -397,6 +407,7 @@ class Catalog:
             arrays.append(arr)
             valids.append(valid)
         self.storage.drop_table(t.id)
+        self._notify_drop(t.id)
         new_store = self.storage.create_table(
             t.id, [(c.name, c.ftype) for c in new_cols]
         )

@@ -180,6 +180,16 @@ def _gather_tile(table, store_ci: int, start: int, end: int):
 
 DEVICE_CACHE = _DeviceCache()
 
+_ALL_TRUE = None
+
+
+def _all_true():
+    """Device-resident all-true TILE mask, transferred once per process."""
+    global _ALL_TRUE
+    if _ALL_TRUE is None:
+        _ALL_TRUE = jax.device_put(np.ones(TILE, dtype=np.bool_))
+    return _ALL_TRUE
+
 
 # ---------------------------------------------------------------------------
 # DAG analysis
@@ -344,13 +354,25 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
 
 
 def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
-    """Returns a jitted fn(datas, valids, row_mask) -> outputs."""
+    """Returns a jitted fn(datas, valids, lo, hi, del_mask) -> outputs.
+
+    The row mask is built ON DEVICE from the [lo, hi) scalars (region clip
+    within the tile) AND'd with del_mask (a cached device-resident all-true
+    array unless the tile has MVCC-deleted rows).  Keeping masks device-side
+    means a steady-state query moves ZERO scan data over PCIe/tunnel: tiles
+    are cached device arrays (keyed on base_version), and only G-sized
+    partials come back.
+    """
     n = TILE
 
     def cols_env(datas, valids):
         return {
             ci: (datas[j], valids[j]) for j, ci in enumerate(col_order)
         }
+
+    def row_mask_of(lo, hi, del_mask):
+        ar = jnp.arange(n, dtype=jnp.int64)
+        return (ar >= lo) & (ar < hi) & del_mask
 
     def selected_mask(cols, row_mask):
         m = row_mask
@@ -360,9 +382,9 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
         return m
 
     if kind == "filter":
-        def fn(datas, valids, row_mask):
+        def fn(datas, valids, lo, hi, del_mask):
             cols = cols_env(datas, valids)
-            m = selected_mask(cols, row_mask)
+            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             outs = None
             if an.proj_exprs is not None:
                 outs = [compile_expr(p, cols, n) for p in an.proj_exprs]
@@ -385,15 +407,15 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
             else:
                 tags.append("argfirst")
 
-        def fn(datas, valids, row_mask):
+        def fn(datas, valids, lo, hi, del_mask):
             cols = cols_env(datas, valids)
-            m = selected_mask(cols, row_mask)
+            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             # mixed-radix group codes (NULL keys excluded by _Analyzed)
             gidx = jnp.zeros(n, dtype=jnp.int64)
             stride = 1
-            for kcol, (lo, card) in zip(an.group_cols, an.group_card):
+            for kcol, (klo, card) in zip(an.group_cols, an.group_card):
                 d, v = cols[kcol]
-                code = jnp.clip(d.astype(jnp.int64) - lo, 0, card - 1)
+                code = jnp.clip(d.astype(jnp.int64) - klo, 0, card - 1)
                 gidx = gidx + code * stride
                 m = m & v
                 stride *= card
@@ -432,8 +454,8 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
 
         jitted = jax.jit(fn)
 
-        def wrapped(datas, valids, row_mask):
-            gcount, results = jitted(datas, valids, row_mask)
+        def wrapped(datas, valids, lo, hi, del_mask):
+            gcount, results = jitted(datas, valids, lo, hi, del_mask)
             return gcount, list(zip(tags, results))
 
         return wrapped
@@ -442,9 +464,9 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
         key_expr, desc = an.topn.order_by[0]
         k = min(an.topn.limit, TILE)
 
-        def fn(datas, valids, row_mask):
+        def fn(datas, valids, lo, hi, del_mask):
             cols = cols_env(datas, valids)
-            m = selected_mask(cols, row_mask)
+            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             d, v = compile_expr(key_expr, cols, n)
             # MySQL NULL order: first ascending, last descending.  The
             # sentinel must stay distinguishable from masked-out rows
@@ -501,38 +523,37 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     topn_parts: List[Chunk] = []
     remaining_limit = an.limit
 
-    for tile_start in range(start - (start % TILE) if start % TILE else start,
-                            end, TILE):
+    for tile_start in range((start // TILE) * TILE, end, TILE):
         t0 = max(tile_start, start)
         t1 = min(tile_start + TILE, end)
         if t0 >= t1:
             continue
         tile_idx = tile_start // TILE
-        aligned = (tile_start % TILE) == 0
+        # tiles are ALWAYS the aligned, device-cached arrays; the region
+        # clip [t0,t1) and deletions become the mask, so repeat queries and
+        # sub-tile regions reuse resident device data (no re-transfer)
         datas, valids = [], []
         for j, ci in enumerate(col_order):
             store_ci = an.scan.columns[ci]
-            if aligned:
-                d, v = DEVICE_CACHE.get_tile(
-                    table, store_ci, tile_idx, tile_start,
-                    min(tile_start + TILE, table.base_rows),
-                )
-            else:
-                d, v = _gather_tile(table, store_ci, t0, t1)
+            d, v = DEVICE_CACHE.get_tile(
+                table, store_ci, tile_idx, tile_start,
+                min(tile_start + TILE, table.base_rows),
+            )
             datas.append(d)
             valids.append(v)
-        # row mask: within [t0,t1) and not deleted
-        base0 = tile_start if aligned else t0
-        nrows_valid = t1 - base0
-        row_mask = np.zeros(TILE, dtype=np.bool_)
-        row_mask[(t0 - base0):(t1 - base0)] = True
+        base0 = tile_start
+        lo = np.int64(t0 - base0)
+        hi = np.int64(t1 - base0)
+        del_mask = _all_true()
         if len(del_arr):
             dd = del_arr[(del_arr >= base0) & (del_arr < base0 + TILE)] - base0
-            row_mask[dd] = False
-        row_mask_j = jnp.asarray(row_mask)
+            if len(dd):
+                dm = np.ones(TILE, dtype=np.bool_)
+                dm[dd] = False
+                del_mask = jnp.asarray(dm)
 
         if kind == "filter":
-            m, outs = fn(datas, valids, row_mask_j)
+            m, outs = fn(datas, valids, lo, hi, del_mask)
             m = np.asarray(m)
             sel = np.flatnonzero(m)
             if remaining_limit is not None:
@@ -555,14 +576,14 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
                 if remaining_limit <= 0:
                     break
         elif kind == "agg":
-            gcount, results = fn(datas, valids, row_mask_j)
+            gcount, results = fn(datas, valids, lo, hi, del_mask)
             agg_accum = _merge_device_agg(
                 agg_accum, np.asarray(gcount),
                 [(t, _np_tree(r)) for t, r in results],
                 table, an, base0,
             )
         else:  # topn
-            idx, cnt = fn(datas, valids, row_mask_j)
+            idx, cnt = fn(datas, valids, lo, hi, del_mask)
             idx = np.asarray(idx)[: int(cnt)]
             if len(idx):
                 topn_parts.append(_gather_rows(table, an.scan, base0, idx))

@@ -1,0 +1,355 @@
+"""Window function executor.
+
+Reference: executor/window.go (windowProcessor over sorted partitions,
+window.go:30-44) + executor/aggfuncs window variants.
+
+Execution: materialize the child, sort by (partition keys, order keys),
+compute every window column vectorized over the sorted layout:
+- partition/peer boundaries via change-point masks,
+- ranking functions from those masks (row_number/rank/dense_rank/
+  percent_rank/cume_dist/ntile),
+- offset functions (lead/lag/first_value/last_value/nth_value) via shifted
+  gathers clipped to partitions,
+- frame aggregates (sum/count/avg/min/max) via prefix sums over per-row
+  [frame_start, frame_end] ranges; min/max accumulate per partition for
+  cumulative frames and fall back to a bounded loop for explicit ROWS
+  frames.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..chunk import Chunk, Column, concat_chunks
+from ..copr.cpu_engine import sort_indices
+from ..errors import ExecutorError, PlanError
+from ..expr.expression import Constant, Expression
+from ..types import FieldType, TypeKind, ty_float, ty_int
+from .base import ExecContext, Executor
+
+RANKING = {"row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+           "ntile"}
+OFFSET = {"lead", "lag", "first_value", "last_value", "nth_value"}
+WIN_AGGS = {"sum", "count", "avg", "min", "max"}
+WINDOW_FUNCS = RANKING | OFFSET | WIN_AGGS
+
+
+def window_ftype(name: str, args: List[Expression]) -> FieldType:
+    if name in ("row_number", "rank", "dense_rank", "ntile"):
+        return ty_int(False)
+    if name in ("percent_rank", "cume_dist"):
+        return ty_float(False)
+    if name in ("lead", "lag", "first_value", "last_value", "nth_value"):
+        return args[0].ftype.with_nullable(True)
+    if name in WIN_AGGS:
+        from ..expr.aggregation import AggDesc
+
+        return AggDesc(name, args).ftype
+    raise PlanError(f"unknown window function {name!r}")
+
+
+@dataclass
+class WindowFuncDesc:
+    name: str
+    args: List[Expression]
+    ftype: FieldType
+
+
+@dataclass
+class Frame:
+    """Resolved frame spec; kind of each bound in
+    {unbounded_preceding, preceding, current, following, unbounded_following}."""
+
+    unit: str = ""  # "" = default frame
+    start: Tuple[str, int] = ("unbounded_preceding", 0)
+    end: Tuple[str, int] = ("current", 0)
+
+
+class WindowExec(Executor):
+    def __init__(self, ctx, child: Executor, funcs: List[WindowFuncDesc],
+                 partition_by: List[Expression],
+                 order_by: List[Tuple[Expression, bool]],
+                 frame: Optional[Frame], plan_id: int = -1):
+        ftypes = list(child.ftypes) + [f.ftype for f in funcs]
+        super().__init__(ctx, ftypes, [child], plan_id)
+        self.funcs = funcs
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.frame = frame or Frame()
+        self._result: Optional[Chunk] = None
+        self._off = 0
+
+    def _open(self):
+        self._result = None
+        self._off = 0
+
+    def _next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._compute()
+        if self._off >= self._result.num_rows:
+            return None
+        c = self._result.slice(
+            self._off, min(self._off + self.ctx.chunk_size,
+                           self._result.num_rows)
+        )
+        self._off += c.num_rows
+        return c
+
+    # ------------------------------------------------------------------
+    def _compute(self) -> Chunk:
+        whole = concat_chunks(self.drain_child())
+        if whole is None or whole.num_rows == 0:
+            return Chunk.empty(self.ftypes)
+        n = whole.num_rows
+        sort_keys = [(e, False) for e in self.partition_by] + list(self.order_by)
+        if sort_keys:
+            perm = sort_indices(sort_keys, whole)
+            whole = whole.take(perm)
+
+        # ---- boundary masks ------------------------------------------
+        new_part = np.zeros(n, dtype=np.bool_)
+        new_part[0] = True
+        for e in self.partition_by:
+            v = e.eval(whole)
+            d, val = v.data, v.validity()
+            if n > 1:
+                change = np.empty(n, dtype=np.bool_)
+                change[0] = True
+                change[1:] = (d[1:] != d[:-1]) | (val[1:] != val[:-1])
+                new_part |= change
+        new_peer = new_part.copy()
+        for e, _ in self.order_by:
+            v = e.eval(whole)
+            d, val = v.data, v.validity()
+            if n > 1:
+                change = np.empty(n, dtype=np.bool_)
+                change[0] = True
+                change[1:] = (d[1:] != d[:-1]) | (val[1:] != val[:-1])
+                new_peer |= change
+
+        idx = np.arange(n, dtype=np.int64)
+        part_first = np.maximum.accumulate(np.where(new_part, idx, 0))
+        # partition last index per row
+        part_last = np.empty(n, dtype=np.int64)
+        ends = np.flatnonzero(new_part)
+        bounds = np.append(ends, n)
+        for i in range(len(ends)):
+            part_last[bounds[i]:bounds[i + 1]] = bounds[i + 1] - 1
+        peer_first = np.maximum.accumulate(np.where(new_peer, idx, 0))
+        peer_last = np.empty(n, dtype=np.int64)
+        pends = np.flatnonzero(new_peer)
+        pbounds = np.append(pends, n)
+        for i in range(len(pends)):
+            peer_last[pbounds[i]:pbounds[i + 1]] = pbounds[i + 1] - 1
+        n_part = part_last - part_first + 1
+        rn = idx - part_first + 1
+
+        out_cols = list(whole.columns)
+        for f in self.funcs:
+            out_cols.append(self._one_func(
+                f, whole, idx, new_part, new_peer, part_first, part_last,
+                peer_first, peer_last, n_part, rn,
+            ))
+        return Chunk(out_cols)
+
+    # ------------------------------------------------------------------
+    def _frame_bounds(self, idx, part_first, part_last, peer_last):
+        """Per-row inclusive [fs, fe] row ranges."""
+        fr = self.frame
+        if not fr.unit:
+            if self.order_by:
+                return part_first, peer_last  # RANGE UNBOUNDED..CURRENT(peers)
+            return part_first, part_last  # whole partition
+        if fr.unit == "range":
+            k0, _ = fr.start
+            k1, _ = fr.end
+            if k0 == "unbounded_preceding" and k1 == "current":
+                return part_first, peer_last
+            if k0 == "unbounded_preceding" and k1 == "unbounded_following":
+                return part_first, part_last
+            raise ExecutorError("RANGE frames with offsets not supported")
+
+        def bound(kind_off):
+            kind, off = kind_off
+            if kind == "unbounded_preceding":
+                return part_first
+            if kind == "unbounded_following":
+                return part_last
+            if kind == "current":
+                return idx
+            if kind == "preceding":
+                return idx - off
+            return idx + off
+
+        # clamp start DOWN only / end UP only so frames entirely outside the
+        # partition stay EMPTY (fs > fe) instead of absorbing edge rows
+        fs = np.maximum(bound(self.frame.start), part_first)
+        fe = np.minimum(bound(self.frame.end), part_last)
+        return fs, fe
+
+    def _one_func(self, f: WindowFuncDesc, whole, idx, new_part, new_peer,
+                  part_first, part_last, peer_first, peer_last, n_part, rn):
+        name = f.name
+        n = whole.num_rows
+        ft = f.ftype
+
+        if name == "row_number":
+            return Column(ft, rn)
+        if name == "rank":
+            return Column(ft, peer_first - part_first + 1)
+        if name == "dense_rank":
+            cum = np.cumsum(new_peer.astype(np.int64))
+            return Column(ft, cum - cum[part_first] + 1)
+        if name == "percent_rank":
+            r = (peer_first - part_first).astype(np.float64)
+            denom = np.maximum(n_part - 1, 1).astype(np.float64)
+            return Column(ft, np.where(n_part > 1, r / denom, 0.0))
+        if name == "cume_dist":
+            return Column(
+                ft, (peer_last - part_first + 1) / n_part.astype(np.float64)
+            )
+        if name == "ntile":
+            if not f.args or not isinstance(f.args[0], Constant):
+                raise ExecutorError("NTILE requires a constant bucket count")
+            k = int(f.args[0].value)
+            if k <= 0:
+                raise ExecutorError("NTILE bucket count must be > 0")
+            size = n_part // k
+            rem = n_part % k
+            pos = rn - 1
+            cut = rem * (size + 1)
+            big = pos // np.maximum(size + 1, 1)
+            small = rem + (pos - cut) // np.maximum(size, 1)
+            return Column(ft, np.where(
+                n_part < k, pos + 1, np.where(pos < cut, big, small) + 1
+            ))
+
+        if name in ("lead", "lag"):
+            off = 1
+            default = None
+            if len(f.args) > 1 and isinstance(f.args[1], Constant):
+                off = int(f.args[1].value)
+            if len(f.args) > 2 and isinstance(f.args[2], Constant):
+                default = f.args[2].value
+            v = f.args[0].eval(whole)
+            shift = off if name == "lead" else -off
+            src = idx + shift
+            ok = (src >= part_first) & (src <= part_last)
+            src_c = np.clip(src, 0, n - 1)
+            data = v.data[src_c].copy()
+            valid = ok & v.validity()[src_c]
+            if default is not None:
+                if v.data.dtype == object:
+                    data[~ok] = str(default)
+                else:
+                    data = np.where(ok, data, default)
+                valid = valid | ~ok
+            return Column(ft, data, valid)
+
+        fs, fe = self._frame_bounds(idx, part_first, part_last, peer_last)
+
+        if name in ("first_value", "last_value", "nth_value"):
+            v = f.args[0].eval(whole)
+            if name == "first_value":
+                src = fs
+                ok = fs <= fe
+            elif name == "last_value":
+                src = fe
+                ok = fs <= fe
+            else:
+                if len(f.args) < 2 or not isinstance(f.args[1], Constant):
+                    raise ExecutorError("NTH_VALUE requires a constant n")
+                k = int(f.args[1].value)
+                src = fs + (k - 1)
+                ok = src <= fe
+            src_c = np.clip(src, 0, n - 1)
+            data = v.data[src_c]
+            if v.data.dtype == object:
+                data = data.copy()
+            valid = np.where(ok, v.validity()[src_c], False)
+            return Column(ft, data, valid)
+
+        # ---- frame aggregates ----------------------------------------
+        # empty frames (fs > fe at partition edges) must yield 0/NULL;
+        # clip prefix-sum indices so they stay in range either way
+        fs_i = np.clip(fs, 0, n)
+        fe_i = np.clip(fe + 1, 0, n)
+        if name == "count":
+            if f.args:
+                v = f.args[0].eval(whole)
+                flags = v.validity().astype(np.int64)
+            else:
+                flags = np.ones(n, dtype=np.int64)
+            pre = np.concatenate([[0], np.cumsum(flags)])
+            return Column(ft, np.maximum(pre[fe_i] - pre[fs_i], 0))
+        if name in ("sum", "avg"):
+            from ..expr.builtins import cast_vec
+            from ..expr.aggregation import sum_type
+
+            v = f.args[0].eval(whole)
+            st = sum_type(f.args[0].ftype)
+            sv = cast_vec(v, st)
+            vals = np.where(sv.validity(), sv.data, 0)
+            pre = np.concatenate([[0], np.cumsum(vals)])
+            s = np.where(fs <= fe, pre[fe_i] - pre[fs_i], 0)
+            cflags = v.validity().astype(np.int64)
+            cpre = np.concatenate([[0], np.cumsum(cflags)])
+            cnt = np.maximum(cpre[fe_i] - cpre[fs_i], 0)
+            cnt = np.where(fs <= fe, cnt, 0)
+            if name == "sum":
+                if ft.kind == TypeKind.FLOAT:
+                    return Column(ft, s.astype(np.float64), cnt > 0)
+                return Column(ft, s.astype(np.int64), cnt > 0)
+            safe = np.maximum(cnt, 1)
+            if ft.kind == TypeKind.FLOAT:
+                return Column(ft, s / safe, cnt > 0)
+            up = ft.scale - st.scale
+            num = s.astype(np.int64) * (10 ** max(up, 0))
+            sign = np.sign(num)
+            return Column(ft, sign * ((np.abs(num) + safe // 2) // safe),
+                          cnt > 0)
+        if name in ("min", "max"):
+            v = f.args[0].eval(whole)
+            valid = v.validity()
+            cumulative = bool((fs == part_first).all())
+            data = np.empty(n, dtype=v.data.dtype)
+            ovalid = np.zeros(n, dtype=np.bool_)
+            starts = np.flatnonzero(new_part)
+            bnds = np.append(starts, n)
+            is_min = name == "min"
+            for b in range(len(starts)):
+                lo, hi = bnds[b], bnds[b + 1]
+                pvals = v.data[lo:hi]
+                pvalid = valid[lo:hi]
+                if cumulative and bool((fe[lo:hi] == peer_last[lo:hi]).all()):
+                    acc = None
+                    seen = False
+                    for i in range(hi - lo):
+                        if pvalid[i]:
+                            x = pvals[i]
+                            acc = x if not seen else (
+                                min(acc, x) if is_min else max(acc, x)
+                            )
+                            seen = True
+                        data[lo + i] = acc if seen else 0
+                        ovalid[lo + i] = seen
+                    # broadcast to peers (RANGE frames include later peers)
+                    pe = peer_last[lo:hi]
+                    data[lo:hi] = data[pe]
+                    ovalid[lo:hi] = ovalid[pe]
+                else:
+                    for i in range(hi - lo):
+                        a, bnd = fs[lo + i] - lo, fe[lo + i] - lo
+                        if a > bnd:
+                            continue  # empty frame -> NULL
+                        seg = pvals[max(a, 0):bnd + 1]
+                        segv = pvalid[max(a, 0):bnd + 1]
+                        if segv.any():
+                            vv = seg[segv]
+                            data[lo + i] = vv.min() if is_min else vv.max()
+                            ovalid[lo + i] = True
+            return Column(ft, data, ovalid)
+        raise ExecutorError(f"window function {name!r} not implemented")

@@ -57,10 +57,26 @@ class UnaryOp(Expr):
 
 
 @dataclass
+class FrameBound(Node):
+    kind: str  # unbounded_preceding|preceding|current|following|unbounded_following
+    offset: int = 0
+
+
+@dataclass
+class WindowSpec(Node):
+    partition_by: List["Expr"] = field(default_factory=list)
+    order_by: List["OrderItem"] = field(default_factory=list)
+    unit: str = ""  # "", "rows", "range"
+    start: Optional[FrameBound] = None
+    end: Optional[FrameBound] = None
+
+
+@dataclass
 class FuncCall(Expr):
     name: str  # lowercase
     args: List[Expr]
     distinct: bool = False  # COUNT(DISTINCT x)
+    over: Optional[WindowSpec] = None  # window function when set
 
 
 @dataclass

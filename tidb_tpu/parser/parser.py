@@ -556,7 +556,8 @@ class Parser:
         if self.at_op("*") and name == "count":
             self.next()
             self.expect_op(")")
-            return ast.FuncCall("count", [ast.Star()])
+            return ast.FuncCall("count", [ast.Star()],
+                                over=self._maybe_over())
         if self.accept_kw("distinct"):
             distinct = True
         if not self.at_op(")"):
@@ -569,7 +570,57 @@ class Parser:
             if self.accept_kw("for"):
                 args.append(self.parse_expr())
         self.expect_op(")")
-        return ast.FuncCall(name, args, distinct)
+        return ast.FuncCall(name, args, distinct, over=self._maybe_over())
+
+    def _maybe_over(self):
+        """`OVER ([PARTITION BY ...] [ORDER BY ...] [ROWS|RANGE frame])`."""
+        if not self.accept_kw("over"):
+            return None
+        self.expect_op("(")
+        spec = ast.WindowSpec()
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            spec.partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                spec.partition_by.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                spec.order_by.append(ast.OrderItem(e, desc))
+                if not self.accept_op(","):
+                    break
+        if self.at_kw("rows") or self.at_kw("range"):
+            spec.unit = self.next().value.lower()
+            if self.accept_kw("between"):
+                spec.start = self._frame_bound()
+                self.expect_kw("and")
+                spec.end = self._frame_bound()
+            else:
+                spec.start = self._frame_bound()
+                spec.end = ast.FrameBound("current")
+        self.expect_op(")")
+        return spec
+
+    def _frame_bound(self) -> "ast.FrameBound":
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ast.FrameBound("unbounded_preceding")
+            self.expect_kw("following")
+            return ast.FrameBound("unbounded_following")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ast.FrameBound("current")
+        n = int(self.next().value)
+        if self.accept_kw("preceding"):
+            return ast.FrameBound("preceding", n)
+        self.expect_kw("following")
+        return ast.FrameBound("following", n)
 
     def _parse_case(self) -> ast.Expr:
         self.expect_kw("case")

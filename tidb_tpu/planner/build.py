@@ -269,6 +269,21 @@ class PlanBuilder:
 
         aggs: List[AggDesc] = []
         agg_uid_of: dict = {}
+        windows: List[dict] = []
+
+        def window_collector(name, args, partition, order, spec):
+            from ..executor.window import WINDOW_FUNCS, window_ftype
+
+            if name not in WINDOW_FUNCS:
+                raise PlanError(f"unknown window function {name!r}")
+            ft = window_ftype(name, args)
+            uid = next_uid()
+            windows.append({
+                "uid": uid, "name": name, "args": args,
+                "partition": partition, "order": order, "spec": spec,
+                "ftype": ft,
+            })
+            return ColumnExpr(-1, ft, f"{name}(..) over(..)", uid)
 
         def agg_collector(name, args, distinct):
             key = (name, tuple(str(a) for a in args), distinct)
@@ -283,7 +298,8 @@ class PlanBuilder:
 
         sub_handler = self._mk_subquery_handler(p.schema, outer)
         eb = ExprBuilder(p.schema, agg_collector if has_agg else None,
-                         sub_handler, outer, self.param_values)
+                         sub_handler, outer, self.param_values,
+                         window_collector=window_collector)
 
         field_exprs: List[Expression] = []
         field_names: List[str] = []
@@ -333,6 +349,8 @@ class PlanBuilder:
             def patch(e: Expression) -> Expression:
                 # rewrite post-agg exprs onto the agg output schema
                 if isinstance(e, ColumnExpr):
+                    if any(w["uid"] == e.unique_id for w in windows):
+                        return e  # window output, computed above the agg
                     if e.unique_id in group_uids or \
                             e.unique_id in [u for u, _ in agg_uid_of.values()]:
                         return e
@@ -364,8 +382,13 @@ class PlanBuilder:
                                             ExprBuilder(p.schema, agg_collector,
                                                         sub_handler, outer,
                                                         self.param_values,
-                                                        alias_fields=amap))
+                                                        alias_fields=amap,
+                                                        window_collector=window_collector))
             order_items = [(patch(e), d) for e, d in order_items]
+            for w in windows:
+                w["args"] = [patch(a) for a in w["args"]]
+                w["partition"] = [patch(x) for x in w["partition"]]
+                w["order"] = [(patch(e), d) for e, d in w["order"]]
 
             agg_schema = Schema(
                 group_schema_cols + [
@@ -388,7 +411,12 @@ class PlanBuilder:
             order_items = self._build_order(
                 sel.order_by, field_names, field_exprs, p.schema,
                 ExprBuilder(p.schema, None, sub_handler, outer,
-                            self.param_values, alias_fields=amap))
+                            self.param_values, alias_fields=amap,
+                            window_collector=window_collector))
+
+        # ---- window operators (one per distinct spec) -----------------
+        if windows:
+            p = self._attach_windows(p, windows)
 
         # ---- ORDER BY placement ---------------------------------------
         if order_items and not sel.distinct:
@@ -428,6 +456,49 @@ class PlanBuilder:
         elif sel.limit is not None and not order_items:
             p = LogicalLimit(p, sel.limit, sel.offset)
 
+        return p
+
+    def _attach_windows(self, p: LogicalPlan, windows: List[dict]):
+        from ..executor.window import Frame, WindowFuncDesc
+        from .logical import LogicalWindow
+
+        def frame_of(spec) -> Frame:
+            if not spec.unit:
+                return Frame()
+            s = (spec.start.kind, spec.start.offset) if spec.start else \
+                ("unbounded_preceding", 0)
+            e = (spec.end.kind, spec.end.offset) if spec.end else \
+                ("current", 0)
+            return Frame(spec.unit, s, e)
+
+        def expr_key(e):
+            # uid-aware structural key: same-named columns from different
+            # tables must NOT collide (str() is display-only)
+            uids: set = set()
+            e.collect_columns(uids)
+            return (str(e), tuple(sorted(uids)))
+
+        groups: dict = {}
+        for w in windows:
+            fr = frame_of(w["spec"])
+            key = (
+                tuple(expr_key(x) for x in w["partition"]),
+                tuple((expr_key(e), d) for e, d in w["order"]),
+                (fr.unit, fr.start, fr.end),
+            )
+            groups.setdefault(key, []).append(w)
+        for key, ws in groups.items():
+            funcs = [
+                (w["uid"], WindowFuncDesc(w["name"], w["args"], w["ftype"]))
+                for w in ws
+            ]
+            fr = frame_of(ws[0]["spec"])
+            cols = list(p.schema.cols) + [
+                SchemaCol(w["uid"], f'{w["name"]}_over', w["ftype"])
+                for w in ws
+            ]
+            p = LogicalWindow(p, funcs, ws[0]["partition"], ws[0]["order"],
+                              fr, Schema(cols))
         return p
 
     def _build_order(self, order_by, field_names, field_exprs, schema,
@@ -669,6 +740,8 @@ def _root_uids(e: Expression) -> set:
 
 def _contains_agg(e: ast.Expr) -> bool:
     if isinstance(e, ast.FuncCall):
+        if e.over is not None:
+            return False  # window function, not an aggregate trigger
         if e.name.lower() in AGG_FUNCS:
             return True
         return any(_contains_agg(a) for a in e.args

@@ -82,7 +82,8 @@ class ExprBuilder:
                  outer_schemas: Optional[List[Schema]] = None,
                  param_values: Optional[list] = None,
                  fold_constants: bool = True,
-                 alias_fields: Optional[dict] = None):
+                 alias_fields: Optional[dict] = None,
+                 window_collector: Optional[Callable] = None):
         self.schema = schema
         self.agg_collector = agg_collector
         self.subquery_handler = subquery_handler
@@ -91,6 +92,7 @@ class ExprBuilder:
         self.fold = fold_constants
         # SELECT-alias fallback scope (HAVING/ORDER BY): name -> Expression
         self.alias_fields = alias_fields or {}
+        self.window_collector = window_collector
 
     # ------------------------------------------------------------------
     def build(self, e: ast.Expr) -> Expression:
@@ -204,6 +206,18 @@ class ExprBuilder:
 
     def _func(self, e: ast.FuncCall) -> Expression:
         name = e.name.lower()
+        if e.over is not None:
+            if self.window_collector is None:
+                raise PlanError(
+                    f"window function {name}() not allowed in this context"
+                )
+            args = [self._build(a) for a in e.args
+                    if not isinstance(a, ast.Star)]
+            partition = [self._build(x) for x in e.over.partition_by]
+            order = [(self._build(it.expr), it.desc)
+                     for it in e.over.order_by]
+            return self.window_collector(name, args, partition, order,
+                                         e.over)
         if name in AGG_FUNCS:
             if self.agg_collector is None:
                 raise PlanError(f"aggregate {name}() not allowed here")

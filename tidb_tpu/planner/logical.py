@@ -121,10 +121,13 @@ class LogicalMaxOneRow(LogicalPlan):
 
 
 class LogicalWindow(LogicalPlan):
-    def __init__(self, child: LogicalPlan, window_funcs, partition_by,
+    """One window spec; funcs = [(uid, WindowFuncDesc)].  Output schema is
+    the child's columns followed by one column per window function."""
+
+    def __init__(self, child: LogicalPlan, funcs, partition_by,
                  order_by, frame, schema: Schema):
         super().__init__(schema, [child])
-        self.window_funcs = window_funcs
+        self.funcs = funcs
         self.partition_by = partition_by
         self.order_by = order_by
         self.frame = frame

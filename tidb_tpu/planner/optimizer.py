@@ -18,7 +18,12 @@ from .build import (
     UpdatePlan,
 )
 from .logical import LogicalPlan
-from .physical import PhysicalContext, PhysicalPlan, physical_for_stmt
+from .physical import (
+    PhysicalContext,
+    PhysicalPlan,
+    annotate_estimates,
+    physical_for_stmt,
+)
 from .rules import optimize_logical
 
 
@@ -39,4 +44,6 @@ def finish_plan(logical, pctx: PhysicalContext) -> PhysicalPlan:
         return physical_for_stmt(logical, pctx)
     assert isinstance(logical, LogicalPlan)
     logical = optimize_logical(logical)
-    return physical_for_stmt(logical, pctx)
+    phys = physical_for_stmt(logical, pctx)
+    annotate_estimates(phys, pctx)
+    return phys

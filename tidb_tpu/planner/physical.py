@@ -68,6 +68,7 @@ class PhysicalPlan:
         self.schema = schema
         self.children = children
         self.id = _next_plan_id()
+        self.est_rows: Optional[float] = None
 
     @property
     def name(self) -> str:
@@ -82,10 +83,14 @@ class PhysicalPlan:
     def build(self, ctx):
         raise NotImplementedError
 
+    def _est_str(self) -> str:
+        return f"{self.est_rows:.2f}" if self.est_rows is not None else ""
+
     def explain_tree(self, indent: int = 0, lines=None) -> List[str]:
         lines = lines if lines is not None else []
         pad = ("  " * indent + "└─") if indent else ""
-        lines.append((f"{pad}{self.name}_{self.id}", self.task(), self.info()))
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(),
+                      self.task(), self.info()))
         for c in self.children:
             c.explain_tree(indent + 1, lines)
         return lines
@@ -143,7 +148,8 @@ class PhysTableReader(PhysicalPlan):
     def explain_tree(self, indent: int = 0, lines=None):
         lines = lines if lines is not None else []
         pad = ("  " * indent + "└─") if indent else ""
-        lines.append((f"{pad}{self.name}_{self.id}", "root", self.info()))
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(), "root",
+                      self.info()))
         for i, ex in enumerate(self.dag.executors):
             pad2 = "  " * (indent + 1 + i) + "└─"
             nm = type(ex).__name__.replace("IR", "")
@@ -159,7 +165,7 @@ class PhysTableReader(PhysicalPlan):
                 info = f"limit:{ex.limit}"
             elif isinstance(ex, LimitIR):
                 info = f"limit:{ex.limit}"
-            lines.append((f"{pad2}{nm}", "cop[tpu]", info))
+            lines.append((f"{pad2}{nm}", "", "cop[tpu]", info))
         return lines
 
 
@@ -374,6 +380,28 @@ class PhysMaxOneRow(PhysicalPlan):
         return MaxOneRowExec(ctx, self.children[0].build(ctx), self.id)
 
 
+class PhysWindow(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, funcs, partition_by, order_by,
+                 frame, schema: Schema):
+        super().__init__(schema, [child])
+        self.funcs = funcs  # [(uid, WindowFuncDesc)] remapped
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.frame = frame
+
+    def info(self) -> str:
+        fns = ", ".join(f.name for _, f in self.funcs)
+        parts = ", ".join(str(p) for p in self.partition_by)
+        return f"funcs:[{fns}] partition:[{parts}]"
+
+    def build(self, ctx):
+        from ..executor.window import WindowExec
+
+        return WindowExec(ctx, self.children[0].build(ctx),
+                          [f for _, f in self.funcs], self.partition_by,
+                          self.order_by, self.frame, self.id)
+
+
 # ---------------------------------------------------------------------------
 # DML physical wrappers
 # ---------------------------------------------------------------------------
@@ -471,6 +499,7 @@ class PhysicalContext:
     dirty_tables: frozenset = frozenset()
     pushdown_blacklist: frozenset = frozenset()
     enable_pushdown: bool = True
+    stats: object = None  # StatsHandle
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
@@ -523,6 +552,29 @@ def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
     if isinstance(plan, LogicalMaxOneRow):
         child = to_physical(plan.children[0], pctx)
         return PhysMaxOneRow(child.schema, [child])
+
+    from ..executor.window import WindowFuncDesc
+    from .logical import LogicalWindow
+
+    if isinstance(plan, LogicalWindow):
+        child = to_physical(plan.children[0], pctx)
+        pos = child.schema.position_map()
+        funcs = [
+            (uid, WindowFuncDesc(
+                f.name, _remap(f.args, child.schema), f.ftype))
+            for uid, f in plan.funcs
+        ]
+        partition = _remap(plan.partition_by, child.schema)
+        order = [(e, d) for e, d in zip(
+            _remap([e for e, _ in plan.order_by], child.schema),
+            [d for _, d in plan.order_by])]
+        win_cols = {uid for uid, _ in plan.funcs}
+        out_schema = Schema(
+            list(child.schema.cols)
+            + [c for c in plan.schema.cols if c.uid in win_cols]
+        )
+        return PhysWindow(child, funcs, partition, order, plan.frame,
+                          out_schema)
 
     raise PlanError(f"no physical impl for {type(plan).__name__}")
 
@@ -710,27 +762,81 @@ def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
                         build_right, plan.schema)
 
 
+def _cop_selectivity(p: "PhysTableReader", conds, pctx) -> float:
+    """Histogram-backed selectivity for pushed conds; conds' ColumnExprs are
+    remapped (by uid) onto STORE column offsets for the stats lookup."""
+    if pctx.stats is None:
+        return 0.25 ** min(len(conds), 2)
+    offmap = {c.uid: c.store_offset for c in p.cop.scan_cols}
+    remapped = [c.remap_columns(offmap) for c in conds]
+    return pctx.stats.estimate_selectivity(p.cop.table.id, remapped)
+
+
 def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
     if isinstance(p, PhysTableReader):
+        st = pctx.stats.get(p.cop.table.id) if pctx.stats else None
         store = pctx.storage.table(p.cop.table.id)
-        rows = store.base_rows + len(store.delta)
+        rows = float(st.row_count if st else store.base_rows + len(store.delta))
         for ex in p.dag.executors[1:]:
             if isinstance(ex, SelectionIR):
-                rows *= 0.25
+                rows *= _cop_selectivity(p, ex.conditions, pctx)
             elif isinstance(ex, (TopNIR, LimitIR)):
                 rows = min(rows, ex.limit)
             elif isinstance(ex, AggregationIR):
-                rows = max(rows * 0.1, 1)
+                ndv = _group_ndv(p, ex, pctx)
+                rows = max(min(rows, ndv), 1)
         return rows
     if isinstance(p, (PhysSelection,)):
         return _est_rows(p.children[0], pctx) * 0.25
     if isinstance(p, (PhysLimit, PhysTopN)):
         return min(_est_rows(p.children[0], pctx), p.limit)
     if isinstance(p, PhysHashAgg):
+        if p.partial_input:
+            # child already emits one row per (shard, group); the final
+            # merge keeps roughly the group count
+            return max(_est_rows(p.children[0], pctx), 1)
         return max(_est_rows(p.children[0], pctx) * 0.1, 1)
-    if p.children:
+    if isinstance(p, PhysHashJoin):
+        l = _est_rows(p.children[0], pctx)
+        r = _est_rows(p.children[1], pctx)
+        if p.kind in ("semi", "anti_semi", "left_outer_semi"):
+            return l
+        return max(l, r)  # FK-join heuristic
+    if isinstance(p, PhysUnionScan):
+        store = pctx.storage.table(p.table.id)
+        return float(store.base_rows + len(store.delta))
+    if isinstance(p, PhysUnion):
         return sum(_est_rows(c, pctx) for c in p.children)
+    if p.children:
+        return _est_rows(p.children[0], pctx)
     return 1.0
+
+
+def _group_ndv(p: "PhysTableReader", agg_ir: AggregationIR, pctx) -> float:
+    if pctx.stats is None:
+        return 100.0
+    st = pctx.stats.get(p.cop.table.id)
+    if st is None:
+        return 100.0
+    ndv = 1.0
+    offmap = {i: c.store_offset for i, c in enumerate(p.cop.scan_cols)}
+    for g in agg_ir.group_by:
+        if isinstance(g, ColumnExpr) and g.index in offmap:
+            cs = st.columns.get(offmap[g.index])
+            ndv *= cs.ndv if cs else 100.0
+        else:
+            ndv *= 100.0
+    return ndv
+
+
+def annotate_estimates(p: PhysicalPlan, pctx: PhysicalContext):
+    """Fill est_rows on every node for EXPLAIN (stats.go row counts)."""
+    try:
+        p.est_rows = _est_rows(p, pctx)
+    except Exception:
+        p.est_rows = None
+    for c in p.children:
+        annotate_estimates(c, pctx)
 
 
 def _is_plain_col(e: Expression) -> bool:
@@ -762,6 +868,7 @@ def explain_text(p: PhysicalPlan) -> str:
     lines = p.explain_tree()
     w1 = max(len(l[0]) for l in lines) + 2
     w2 = max(len(l[1]) for l in lines) + 2
+    w3 = max(len(l[2]) for l in lines) + 2
     return "\n".join(
-        f"{a:<{w1}}{b:<{w2}}{c}" for a, b, c in lines
+        f"{a:<{w1}}{b:<{w2}}{c:<{w3}}{d}" for a, b, c, d in lines
     )

@@ -97,6 +97,22 @@ def prune_columns(plan: LogicalPlan, needed: Set[int]):
         for c in plan.children:
             prune_columns(c, set(c.schema.uids()))
         return
+    from .logical import LogicalWindow
+
+    if isinstance(plan, LogicalWindow):
+        child = plan.children[0]
+        win_uids = {uid for uid, _ in plan.funcs}
+        req = (needed - win_uids) & set(child.schema.uids())
+        for _, f in plan.funcs:
+            req |= _expr_uids(f.args)
+        req |= _expr_uids(plan.partition_by)
+        req |= _expr_uids([e for e, _ in plan.order_by])
+        prune_columns(child, req)
+        plan.schema = Schema(
+            list(child.schema.cols)
+            + [c for c in plan.schema.cols if c.uid in win_uids]
+        )
+        return
     for c in plan.children:
         prune_columns(c, needed)
 
@@ -195,6 +211,25 @@ def _ppd(plan: LogicalPlan, conds: List[Expression]):
             child = LogicalSelection(child, rest)
         plan.children = [child]
         return plan, []
+
+    from .logical import LogicalWindow
+
+    if isinstance(plan, LogicalWindow):
+        # only predicates on bare partition columns commute with a window
+        # (they remove whole partitions)
+        part_uids = set()
+        for e in plan.partition_by:
+            if isinstance(e, ColumnExpr):
+                part_uids.add(e.unique_id)
+        deeper, stay = [], []
+        for cond in conds:
+            uids = _expr_uids([cond])
+            (deeper if uids and uids <= part_uids else stay).append(cond)
+        child, rest = _ppd(plan.children[0], deeper)
+        if rest:
+            child = LogicalSelection(child, rest)
+        plan.children = [child]
+        return plan, stay
 
     if isinstance(plan, (LogicalTopN, LogicalLimit, LogicalMaxOneRow,
                          LogicalUnion, LogicalDual)):

@@ -11,6 +11,7 @@ import threading
 from typing import Dict, Optional
 
 from ..catalog import Catalog
+from ..statistics import StatsHandle
 from ..store.storage import BlockStorage
 from .vars import SessionVars
 
@@ -19,6 +20,8 @@ class Domain:
     def __init__(self, storage: Optional[BlockStorage] = None):
         self.storage = storage or BlockStorage()
         self.catalog = Catalog(self.storage)
+        self.stats = StatsHandle(self.storage)
+        self.catalog.on_table_dropped = self.stats.drop
         self.global_vars: Dict[str, str] = {}
         self._mu = threading.RLock()
         self._conn_counter = 0
@@ -47,6 +50,16 @@ class Domain:
         s = self.sessions.get(conn_id)
         if s is not None:
             s.kill()
+
+    def maybe_auto_analyze(self, table_ids):
+        """Post-DML auto-analyze check (update.go:621-639 analog, run inline
+        instead of on a background ticker)."""
+        for tid in table_ids:
+            try:
+                if self.stats.need_auto_analyze(tid):
+                    self.stats.analyze_table(tid)
+            except Exception:
+                pass  # stats are advisory; never fail the statement
 
     def record_stmt(self, sql: str, dur_s: float, rows: int):
         with self._mu:

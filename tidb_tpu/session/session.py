@@ -229,6 +229,7 @@ class Session:
             dirty_tables=dirty,
             pushdown_blacklist=frozenset(),
             enable_pushdown=self.vars.get_bool("tidb_enable_pushdown"),
+            stats=self.domain.stats,
         )
 
     def _exec_ctx(self) -> ExecContext:
@@ -287,8 +288,11 @@ class Session:
                 phys = self._plan(stmt, params)
                 self.last_plan = phys
                 collect_all(phys.build(ctx))
+                touched = {tid for (tid, _h) in txn.buffer.keys()}
                 if auto:
                     self.commit()
+                if touched:
+                    self.domain.maybe_auto_analyze(touched)
                 return ResultSet(affected_rows=ctx.affected_rows,
                                  last_insert_id=ctx.last_insert_id,
                                  warnings=list(ctx.warnings))
@@ -321,15 +325,16 @@ class Session:
             if auto:
                 self.commit()
             rows = []
-            for nm, task, info in phys.explain_tree():
+            for nm, est, task, info in phys.explain_tree():
                 st = ctx.stats.get(_plan_id_of(nm))
                 extra = (f"rows:{st.rows} loops:{st.loops} "
                          f"time:{st.time_ns/1e6:.2f}ms") if st else ""
-                rows.append((nm, task, info, extra))
-            return ResultSet(headers=["id", "task", "info", "execution info"],
-                             rows=rows, is_query=True)
-        rows = [(nm, task, info) for nm, task, info in phys.explain_tree()]
-        return ResultSet(headers=["id", "task", "info"], rows=rows,
+                rows.append((nm, est, task, info, extra))
+            return ResultSet(
+                headers=["id", "estRows", "task", "info", "execution info"],
+                rows=rows, is_query=True)
+        rows = list(phys.explain_tree())
+        return ResultSet(headers=["id", "estRows", "task", "info"], rows=rows,
                          is_query=True)
 
     def _run_trace(self, s: ast.TraceStmt) -> ResultSet:
@@ -479,7 +484,8 @@ class Session:
             )
             store = self.domain.storage.table(t.id)
             for ci in range(store.n_cols):
-                store.column_stats(ci)  # warm min/max cache
+                store.column_stats(ci)  # warm min/max cache (device engine)
+            self.domain.stats.analyze_table(t.id)
         return ResultSet()
 
     def _run_split(self, s: ast.SplitRegionStmt) -> ResultSet:

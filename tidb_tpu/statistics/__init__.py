@@ -1,0 +1,7 @@
+from .handle import ColumnStats, StatsHandle, TableStats
+from .histogram import Bucket, CMSketch, FMSketch, Histogram
+
+__all__ = [
+    "StatsHandle", "TableStats", "ColumnStats",
+    "Histogram", "Bucket", "CMSketch", "FMSketch",
+]

@@ -1,0 +1,233 @@
+"""Statistics lifecycle: build on ANALYZE, cache per table version, feed the
+planner's row estimates.
+
+Reference: statistics/handle (load/update cache handle.go:148, auto-analyze
+NeedAnalyzeTable update.go:621-639), statistics/selectivity.go.
+
+The build path is columnar: ANALYZE pulls each column's base blocks (plus the
+delta overlay) and builds Histogram + CMSketch + null/NDV counts with numpy —
+the pushdown-ANALYZE shape of executor/analyze.go, minus the RPC hop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..types import TypeKind
+from .histogram import CMSketch, FMSketch, Histogram
+
+
+@dataclass
+class ColumnStats:
+    hist: Histogram
+    cms: Optional[CMSketch]
+    null_count: int
+    ndv: int
+
+
+@dataclass
+class TableStats:
+    table_id: int
+    version: int  # storage base_version + delta size at build time
+    row_count: int
+    columns: Dict[int, ColumnStats] = field(default_factory=dict)
+    build_time: float = 0.0
+    modify_count: int = 0
+
+
+class StatsHandle:
+    def __init__(self, storage):
+        self.storage = storage
+        self._cache: Dict[int, TableStats] = {}
+        self._mu = threading.RLock()
+        self.auto_analyze_ratio = 0.5
+
+    # ------------------------------------------------------------------
+    def analyze_table(self, table_id: int, n_buckets: int = 64) -> TableStats:
+        store = self.storage.table(table_id)
+        ts = self.storage.current_ts()
+        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+        dele = set(deleted)
+        n_base = store.base_rows
+        stats = TableStats(
+            table_id,
+            version=store.base_version * 1_000_003 + len(store.delta),
+            row_count=n_base - len(dele) + len(inserted),
+            build_time=time.time(),
+        )
+        for ci in range(store.n_cols):
+            meta = store.cols[ci]
+            chunk = store.base_chunk([ci], 0, n_base, decode_strings=False)
+            col = chunk.col(0)
+            data = col.data
+            valid = col.validity()
+            if dele:
+                keep = np.ones(n_base, dtype=np.bool_)
+                keep[list(dele)] = False
+                data, valid = data[keep], valid[keep]
+            vals = data[valid]
+            nulls = int((~valid).sum())
+            if inserted:
+                # fold committed delta rows in (strings -> dict codes)
+                dvals = []
+                for row in inserted.values():
+                    x = row[ci]
+                    if x is None:
+                        nulls += 1
+                        continue
+                    if meta.ftype.kind == TypeKind.STRING:
+                        code = store.encode_dict_const(ci, str(x)) \
+                            if meta.dictionary is not None else \
+                            hash(str(x)) & 0x7FFFFFFF
+                        dvals.append(code)
+                    else:
+                        dvals.append(x)
+                if dvals:
+                    vals = np.concatenate([
+                        vals.astype(np.float64, copy=False),
+                        np.asarray(dvals, dtype=np.float64),
+                    ])
+            if meta.ftype.kind == TypeKind.STRING and vals.dtype == object:
+                # shouldn't happen (dict-encoded), but guard
+                vals = np.array([hash(x) & 0x7FFFFFFF for x in vals],
+                                dtype=np.int64)
+            vals64 = vals.astype(np.float64, copy=False)
+            hist = Histogram.build(vals64, nulls, n_buckets)
+            cms = CMSketch()
+            if len(vals):
+                cms.insert_batch(vals.astype(np.int64, copy=False)
+                                 if vals.dtype != np.float64
+                                 else vals.view(np.int64))
+            stats.columns[ci] = ColumnStats(hist, cms, nulls, hist.ndv)
+        with self._mu:
+            self._cache[table_id] = stats
+        return stats
+
+    def drop(self, table_id: int):
+        with self._mu:
+            self._cache.pop(table_id, None)
+
+    def get(self, table_id: int) -> Optional[TableStats]:
+        with self._mu:
+            return self._cache.get(table_id)
+
+    # ------------------------------------------------------------------
+    def need_auto_analyze(self, table_id: int) -> bool:
+        """update.go:621-639 NeedAnalyzeTable: analyze when modified rows
+        exceed ratio * row_count or no stats exist for a non-empty table."""
+        store = self.storage.table(table_id)
+        st = self.get(table_id)
+        cur_rows = store.base_rows + len(store.delta)
+        if st is None:
+            return cur_rows > 0
+        cur_version = store.base_version * 1_000_003 + len(store.delta)
+        if cur_version == st.version:
+            return False
+        modified = abs(cur_rows - st.row_count) + len(store.delta)
+        return modified > max(st.row_count, 1) * self.auto_analyze_ratio
+
+    # ------------------------------------------------------------------
+    # selectivity (statistics/selectivity.go, simplified to per-conjunct
+    # independence like the reference's fallback path)
+    # ------------------------------------------------------------------
+    def estimate_selectivity(self, table_id: int, conds) -> float:
+        from ..expr.expression import ColumnExpr, Constant, ScalarFunc
+
+        st = self.get(table_id)
+        if st is None or st.row_count == 0:
+            return 0.25 ** min(len(conds), 2) if conds else 1.0
+        sel = 1.0
+        for c in conds:
+            sel *= self._cond_selectivity(st, c)
+        return max(min(sel, 1.0), 1e-6)
+
+    def _cond_selectivity(self, st: TableStats, cond) -> float:
+        from ..expr.expression import ColumnExpr, Constant, ScalarFunc
+
+        default = 0.8  # unknown predicate shapes barely filter
+        if not isinstance(cond, ScalarFunc):
+            return default
+        name = cond.name
+        if name in ("and",):
+            a, b = cond.args
+            return self._cond_selectivity(st, a) * self._cond_selectivity(st, b)
+        if name in ("or",):
+            a, b = cond.args
+            sa = self._cond_selectivity(st, a)
+            sb = self._cond_selectivity(st, b)
+            return min(sa + sb, 1.0)
+        col, const, flipped = _col_const(cond)
+        if col is None:
+            return 0.25 if name in ("=", "<", "<=", ">", ">=", "in",
+                                    "like") else default
+        # callers remap ColumnExpr.index to the STORE column offset before
+        # asking for selectivity (see planner/physical._selectivity)
+        cs = st.columns.get(col.index)
+        if cs is None or cs.hist.row_count() == 0:
+            return 0.25
+        total = float(cs.hist.row_count())
+        x = _const_as_float(const)
+        if x is None:
+            return 0.25
+        op = name if not flipped else _FLIP.get(name, name)
+        h = cs.hist
+        if op == "=":
+            # point predicates: Count-Min beats the histogram's in-bucket
+            # average when the value is an integer representation
+            v = const.value
+            if cs.cms is not None and cs.cms.count > 0 and \
+                    isinstance(v, int):
+                return min(cs.cms.query(v) / total, 1.0)
+            return min(h.equal_row_count(x) / total, 1.0)
+        if op == "!=":
+            return max(1.0 - h.equal_row_count(x) / total, 0.0)
+        if op == "<":
+            return min(h.less_row_count(x) / total, 1.0)
+        if op == "<=":
+            return min((h.less_row_count(x) + h.equal_row_count(x)) / total, 1.0)
+        if op == ">":
+            return max(1.0 - (h.less_row_count(x) + h.equal_row_count(x))
+                       / total, 0.0)
+        if op == ">=":
+            return max(1.0 - h.less_row_count(x) / total, 0.0)
+        if op == "isnull":
+            return cs.null_count / total
+        if op == "isnotnull":
+            return 1.0 - cs.null_count / total
+        return default
+
+
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _col_const(cond):
+    from ..expr.expression import ColumnExpr, Constant
+
+    if cond.name in ("isnull", "isnotnull") and len(cond.args) == 1 and \
+            isinstance(cond.args[0], ColumnExpr):
+        return cond.args[0], Constant(0, None), False
+    if len(getattr(cond, "args", ())) != 2:
+        return None, None, False
+    a, b = cond.args
+    if isinstance(a, ColumnExpr) and isinstance(b, Constant):
+        return a, b, False
+    if isinstance(b, ColumnExpr) and isinstance(a, Constant):
+        return b, a, True
+    return None, None, False
+
+
+def _const_as_float(c) -> Optional[float]:
+    v = getattr(c, "value", None)
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        ft = getattr(c, "ftype", None)
+        if ft is not None and getattr(ft, "kind", None) == TypeKind.DECIMAL:
+            return float(v)  # scaled-int repr matches stored values
+        return float(v)
+    return None
