@@ -1,0 +1,227 @@
+"""TPC-H golden suite: the explaintest analog (SURVEY.md §4 carry-over).
+
+Every query runs on BOTH engines — device (jax) and host oracle (numpy) —
+and the result sets must be identical (the north-star's result-identity
+requirement).  Data is synthetic TPC-H-shaped at a tiny scale factor,
+deterministic, loaded through the columnar bulk path with multi-region
+splits so the DP fan-out is exercised.
+
+Reference: cmd/explaintest/t/tpch.test (golden TPC-H plans).
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain
+from tidb_tpu.types.values import parse_date
+
+N_LINE = 8000
+N_ORDERS = 2000
+N_CUST = 300
+N_PART = 200
+N_SUPP = 40
+N_NATION = 25
+
+
+@pytest.fixture(scope="module")
+def sess():
+    d = Domain()
+    s = d.new_session()
+    rng = np.random.default_rng(1234)
+    base = parse_date("1992-01-01")
+    span = parse_date("1998-12-01") - base
+
+    def load(name, ddl, arrays):
+        s.execute(ddl)
+        t = d.catalog.info_schema().table("test", name)
+        store = d.storage.table(t.id)
+        store.bulk_load_arrays(arrays, ts=d.storage.current_ts())
+        d.storage.regions.split_even(t.id, 4, store.base_rows)
+        return t
+
+    load("nation", "create table nation (n_nationkey bigint, n_name "
+         "varchar(25), n_regionkey bigint)", [
+        np.arange(N_NATION, dtype=np.int64),
+        np.array([f"NATION{i:02d}" for i in range(N_NATION)], dtype=object),
+        rng.integers(0, 5, N_NATION, dtype=np.int64),
+    ])
+    load("supplier", "create table supplier (s_suppkey bigint, s_name "
+         "varchar(25), s_nationkey bigint, s_acctbal double)", [
+        np.arange(N_SUPP, dtype=np.int64),
+        np.array([f"SUPP{i:04d}" for i in range(N_SUPP)], dtype=object),
+        rng.integers(0, N_NATION, N_SUPP, dtype=np.int64),
+        np.round(rng.uniform(-999, 9999, N_SUPP), 2),
+    ])
+    load("customer", "create table customer (c_custkey bigint, c_name "
+         "varchar(25), c_nationkey bigint, c_mktsegment varchar(10), "
+         "c_acctbal double)", [
+        np.arange(N_CUST, dtype=np.int64),
+        np.array([f"CUST{i:05d}" for i in range(N_CUST)], dtype=object),
+        rng.integers(0, N_NATION, N_CUST, dtype=np.int64),
+        np.array(["BUILDING", "MACHINERY", "AUTOMOBILE", "HOUSEHOLD",
+                  "FURNITURE"], dtype=object)[rng.integers(0, 5, N_CUST)],
+        np.round(rng.uniform(-999, 9999, N_CUST), 2),
+    ])
+    load("part", "create table part (p_partkey bigint, p_name varchar(30), "
+         "p_type varchar(25), p_size bigint)", [
+        np.arange(N_PART, dtype=np.int64),
+        np.array([f"PART{i:05d}" for i in range(N_PART)], dtype=object),
+        np.array(["PROMO BRUSHED", "STANDARD POLISHED", "SMALL PLATED",
+                  "MEDIUM BURNISHED"], dtype=object)[
+            rng.integers(0, 4, N_PART)],
+        rng.integers(1, 50, N_PART, dtype=np.int64),
+    ])
+    odate = (base + rng.integers(0, span, N_ORDERS)).astype(np.int32)
+    load("orders", "create table orders (o_orderkey bigint, o_custkey "
+         "bigint, o_orderstatus varchar(1), o_totalprice double, "
+         "o_orderdate date, o_orderpriority varchar(15))", [
+        np.arange(N_ORDERS, dtype=np.int64),
+        # leave the top 60 custkeys order-less so NOT IN subqueries hit
+        rng.integers(0, N_CUST - 60, N_ORDERS, dtype=np.int64),
+        np.array(["O", "F", "P"], dtype=object)[
+            rng.integers(0, 3, N_ORDERS)],
+        np.round(rng.uniform(1000, 400000, N_ORDERS), 2),
+        odate,
+        np.array(["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+                  "5-LOW"], dtype=object)[rng.integers(0, 5, N_ORDERS)],
+    ])
+    okeys = rng.integers(0, N_ORDERS, N_LINE, dtype=np.int64)
+    sdate = odate[okeys] + rng.integers(1, 120, N_LINE).astype(np.int32)
+    load("lineitem", "create table lineitem (l_orderkey bigint, l_partkey "
+         "bigint, l_suppkey bigint, l_quantity decimal(15,2), "
+         "l_extendedprice double, l_discount double, l_tax double, "
+         "l_returnflag varchar(1), l_linestatus varchar(1), "
+         "l_shipdate date)", [
+        okeys,
+        rng.integers(0, N_PART, N_LINE, dtype=np.int64),
+        rng.integers(0, N_SUPP, N_LINE, dtype=np.int64),
+        rng.integers(100, 5100, N_LINE, dtype=np.int64),  # scaled .2
+        np.round(rng.uniform(900, 105000, N_LINE), 2),
+        np.round(rng.uniform(0.0, 0.1, N_LINE), 2),
+        np.round(rng.uniform(0.0, 0.08, N_LINE), 2),
+        np.array(["A", "N", "R"], dtype=object)[rng.integers(0, 3, N_LINE)],
+        np.array(["O", "F"], dtype=object)[rng.integers(0, 2, N_LINE)],
+        sdate,
+    ])
+    for t in ("lineitem", "orders", "customer"):
+        s.execute(f"analyze table {t}")
+    return s
+
+
+QUERIES = {
+    "q1": """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus""",
+    "q3": """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate
+order by revenue desc, o_orderkey
+limit 10""",
+    "q5": """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey
+  and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'
+group by n_name order by revenue desc""",
+    "q6": """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24""",
+    "q10": """
+select c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate >= date '1993-10-01' and o_orderdate < date '1994-01-01'
+  and l_returnflag = 'R'
+group by c_custkey, c_name
+order by revenue desc, c_custkey limit 20""",
+    "q12": """
+select o_orderpriority, count(*) as order_count,
+       sum(case when o_orderpriority = '1-URGENT' then 1 else 0 end) urgent
+from orders join lineitem on o_orderkey = l_orderkey
+where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+group by o_orderpriority order by o_orderpriority""",
+    "q13": """
+select c_count, count(*) as custdist from (
+  select c_custkey, count(o_orderkey) as c_count
+  from customer left join orders on c_custkey = o_custkey
+  group by c_custkey
+) c_orders
+group by c_count
+order by custdist desc, c_count desc limit 10""",
+    "q14": """
+select 100.00 * sum(case when p_type like 'PROMO%%'
+                         then l_extendedprice * (1 - l_discount)
+                         else 0 end) / sum(l_extendedprice * (1 - l_discount))
+       as promo_revenue
+from lineitem, part
+where l_partkey = p_partkey
+  and l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'""",
+    "q18": """
+select c_custkey, o_orderkey, o_totalprice, sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+    select l_orderkey from lineitem group by l_orderkey
+    having sum(l_quantity) > 100
+  )
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_custkey, o_orderkey, o_totalprice
+order by o_totalprice desc, o_orderkey limit 10""",
+    "q19": """
+select sum(l_extendedprice * (1 - l_discount)) as revenue
+from lineitem, part
+where p_partkey = l_partkey
+  and ((p_size >= 1 and p_size <= 15 and l_quantity >= 1)
+       or (p_size >= 16 and l_quantity >= 10))
+  and l_shipdate >= date '1994-01-01'""",
+    "q22_lite": """
+select c_mktsegment, count(*) as numcust, sum(c_acctbal) as totacctbal
+from customer
+where c_acctbal > (select avg(c_acctbal) from customer where c_acctbal > 0)
+  and c_custkey not in (select o_custkey from orders)
+group by c_mktsegment order by c_mktsegment""",
+}
+
+
+def _norm(rows):
+    out = []
+    for r in rows:
+        out.append(tuple(
+            round(v, 6) if isinstance(v, float) else v for v in r
+        ))
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_tpch_parity(sess, name):
+    sql = QUERIES[name]
+    sess.execute("set tidb_use_tpu = 1")
+    tpu = _norm(sess.query(sql))
+    sess.execute("set tidb_use_tpu = 0")
+    cpu = _norm(sess.query(sql))
+    assert tpu == cpu, f"{name}: engine mismatch"
+    if name not in ("q18",):
+        assert len(tpu) > 0, f"{name}: empty result"
+
+
+def test_q1_plan_pushes_agg(sess):
+    rows = sess.execute("explain " + QUERIES["q1"])[0].rows
+    cop = [r for r in rows if r[2] == "cop[tpu]"]
+    assert any("Aggregation" in r[0] for r in cop)
+    assert any("Selection" in r[0] for r in cop)

@@ -1,0 +1,119 @@
+"""Index readers: point get by key and index lookup.
+
+Reference: executor/point_get.go:87 (PointGet bypasses distsql),
+executor/distsql.go IndexLookUpReader (index worker fetches handles, table
+workers fetch rows).  Here the "index side" is a binary search over the
+table's sorted index (store/index.py) and the "table side" is a sparse
+block gather — plus the usual base+delta(+txn buffer) overlay.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..catalog import TableInfo
+from ..chunk import Chunk, Column
+from ..expr.expression import Expression, eval_bool_mask
+from ..planner.ranger import IndexRange
+from .base import ExecContext, Executor
+
+
+class IndexLookUpExec(Executor):
+    """fetch_offsets: store columns materialized for predicate evaluation
+    (out columns ∪ condition columns); out_pick: positions within the fetch
+    layout that form the output.  Conditions are remapped to the fetch
+    layout by the planner."""
+
+    def __init__(self, ctx, table: TableInfo, index_offsets: List[int],
+                 rng: IndexRange, fetch_offsets: List[int],
+                 out_pick: List[int], all_conds: List[Expression],
+                 residual_conds: List[Expression], plan_id: int = -1):
+        fetch_ftypes = [table.columns[o].ftype for o in fetch_offsets]
+        ftypes = [fetch_ftypes[i] for i in out_pick]
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.table = table
+        self.index_offsets = index_offsets
+        self.rng = rng
+        self.fetch_offsets = fetch_offsets
+        self.fetch_ftypes = fetch_ftypes
+        self.out_pick = out_pick
+        # all_conds (access + residual) re-checked on delta/buffer rows;
+        # residual_conds applied to base rows fetched via the index
+        self.all_conds = all_conds
+        self.residual_conds = residual_conds
+        self._batches: Optional[List[Chunk]] = None
+        self._pos = 0
+
+    def _open(self):
+        self._batches = None
+        self._pos = 0
+
+    def _next(self) -> Optional[Chunk]:
+        if self._batches is None:
+            self._batches = self._run()
+        if self._pos >= len(self._batches):
+            return None
+        c = self._batches[self._pos]
+        self._pos += 1
+        return c
+
+    # ------------------------------------------------------------------
+    def _run(self) -> List[Chunk]:
+        store = self.ctx.storage.table(self.table.id)
+        ts = self.ctx.snapshot_ts()
+        txn = self.ctx.txn
+        idx = store.indexes.get(store, self.index_offsets)
+        handles = idx.search_range(
+            self.rng.low_tuple(), self.rng.high_tuple(),
+            self.rng.low_open, self.rng.high_open,
+        )
+        # ---- overlay: any handle with a delta chain or txn-buffer entry
+        # is re-evaluated on the row-value path
+        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+        buffer = {}
+        if txn is not None:
+            for (tid, h), m in txn.buffer.items():
+                if tid == self.table.id:
+                    buffer[h] = m
+        overlay_handles = set(deleted) | set(inserted) | set(buffer)
+        if overlay_handles and len(handles):
+            mask = ~np.isin(handles, np.fromiter(
+                overlay_handles, dtype=np.int64, count=len(overlay_handles)
+            ))
+            handles = handles[mask]
+        out: List[Chunk] = []
+        n_rows = 0
+        if len(handles):
+            chunk = store.gather_chunk(self.fetch_offsets, np.sort(handles))
+            if self.residual_conds:
+                chunk = chunk.filter(
+                    eval_bool_mask(self.residual_conds, chunk)
+                )
+            if chunk.num_rows:
+                out.append(chunk.select(self.out_pick))
+                n_rows += chunk.num_rows
+        # ---- delta / buffer rows: evaluate ALL conds on materialized rows
+        rows = []
+        for h in sorted(set(inserted) | set(buffer)):
+            if h in buffer:
+                m = buffer[h]
+                if m.op != "put":
+                    continue
+                vals = m.values
+            else:
+                vals = inserted[h]
+            rows.append(tuple(vals[o] for o in self.fetch_offsets))
+        if rows:
+            cols = [
+                Column.from_values(ft, [r[i] for r in rows])
+                for i, ft in enumerate(self.fetch_ftypes)
+            ]
+            dchunk = Chunk(cols)
+            if self.all_conds:
+                dchunk = dchunk.filter(eval_bool_mask(self.all_conds, dchunk))
+            if dchunk.num_rows:
+                out.append(dchunk.select(self.out_pick))
+                n_rows += dchunk.num_rows
+        return out

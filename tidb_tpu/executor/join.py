@@ -62,6 +62,24 @@ def _key_matrix(chunk: Chunk, keys: List[Expression],
     return np.stack(cols, axis=1), null
 
 
+def _hash_combine(mat: np.ndarray) -> np.ndarray:
+    """Row hash over an int64 key matrix (vectorized splitmix chain).
+
+    Collisions are resolved by exact-key verification after match
+    expansion (hash-join-with-verification) — so multi-column probes cost
+    one vectorized hash instead of an np.unique(axis=0) per chunk."""
+    n = mat.shape[0]
+    if mat.shape[1] == 1:
+        return mat[:, 0]  # raw values are exact — no verification needed
+    h = np.zeros(n, dtype=np.uint64)
+    for j in range(mat.shape[1]):
+        x = mat[:, j].astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15) + h
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = x ^ (x >> np.uint64(31))
+    return h.view(np.int64)
+
+
 def _expand_matches(sorted_codes: np.ndarray, order: np.ndarray,
                     probe_codes: np.ndarray, probe_ok: np.ndarray):
     """All (probe_idx, build_idx) match pairs, vectorized."""
@@ -119,14 +137,7 @@ class HashJoinExec(Executor):
             bc = self.child(0).empty_chunk()
         self._build_chunk = bc
         mat, null = _key_matrix(bc, self.build_keys, self._str_dict)
-        # collapse key columns to one code per row via unique-rows
-        if bc.num_rows == 0:
-            codes = np.zeros(0, dtype=np.int64)
-        elif mat.shape[1] == 1:
-            codes = mat[:, 0]
-        else:
-            _, codes = np.unique(mat, axis=0, return_inverse=True)
-            codes = codes.astype(np.int64)
+        codes = _hash_combine(mat) if bc.num_rows else np.zeros(0, np.int64)
         # null keys never match: shunt them to a reserved unmatched bucket
         self._mat_multi = mat.shape[1] > 1
         self._build_mat = mat
@@ -138,25 +149,10 @@ class HashJoinExec(Executor):
 
     def _probe_codes(self, chunk: Chunk):
         mat, null = _key_matrix(chunk, self.probe_keys, self._str_dict)
-        if self._mat_multi:
-            # map probe key rows into the build row-code space
-            bmat = self._build_mat
-            if bmat.shape[0] == 0:
-                return np.full(chunk.num_rows, -1, dtype=np.int64), null
-            uniq, inv = np.unique(
-                np.concatenate([bmat, mat], axis=0), axis=0,
-                return_inverse=True,
-            )
-            inv = inv.astype(np.int64)
-            # recompute build codes in this combined space
-            bcodes = np.where(self._build_null, np.int64(-(1 << 62)),
-                              inv[: bmat.shape[0]])
-            order = np.argsort(bcodes, kind="stable")
-            self._order = order
-            self._sorted_codes = bcodes[order]
-            return inv[bmat.shape[0]:], null
-        return (mat[:, 0] if mat.shape[1] else
-                np.zeros(chunk.num_rows, dtype=np.int64)), null
+        self._probe_mat = mat
+        if mat.shape[1] == 0:
+            return np.zeros(chunk.num_rows, dtype=np.int64), null
+        return _hash_combine(mat), null
 
     # ---- probe phase ---------------------------------------------------
     def _next(self) -> Optional[Chunk]:
@@ -179,6 +175,14 @@ class HashJoinExec(Executor):
         probe_idx, build_idx, _ = _expand_matches(
             self._sorted_codes, self._order, codes, ok
         )
+        if self._mat_multi and len(probe_idx):
+            # hash collisions: verify exact key equality per pair
+            exact = np.ones(len(probe_idx), dtype=np.bool_)
+            for j in range(self._build_mat.shape[1]):
+                exact &= (self._build_mat[build_idx, j]
+                          == self._probe_mat[probe_idx, j])
+            probe_idx = probe_idx[exact]
+            build_idx = build_idx[exact]
         matched = np.zeros(pc.num_rows, dtype=np.bool_)
         if len(probe_idx):
             pairs = self._pair_chunk(pc, probe_idx, bc, build_idx)

@@ -1,0 +1,161 @@
+"""Native host kernels (C++ via ctypes), with transparent Python fallback.
+
+Build happens lazily on first import: g++ -O3 -shared into a cached .so next
+to the source (keyed on source mtime).  Absence of a toolchain degrades to
+the numpy fallbacks — behavior identical, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hashkit.cpp")
+_SO = os.path.join(_HERE, "_hashkit.so")
+
+_lib = None
+_lib_mu = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_mu:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.ht64_new.restype = ctypes.c_void_p
+            lib.ht64_new.argtypes = [ctypes.c_int64]
+            lib.ht64_free.argtypes = [ctypes.c_void_p]
+            lib.ht64_upsert.restype = ctypes.c_int64
+            lib.ht64_upsert.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.ht64_lookup.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.encode_i64_memcomparable.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.decode_i64_memcomparable.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class KeyTable:
+    """Shared factorization table: build side upserts, probe side looks up.
+
+    Native when possible; the numpy/dict fallback preserves semantics."""
+
+    def __init__(self, expected: int = 1024):
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ht64_new(int(max(expected, 16)))
+            if not self._h:
+                self._lib = None
+        if self._lib is None:
+            self._py: dict = {}
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and self._h:
+            self._lib.ht64_free(self._h)
+            self._h = None
+
+    def _bufs(self, keys: np.ndarray, valid: Optional[np.ndarray]):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        v = None
+        if valid is not None:
+            v = np.ascontiguousarray(valid, dtype=np.uint8)
+        return keys, v
+
+    def upsert(self, keys: np.ndarray,
+               valid: Optional[np.ndarray] = None) -> np.ndarray:
+        n = len(keys)
+        codes = np.empty(n, dtype=np.int64)
+        if self._lib is not None:
+            keys, v = self._bufs(keys, valid)
+            self._lib.ht64_upsert(
+                self._h, keys.ctypes.data, 0 if v is None else v.ctypes.data,
+                n, codes.ctypes.data,
+            )
+            return codes
+        d = self._py
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                codes[i] = -1
+                continue
+            k = int(keys[i])
+            c = d.get(k)
+            if c is None:
+                c = d[k] = len(d)
+            codes[i] = c
+        return codes
+
+    def lookup(self, keys: np.ndarray,
+               valid: Optional[np.ndarray] = None) -> np.ndarray:
+        n = len(keys)
+        codes = np.empty(n, dtype=np.int64)
+        if self._lib is not None:
+            keys, v = self._bufs(keys, valid)
+            self._lib.ht64_lookup(
+                self._h, keys.ctypes.data, 0 if v is None else v.ctypes.data,
+                n, codes.ctypes.data,
+            )
+            return codes
+        d = self._py
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                codes[i] = -1
+            else:
+                codes[i] = d.get(int(keys[i]), -1)
+        return codes
+
+
+def encode_i64_keys(arr: np.ndarray) -> bytes:
+    """Order-preserving (memcomparable) encoding of an int64 array."""
+    arr = np.ascontiguousarray(arr, dtype=np.int64)
+    lib = _load()
+    out = np.empty(len(arr) * 8, dtype=np.uint8)
+    if lib is not None:
+        lib.encode_i64_memcomparable(arr.ctypes.data, len(arr),
+                                     out.ctypes.data)
+        return out.tobytes()
+    u = (arr.astype(np.uint64) ^ np.uint64(1 << 63))
+    return u.byteswap().tobytes()
+
+
+def decode_i64_keys(data: bytes) -> np.ndarray:
+    n = len(data) // 8
+    lib = _load()
+    out = np.empty(n, dtype=np.int64)
+    if lib is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        lib.decode_i64_memcomparable(buf.ctypes.data, n, out.ctypes.data)
+        return out
+    u = np.frombuffer(data, dtype=np.uint64).byteswap()
+    return (u ^ np.uint64(1 << 63)).astype(np.int64)

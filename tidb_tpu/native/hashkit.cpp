@@ -1,0 +1,128 @@
+// Native host kernels for the root executor runtime.
+//
+// Reference rationale: the reference's performance-critical storage half is
+// native (TiKV/Rust, outside its repo); here the device compute path is
+// JAX/XLA and THIS file is the native runtime piece for host-side hot loops
+// the device cannot take: hash-join key factorization and memcomparable key
+// encoding (util/codec analog).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// Build: tidb_tpu/native/build.py (gcc -O3 -shared -fPIC, cached .so).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// Open-addressing hash table over int64 keys.  Factorizes `keys[n]` into
+// dense codes [0, n_distinct): codes_out[i] = dense id of keys[i].
+// Returns n_distinct, or -1 on allocation failure.
+//
+// The join build+probe both call this with a SHARED table handle so probe
+// keys map into the build key space (unseen probe keys get code -1).
+
+typedef struct {
+    int64_t *slots;   // key per slot
+    int64_t *codes;   // dense code per slot
+    uint64_t mask;    // capacity - 1
+    int64_t n;        // distinct count
+} ht64;
+
+static inline uint64_t mix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
+}
+
+static const int64_t EMPTY = INT64_MIN + 7;  // sentinel unlikely as key
+
+ht64 *ht64_new(int64_t expected) {
+    uint64_t cap = 16;
+    while (cap < (uint64_t)(expected * 2 + 1)) cap <<= 1;
+    ht64 *h = (ht64 *)malloc(sizeof(ht64));
+    if (!h) return nullptr;
+    h->slots = (int64_t *)malloc(cap * sizeof(int64_t));
+    h->codes = (int64_t *)malloc(cap * sizeof(int64_t));
+    if (!h->slots || !h->codes) { free(h->slots); free(h->codes); free(h); return nullptr; }
+    for (uint64_t i = 0; i < cap; i++) h->slots[i] = EMPTY;
+    h->mask = cap - 1;
+    h->n = 0;
+    return h;
+}
+
+void ht64_free(ht64 *h) {
+    if (!h) return;
+    free(h->slots);
+    free(h->codes);
+    free(h);
+}
+
+// insert-or-get codes for keys; valid[i]==0 rows get code -1.
+int64_t ht64_upsert(ht64 *h, const int64_t *keys, const uint8_t *valid,
+                    int64_t n, int64_t *codes_out) {
+    for (int64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) { codes_out[i] = -1; continue; }
+        int64_t k = keys[i];
+        uint64_t pos = mix64((uint64_t)k) & h->mask;
+        for (;;) {
+            int64_t s = h->slots[pos];
+            if (s == k) { codes_out[i] = h->codes[pos]; break; }
+            if (s == EMPTY) {
+                h->slots[pos] = k;
+                h->codes[pos] = h->n;
+                codes_out[i] = h->n;
+                h->n++;
+                break;
+            }
+            pos = (pos + 1) & h->mask;
+        }
+    }
+    return h->n;
+}
+
+// lookup-only: unseen keys -> -1 (probe side).
+void ht64_lookup(const ht64 *h, const int64_t *keys, const uint8_t *valid,
+                 int64_t n, int64_t *codes_out) {
+    for (int64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) { codes_out[i] = -1; continue; }
+        int64_t k = keys[i];
+        uint64_t pos = mix64((uint64_t)k) & h->mask;
+        for (;;) {
+            int64_t s = h->slots[pos];
+            if (s == k) { codes_out[i] = h->codes[pos]; break; }
+            if (s == EMPTY) { codes_out[i] = -1; break; }
+            pos = (pos + 1) & h->mask;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// memcomparable codec (util/codec analog): order-preserving encoding of
+// int64 keys so encoded byte strings sort like the integers (sign-flipped
+// big-endian).  Used by the KV checkpoint format and the wire protocol.
+// dst must hold 8*n bytes.
+void encode_i64_memcomparable(const int64_t *src, int64_t n, uint8_t *dst) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = (uint64_t)src[i] ^ 0x8000000000000000ull;
+        uint8_t *d = dst + i * 8;
+        d[0] = (uint8_t)(u >> 56); d[1] = (uint8_t)(u >> 48);
+        d[2] = (uint8_t)(u >> 40); d[3] = (uint8_t)(u >> 32);
+        d[4] = (uint8_t)(u >> 24); d[5] = (uint8_t)(u >> 16);
+        d[6] = (uint8_t)(u >> 8);  d[7] = (uint8_t)u;
+    }
+}
+
+void decode_i64_memcomparable(const uint8_t *src, int64_t n, int64_t *dst) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *s = src + i * 8;
+        uint64_t u = ((uint64_t)s[0] << 56) | ((uint64_t)s[1] << 48) |
+                     ((uint64_t)s[2] << 40) | ((uint64_t)s[3] << 32) |
+                     ((uint64_t)s[4] << 24) | ((uint64_t)s[5] << 16) |
+                     ((uint64_t)s[6] << 8) | (uint64_t)s[7];
+        dst[i] = (int64_t)(u ^ 0x8000000000000000ull);
+    }
+}
+
+}  // extern "C"

@@ -169,6 +169,48 @@ class PhysTableReader(PhysicalPlan):
         return lines
 
 
+class PhysIndexLookUp(PhysicalPlan):
+    """Index-range read: binary search the sorted index for handles, sparse
+    block gather for rows (root task, host path — the OLTP lane)."""
+
+    def __init__(self, schema: Schema, table: TableInfo, index_name: str,
+                 index_offsets, rng, all_conds, residual_conds,
+                 point_get: bool = False):
+        super().__init__(schema, [])
+        self.table = table
+        self.index_name = index_name
+        self.index_offsets = index_offsets
+        self.rng = rng
+        self.all_conds = all_conds
+        self.residual_conds = residual_conds
+        self.point_get = point_get
+
+    @property
+    def name(self) -> str:
+        return "PointGet" if self.point_get else "IndexLookUp"
+
+    def info(self) -> str:
+        r = self.rng
+        parts = [f"table:{self.table.name}", f"index:{self.index_name}"]
+        if r.eq_prefix:
+            parts.append(f"eq:{r.eq_prefix}")
+        if r.low is not None or r.high is not None:
+            lo = "(" if r.low_open else "["
+            hi = ")" if r.high_open else "]"
+            parts.append(f"range:{lo}{r.low}, {r.high}{hi}")
+        return ", ".join(parts)
+
+    def build(self, ctx):
+        from ..executor.index_reader import IndexLookUpExec
+
+        offsets = [c.store_offset for c in self.schema.cols]
+        return IndexLookUpExec(
+            ctx, self.table, list(self.index_offsets), self.rng,
+            offsets, list(range(len(offsets))), self.all_conds,
+            self.residual_conds, plan_id=self.id,
+        )
+
+
 class PhysUnionScan(PhysicalPlan):
     """Dirty-table scan merging the txn buffer (no pushdown)."""
 
@@ -631,6 +673,9 @@ def _start_cop(ds: LogicalDataSource, pctx: PhysicalContext):
 
 def _finish_datasource(ds: LogicalDataSource,
                        pctx: PhysicalContext) -> PhysicalPlan:
+    ix = _try_index_path(ds, pctx)
+    if ix is not None:
+        return ix
     task, residual = _start_cop(ds, pctx)
     if task is None:
         return PhysUnionScan(ds.schema, ds.table, list(ds.pushed_conds))
@@ -642,9 +687,78 @@ def _finish_datasource(ds: LogicalDataSource,
     return out
 
 
+def _try_index_path(ds: LogicalDataSource,
+                    pctx: PhysicalContext) -> Optional[PhysicalPlan]:
+    """Pick an index read over the device scan when the predicate pins a
+    unique key or stats say the range is very selective (find_best_task's
+    index-path choice, rule-based)."""
+    if not ds.pushed_conds or not ds.table.indexes:
+        return None
+    from .ranger import build_access_path
+
+    store = pctx.storage.table(ds.table.id)
+    by_name = {c.name.lower(): c for c in ds.schema.cols}
+    uid_to_off = {c.uid: c.store_offset for c in ds.schema.cols}
+    best = None  # (score, index, path)
+    for ix in ds.table.indexes:
+        uids = []
+        for cname in ix.columns:
+            sc = by_name.get(cname.lower())
+            if sc is None:
+                break  # column pruned away -> no conds reference it
+            uids.append(sc.uid)
+        if not uids:
+            continue
+        path = build_access_path(ds.pushed_conds, uids, uid_to_off, store)
+        if path is None:
+            continue
+        unique_full_eq = (
+            (ix.unique or ix.primary)
+            and path.rng.full_eq_depth == len(ix.columns)
+            and path.rng.low is None and path.rng.high is None
+        )
+        score = (2 if unique_full_eq else 0) + path.rng.full_eq_depth \
+            + (0.5 if path.rng.low is not None or path.rng.high is not None
+               else 0)
+        if best is None or score > best[0]:
+            best = (score, ix, path, unique_full_eq)
+    if best is None:
+        return None
+    _, ix, path, unique_full_eq = best
+    if not unique_full_eq:
+        # non-unique: only beat the device brute-force scan when stats say
+        # the range is tiny
+        if pctx.stats is None:
+            return None
+        offmap = {c.uid: c.store_offset for c in ds.schema.cols}
+        remapped = [c.remap_columns(offmap) for c in path.access_conds]
+        sel = pctx.stats.estimate_selectivity(ds.table.id, remapped)
+        total = store.base_rows + len(store.delta)
+        if pctx.stats.get(ds.table.id) is None or \
+                sel * total > max(1000.0, 0.05 * total):
+            return None
+    index_offsets = [store.col_index(c) for c in ix.columns[:max(
+        path.rng.full_eq_depth + (1 if path.rng.low is not None
+                                  or path.rng.high is not None else 0), 1)]]
+    pos = {c.uid: i for i, c in enumerate(ds.schema.cols)}
+    all_conds = [c.remap_columns(pos) for c in ds.pushed_conds]
+    residual = [c.remap_columns(pos) for c in path.residual_conds]
+    return PhysIndexLookUp(ds.schema, ds.table, ix.name, index_offsets,
+                           path.rng, all_conds, residual,
+                           point_get=unique_full_eq)
+
+
 def _physical_agg(plan: LogicalAggregation,
                   pctx: PhysicalContext) -> PhysicalPlan:
     child_l = plan.children[0]
+    # a pin-point index read beats the device scan for OLTP-shaped aggs
+    if isinstance(child_l, LogicalDataSource):
+        ix = _try_index_path(child_l, pctx)
+        if ix is not None:
+            gb = _remap(plan.group_by, ix.schema)
+            aggs = [a.remap_columns(ix.schema.position_map())
+                    for a in plan.aggs]
+            return PhysHashAgg(ix, gb, aggs, False, plan.schema)
     # direct cop-task child (DataSource or Selection(DataSource) already
     # collapsed by rules into ds.pushed_conds)
     if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
@@ -802,6 +916,19 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
         if p.kind in ("semi", "anti_semi", "left_outer_semi"):
             return l
         return max(l, r)  # FK-join heuristic
+    if isinstance(p, PhysIndexLookUp):
+        if p.point_get:
+            return 1.0
+        store = pctx.storage.table(p.table.id)
+        total = float(store.base_rows + len(store.delta))
+        if pctx.stats is not None:
+            offmap = {c.uid: c.store_offset for c in p.schema.cols}
+            remapped = [c.remap_columns(offmap) for c in p.all_conds]
+            return max(
+                pctx.stats.estimate_selectivity(p.table.id, remapped) * total,
+                1.0,
+            )
+        return max(total * 0.01, 1.0)
     if isinstance(p, PhysUnionScan):
         store = pctx.storage.table(p.table.id)
         return float(store.base_rows + len(store.delta))

@@ -1,0 +1,169 @@
+"""Range derivation: access conditions -> index key ranges.
+
+Reference: util/ranger (BuildTableRange ranger.go:282, points2Ranges :54)
+— splits a conjunction into access conditions (consumed by the index range)
+and residual filter conditions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+from ..types import TypeKind
+
+
+@dataclass
+class IndexRange:
+    """Bounds over a prefix of the index columns: eq_prefix values for the
+    leading columns, then an optional range on the next column."""
+
+    eq_prefix: List[object] = field(default_factory=list)
+    low: Optional[object] = None
+    high: Optional[object] = None
+    low_open: bool = False
+    high_open: bool = False
+
+    def low_tuple(self) -> Optional[tuple]:
+        if self.low is not None:
+            return tuple(self.eq_prefix) + (self.low,)
+        return tuple(self.eq_prefix) if self.eq_prefix else None
+
+    def high_tuple(self) -> Optional[tuple]:
+        if self.high is not None:
+            return tuple(self.eq_prefix) + (self.high,)
+        return tuple(self.eq_prefix) if self.eq_prefix else None
+
+    @property
+    def full_eq_depth(self) -> int:
+        return len(self.eq_prefix)
+
+
+@dataclass
+class AccessPath:
+    index_uids: List[int]  # uids of the index columns, in index order
+    rng: IndexRange
+    access_conds: List[Expression]
+    residual_conds: List[Expression]
+
+
+def _col_const(cond):
+    """(col, const, op) for col-op-const or const-op-col (op flipped)."""
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    if not isinstance(cond, ScalarFunc) or len(cond.args) != 2:
+        return None
+    a, b = cond.args
+    if cond.name not in flip:
+        return None
+    if isinstance(a, ColumnExpr) and isinstance(b, Constant):
+        return a, b, cond.name
+    if isinstance(b, ColumnExpr) and isinstance(a, Constant):
+        return b, a, flip[cond.name]
+    return None
+
+
+def _const_key(col: ColumnExpr, const: Constant, store, store_offset: int,
+               op: str):
+    """Constant -> (index key repr, effective op) for the column, or None
+    when the constant cannot be represented exactly (cond stays residual).
+    The effective op can differ from `op` when the bound is adjusted, e.g.
+    int_col > 10.5 becomes int_col >= 11 (closed bound!)."""
+    v = const.value
+    if v is None:
+        return None
+    kind = col.ftype.kind
+    if kind == TypeKind.STRING:
+        if not isinstance(v, str):
+            return None
+        meta = store.cols[store_offset]
+        if meta.dictionary is None:
+            return None
+        if op == "=":
+            code = store.encode_dict_const(store_offset, v)
+            return (code if code >= 0 else -1, "=")
+        side = "left" if op in (">=", "<") else "right"
+        # >=/<: first code with value >= v; >/<=: first code > v — the
+        # bound code is then used with CLOSED-low/OPEN-high semantics
+        code = store.dict_bound(store_offset, v, side)
+        eff = ">=" if op in (">", ">=") else "<"
+        return (code, eff)
+    if kind in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL, TypeKind.DATE,
+                TypeKind.DATETIME):
+        if isinstance(v, float):
+            if v != int(v):
+                import math
+
+                if op == "=":
+                    return None
+                # fractional bound: int_col > 10.5 == int_col >= 11;
+                # int_col < 2.5 == int_col <= 2 (bounds become CLOSED)
+                if op in ("<", "<="):
+                    return (math.floor(v), "<=")
+                return (math.ceil(v), ">=")
+            v = int(v)
+        return (int(v), op) if isinstance(v, int) else None
+    if kind == TypeKind.DECIMAL:
+        return (v, op) if isinstance(v, int) else None  # scaled-int repr
+    if kind == TypeKind.FLOAT:
+        return (float(v), op) if isinstance(v, (int, float)) else None
+    return None
+
+
+def build_access_path(conds: List[Expression], index_uids: List[int],
+                      uid_to_store_offset: dict, store) -> Optional[AccessPath]:
+    """Best-effort range over a prefix of `index_uids` from the conjuncts."""
+    eq_prefix: List[object] = []
+    used: List[Expression] = []
+    remaining = list(conds)
+    rng = IndexRange()
+
+    for depth, uid in enumerate(index_uids):
+        store_off = uid_to_store_offset[uid]
+        eq_val = None
+        eq_cond = None
+        lows, highs = [], []
+        for cond in remaining:
+            cc = _col_const(cond)
+            if cc is None:
+                continue
+            col, const, op = cc
+            if (col.unique_id if col.unique_id >= 0 else col.index) != uid:
+                continue
+            ke = _const_key(col, const, store, store_off, op)
+            if ke is None:
+                continue
+            key, eff = ke
+            if eff == "=":
+                eq_val, eq_cond = key, cond
+                break
+            if eff == ">":
+                lows.append((key, True, cond))
+            elif eff == ">=":
+                lows.append((key, False, cond))
+            elif eff == "<":
+                highs.append((key, True, cond))
+            elif eff == "<=":
+                highs.append((key, False, cond))
+        if eq_val is not None:
+            eq_prefix.append(eq_val)
+            used.append(eq_cond)
+            continue
+        # range on this column terminates the prefix walk
+        if lows:
+            key, open_, cond = max(lows, key=lambda t: t[0])
+            rng.low, rng.low_open = key, open_
+            used.append(cond)
+        if highs:
+            key, open_, cond = min(highs, key=lambda t: t[0])
+            rng.high, rng.high_open = key, open_
+            used.append(cond)
+        break
+
+    if not eq_prefix and rng.low is None and rng.high is None:
+        return None
+    rng.eq_prefix = eq_prefix
+    # keep access conds in the residual set too when they were only
+    # approximate (string ranges via dict_bound are exact, so drop them)
+    residual = [c for c in conds if c not in used]
+    return AccessPath(index_uids, rng, used, residual)

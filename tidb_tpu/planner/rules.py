@@ -35,9 +35,44 @@ RULES = ("prune_columns", "push_predicates", "eliminate_projections",
 def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
     plan = push_predicates(plan)
     prune_columns(plan, set(plan.schema.uids()))
+    refresh_schemas(plan)
     plan = eliminate_projections(plan, top=True)
     plan = merge_limit_sort(plan)
     return plan
+
+
+def refresh_schemas(plan: LogicalPlan):
+    """Bottom-up schema rebuild after pruning: pass-through nodes captured
+    their child's Schema OBJECT at build time; pruning replaces children's
+    schemas, so stale references must be re-derived or physical remapping
+    sees pre-prune column positions."""
+    for c in plan.children:
+        refresh_schemas(c)
+    from .logical import LogicalWindow
+
+    if isinstance(plan, (LogicalSelection, LogicalSort, LogicalTopN,
+                         LogicalLimit, LogicalMaxOneRow)):
+        plan.schema = plan.children[0].schema
+    elif isinstance(plan, LogicalJoin):
+        if plan.kind in ("inner", "left_outer"):
+            plan.schema = Schema(
+                list(plan.children[0].schema.cols)
+                + list(plan.children[1].schema.cols)
+            )
+        else:  # semi kinds: output is the left child (+ flag col kept as-is)
+            if plan.kind == "left_outer_semi":
+                extra = plan.schema.cols[len(plan.schema.cols) - 1:]
+                plan.schema = Schema(
+                    list(plan.children[0].schema.cols) + list(extra)
+                )
+            else:
+                plan.schema = plan.children[0].schema
+    elif isinstance(plan, LogicalWindow):
+        win_uids = {uid for uid, _ in plan.funcs}
+        plan.schema = Schema(
+            list(plan.children[0].schema.cols)
+            + [c for c in plan.schema.cols if c.uid in win_uids]
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -164,6 +199,14 @@ def _ppd(plan: LogicalPlan, conds: List[Expression]):
                 lconds.append(cond)
             elif uids and uids <= ruids and plan.kind == "inner":
                 rconds.append(cond)
+            elif plan.kind == "inner":
+                # cross-table equality -> hash-join key (comma joins write
+                # their join conditions in WHERE)
+                pair = _as_join_eq(cond, luids, ruids)
+                if pair is not None:
+                    plan.eq_conds.append(pair)
+                else:
+                    stay.append(cond)
             else:
                 stay.append(cond)
         # ON other-conds referencing only the inner side of an inner join
@@ -252,6 +295,20 @@ def _ppd(plan: LogicalPlan, conds: List[Expression]):
         new_children.append(nc)
     plan.children = new_children
     return plan, conds
+
+
+def _as_join_eq(cond: Expression, luids: set, ruids: set):
+    """left_expr = right_expr over disjoint child column sets, or None."""
+    if isinstance(cond, ScalarFunc) and cond.name == "=" and \
+            len(cond.args) == 2:
+        a, b = cond.args
+        ua, ub = _expr_uids([a]), _expr_uids([b])
+        if ua and ub:
+            if ua <= luids and ub <= ruids:
+                return (a, b)
+            if ua <= ruids and ub <= luids:
+                return (b, a)
+    return None
 
 
 def _substitute(cond: Expression, sub: dict) -> Optional[Expression]:

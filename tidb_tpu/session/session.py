@@ -138,7 +138,12 @@ class Session:
         if self._txn is not None:
             txn, self._txn = self._txn, None
             self._in_txn = False
+            touched = {tid for (tid, _h) in txn.buffer.keys()}
             txn.commit()
+            if touched:
+                for tid in touched:
+                    self.domain.storage.maybe_compact(tid)
+                self.domain.maybe_auto_analyze(touched)
         else:
             self._in_txn = False
 
@@ -288,11 +293,8 @@ class Session:
                 phys = self._plan(stmt, params)
                 self.last_plan = phys
                 collect_all(phys.build(ctx))
-                touched = {tid for (tid, _h) in txn.buffer.keys()}
                 if auto:
-                    self.commit()
-                if touched:
-                    self.domain.maybe_auto_analyze(touched)
+                    self.commit()  # compaction/auto-analyze hooks run there
                 return ResultSet(affected_rows=ctx.affected_rows,
                                  last_insert_id=ctx.last_insert_id,
                                  warnings=list(ctx.warnings))

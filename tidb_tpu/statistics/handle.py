@@ -141,12 +141,16 @@ class StatsHandle:
         st = self.get(table_id)
         if st is None or st.row_count == 0:
             return 0.25 ** min(len(conds), 2) if conds else 1.0
+        try:
+            store = self.storage.table(table_id)
+        except Exception:
+            store = None
         sel = 1.0
         for c in conds:
-            sel *= self._cond_selectivity(st, c)
+            sel *= self._cond_selectivity(st, c, store)
         return max(min(sel, 1.0), 1e-6)
 
-    def _cond_selectivity(self, st: TableStats, cond) -> float:
+    def _cond_selectivity(self, st: TableStats, cond, store=None) -> float:
         from ..expr.expression import ColumnExpr, Constant, ScalarFunc
 
         default = 0.8  # unknown predicate shapes barely filter
@@ -155,11 +159,12 @@ class StatsHandle:
         name = cond.name
         if name in ("and",):
             a, b = cond.args
-            return self._cond_selectivity(st, a) * self._cond_selectivity(st, b)
+            return self._cond_selectivity(st, a, store) * \
+                self._cond_selectivity(st, b, store)
         if name in ("or",):
             a, b = cond.args
-            sa = self._cond_selectivity(st, a)
-            sb = self._cond_selectivity(st, b)
+            sa = self._cond_selectivity(st, a, store)
+            sb = self._cond_selectivity(st, b, store)
             return min(sa + sb, 1.0)
         col, const, flipped = _col_const(cond)
         if col is None:
@@ -171,10 +176,27 @@ class StatsHandle:
         if cs is None or cs.hist.row_count() == 0:
             return 0.25
         total = float(cs.hist.row_count())
+        op = name if not flipped else _FLIP.get(name, name)
+        v = const.value
+        if isinstance(v, str) and store is not None:
+            # stats are over dictionary codes; encode the literal using the
+            # EFFECTIVE (flip-adjusted) operator's bound side
+            meta = store.cols[col.index] if col.index < store.n_cols else None
+            if meta is None or meta.dictionary is None:
+                return 0.25
+            if op == "=":
+                v = store.encode_dict_const(col.index, v)
+                if v < 0:
+                    return 0.0
+            else:
+                v = store.dict_bound(
+                    col.index, v,
+                    "left" if op in ("<", ">=") else "right",
+                )
+            const = type(const)(v, const.ftype)
         x = _const_as_float(const)
         if x is None:
             return 0.25
-        op = name if not flipped else _FLIP.get(name, name)
         h = cs.hist
         if op == "=":
             # point predicates: Count-Min beats the histogram's in-bucket

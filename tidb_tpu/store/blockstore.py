@@ -76,6 +76,9 @@ class TableStore:
         # bumped on bulk load / compact: device caches key on this
         self.base_version = 0
         self._col_stats: Dict[int, Tuple[int, int, bool]] = {}
+        from .index import IndexManager
+
+        self.indexes = IndexManager()
 
     # ------------------------------------------------------------------
     # schema helpers

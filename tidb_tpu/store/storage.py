@@ -67,6 +67,24 @@ class BlockStorage(Storage):
     def get_client(self) -> "CoprClient":
         return self._client
 
+    def maybe_compact(self, table_id: int, threshold: int = 4096):
+        """Delta-merge when the row store outgrows the threshold (TiFlash's
+        delta-merge policy): folds committed delta into fresh base blocks so
+        scans stay columnar (and strings dictionary-encoded).  Skipped when
+        live locks exist.  NOTE: compaction advances base_ts, so snapshots
+        older than the merge no longer see the table — in-process sessions
+        take fresh timestamps per statement, and long-lived historical reads
+        are bounded by the GC safepoint exactly as in the reference.
+        """
+        t = self._tables.get(table_id)
+        if t is None or t.locks:
+            return
+        if len(t.delta) > max(threshold, t.base_rows // 10):
+            try:
+                t.compact(self.current_ts())
+            except KVError:
+                pass  # raced with a new lock; next DML retriggers
+
 
 class CoprClient(StoreClient):
     """The pushdown boundary implementation: fan a CopRequest out per region
