@@ -1,0 +1,249 @@
+"""MySQL wire protocol tests with a minimal in-repo client.
+
+Reference test model: server/conn_test.go + packetio tests — the client here
+speaks just enough protocol 4.1 (handshake response, COM_QUERY, COM_PING,
+COM_STMT_PREPARE/EXECUTE) to verify framing, result sets and errors.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from tidb_tpu.server import MySQLServer
+from tidb_tpu.server.packet import (
+    PacketReader,
+    PacketWriter,
+    read_lenenc_int,
+    read_lenenc_str,
+)
+from tidb_tpu.server import protocol as P
+
+
+class MiniClient:
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+
+    async def connect(self, db=""):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self.pr = PacketReader(self.reader)
+        self.pw = PacketWriter(self.writer)
+        greeting = await self.pr.recv()
+        assert greeting[0] == 10  # protocol version
+        caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+        if db:
+            caps |= P.CLIENT_CONNECT_WITH_DB
+        resp = struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+        resp += bytes([33]) + b"\x00" * 23
+        resp += b"root\x00" + b"\x00"  # user, empty auth
+        if db:
+            resp += db.encode() + b"\x00"
+        self.pw.seq = self.pr.seq
+        await self.pw.send(resp)
+        ok = await self.pr.recv()
+        assert ok[0] == 0x00, ok
+
+    async def command(self, cmd: int, payload: bytes = b""):
+        self.pw.reset_seq()
+        await self.pw.send(bytes([cmd]) + payload)
+
+    async def query(self, sql: str):
+        await self.command(0x03, sql.encode())
+        first = await self.pr.recv()
+        if first[0] == 0x00:  # OK
+            affected, pos = read_lenenc_int(first, 1)
+            return {"ok": True, "affected": affected}
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            return {"error": code, "message": first[9:].decode()}
+        ncols, _ = read_lenenc_int(first, 0)
+        cols = []
+        for _ in range(ncols):
+            cdef = await self.pr.recv()
+            pos = 0
+            vals = []
+            for _ in range(6):
+                v, pos = read_lenenc_str(cdef, pos)
+                vals.append(v)
+            cols.append(vals[4].decode())
+        eof = await self.pr.recv()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = await self.pr.recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            pos = 0
+            row = []
+            for _ in range(ncols):
+                if pkt[pos] == 0xFB:
+                    row.append(None)
+                    pos += 1
+                else:
+                    v, pos = read_lenenc_str(pkt, pos)
+                    row.append(v.decode())
+            rows.append(tuple(row))
+        return {"cols": cols, "rows": rows}
+
+    async def close(self):
+        await self.command(0x01)
+        self.writer.close()
+
+
+@pytest.fixture()
+def server_client():
+    async def setup():
+        srv = MySQLServer(port=0)
+        await srv.start()
+        cli = MiniClient(srv.host, srv.port)
+        await cli.connect(db="test")
+        return srv, cli
+
+    return setup
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_handshake_query_roundtrip(server_client):
+    async def body():
+        srv, cli = await server_client()
+        r = await cli.query("create table t (a bigint, b varchar(10))")
+        assert r.get("ok")
+        r = await cli.query("insert into t values (1, 'x'), (2, null)")
+        assert r["affected"] == 2
+        r = await cli.query("select a, b from t order by a")
+        assert r["cols"] == ["a", "b"]
+        assert r["rows"] == [("1", "x"), ("2", None)]
+        r = await cli.query("select count(*), sum(a) from t")
+        assert r["rows"] == [("2", "3")]
+        await cli.close()
+        await srv.stop()
+
+    run(body())
+
+
+def test_error_packet(server_client):
+    async def body():
+        srv, cli = await server_client()
+        r = await cli.query("select * from nosuchtable")
+        assert "error" in r
+        await cli.close()
+        await srv.stop()
+
+    run(body())
+
+
+def test_ping_and_init_db(server_client):
+    async def body():
+        srv, cli = await server_client()
+        await cli.command(0x0E)  # ping
+        ok = await cli.pr.recv()
+        assert ok[0] == 0x00
+        await cli.command(0x02, b"mysql")  # init_db
+        ok = await cli.pr.recv()
+        assert ok[0] == 0x00
+        await cli.close()
+        await srv.stop()
+
+    run(body())
+
+
+def test_prepared_statement_binary(server_client):
+    async def body():
+        srv, cli = await server_client()
+        await cli.query("create table p (a bigint, b varchar(10))")
+        await cli.query("insert into p values (1,'x'),(2,'y'),(3,'z')")
+        await cli.command(0x16, b"select b from p where a = ?")
+        resp = await cli.pr.recv()
+        assert resp[0] == 0x00
+        stmt_id = struct.unpack_from("<I", resp, 1)[0]
+        n_params = struct.unpack_from("<H", resp, 7)[0]
+        assert n_params == 1
+        for _ in range(n_params):
+            await cli.pr.recv()  # param defs
+        await cli.pr.recv()  # eof
+        # execute with long param = 2
+        payload = struct.pack("<I", stmt_id) + b"\x00" + struct.pack("<I", 1)
+        payload += b"\x00"  # null bitmap
+        payload += b"\x01"  # new params bound
+        payload += bytes([0x08, 0x00])  # longlong
+        payload += struct.pack("<q", 2)
+        await cli.command(0x17, payload)
+        first = await cli.pr.recv()
+        ncols, _ = read_lenenc_int(first, 0)
+        for _ in range(ncols):
+            await cli.pr.recv()
+        await cli.pr.recv()  # eof
+        rows = []
+        while True:
+            pkt = await cli.pr.recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            # binary-protocol row: 0x00 header, null bitmap, lenenc string
+            assert pkt[0] == 0x00
+            nb = (ncols + 9) // 8
+            v, _ = read_lenenc_str(pkt, 1 + nb)
+            rows.append(v.decode())
+        assert rows == ["y"]
+        # re-execute WITHOUT re-sending types (new_params_bound_flag = 0)
+        payload2 = struct.pack("<I", stmt_id) + b"\x00" + struct.pack("<I", 1)
+        payload2 += b"\x00"  # null bitmap
+        payload2 += b"\x00"  # new params bound = 0 -> reuse cached types
+        payload2 += struct.pack("<q", 3)
+        await cli.command(0x17, payload2)
+        first = await cli.pr.recv()
+        ncols, _ = read_lenenc_int(first, 0)
+        for _ in range(ncols):
+            await cli.pr.recv()
+        await cli.pr.recv()
+        rows2 = []
+        while True:
+            pkt = await cli.pr.recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            nb = (ncols + 9) // 8
+            v, _ = read_lenenc_str(pkt, 1 + nb)
+            rows2.append(v.decode())
+        assert rows2 == ["z"]
+        await cli.close()
+        await srv.stop()
+
+    run(body())
+
+
+def test_param_count_ignores_literal_question_marks(server_client):
+    async def body():
+        srv, cli = await server_client()
+        await cli.query("create table q (a bigint, s varchar(10))")
+        await cli.query("insert into q values (1, 'who?')")
+        await cli.command(
+            0x16, b"select a from q where s = 'who?' and a = ?"
+        )
+        resp = await cli.pr.recv()
+        assert resp[0] == 0x00
+        n_params = struct.unpack_from("<H", resp, 7)[0]
+        assert n_params == 1  # the '?' inside the literal doesn't count
+        await cli.close()
+        await srv.stop()
+
+    run(body())
+
+
+def test_concurrent_connections(server_client):
+    async def body():
+        srv, cli = await server_client()
+        await cli.query("create table c (a bigint)")
+        await cli.query("insert into c values (1)")
+        cli2 = MiniClient(srv.host, srv.port)
+        await cli2.connect(db="test")
+        r = await cli2.query("select a from c")
+        assert r["rows"] == [("1",)]
+        await cli2.close()
+        await cli.close()
+        await srv.stop()
+
+    run(body())

@@ -1,0 +1,49 @@
+"""tidb-tpu server entry point (tidb-server/main.go:152 analog).
+
+    python -m tidb_tpu --host 127.0.0.1 --port 4000
+
+Boots a Domain (storage + catalog + stats), then serves the MySQL wire
+protocol.  Checkpoint/resume: --data-dir persists the catalog JSON on DDL
+and reloads it at boot (storage blocks are rebuilt from LOAD DATA / inserts;
+the durable-store tier is a later-round item).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser("tidb-tpu")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=4000)
+    ap.add_argument("--data-dir", default="")
+    ap.add_argument("--engine", default="tpu", choices=["tpu", "cpu"],
+                    help="default coprocessor engine routing")
+    args = ap.parse_args()
+
+    from .session import Domain
+    from .server import serve_forever
+
+    domain = Domain()
+    if args.engine == "cpu":
+        domain.global_vars["tidb_use_tpu"] = "0"
+    if args.data_dir:
+        os.makedirs(args.data_dir, exist_ok=True)
+        meta = os.path.join(args.data_dir, "catalog.json")
+        if os.path.exists(meta):
+            domain.catalog.load_json(open(meta).read())
+
+        def persist(catalog):
+            tmp = meta + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(catalog.to_json())
+            os.replace(tmp, meta)
+
+        domain.catalog.on_ddl = persist
+    serve_forever(args.host, args.port, domain)
+
+
+if __name__ == "__main__":
+    main()

@@ -115,6 +115,10 @@ class Catalog:
         # optional hook: called with a table id whenever its storage is
         # dropped/replaced (Domain wires this to StatsHandle.drop)
         self.on_table_dropped = None
+        # optional hook: called (with this catalog) after every committed
+        # DDL — the supported seam for persistence (ddl callbacks analog,
+        # domain/domain.go:584-589)
+        self.on_ddl = None
 
     def _notify_drop(self, table_id: int):
         if self.on_table_dropped is not None:
@@ -131,6 +135,8 @@ class Catalog:
     def _bump(self):
         self.schema_version += 1
         self._snapshot = None
+        if self.on_ddl is not None:
+            self.on_ddl(self)
 
     def info_schema(self) -> InfoSchema:
         with self._mu:

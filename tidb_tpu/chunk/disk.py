@@ -1,0 +1,54 @@
+"""Disk spill for chunk lists.
+
+Reference: util/chunk/disk.go:60-147 (ListInDisk) — chunks serialize through
+the wire codec into a temp file; readback streams them in insertion order.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+from typing import Iterator, List, Optional
+
+from .chunk import Chunk
+from .codec import decode_chunk, encode_chunk
+
+
+class ListInDisk:
+    def __init__(self, label: str = "spill"):
+        self._f = tempfile.TemporaryFile(prefix=f"tidbtpu-{label}-")
+        self._offsets: List[int] = []
+        self.n_chunks = 0
+        self.n_rows = 0
+        self.bytes_written = 0
+
+    def add(self, chunk: Chunk):
+        buf = encode_chunk(chunk)
+        self._offsets.append(self._f.tell())
+        self._f.write(struct.pack("<Q", len(buf)))
+        self._f.write(buf)
+        self.n_chunks += 1
+        self.n_rows += chunk.num_rows
+        self.bytes_written += len(buf)
+
+    def __iter__(self) -> Iterator[Chunk]:
+        for off in self._offsets:
+            self._f.seek(off)
+            (n,) = struct.unpack("<Q", self._f.read(8))
+            yield decode_chunk(self._f.read(n))
+        self._f.seek(0, os.SEEK_END)
+
+    def chunk_at(self, i: int) -> Chunk:
+        off = self._offsets[i]
+        self._f.seek(off)
+        (n,) = struct.unpack("<Q", self._f.read(8))
+        c = decode_chunk(self._f.read(n))
+        self._f.seek(0, os.SEEK_END)
+        return c
+
+    def close(self):
+        try:
+            self._f.close()
+        except Exception:
+            pass

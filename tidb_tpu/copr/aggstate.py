@@ -31,13 +31,38 @@ def _sum_repr(v: Vec, st: FieldType) -> np.ndarray:
 
 
 def group_indices(cols: List[Column]) -> Tuple[np.ndarray, List[tuple], int]:
-    """Map rows to dense group ids.  Returns (gidx, key_tuples, G)."""
+    """Map rows to dense group ids.  Returns (gidx, key_tuples, G).
+
+    Single fixed-width columns factorize through the native open-addressing
+    hash (tidb_tpu/native), assigning codes in first-appearance order — the
+    C-speed replacement for the reference's row-at-a-time agg hash maps."""
     n = len(cols[0]) if cols else 0
     if not cols:
         return np.zeros(n, dtype=np.int64), [()], 1
+    if len(cols) == 1 and cols[0].data.dtype != object and n:
+        from ..native import KeyTable
+
+        c = cols[0]
+        data = c.data
+        if data.dtype == np.float64:
+            data = np.where(data == 0.0, 0.0, data).view(np.int64)
+        else:
+            data = data.astype(np.int64, copy=False)
+        valid = c.valid  # None = all valid
+        kt = KeyTable(min(n, 1 << 20))
+        gidx = kt.upsert(data, valid)
+        n_named = int(gidx.max()) + 1 if (gidx >= 0).any() else 0
+        has_null = bool((gidx < 0).any())
+        if has_null:
+            gidx = np.where(gidx < 0, n_named, gidx)  # NULL = its own group
+        G = n_named + (1 if has_null else 0)
+        # first-occurrence row per group -> key tuples
+        first = np.full(G, n, dtype=np.int64)
+        np.minimum.at(first, gidx, np.arange(n, dtype=np.int64))
+        keys = [(c.get(int(first[g])),) for g in range(G)]
+        return gidx, keys, G
     keys: Dict[tuple, int] = {}
     gidx = np.zeros(n, dtype=np.int64)
-    # fast path: single int-like column
     rows = list(zip(*[c.to_pylist() for c in cols]))
     for i, r in enumerate(rows):
         g = keys.get(r)

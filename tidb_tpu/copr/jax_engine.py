@@ -135,14 +135,15 @@ class _DeviceCache:
         self._bytes = 0
         self.capacity = capacity_bytes
 
-    def get_tile(self, table, store_ci: int, tile_idx: int, start: int, end: int):
-        key = (table.table_id, table.base_version, store_ci, tile_idx)
+    def get_tile(self, table, store_ci: int, tile_idx: int, start: int,
+                 end: int, device=None):
+        key = (table.store_uid, table.base_version, store_ci, tile_idx)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
         data, valid = _gather_tile(table, store_ci, start, end)
-        data = jax.device_put(data)
-        valid = jax.device_put(valid)
+        data = jax.device_put(data, device)
+        valid = jax.device_put(valid, device)
         nbytes = data.nbytes + valid.nbytes
         while self._bytes + nbytes > self.capacity and self._order:
             old = self._order.pop(0)
@@ -180,15 +181,17 @@ def _gather_tile(table, store_ci: int, start: int, end: int):
 
 DEVICE_CACHE = _DeviceCache()
 
-_ALL_TRUE = None
+_ALL_TRUE: Dict[object, object] = {}
 
 
-def _all_true():
-    """Device-resident all-true TILE mask, transferred once per process."""
-    global _ALL_TRUE
-    if _ALL_TRUE is None:
-        _ALL_TRUE = jax.device_put(np.ones(TILE, dtype=np.bool_))
-    return _ALL_TRUE
+def _all_true(device=None):
+    """Device-resident all-true TILE mask, transferred once per device."""
+    m = _ALL_TRUE.get(device)
+    if m is None:
+        m = _ALL_TRUE[device] = jax.device_put(
+            np.ones(TILE, dtype=np.bool_), device
+        )
+    return m
 
 
 # ---------------------------------------------------------------------------
@@ -523,6 +526,7 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     topn_parts: List[Chunk] = []
     remaining_limit = an.limit
 
+    devices = jax.devices()
     for tile_start in range((start // TILE) * TILE, end, TILE):
         t0 = max(tile_start, start)
         t1 = min(tile_start + TILE, end)
@@ -531,26 +535,29 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         tile_idx = tile_start // TILE
         # tiles are ALWAYS the aligned, device-cached arrays; the region
         # clip [t0,t1) and deletions become the mask, so repeat queries and
-        # sub-tile regions reuse resident device data (no re-transfer)
+        # sub-tile regions reuse resident device data (no re-transfer).
+        # Multi-chip: tiles round-robin across devices — async dispatch
+        # runs per-tile kernels concurrently (DP over shards, SURVEY §2.6)
+        dev = devices[tile_idx % len(devices)] if len(devices) > 1 else None
         datas, valids = [], []
         for j, ci in enumerate(col_order):
             store_ci = an.scan.columns[ci]
             d, v = DEVICE_CACHE.get_tile(
                 table, store_ci, tile_idx, tile_start,
-                min(tile_start + TILE, table.base_rows),
+                min(tile_start + TILE, table.base_rows), device=dev,
             )
             datas.append(d)
             valids.append(v)
         base0 = tile_start
         lo = np.int64(t0 - base0)
         hi = np.int64(t1 - base0)
-        del_mask = _all_true()
+        del_mask = _all_true(dev)
         if len(del_arr):
             dd = del_arr[(del_arr >= base0) & (del_arr < base0 + TILE)] - base0
             if len(dd):
                 dm = np.ones(TILE, dtype=np.bool_)
                 dm[dd] = False
-                del_mask = jnp.asarray(dm)
+                del_mask = jax.device_put(dm, dev)
 
         if kind == "filter":
             m, outs = fn(datas, valids, lo, hi, del_mask)

@@ -107,6 +107,8 @@ class SelectResult:
             n_workers = min(self.req.concurrency, len(tasks))
 
             def run_task(clip: KeyRange) -> List[Chunk]:
+                from ..metrics import REGISTRY
+
                 sub = CopRequest(
                     dag=self.req.dag, ranges=[clip], ts=self.req.ts,
                     concurrency=1, keep_order=self.req.keep_order,
@@ -115,6 +117,8 @@ class SelectResult:
                 out: List[Chunk] = []
                 for resp in client.send(sub):
                     out.extend(resp.chunks)
+                REGISTRY.inc("cop_tasks_total")
+                REGISTRY.inc(f"cop_tasks_{self.req.engine}_total")
                 return out
 
             if n_workers == 1:

@@ -47,6 +47,7 @@ class HashAggExec(Executor):
 
     def _compute(self) -> List[Chunk]:
         chunks = self.drain_child()
+        self.ctx.mem_tracker.consume(sum(c.nbytes() for c in chunks))
         n_keys = len(self.group_by)
         if self.partial_input:
             final = aggstate.merge_partials_to_final(n_keys, self.aggs, chunks)

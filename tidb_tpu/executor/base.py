@@ -48,6 +48,13 @@ class ExecContext:
         self.affected_rows = 0
         self.last_insert_id = 0
         self.found_rows = 0
+        from ..util_memory import MemTracker
+
+        quota = sess_vars.get_int("tidb_mem_quota_query") if sess_vars else 0
+        action = "cancel"
+        if sess_vars and sess_vars.get("tidb_oom_action"):
+            action = sess_vars.get("tidb_oom_action")
+        self.mem_tracker = MemTracker("query", quota, action=action)
 
     # tuning knobs with reference defaults (sessionctx/variable/tidb_vars.go)
     @property

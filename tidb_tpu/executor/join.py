@@ -135,6 +135,7 @@ class HashJoinExec(Executor):
         bc = concat_chunks(chunks)
         if bc is None:
             bc = self.child(0).empty_chunk()
+        self.ctx.mem_tracker.consume(bc.nbytes())
         self._build_chunk = bc
         mat, null = _key_matrix(bc, self.build_keys, self._str_dict)
         codes = _hash_combine(mat) if bc.num_rows else np.zeros(0, np.int64)

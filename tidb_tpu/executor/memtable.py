@@ -1,0 +1,49 @@
+"""MemTable executor: rows from an INFORMATION_SCHEMA provider.
+
+Reference: executor/infoschema_reader + mem_reader — providers snapshot
+domain state at Open."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..chunk import Chunk, Column
+from ..errors import ExecutorError
+from ..expr.expression import Expression, eval_bool_mask
+from .base import ExecContext, Executor
+
+
+class MemTableExec(Executor):
+    def __init__(self, ctx, provider_name: str, col_picks: List[int],
+                 ftypes, conds: List[Expression], plan_id: int = -1):
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.provider_name = provider_name
+        self.col_picks = col_picks
+        self.conds = conds
+        self._done = False
+
+    def _open(self):
+        self._done = False
+
+    def _next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        from ..infoschema_tables import MEMTABLES
+
+        spec = MEMTABLES.get(self.provider_name)
+        if spec is None:
+            raise ExecutorError(f"no memtable {self.provider_name!r}")
+        cols_spec, provider = spec
+        domain = getattr(self.ctx, "domain", None)
+        if domain is None:
+            raise ExecutorError("memtable requires a domain-bound session")
+        rows = provider(domain, self.ctx.infoschema)
+        cols = []
+        for out_i, pick in enumerate(self.col_picks):
+            ft = self.ftypes[out_i]
+            cols.append(Column.from_values(ft, [r[pick] for r in rows]))
+        chunk = Chunk(cols)
+        if self.conds:
+            chunk = chunk.filter(eval_bool_mask(self.conds, chunk))
+        return chunk

@@ -16,26 +16,115 @@ from ..expr.expression import Expression
 from .base import ExecContext, Executor
 
 
+class _MergeKey:
+    """Per-row comparable for the external merge (mirrors sort_indices
+    semantics: NULLs first ascending, last descending)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, row_vals, descs):
+        k = []
+        for v, desc in zip(row_vals, descs):
+            if not desc:
+                k.append((0, 0) if v is None else (1, v))
+            else:
+                k.append((0 if v is not None else 1,
+                          _Neg(v) if v is not None else 0))
+        self.key = tuple(k)
+
+    def __lt__(self, other):
+        return self.key < other.key
+
+
+class _Neg:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v  # reversed
+
+    def __eq__(self, other):
+        return isinstance(other, _Neg) and self.v == other.v
+
+
 class SortExec(Executor):
+    """Full sort with disk spill: when the memory tracker trips, the
+    buffered rows sort into a run on disk (ListInDisk); the output phase
+    k-way merges all runs (sort.go rowContainer + external merge)."""
+
     def __init__(self, ctx, child: Executor,
                  order_by: List[Tuple[Expression, bool]], plan_id: int = -1):
         super().__init__(ctx, child.ftypes, [child], plan_id)
         self.order_by = order_by
         self._sorted: Optional[Chunk] = None
         self._off = 0
+        self._runs = []  # ListInDisk, each one sorted run
+        self._buf: List[Chunk] = []
+        self._buf_bytes = 0
+        self._merge_iter = None
 
     def _open(self):
         self._sorted = None
         self._off = 0
+        self._runs = []
+        self._buf = []
+        self._buf_bytes = 0
+        self._merge_iter = None
+        self.ctx.mem_tracker.register_spill(self._spill)
+
+    def _close(self):
+        for r in self._runs:
+            r.close()
+        self._runs = []
+
+    def _spill(self) -> int:
+        if not self._buf:
+            return 0
+        from ..chunk.disk import ListInDisk
+
+        whole = concat_chunks(self._buf)
+        idx = sort_indices(self.order_by, whole)
+        run = ListInDisk("sort")
+        for c in whole.take(idx).split(1 << 14):
+            run.add(c)
+        self._runs.append(run)
+        freed = self._buf_bytes
+        self._buf = []
+        self._buf_bytes = 0
+        self.ctx.mem_tracker.release(freed)
+        return freed
+
+    def _input(self):
+        while True:
+            c = self.child().next()
+            if c is None:
+                return
+            if c.num_rows == 0:
+                continue
+            self._buf.append(c)
+            nb = c.nbytes()
+            self._buf_bytes += nb
+            self.ctx.mem_tracker.consume(nb)
 
     def _next(self) -> Optional[Chunk]:
-        if self._sorted is None:
-            whole = concat_chunks(self.drain_child())
-            if whole is None or whole.num_rows == 0:
-                self._sorted = self.empty_chunk()
+        if self._sorted is None and self._merge_iter is None:
+            self._input()
+            if self._runs:
+                # spilled: final in-memory batch becomes the last run
+                self._spill()
+                self._merge_iter = self._merge_runs()
             else:
-                idx = sort_indices(self.order_by, whole)
-                self._sorted = whole.take(idx)
+                whole = concat_chunks(self._buf)
+                self._buf = []
+                if whole is None or whole.num_rows == 0:
+                    self._sorted = self.empty_chunk()
+                else:
+                    idx = sort_indices(self.order_by, whole)
+                    self._sorted = whole.take(idx)
+        if self._merge_iter is not None:
+            return next(self._merge_iter, None)
         if self._off >= self._sorted.num_rows:
             return None
         chunk = self._sorted.slice(
@@ -44,6 +133,39 @@ class SortExec(Executor):
         )
         self._off += chunk.num_rows
         return chunk
+
+    def _merge_runs(self):
+        import heapq
+
+        descs = [d for _, d in self.order_by]
+
+        def run_rows(run):
+            for chunk in run:
+                keys = [e.eval(chunk) for e, _ in self.order_by]
+                kcols = [k.to_column() for k in keys]
+                for i in range(chunk.num_rows):
+                    yield (_MergeKey([c.get(i) for c in kcols], descs),
+                           chunk.row(i))
+
+        merged = heapq.merge(*[run_rows(r) for r in self._runs],
+                             key=lambda t: t[0])
+        batch: List[tuple] = []
+        for _, row in merged:
+            batch.append(row)
+            if len(batch) >= self.ctx.chunk_size:
+                yield _rows_to_chunk(batch, self.ftypes)
+                batch = []
+        if batch:
+            yield _rows_to_chunk(batch, self.ftypes)
+
+
+def _rows_to_chunk(rows: List[tuple], ftypes) -> Chunk:
+    from ..chunk import Column
+
+    return Chunk([
+        Column.from_values(ft, [r[i] for r in rows])
+        for i, ft in enumerate(ftypes)
+    ])
 
 
 class TopNExec(Executor):
@@ -71,9 +193,14 @@ class TopNExec(Executor):
                     break
                 if c.num_rows == 0:
                     continue
+                self.ctx.mem_tracker.consume(c.nbytes())
                 buf = c if buf is None else buf.append(c)
                 if buf.num_rows > 4 * max(k, 256):
-                    buf = run_topn(self.order_by, k, buf)
+                    trimmed = run_topn(self.order_by, k, buf)
+                    self.ctx.mem_tracker.release(
+                        buf.nbytes() - trimmed.nbytes()
+                    )
+                    buf = trimmed
             if buf is None:
                 self._result = self.empty_chunk()
             else:

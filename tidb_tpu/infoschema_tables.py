@@ -1,0 +1,151 @@
+"""INFORMATION_SCHEMA virtual tables (memtables).
+
+Reference: infoschema/tables.go:2244 (name -> column map, row providers),
+infoschema/slow_log.go, util/stmtsummary.  Providers run at execution time
+against the domain, so results always reflect the live catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from .types import (
+    FieldType,
+    ty_float,
+    ty_int,
+    ty_string,
+)
+
+# name -> (columns [(name, ftype)], provider(domain, infoschema) -> rows)
+MEMTABLES: Dict[str, Tuple[List[Tuple[str, FieldType]], Callable]] = {}
+
+
+def _register(name: str, columns):
+    def deco(fn):
+        MEMTABLES[name] = (columns, fn)
+        return fn
+
+    return deco
+
+
+@_register("schemata", [("catalog_name", ty_string()),
+                        ("schema_name", ty_string())])
+def _schemata(domain, isc):
+    return [("def", n) for n in isc.schema_names()]
+
+
+@_register("tables", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("table_type", ty_string()), ("table_rows", ty_int()),
+    ("data_length", ty_int()), ("tidb_table_id", ty_int()),
+])
+def _tables(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            if t.is_view:
+                rows.append((dbn, t.name, "VIEW", 0, 0, t.id))
+                continue
+            try:
+                store = domain.storage.table(t.id)
+                n = store.base_rows + len(store.delta)
+                nbytes = store.nbytes()
+            except Exception:
+                n, nbytes = 0, 0
+            rows.append((dbn, t.name, "BASE TABLE", n, nbytes, t.id))
+    return rows
+
+
+@_register("columns", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("column_name", ty_string()), ("ordinal_position", ty_int()),
+    ("data_type", ty_string()), ("is_nullable", ty_string()),
+    ("column_key", ty_string()),
+])
+def _columns(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            for c in t.public_columns():
+                key = "PRI" if c.primary_key else ""
+                rows.append((
+                    dbn, t.name, c.name, c.offset + 1,
+                    c.ftype.sql_name().lower(),
+                    "YES" if c.ftype.nullable else "NO", key,
+                ))
+    return rows
+
+
+@_register("statistics", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("index_name", ty_string()), ("non_unique", ty_int()),
+    ("seq_in_index", ty_int()), ("column_name", ty_string()),
+])
+def _statistics(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            for ix in t.indexes:
+                for seq, col in enumerate(ix.columns):
+                    rows.append((dbn, t.name, ix.name,
+                                 0 if ix.unique else 1, seq + 1, col))
+    return rows
+
+
+@_register("processlist", [
+    ("id", ty_int()), ("user", ty_string()), ("host", ty_string()),
+    ("db", ty_string()), ("command", ty_string()),
+])
+def _processlist(domain, isc):
+    return [
+        (cid, "root", "localhost", s.current_db, "Sleep")
+        for cid, s in domain.sessions.items()
+    ]
+
+
+@_register("slow_query", [
+    ("query", ty_string()), ("query_time", ty_float()),
+])
+def _slow_query(domain, isc):
+    return [(sql, dur) for sql, dur in domain.slow_queries]
+
+
+@_register("statements_summary", [
+    ("digest_text", ty_string()), ("exec_count", ty_int()),
+    ("sum_latency", ty_float()), ("avg_latency", ty_float()),
+    ("sum_rows", ty_int()),
+])
+def _statements_summary(domain, isc):
+    agg: dict = {}
+    for sql, dur, rows in domain.stmt_summary:
+        key = sql.strip()[:256].lower()
+        c, t, r = agg.get(key, (0, 0.0, 0))
+        agg[key] = (c + 1, t + dur, r + rows)
+    return [
+        (k, c, t, t / c, r) for k, (c, t, r) in sorted(agg.items())
+    ]
+
+
+@_register("tidb_regions", [
+    ("region_id", ty_int()), ("table_id", ty_int()), ("start_key", ty_int()),
+    ("end_key", ty_int()), ("epoch", ty_int()), ("leader_store", ty_int()),
+])
+def _tidb_regions(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            if t.is_view:
+                continue
+            for r in domain.storage.regions.regions_of(t.id):
+                rows.append((r.region_id, t.id, r.start,
+                             min(r.end, 1 << 62), r.epoch, r.leader_store))
+    return rows
+
+
+@_register("metrics", [
+    ("name", ty_string()), ("value", ty_float()),
+])
+def _metrics(domain, isc):
+    from .metrics import REGISTRY
+
+    return sorted(REGISTRY.snapshot().items())

@@ -1,0 +1,41 @@
+"""Metrics registry (Prometheus-style counters/gauges/histograms, pull-only).
+
+Reference: metrics/metrics.go:60 (100 collectors registered centrally,
+exposed on the status port).  Here: a process-global registry surfaced
+through information_schema.metrics and the HTTP status endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+
+    def inc(self, name: str, value: float = 1.0):
+        with self._mu:
+            self._counters[name] += value
+
+    def observe(self, name: str, value: float):
+        """Histogram-lite: tracks _count/_sum/_max."""
+        with self._mu:
+            self._counters[name + "_count"] += 1
+            self._counters[name + "_sum"] += value
+            if value > self._counters[name + "_max"]:
+                self._counters[name + "_max"] = value
+
+    def set(self, name: str, value: float):
+        with self._mu:
+            self._counters[name] = value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._mu:
+            return dict(self._counters)
+
+
+REGISTRY = Registry()

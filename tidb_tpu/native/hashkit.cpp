@@ -59,11 +59,38 @@ void ht64_free(ht64 *h) {
     free(h);
 }
 
+// grow to the next power of two and rehash; returns 0 on OOM.
+static int ht64_grow(ht64 *h) {
+    uint64_t old_cap = h->mask + 1;
+    uint64_t cap = old_cap << 1;
+    int64_t *slots = (int64_t *)malloc(cap * sizeof(int64_t));
+    int64_t *codes = (int64_t *)malloc(cap * sizeof(int64_t));
+    if (!slots || !codes) { free(slots); free(codes); return 0; }
+    for (uint64_t i = 0; i < cap; i++) slots[i] = EMPTY;
+    uint64_t mask = cap - 1;
+    for (uint64_t i = 0; i < old_cap; i++) {
+        int64_t k = h->slots[i];
+        if (k == EMPTY) continue;
+        uint64_t pos = mix64((uint64_t)k) & mask;
+        while (slots[pos] != EMPTY) pos = (pos + 1) & mask;
+        slots[pos] = k;
+        codes[pos] = h->codes[i];
+    }
+    free(h->slots); free(h->codes);
+    h->slots = slots; h->codes = codes; h->mask = mask;
+    return 1;
+}
+
 // insert-or-get codes for keys; valid[i]==0 rows get code -1.
+// Returns n_distinct, or -1 on allocation failure during growth.
 int64_t ht64_upsert(ht64 *h, const int64_t *keys, const uint8_t *valid,
                     int64_t n, int64_t *codes_out) {
     for (int64_t i = 0; i < n; i++) {
         if (valid && !valid[i]) { codes_out[i] = -1; continue; }
+        // keep load factor < 0.75 so the probe loop always terminates
+        if ((uint64_t)h->n * 4 >= (h->mask + 1) * 3) {
+            if (!ht64_grow(h)) return -1;
+        }
         int64_t k = keys[i];
         uint64_t pos = mix64((uint64_t)k) & h->mask;
         for (;;) {

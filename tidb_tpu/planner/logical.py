@@ -133,6 +133,15 @@ class LogicalWindow(LogicalPlan):
         self.frame = frame
 
 
+class LogicalMemTable(LogicalPlan):
+    """Virtual table backed by a provider function (INFORMATION_SCHEMA)."""
+
+    def __init__(self, provider_name: str, schema: Schema):
+        super().__init__(schema, [])
+        self.provider_name = provider_name
+        self.pushed_conds: List[Expression] = []
+
+
 def walk(plan: LogicalPlan):
     yield plan
     for c in plan.children:

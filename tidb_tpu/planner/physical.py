@@ -422,6 +422,25 @@ class PhysMaxOneRow(PhysicalPlan):
         return MaxOneRowExec(ctx, self.children[0].build(ctx), self.id)
 
 
+class PhysMemTable(PhysicalPlan):
+    def __init__(self, schema: Schema, provider_name: str, conds):
+        super().__init__(schema, [])
+        self.provider_name = provider_name
+        self.conds = conds
+
+    def info(self) -> str:
+        return f"table:information_schema.{self.provider_name}"
+
+    def build(self, ctx):
+        from ..executor.memtable import MemTableExec
+
+        pos = {c.uid: i for i, c in enumerate(self.schema.cols)}
+        conds = [c.remap_columns(pos) for c in self.conds]
+        return MemTableExec(ctx, self.provider_name,
+                            [c.store_offset for c in self.schema.cols],
+                            self.schema.ftypes(), conds, self.id)
+
+
 class PhysWindow(PhysicalPlan):
     def __init__(self, child: PhysicalPlan, funcs, partition_by, order_by,
                  frame, schema: Schema):
@@ -545,11 +564,21 @@ class PhysicalContext:
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
+    from .logical import LogicalMemTable
+
     if isinstance(plan, LogicalDataSource):
         return _finish_datasource(plan, pctx)
 
+    if isinstance(plan, LogicalMemTable):
+        return PhysMemTable(plan.schema, plan.provider_name,
+                            plan.pushed_conds)
+
     if isinstance(plan, LogicalSelection):
         child_l = plan.children[0]
+        if isinstance(child_l, LogicalMemTable):
+            child_l.pushed_conds.extend(plan.conds)
+            return PhysMemTable(child_l.schema, child_l.provider_name,
+                                child_l.pushed_conds)
         if isinstance(child_l, LogicalDataSource):
             child_l.pushed_conds.extend(plan.conds)
             return _finish_datasource(child_l, pctx)

@@ -1,0 +1,3 @@
+from .server import MySQLServer, serve_forever
+
+__all__ = ["MySQLServer", "serve_forever"]

@@ -1,0 +1,250 @@
+"""MySQL-wire server: asyncio listener bridging connections to sessions.
+
+Reference: server/server.go (Server, connection loop), server/conn.go:800
+(clientConn.dispatch), conn_stmt.go (prepared-statement commands).  SQL
+execution itself runs in a thread pool (sessions are synchronous; numpy/JAX
+release the GIL), so one slow query doesn't stall other connections —
+the goroutine-per-conn model mapped onto asyncio + executor threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from ..errors import TiDBTPUError
+from ..session import Domain, ResultSet
+from . import protocol as P
+from .packet import PacketReader, PacketWriter, read_lenenc_int
+
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
+
+
+class MySQLServer:
+    def __init__(self, domain: Optional[Domain] = None, host: str = "127.0.0.1",
+                 port: int = 4000, workers: int = 8):
+        self.domain = domain or Domain()
+        self.host = host
+        self.port = port
+        self.pool = ThreadPoolExecutor(max_workers=workers)
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        return addr
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    async def _handle(self, reader, writer):
+        sess = self.domain.new_session()
+        pr, pw = PacketReader(reader), PacketWriter(writer)
+        loop = asyncio.get_running_loop()
+        prepared: Dict[int, str] = {}
+        next_stmt_id = [1]
+        try:
+            salt = os.urandom(20)
+            await pw.send(P.handshake_v10(sess.conn_id, salt))
+            resp = await pr.recv()
+            hs = P.parse_handshake_response(resp)
+            if hs["db"]:
+                try:
+                    sess.execute(f"use {hs['db']}")
+                except TiDBTPUError:
+                    pass
+            pw.seq = pr.seq
+            await pw.send(P.ok_packet())
+
+            while True:
+                pr.seq = 0
+                data = await pr.recv()
+                if not data:
+                    break
+                pw.seq = pr.seq
+                cmd, payload = data[0], data[1:]
+                if cmd == COM_QUIT:
+                    break
+                if cmd == COM_PING:
+                    await pw.send(P.ok_packet())
+                    continue
+                if cmd == COM_INIT_DB:
+                    await self._run_sql(
+                        sess, f"use {payload.decode()}", pw, loop
+                    )
+                    continue
+                if cmd == COM_QUERY:
+                    sql = payload.decode("utf8", "replace")
+                    await self._run_sql(sess, sql, pw, loop)
+                    continue
+                if cmd == COM_FIELD_LIST:
+                    await pw.send(P.eof_packet())
+                    continue
+                if cmd == COM_STMT_PREPARE:
+                    sql = payload.decode("utf8", "replace")
+                    sid = next_stmt_id[0]
+                    next_stmt_id[0] += 1
+                    n_params = _count_params(sql)
+                    prepared[sid] = {"sql": sql, "n": n_params,
+                                     "types": None}
+                    out = (b"\x00" + struct.pack("<I", sid)
+                           + struct.pack("<H", 0)          # columns
+                           + struct.pack("<H", n_params)
+                           + b"\x00" + struct.pack("<H", 0))
+                    await pw.send(out)
+                    for _ in range(n_params):
+                        await pw.send(P.column_def("?", None))
+                    if n_params:
+                        await pw.send(P.eof_packet())
+                    continue
+                if cmd == COM_STMT_EXECUTE:
+                    sid = struct.unpack_from("<I", payload, 0)[0]
+                    st = prepared.get(sid)
+                    if st is None:
+                        await pw.send(P.err_packet(1243, "unknown stmt"))
+                        continue
+                    params, st["types"] = _parse_exec_params(
+                        payload, st["n"], st["types"]
+                    )
+                    await self._run_sql(sess, st["sql"], pw, loop,
+                                        params=params, binary=True)
+                    continue
+                if cmd in (COM_STMT_CLOSE, COM_STMT_RESET):
+                    sid = struct.unpack_from("<I", payload, 0)[0]
+                    prepared.pop(sid, None)
+                    if cmd == COM_STMT_RESET:
+                        await pw.send(P.ok_packet())
+                    continue
+                await pw.send(P.err_packet(1047, f"unknown command {cmd}"))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            sess.rollback()
+            self.domain.sessions.pop(sess.conn_id, None)
+            writer.close()
+
+    async def _run_sql(self, sess, sql: str, pw: PacketWriter, loop,
+                       params=None, binary: bool = False):
+        try:
+            rss = await loop.run_in_executor(
+                self.pool, lambda: sess.execute(sql, params)
+            )
+        except TiDBTPUError as e:
+            await pw.send(P.err_packet(1105, str(e)))
+            return
+        except Exception as e:  # pragma: no cover - defensive
+            await pw.send(P.err_packet(1105, f"internal error: {e}"))
+            return
+        rs = rss[-1] if rss else ResultSet()
+        if not rs.is_query:
+            await pw.send(P.ok_packet(rs.affected_rows, rs.last_insert_id,
+                                      warnings=len(rs.warnings)))
+            return
+        fts = rs.ftypes
+        await pw.send(bytes([len(rs.headers)]))
+        for i, h in enumerate(rs.headers):
+            await pw.send(P.column_def(
+                h, fts[i] if fts and i < len(fts) else None
+            ))
+        await pw.send(P.eof_packet())
+        encode = (lambda r: P.binary_row(r, fts)) if binary else P.text_row
+        for row in rs.rows:
+            await pw.send(encode(row))
+        await pw.send(P.eof_packet())
+
+
+def _count_params(sql: str) -> int:
+    """Placeholder count via the real parser (a raw '?' scan miscounts
+    question marks inside string literals); falls back to the scan only
+    when the statement does not parse at PREPARE time."""
+    try:
+        from ..parser.parser import Parser
+
+        p = Parser(sql)
+        p.parse_statements()
+        return p.n_params
+    except Exception:
+        return sql.count("?")
+
+
+def _parse_exec_params(payload: bytes, n_params: int, cached_types):
+    """COM_STMT_EXECUTE payload -> (values, types).  Types arrive only on
+    the first execute (new_params_bound_flag=1); later executes reuse the
+    cached ones per protocol."""
+    if n_params == 0:
+        return [], cached_types
+    pos = 4 + 1 + 4  # stmt_id, flags, iteration count (cmd byte stripped)
+    null_bytes = (n_params + 7) // 8
+    null_bitmap = payload[pos:pos + null_bytes]
+    pos += null_bytes
+    new_bound = payload[pos]
+    pos += 1
+    types = []
+    if new_bound:
+        for _ in range(n_params):
+            types.append((payload[pos], payload[pos + 1]))
+            pos += 2
+    elif cached_types:
+        types = cached_types
+    values = []
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            values.append(None)
+            continue
+        t = types[i][0] if types else 0xFD
+        if t in (0x01,):  # tiny
+            values.append(struct.unpack_from("<b", payload, pos)[0])
+            pos += 1
+        elif t in (0x02,):  # short
+            values.append(struct.unpack_from("<h", payload, pos)[0])
+            pos += 2
+        elif t in (0x03,):  # long
+            values.append(struct.unpack_from("<i", payload, pos)[0])
+            pos += 4
+        elif t in (0x08,):  # longlong
+            values.append(struct.unpack_from("<q", payload, pos)[0])
+            pos += 8
+        elif t in (0x04,):  # float
+            values.append(struct.unpack_from("<f", payload, pos)[0])
+            pos += 4
+        elif t in (0x05,):  # double
+            values.append(struct.unpack_from("<d", payload, pos)[0])
+            pos += 8
+        else:  # string-ish
+            n, pos = read_lenenc_int(payload, pos)
+            values.append(payload[pos:pos + n].decode("utf8", "replace"))
+            pos += n
+    return values, types
+
+
+def serve_forever(host: str = "127.0.0.1", port: int = 4000,
+                  domain: Optional[Domain] = None):
+    """Blocking entry point (tidb-server/main.go analog)."""
+
+    async def main():
+        srv = MySQLServer(domain, host, port)
+        await srv.start()
+        print(f"tidb-tpu listening on {srv.host}:{srv.port}")
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(main())

@@ -62,6 +62,10 @@ class Domain:
                 pass  # stats are advisory; never fail the statement
 
     def record_stmt(self, sql: str, dur_s: float, rows: int):
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("statements_total")
+        REGISTRY.observe("statement_duration_seconds", dur_s)
         with self._mu:
             self.stmt_summary.append((sql, dur_s, rows))
             if len(self.stmt_summary) > 1000:

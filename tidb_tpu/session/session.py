@@ -57,6 +57,7 @@ class ResultSet:
     last_insert_id: int = 0
     warnings: List[str] = field(default_factory=list)
     is_query: bool = False
+    ftypes: Optional[List[FieldType]] = None  # column types for the wire
 
     def scalar(self):
         return self.rows[0][0] if self.rows else None
@@ -247,6 +248,7 @@ class Session:
             read_ts=self.domain.storage.current_ts() if txn is None else 0,
         )
         ctx.killed = self._killed
+        ctx.domain = self.domain  # memtable providers read live state
         self.last_exec_ctx = ctx
         return ctx
 
@@ -279,7 +281,7 @@ class Session:
             for r in c.to_pylist():
                 rows.append(_format_row(r, fts))
         return ResultSet(headers=headers, rows=rows, is_query=True,
-                         warnings=list(ctx.warnings))
+                         warnings=list(ctx.warnings), ftypes=fts)
 
     def _run_dml(self, stmt, params=None) -> ResultSet:
         retries = max(self.vars.get_int("tidb_retry_limit", 10), 0)

@@ -38,6 +38,7 @@ SYSVAR_DEFAULTS = {
     "tidb_projection_concurrency": ("4", "int"),
     "tidb_index_lookup_concurrency": ("4", "int"),
     "tidb_mem_quota_query": (str(32 << 30), "int"),
+    "tidb_oom_action": ("cancel", "str"),
     "tidb_retry_limit": ("10", "int"),
     "tidb_disable_txn_auto_retry": ("0", "bool"),
     "tidb_snapshot": ("", "str"),

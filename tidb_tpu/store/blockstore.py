@@ -21,6 +21,7 @@ note).  Design:
 from __future__ import annotations
 
 import bisect
+import itertools
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
@@ -32,6 +33,8 @@ from ..errors import KVError, LockedError, TxnConflictError
 from ..types import FieldType, TypeKind
 
 BLOCK_SIZE = 1 << 16  # 65536 rows per block
+
+_STORE_SEQ = itertools.count(1)  # process-unique store tokens (cache keys)
 
 
 @dataclass
@@ -62,6 +65,9 @@ class Version:
 class TableStore:
     def __init__(self, table_id: int, columns: List[Tuple[str, FieldType]]):
         self.table_id = table_id
+        # process-unique token: table ids repeat across Domains (each catalog
+        # numbers from 100), so shared caches MUST key on this, not table_id
+        self.store_uid = next(_STORE_SEQ)
         self.cols: List[ColumnMeta] = [ColumnMeta(n, t) for n, t in columns]
         self.base_rows = 0
         # per column: list of numpy blocks + validity blocks

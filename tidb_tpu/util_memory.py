@@ -1,0 +1,70 @@
+"""Memory tracking with OOM actions.
+
+Reference: util/memory/tracker.go:40-174 (Tracker tree attached from
+Request.MemTracker down to operators) + action.go:28-100 (ActionOnExceed =
+log | cancel | spill).  Cancel surfaces as MemoryQuotaExceededError caught at
+the statement boundary (executor/adapter.go:275-284 catches the panic).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from .errors import MemoryQuotaExceededError
+
+
+class MemTracker:
+    def __init__(self, label: str, quota: int = 0,
+                 parent: Optional["MemTracker"] = None,
+                 action: str = "cancel"):
+        self.label = label
+        self.quota = quota  # 0 = unlimited
+        self.parent = parent
+        self.action = action  # cancel | log
+        self._consumed = 0
+        self._max = 0
+        self._mu = threading.Lock()
+        # spill callbacks registered by operators that can shed memory
+        self._spill_hooks: List[Callable[[], int]] = []
+
+    @property
+    def consumed(self) -> int:
+        return self._consumed
+
+    @property
+    def max_consumed(self) -> int:
+        return self._max
+
+    def register_spill(self, hook: Callable[[], int]):
+        """hook() frees memory and returns bytes released."""
+        self._spill_hooks.append(hook)
+
+    def consume(self, nbytes: int):
+        with self._mu:
+            self._consumed += nbytes
+            if self._consumed > self._max:
+                self._max = self._consumed
+        if self.parent is not None:
+            self.parent.consume(nbytes)
+            return
+        if self.quota and self._consumed > self.quota:
+            self._on_exceed()
+
+    def release(self, nbytes: int):
+        self.consume(-nbytes)
+
+    def _on_exceed(self):
+        # try spilling first (action.go SpillDiskAction analog)
+        for hook in list(self._spill_hooks):
+            freed = hook()
+            if freed > 0 and self._consumed <= self.quota:
+                return
+        if self._consumed <= self.quota:
+            return
+        if self.action == "cancel":
+            raise MemoryQuotaExceededError(self.quota, self._consumed)
+        # log action: keep going (the reference logs; we count it)
+        from .metrics import REGISTRY
+
+        REGISTRY.inc("mem_quota_exceeded_total")
