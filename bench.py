@@ -26,6 +26,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+if os.environ.get("BENCH_FORCE_CPU") == "1":
+    # the image sitecustomize force-registers the TPU tunnel and overrides
+    # JAX_PLATFORMS; config wins over both
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from tidb_tpu.session import Domain  # noqa: E402
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
@@ -109,36 +116,67 @@ def bench_query(sess, sql: str, engine: str) -> float:
     return best
 
 
-def main():
+def _run(state: dict):
     domain = Domain()
     sess = build_lineitem(domain, N_ROWS)
+    state["loaded"] = True
 
-    q1_tpu = bench_query(sess, Q1, "tpu")
-    q6_tpu = bench_query(sess, Q6, "tpu")
+    state["q1_tpu"] = bench_query(sess, Q1, "tpu")
+    state["q6_tpu"] = bench_query(sess, Q6, "tpu")
     # CPU-engine baseline on a subsample to bound wall time, scaled
     cpu_rows = min(N_ROWS, 1_000_000)
     if cpu_rows < N_ROWS:
         d2 = Domain()
         s2 = build_lineitem(d2, cpu_rows)
     else:
-        d2, s2 = domain, sess
-    q1_cpu = bench_query(s2, Q1, "cpu") * (N_ROWS / cpu_rows)
-    q6_cpu = bench_query(s2, Q6, "cpu") * (N_ROWS / cpu_rows)
+        s2 = sess
+    state["q1_cpu"] = bench_query(s2, Q1, "cpu") * (N_ROWS / cpu_rows)
+    state["q6_cpu"] = bench_query(s2, Q6, "cpu") * (N_ROWS / cpu_rows)
+    state["done"] = True
 
-    value = N_ROWS / q1_tpu
-    out = {
-        "metric": "tpch_q1_rows_per_sec",
-        "value": round(value, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(q1_cpu / q1_tpu, 3),
-        "detail": {
-            "rows": N_ROWS,
-            "q1_tpu_s": round(q1_tpu, 4),
-            "q1_cpu_est_s": round(q1_cpu, 4),
-            "q6_tpu_rows_per_sec": round(N_ROWS / q6_tpu, 1),
-            "q6_speedup": round(q6_cpu / q6_tpu, 3),
-        },
-    }
+
+def main():
+    # The TPU arrives over a network tunnel in some environments; a hung
+    # device must not leave the driver with NO output line, so the work
+    # runs on a watchdog thread and partial results still print.
+    import threading
+
+    wall_limit = float(os.environ.get("BENCH_WALL_LIMIT", 1500))
+    state: dict = {}
+    t = threading.Thread(target=_run, args=(state,), daemon=True)
+    t.start()
+    t.join(wall_limit)
+
+    q1_tpu = state.get("q1_tpu")
+    if q1_tpu:
+        value = N_ROWS / q1_tpu
+        q1_cpu = state.get("q1_cpu")
+        q6_tpu = state.get("q6_tpu")
+        q6_cpu = state.get("q6_cpu")
+        out = {
+            "metric": "tpch_q1_rows_per_sec",
+            "value": round(value, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(q1_cpu / q1_tpu, 3) if q1_cpu else None,
+            "detail": {
+                "rows": N_ROWS,
+                "q1_tpu_s": round(q1_tpu, 4),
+                "q1_cpu_est_s": round(q1_cpu, 4) if q1_cpu else None,
+                "q6_tpu_rows_per_sec":
+                    round(N_ROWS / q6_tpu, 1) if q6_tpu else None,
+                "q6_speedup":
+                    round(q6_cpu / q6_tpu, 3) if q6_tpu and q6_cpu else None,
+                "complete": bool(state.get("done")),
+            },
+        }
+    else:
+        out = {
+            "metric": "tpch_q1_rows_per_sec", "value": 0.0,
+            "unit": "rows/s", "vs_baseline": 0.0,
+            "detail": {"error": "device unreachable or bench timed out",
+                       "loaded": bool(state.get("loaded")),
+                       "wall_limit_s": wall_limit},
+        }
     print(json.dumps(out))
 
 

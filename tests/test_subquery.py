@@ -1,0 +1,111 @@
+"""Correlated subquery decorrelation tests (rule_decorrelate.go analog)."""
+
+import pytest
+
+from tidb_tpu.errors import PlanError
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def sess():
+    s = Domain().new_session()
+    s.execute("create table orders (o_orderkey bigint, o_custkey bigint, "
+              "o_total double)")
+    s.execute("create table lineitem (l_orderkey bigint, l_qty bigint, "
+              "l_price double)")
+    s.execute("insert into orders values (1, 10, 100.0), (2, 20, 200.0), "
+              "(3, 30, 300.0)")
+    s.execute("insert into lineitem values (1, 5, 9.0), (1, 7, 8.0), "
+              "(2, 40, 7.0)")
+    return s
+
+
+def test_correlated_exists(sess):
+    assert sess.query(
+        "select o_orderkey from orders where exists (select 1 from lineitem "
+        "where l_orderkey = o_orderkey and l_qty > 6) order by o_orderkey"
+    ) == [(1,), (2,)]
+
+
+def test_correlated_not_exists(sess):
+    assert sess.query(
+        "select o_orderkey from orders where not exists (select 1 from "
+        "lineitem where l_orderkey = o_orderkey) order by o_orderkey"
+    ) == [(3,)]
+
+
+def test_correlated_scalar_agg(sess):
+    # o1: 100 > 10*(9+8)=170 no; o2: 200 > 70 yes; o3: no lineitems -> NULL
+    assert sess.query(
+        "select o_orderkey from orders where o_total > (select sum(l_price) "
+        "* 10 from lineitem where l_orderkey = o_orderkey) "
+        "order by o_orderkey"
+    ) == [(2,)]
+
+
+def test_correlated_scalar_in_derived_expr(sess):
+    # o1: 100 > 15*avg(5,7)=90 yes; o2: 200 > 15*40=600 no; o3: NULL
+    assert sess.query(
+        "select o_orderkey from orders where o_total > (select 15 * "
+        "avg(l_qty) from lineitem where l_orderkey = o_orderkey) "
+        "order by o_orderkey"
+    ) == [(1,)]
+
+
+def test_correlated_in_equality(sess):
+    assert sess.query(
+        "select o_orderkey from orders where o_orderkey in (select "
+        "l_orderkey from lineitem where l_orderkey = o_orderkey and "
+        "l_qty > 6) order by o_orderkey"
+    ) == [(1,), (2,)]
+
+
+def test_non_equality_correlation_as_join_cond(sess):
+    # qtys are 5,7,40: custkey 10 -> 5,7 qualify; 20 -> all; 30 -> all
+    assert sess.query(
+        "select o_orderkey from orders where exists (select 1 from "
+        "lineitem where l_qty < o_custkey) order by o_orderkey"
+    ) == [(1,), (2,), (3,)]
+    assert sess.query(
+        "select o_orderkey from orders where exists (select 1 from "
+        "lineitem where l_qty > 3 * o_custkey) order by o_orderkey"
+    ) == [(1,)]  # 40 > 30 only for custkey 10
+
+    # correlated scalar aggs still demand equality correlation
+    with pytest.raises(PlanError):
+        sess.query(
+            "select o_orderkey from orders where o_total > (select "
+            "avg(l_price) from lineitem where l_qty < o_custkey)"
+        )
+
+
+def test_uncorrelated_paths_still_work(sess):
+    assert sess.query(
+        "select o_orderkey from orders where o_orderkey in "
+        "(select l_orderkey from lineitem) order by o_orderkey"
+    ) == [(1,), (2,)]
+    assert sess.query(
+        "select count(*) from orders where o_total > "
+        "(select avg(o_total) from orders)"
+    ) == [(1,)]
+
+
+def test_tpch_q17_shape(sess):
+    # 0.2 * avg quantity threshold against per-order lineitems
+    rows = sess.query(
+        "select sum(l_price) from lineitem, orders "
+        "where l_orderkey = o_orderkey and l_qty < (select 10 + avg(l_qty) "
+        "from lineitem where l_orderkey = o_orderkey)"
+    )
+    # o1 threshold 16: qty 5,7 pass (9+8); o2 threshold 50: qty 40 passes (7)
+    assert rows[0][0] == pytest.approx(24.0)
+
+
+def test_tpch_q21_shape(sess):
+    rows = sess.query(
+        "select o_orderkey from orders where exists (select 1 from lineitem "
+        "where l_orderkey = o_orderkey and l_qty > 5) and not exists "
+        "(select 1 from lineitem where l_orderkey = o_orderkey and "
+        "l_qty > 30) order by o_orderkey"
+    )
+    assert rows == [(1,)]

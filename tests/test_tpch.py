@@ -190,6 +190,27 @@ where p_partkey = l_partkey
   and ((p_size >= 1 and p_size <= 15 and l_quantity >= 1)
        or (p_size >= 16 and l_quantity >= 10))
   and l_shipdate >= date '1994-01-01'""",
+    "q4": """
+select o_orderpriority, count(*) as order_count
+from orders
+where o_orderdate >= date '1993-07-01' and o_orderdate < date '1993-10-01'
+  and exists (select 1 from lineitem
+              where l_orderkey = o_orderkey and l_shipdate > o_orderdate)
+group by o_orderpriority order by o_orderpriority""",
+    "q17": """
+select sum(l_extendedprice) / 7.0 as avg_yearly
+from lineitem, part
+where p_partkey = l_partkey and p_type = 'PROMO BRUSHED'
+  and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                    where l_partkey = p_partkey)""",
+    "q21_lite": """
+select o_orderstatus, count(*) as waitcount
+from orders
+where exists (select 1 from lineitem
+              where l_orderkey = o_orderkey and l_quantity > 30)
+  and not exists (select 1 from lineitem
+                  where l_orderkey = o_orderkey and l_quantity > 48)
+group by o_orderstatus order by o_orderstatus""",
     "q22_lite": """
 select c_mktsegment, count(*) as numcust, sum(c_acctbal) as totacctbal
 from customer

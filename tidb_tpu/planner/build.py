@@ -224,10 +224,30 @@ class PlanBuilder:
     # ------------------------------------------------------------------
     # subqueries (expression_rewriter.go handleInSubquery/buildSemiApply)
     # ------------------------------------------------------------------
-    def _mk_subquery_handler(self, schema: Schema, outer: List[Schema]):
+    def _mk_subquery_handler(self, schema: Schema, outer: List[Schema],
+                             plan_holder: Optional[list] = None):
+        """plan_holder: 1-element mutable list with the plan being filtered;
+        correlated scalar subqueries decorrelate by REPLACING it with a
+        left-join against the grouped inner (rule_decorrelate.go analog)."""
+
         def handler(query, kind, negated, operand):
             if kind == "scalar":
+                outer_uids = set(schema.uids())
+                if plan_holder is not None and \
+                        self._is_correlated_agg(query, schema, outer):
+                    return self._decorrelate_scalar(
+                        query, schema, outer, plan_holder
+                    )
                 sub = self.build_select(query, [schema] + outer)
+                used = set()
+                for node in _walk_exprs(sub):
+                    node.collect_columns(used)
+                if used & outer_uids:
+                    raise PlanError(
+                        "correlated scalar subquery of this shape is not "
+                        "supported (only aggregated subqueries with "
+                        "equality correlation decorrelate)"
+                    )
                 if len(sub.schema) != 1:
                     raise PlanError("scalar subquery must return one column")
                 rows = self._eval_subplan(sub)
@@ -241,6 +261,79 @@ class PlanBuilder:
             )
 
         return handler
+
+    def _is_correlated_agg(self, query, schema: Schema, outer) -> bool:
+        """Cheap AST check: single aggregate select field, no GROUP BY, and
+        the WHERE references an enclosing column."""
+        if not isinstance(query, ast.SelectStmt) or query.group_by:
+            return False
+        if len(query.fields) != 1 or not _contains_agg(query.fields[0].expr):
+            return False
+        return _references_outer(query, schema, self.infoschema,
+                                 self.current_db)
+
+    def _decorrelate_scalar(self, query, schema: Schema, outer,
+                            plan_holder):
+        """t1.x > (SELECT agg(e) FROM t2 WHERE t2.k = t1.k AND ...) becomes
+        LEFT JOIN (SELECT t2.k, agg(e) FROM t2 WHERE ... GROUP BY t2.k) ON
+        t2.k = t1.k, with the expression reading the agg output column."""
+        inner = self.build_from(query.from_clause, [schema] + outer)
+        outer_uids = set(schema.uids())
+        conds: List[Expression] = []
+        if query.where is not None:
+            eb = ExprBuilder(inner.schema, None, None, [schema] + outer,
+                             self.param_values)
+            # widen resolution: correlated refs resolve via outer schemas
+            for conj in split_and(query.where):
+                conds.append(eb.build(conj))
+        pairs, residual = _split_corr_eqs(conds, outer_uids,
+                                          set(inner.schema.uids()))
+        if any(_expr_uids([c]) & outer_uids for c in residual):
+            raise PlanError("correlated predicate must be an equality "
+                            "with an outer column")
+        if residual:
+            inner = LogicalSelection(inner, residual)
+        # build the select field: arbitrary expression over collected aggs
+        aggs: List[AggDesc] = []
+        agg_uids: List[int] = []
+
+        def collector(name, args, distinct):
+            d = AggDesc(name, args, distinct)
+            aggs.append(d)
+            uid = next_uid()
+            agg_uids.append(uid)
+            return ColumnExpr(-1, d.ftype.with_nullable(True), str(d), uid)
+
+        feb = ExprBuilder(inner.schema, collector, None, [schema] + outer,
+                          self.param_values)
+        field_expr = feb.build(query.fields[0].expr)
+        if not aggs:
+            raise PlanError("correlated subquery must aggregate")
+        used = _expr_uids([field_expr])
+        if used - set(agg_uids):
+            raise PlanError("correlated subquery field may only combine "
+                            "aggregates and constants")
+        group_exprs = [ie for ie, _oe in pairs]
+        gcols = []
+        for ge in group_exprs:
+            uid = ge.unique_id if isinstance(ge, ColumnExpr) and \
+                ge.unique_id >= 0 else next_uid()
+            gcols.append(SchemaCol(uid, str(ge), ge.ftype, "", str(ge)))
+        agg_schema = Schema(gcols + [
+            SchemaCol(uid, str(a), a.ftype.with_nullable(True), "", str(a))
+            for uid, a in zip(agg_uids, aggs)
+        ])
+        inner_agg = LogicalAggregation(inner, group_exprs, aggs, agg_schema)
+        p = plan_holder[0]
+        eqs = [(oe, gc.to_expr()) for (_ie, oe), gc in zip(pairs, gcols)]
+        joined_schema = Schema(
+            list(p.schema.cols)
+            + [SchemaCol(c.uid, c.name, c.ftype.with_nullable(True), c.table,
+                         c.display, c.store_offset) for c in agg_schema.cols]
+        )
+        plan_holder[0] = LogicalJoin(p, inner_agg, "left_outer", eqs, [],
+                                     joined_schema)
+        return field_expr
 
     def _eval_subplan(self, plan: LogicalPlan) -> List[tuple]:
         if self.exec_subplan is None:
@@ -540,6 +633,7 @@ class PlanBuilder:
         return items
 
     def _build_filter(self, p: LogicalPlan, where, outer) -> LogicalPlan:
+        holder = [p]
         conds: List[Expression] = []
         for conj in split_and(where):
             neg = False
@@ -548,38 +642,87 @@ class PlanBuilder:
                 if isinstance(node.operand, (ast.Exists, ast.InSubquery)):
                     neg, node = True, node.operand
             if isinstance(node, ast.InSubquery):
-                p = self._semi_join(p, node.query, node.expr,
-                                    node.negated or neg, outer)
+                holder[0] = self._semi_join(holder[0], node.query, node.expr,
+                                            node.negated or neg, outer)
                 continue
             if isinstance(node, ast.Exists):
-                p = self._exists_join(p, node.query, node.negated or neg,
-                                      outer)
+                holder[0] = self._exists_join(holder[0], node.query,
+                                              node.negated or neg, outer)
                 continue
-            eb = ExprBuilder(p.schema, None,
-                             self._mk_subquery_handler(p.schema, outer),
+            eb = ExprBuilder(holder[0].schema, None,
+                             self._mk_subquery_handler(holder[0].schema,
+                                                       outer, holder),
                              outer, self.param_values)
             conds.append(eb.build(conj))
+        p = holder[0]
         if conds:
             p = LogicalSelection(p, conds)
         return p
 
     def _semi_join(self, p: LogicalPlan, query, operand, negated: bool,
                    outer) -> LogicalPlan:
+        kind = "anti_semi" if negated else "semi"
+        eb = ExprBuilder(p.schema, None, None, outer, self.param_values)
+        left_key = eb.build(operand)
+        if _references_outer(query, p.schema, self.infoschema, self.current_db):
+            inner, pairs, other = self._correlated_source(
+                query, p.schema, outer)
+            veb = ExprBuilder(inner.schema, None, None,
+                              [p.schema] + outer, self.param_values)
+            value = veb.build(query.fields[0].expr)
+            eqs = [(left_key, value)] + [(oe, ie) for ie, oe in pairs]
+            return LogicalJoin(p, inner, kind, eqs, other, p.schema)
         sub = self.build_select(query, [p.schema] + outer)
         if len(sub.schema) != 1:
             raise PlanError("IN subquery must return one column")
-        eb = ExprBuilder(p.schema, None, None, outer, self.param_values)
-        left_key = eb.build(operand)
         right_key = sub.schema.col(0).to_expr()
-        kind = "anti_semi" if negated else "semi"
         return LogicalJoin(p, sub, kind, [(left_key, right_key)], [],
                            p.schema)
 
     def _exists_join(self, p: LogicalPlan, query, negated: bool,
                      outer) -> LogicalPlan:
-        sub = self.build_select(query, [p.schema] + outer)
         kind = "anti_semi" if negated else "semi"
+        if _references_outer(query, p.schema, self.infoschema, self.current_db):
+            inner, pairs, other = self._correlated_source(
+                query, p.schema, outer)
+            eqs = [(oe, ie) for ie, oe in pairs]
+            return LogicalJoin(p, inner, kind, eqs, other, p.schema)
+        sub = self.build_select(query, [p.schema] + outer)
         return LogicalJoin(p, sub, kind, [], [], p.schema)
+
+    def _correlated_source(self, query, schema: Schema, outer,
+                           allow_other: bool = True):
+        """FROM+WHERE of a correlated IN/EXISTS block, with the correlated
+        equality pairs pulled out (rule_decorrelate.go): returns
+        (inner_plan, [(inner_expr, outer_colexpr)], other_corr_conds).
+        Non-equality correlated conjuncts become semi-join other-conds when
+        allowed (they evaluate over the outer++inner pair layout)."""
+        if not isinstance(query, ast.SelectStmt):
+            raise PlanError("correlated subquery must be a simple SELECT")
+        if query.group_by or query.having:
+            raise PlanError(
+                "GROUP BY/HAVING in a correlated IN/EXISTS is not supported"
+            )
+        inner = self.build_from(query.from_clause, [schema] + outer)
+        outer_uids = set(schema.uids())
+        conds: List[Expression] = []
+        if query.where is not None:
+            eb = ExprBuilder(inner.schema, None, None, [schema] + outer,
+                             self.param_values)
+            for conj in split_and(query.where):
+                conds.append(eb.build(conj))
+        pairs, residual = _split_corr_eqs(conds, outer_uids,
+                                          set(inner.schema.uids()))
+        other_corr = [c for c in residual if _expr_uids([c]) & outer_uids]
+        residual = [c for c in residual if not (_expr_uids([c]) & outer_uids)]
+        if other_corr and not allow_other:
+            raise PlanError("correlated predicate must be an equality "
+                            "with an outer column")
+        if residual:
+            inner = LogicalSelection(inner, residual)
+        if not pairs and not other_corr:
+            raise PlanError("could not decorrelate subquery")
+        return inner, pairs, other_corr
 
     # ------------------------------------------------------------------
     # UNION
@@ -753,6 +896,148 @@ def _root_uids(e: Expression) -> set:
     out: set = set()
     e.collect_columns(out)
     return out
+
+
+def _expr_uids(exprs) -> set:
+    out: set = set()
+    for e in exprs:
+        e.collect_columns(out)
+    return out
+
+
+def _split_corr_eqs(conds, outer_uids: set, inner_uids: set):
+    """Partition conjuncts into correlated equality pairs
+    [(inner_expr, outer_colexpr)] and residual conds."""
+    pairs, residual = [], []
+    for cond in conds:
+        uids = _expr_uids([cond])
+        if not (uids & outer_uids):
+            residual.append(cond)
+            continue
+        ok = False
+        if isinstance(cond, ScalarFunc) and cond.name == "=" and \
+                len(cond.args) == 2:
+            a, b = cond.args
+            ua, ub = _expr_uids([a]), _expr_uids([b])
+            if isinstance(a, ColumnExpr) and a.unique_id in outer_uids \
+                    and ub and ub <= inner_uids:
+                pairs.append((b, a))
+                ok = True
+            elif isinstance(b, ColumnExpr) and b.unique_id in outer_uids \
+                    and ua and ua <= inner_uids:
+                pairs.append((a, b))
+                ok = True
+        if not ok:
+            residual.append(cond)
+    return pairs, residual
+
+
+def _references_outer(query, schema: Schema,
+                      infoschema=None, current_db: str = "") -> bool:
+    """Does the subquery's AST reference a column resolvable ONLY in the
+    outer schema?  Walk over ColumnRefs: names the inner FROM cannot
+    provide but the outer schema can."""
+    outer_names = {(c.table.lower(), c.name.lower()) for c in schema.cols}
+    outer_bare = {c.name.lower() for c in schema.cols}
+    inner_tables = set()
+    inner_cols = set()  # bare column names the inner FROM provides
+
+    def from_names(node):
+        if isinstance(node, ast.TableName):
+            inner_tables.add((node.alias or node.name).lower())
+            if infoschema is not None:
+                try:
+                    t = infoschema.table(node.db or current_db, node.name)
+                    inner_cols.update(c.name.lower()
+                                      for c in t.public_columns())
+                except Exception:
+                    pass
+        elif isinstance(node, ast.SubqueryRef):
+            inner_tables.add(node.alias.lower())
+            for f in getattr(node.query, "fields", []):
+                if f.alias:
+                    inner_cols.add(f.alias.lower())
+                elif isinstance(f.expr, ast.ColumnRef):
+                    inner_cols.add(f.expr.name.lower())
+        elif isinstance(node, ast.Join):
+            from_names(node.left)
+            from_names(node.right)
+
+    if isinstance(query, ast.SelectStmt):
+        from_names(query.from_clause)
+
+    hit = [False]
+
+    def walk_expr(e):
+        if hit[0] or not isinstance(e, ast.Node):
+            return
+        if isinstance(e, ast.ColumnRef):
+            if e.table:
+                if e.table.lower() not in inner_tables and \
+                        (e.table.lower(), e.name.lower()) in outer_names:
+                    hit[0] = True
+            else:
+                if infoschema is not None and e.name.lower() in outer_bare \
+                        and e.name.lower() not in inner_cols:
+                    hit[0] = True
+            return
+        if isinstance(e, (ast.InSubquery, ast.Exists, ast.ScalarSubquery)):
+            return  # nested blocks judge their own correlation
+        for attr in ("left", "right", "operand", "expr", "low", "high",
+                     "else_expr", "value"):
+            v = getattr(e, attr, None)
+            if isinstance(v, ast.Node):
+                walk_expr(v)
+        for attr in ("args", "items"):
+            v = getattr(e, attr, None)
+            if isinstance(v, list):
+                for x in v:
+                    walk_expr(x)
+        if isinstance(e, ast.CaseWhen):
+            for w, t in e.branches:
+                walk_expr(w)
+                walk_expr(t)
+
+    if isinstance(query, ast.SelectStmt):
+        for f in query.fields:
+            walk_expr(f.expr)
+        if query.where is not None:
+            walk_expr(query.where)
+    return hit[0]
+
+
+def _walk_exprs(plan: LogicalPlan):
+    """All expressions in a logical plan tree."""
+    from .logical import LogicalWindow
+
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children)
+        if isinstance(node, LogicalSelection):
+            yield from node.conds
+        elif isinstance(node, LogicalProjection):
+            yield from node.exprs
+        elif isinstance(node, LogicalAggregation):
+            yield from node.group_by
+            for a in node.aggs:
+                yield from a.args
+        elif isinstance(node, LogicalJoin):
+            for l, r in node.eq_conds:
+                yield l
+                yield r
+            yield from node.other_conds
+        elif isinstance(node, (LogicalSort, LogicalTopN)):
+            for e, _ in node.items:
+                yield e
+        elif isinstance(node, LogicalDataSource):
+            yield from node.pushed_conds
+        elif isinstance(node, LogicalWindow):
+            for _, f in node.funcs:
+                yield from f.args
+            yield from node.partition_by
+            for e, _ in node.order_by:
+                yield e
 
 
 def _contains_agg(e: ast.Expr) -> bool:

@@ -154,11 +154,12 @@ class ExprBuilder:
             return col.to_expr()
         if not e.table and e.name.lower() in self.alias_fields:
             return self.alias_fields[e.name.lower()]
-        # correlated reference into an enclosing query block
+        # correlated reference into an enclosing query block: resolve to the
+        # outer column's uid — the subquery planner decorrelates or rejects
         for sc in self.outer_schemas:
             oc = sc.try_resolve(e.name, e.table)
             if oc is not None:
-                raise CorrelatedColumn(oc)
+                return oc.to_expr()
         raise UnknownColumnError(
             f"{e.table + '.' if e.table else ''}{e.name}"
         )
