@@ -1,0 +1,148 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain
+from tidb_tpu.store.fault import FAILPOINTS, once
+
+
+@pytest.fixture()
+def sess():
+    return Domain().new_session()
+
+
+def test_union_scan_sees_committed_base_update(sess):
+    """ADVICE high #1: a committed UPDATE of a base row must stay visible
+    through UnionScanExec when the session txn is dirty on the table."""
+    sess.execute("create table t (a bigint, b bigint)")
+    sess.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    # force rows into base blocks
+    sess.domain.storage.maybe_compact(
+        sess.domain.catalog.info_schema().table("test", "t").id, threshold=0
+    )
+    sess.execute("update t set b = 11 where a = 1")  # autocommit update
+    sess.execute("begin")
+    sess.execute("insert into t values (4, 40)")  # txn now dirty on t
+    rows = sess.query("select a, b from t order by a")
+    sess.execute("rollback")
+    assert rows == [(1, 11), (2, 20), (3, 30), (4, 40)]
+
+
+def test_union_scan_committed_update_not_compacted(sess):
+    """Same scenario without compaction: update lands in the delta chain."""
+    sess.execute("create table t (a bigint, b bigint)")
+    sess.execute("insert into t values (1, 10), (2, 20)")
+    sess.execute("update t set b = 99 where a = 2")
+    sess.execute("begin")
+    sess.execute("update t set b = 100 where a = 1")  # dirty
+    rows = sess.query("select a, b from t order by a")
+    sess.execute("commit")
+    assert rows == [(1, 100), (2, 99)]
+
+
+def test_correlated_count_returns_zero_not_null(sess):
+    """ADVICE high #2: COUNT over an empty correlated group reads 0, so the
+    unmatched outer row qualifies (classic COUNT decorrelation bug)."""
+    sess.execute("create table t1 (a bigint)")
+    sess.execute("create table t2 (b bigint)")
+    sess.execute("insert into t1 values (5), (0)")
+    sess.execute("insert into t2 values (5), (5)")
+    # a=5: count=2, 5>2 yes.  a=0: count=0, 0>0 no.
+    assert sess.query(
+        "select a from t1 where a > (select count(*) from t2 where t2.b = t1.a)"
+    ) == [(5,)]
+    # and the zero must be observable as a value too
+    sess.execute("create table t3 (c bigint)")
+    sess.execute("insert into t3 values (7)")
+    assert sess.query(
+        "select c from t3 where (select count(*) from t2 where t2.b = t3.c) = 0"
+    ) == [(7,)]
+
+
+def test_join_null_keys_never_match_sentinel_value(sess):
+    """ADVICE low #3: a probe value equal to the old NULL sentinel
+    -(1<<62) must not match NULL build keys."""
+    sentinel = -(1 << 62)
+    sess.execute("create table b (k bigint, v bigint)")
+    sess.execute("create table p (k bigint, w bigint)")
+    sess.execute(f"insert into b values (null, 1), ({sentinel}, 2)")
+    sess.execute(f"insert into p values ({sentinel}, 10), (null, 20)")
+    rows = sess.query(
+        "select p.w, b.v from p join b on p.k = b.k"
+    )
+    # only the real sentinel-valued pair matches; NULLs never join
+    assert rows == [(10, 2)]
+
+
+def test_keytable_sentinel_key():
+    """ADVICE low #4: a real key equal to the C table's old EMPTY sentinel
+    (INT64_MIN+7) must factorize correctly, not read uninitialized slots."""
+    from tidb_tpu.native import KeyTable
+
+    weird = np.int64(-(1 << 63) + 7)
+    keys = np.array([weird, 5, weird, 7, weird], dtype=np.int64)
+    t = KeyTable(4)
+    codes = t.upsert(keys)
+    assert codes[0] == codes[2] == codes[4]
+    assert len({int(c) for c in codes}) == 3
+    probe = t.lookup(np.array([weird, 6], dtype=np.int64))
+    assert probe[0] == codes[0]
+    assert probe[1] == -1
+
+
+def test_select_result_close_cancels(sess):
+    """ADVICE low #5: closing a SelectResult early (LIMIT satisfied) stops
+    the producer instead of leaking a blocked thread."""
+    import threading
+
+    sess.execute("create table big (a bigint)")
+    t = sess.domain.catalog.info_schema().table("test", "big")
+    store = sess.domain.storage.table(t.id)
+    store.bulk_load_arrays(
+        [np.arange(200_000, dtype=np.int64)],
+        ts=sess.domain.storage.current_ts(),
+    )
+    sess.domain.storage.regions.split_even(t.id, 16, store.base_rows)
+    before = threading.active_count()
+    for _ in range(5):
+        rows = sess.query("select a from big limit 3")
+        assert len(rows) == 3
+    # producer threads must exit; allow scheduler slack
+    import time
+
+    deadline = time.time() + 5
+    while threading.active_count() > before + 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 2
+
+
+def test_scan_fault_device_fallback(sess):
+    """Runtime device error on one region task falls back to the CPU engine
+    and the query still returns correct rows."""
+    sess.execute("create table t (a bigint)")
+    t = sess.domain.catalog.info_schema().table("test", "t")
+    store = sess.domain.storage.table(t.id)
+    store.bulk_load_arrays(
+        [np.arange(1000, dtype=np.int64)],
+        ts=sess.domain.storage.current_ts(),
+    )
+    sess.domain.storage.regions.split_even(t.id, 4, store.base_rows)
+    FAILPOINTS.enable("distsql/task_error", once(RuntimeError("chip died")))
+    try:
+        rows = sess.query("select sum(a) from t")
+        assert rows == [(sum(range(1000)),)]
+    finally:
+        FAILPOINTS.disable("distsql/task_error")
+
+
+def test_scan_fault_transient_retry(sess):
+    """A transient non-device task error retries with backoff and succeeds."""
+    sess.execute("set tidb_use_tpu = 0")
+    sess.execute("create table t (a bigint)")
+    sess.execute("insert into t values (1), (2), (3)")
+    FAILPOINTS.enable("distsql/task_error", once(OSError("net blip")))
+    try:
+        assert sess.query("select sum(a) from t") == [(6,)]
+    finally:
+        FAILPOINTS.disable("distsql/task_error")

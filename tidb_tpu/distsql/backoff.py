@@ -1,0 +1,54 @@
+"""Typed exponential backoff for the distributed scan path.
+
+Reference: store/tikv/backoff.go:243-298 — a Backoffer carries a total sleep
+budget per request; each backoff *type* has its own base/cap growth schedule,
+and exceeding the budget surfaces the last error instead of retrying forever.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from ..errors import KVError
+
+# (base_ms, cap_ms) per backoff type — mirrors backoff.go's NewBackoffFn
+# schedules (equal-jitter growth, capped).
+BACKOFF_TYPES: Dict[str, tuple] = {
+    "region_miss": (2, 500),
+    "task_error": (5, 1000),
+    "device_error": (10, 2000),
+}
+
+
+class BackoffBudgetExceeded(KVError):
+    pass
+
+
+class Backoffer:
+    """Sleep with exponential growth per type, bounded by a total budget."""
+
+    def __init__(self, budget_ms: int = 10_000, *, sleep=time.sleep):
+        self.budget_ms = budget_ms
+        self.slept_ms = 0.0
+        self._attempts: Dict[str, int] = {}
+        self._sleep = sleep
+        self.errors: list = []
+
+    def backoff(self, typ: str, err: BaseException | None = None):
+        if err is not None:
+            self.errors.append(err)
+        base, cap = BACKOFF_TYPES.get(typ, (5, 1000))
+        n = self._attempts.get(typ, 0)
+        self._attempts[typ] = n + 1
+        ms = min(base * (2 ** n), cap)
+        if self.slept_ms + ms > self.budget_ms:
+            raise BackoffBudgetExceeded(
+                f"backoff budget exhausted after {self.slept_ms:.0f}ms "
+                f"({typ}); last error: {self.errors[-1] if self.errors else None}"
+            ) from err
+        self._sleep(ms / 1000.0)
+        self.slept_ms += ms
+
+    def attempts(self, typ: str) -> int:
+        return self._attempts.get(typ, 0)

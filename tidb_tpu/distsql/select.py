@@ -8,9 +8,14 @@ fan-out: key ranges split per region into tasks, executed by a bounded worker
 pool, results streamed back with optional order preservation (KeepOrder /
 sendRate) — DP over storage shards.
 
-Here the worker pool is a ThreadPoolExecutor (workers block on numpy/JAX which
-release the GIL); per-region results are queued and yielded in task order when
-keep_order, else completion order.
+Resilience (region_request.go:74-161 + backoff.go analogs):
+- per-task retry with typed exponential backoff (Backoffer);
+- a device failure at *runtime* (not just DAG-analysis time) retries the
+  failed region task on the CPU engine, so one sick chip degrades one
+  region's throughput instead of killing the query;
+- close() actually cancels: a stop event is honored by queued tasks and
+  producer puts, and unstarted futures are cancelled (the reference's
+  copIterator Close + killed-flag behavior).
 """
 
 from __future__ import annotations
@@ -23,7 +28,10 @@ from typing import Iterator, List, Optional
 
 from ..chunk import Chunk
 from ..copr.ir import DAG
+from ..errors import TiDBTPUError
+from ..store.fault import FAILPOINTS
 from ..store.kv import CopRequest, KeyRange
+from .backoff import Backoffer
 
 
 @dataclass
@@ -74,10 +82,14 @@ class RequestBuilder:
 _DONE = object()
 
 
+class _Closed(Exception):
+    """Internal: the consumer closed the result; abandon production."""
+
+
 class SelectResult:
     """Streaming chunk iterator over the fan-out (select_result.go:43).
 
-    Pull API: next_chunk() -> Chunk | None.  Close() cancels outstanding
+    Pull API: next_chunk() -> Chunk | None.  close() cancels outstanding
     work.  Exec summaries accumulate for EXPLAIN ANALYZE.
     """
 
@@ -86,15 +98,71 @@ class SelectResult:
         self.req = req
         self._chunks: "queue.Queue" = queue.Queue(maxsize=max(4, req.concurrency * 2))
         self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
         self._closed = False
-        self._pending: List[Chunk] = []
         self._rows_returned = 0
+        self.fallback_tasks = 0  # regions that ran on the CPU engine after a device error
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # ---- producer side -------------------------------------------------
-    def _run(self):
+    def _put(self, item):
+        """Bounded put that never deadlocks a closed result."""
+        while True:
+            if self._stop.is_set():
+                raise _Closed()
+            try:
+                self._chunks.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def _run_task(self, clip: KeyRange) -> List[Chunk]:
+        """One region's cop task: retry transient errors with typed backoff;
+        on a device (non-framework) error, rerun the region on the CPU
+        engine — the runtime analog of the JaxUnsupported compile-time
+        fallback."""
+        from ..metrics import REGISTRY
+
         client = self.storage.get_client()
+        bo = Backoffer()
+        engine = self.req.engine
+        while True:
+            if self._stop.is_set():
+                raise _Closed()
+            sub = CopRequest(
+                dag=self.req.dag, ranges=[clip], ts=self.req.ts,
+                concurrency=1, keep_order=self.req.keep_order,
+                streaming=self.req.streaming, engine=engine,
+            )
+            try:
+                FAILPOINTS.hit("distsql/task_error", range=clip)
+                out: List[Chunk] = []
+                for resp in client.send(sub):
+                    out.extend(resp.chunks)
+                REGISTRY.inc("cop_tasks_total")
+                REGISTRY.inc(f"cop_tasks_{engine}_total")
+                return out
+            except TiDBTPUError:
+                # semantic error (lock conflict, kill, quota, bad plan):
+                # surfaces to the consumer, never silently retried here —
+                # region-level routing retry already ran inside CoprClient
+                raise
+            except _Closed:
+                raise
+            except BaseException as e:
+                if engine == "tpu":
+                    # runtime device failure: this region falls back to the
+                    # CPU engine (coprocessor.go:912-999 retries a failed
+                    # region; our "other store" is the host oracle engine)
+                    engine = "cpu"
+                    self.fallback_tasks += 1
+                    REGISTRY.inc("cop_tasks_device_fallback_total")
+                    bo.backoff("device_error", e)
+                    continue
+                bo.backoff("task_error", e)
+
+    def _run(self):
         try:
             # split ranges per region up front: each task is one region's clip
             tasks = []
@@ -102,54 +170,45 @@ class SelectResult:
                 for region, clipped in self.storage.regions.locate(kr):
                     tasks.append(clipped)
             if not tasks:
-                self._chunks.put(_DONE)
+                self._put(_DONE)
                 return
             n_workers = min(self.req.concurrency, len(tasks))
 
-            def run_task(clip: KeyRange) -> List[Chunk]:
-                from ..metrics import REGISTRY
-
-                sub = CopRequest(
-                    dag=self.req.dag, ranges=[clip], ts=self.req.ts,
-                    concurrency=1, keep_order=self.req.keep_order,
-                    streaming=self.req.streaming, engine=self.req.engine,
-                )
-                out: List[Chunk] = []
-                for resp in client.send(sub):
-                    out.extend(resp.chunks)
-                REGISTRY.inc("cop_tasks_total")
-                REGISTRY.inc(f"cop_tasks_{self.req.engine}_total")
-                return out
-
             if n_workers == 1:
                 for clip in tasks:
-                    if self._closed:
-                        return
-                    for c in run_task(clip):
-                        self._chunks.put(c)
-            else:
-                with ThreadPoolExecutor(max_workers=n_workers) as pool:
-                    futures = [pool.submit(run_task, t) for t in tasks]
-                    if self.req.keep_order:
-                        # task submission order == handle order (locate is
-                        # sorted); yield in that order
-                        for f in futures:
-                            if self._closed:
-                                return
-                            for c in f.result():
-                                self._chunks.put(c)
-                    else:
-                        from concurrent.futures import as_completed
+                    for c in self._run_task(clip):
+                        self._put(c)
+                self._put(_DONE)
+                return
 
-                        for f in as_completed(futures):
-                            if self._closed:
-                                return
-                            for c in f.result():
-                                self._chunks.put(c)
-            self._chunks.put(_DONE)
+            pool = ThreadPoolExecutor(max_workers=n_workers)
+            futures = [pool.submit(self._run_task, t) for t in tasks]
+            try:
+                if self.req.keep_order:
+                    # task submission order == handle order (locate is
+                    # sorted); yield in that order
+                    for f in futures:
+                        for c in f.result():
+                            self._put(c)
+                else:
+                    from concurrent.futures import as_completed
+
+                    for f in as_completed(futures):
+                        for c in f.result():
+                            self._put(c)
+                self._put(_DONE)
+            finally:
+                for f in futures:
+                    f.cancel()
+                pool.shutdown(wait=False)
+        except _Closed:
+            pass
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
-            self._chunks.put(_DONE)
+            try:
+                self._put(_DONE)
+            except _Closed:
+                pass
 
     # ---- consumer side -------------------------------------------------
     def next_chunk(self) -> Optional[Chunk]:
@@ -159,9 +218,9 @@ class SelectResult:
         if item is _DONE:
             if self._err is not None:
                 err, self._err = self._err, None
-                self._closed = True
+                self.close()
                 raise err
-            self._closed = True
+            self.close()
             return None
         self._rows_returned += item.num_rows
         return item
@@ -175,7 +234,8 @@ class SelectResult:
 
     def close(self):
         self._closed = True
-        # drain so the producer unblocks
+        self._stop.set()
+        # drain so a producer blocked on a full queue unblocks immediately
         try:
             while True:
                 self._chunks.get_nowait()

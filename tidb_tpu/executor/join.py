@@ -139,13 +139,15 @@ class HashJoinExec(Executor):
         self._build_chunk = bc
         mat, null = _key_matrix(bc, self.build_keys, self._str_dict)
         codes = _hash_combine(mat) if bc.num_rows else np.zeros(0, np.int64)
-        # null keys never match: shunt them to a reserved unmatched bucket
+        # null keys never match: drop them from the match structure entirely
+        # (a sentinel code could collide with a legitimate probe value in the
+        # single-column path, which skips exact verification)
         self._mat_multi = mat.shape[1] > 1
         self._build_mat = mat
-        codes = np.where(null, np.int64(-(1 << 62)), codes)
-        self._order = np.argsort(codes, kind="stable")
+        nonnull = np.flatnonzero(~null)
+        local = np.argsort(codes[nonnull], kind="stable")
+        self._order = nonnull[local]
         self._sorted_codes = codes[self._order]
-        self._build_null = null
         self._built = True
 
     def _probe_codes(self, chunk: Chunk):

@@ -157,7 +157,10 @@ class UnionScanExec(Executor):
                     if m.op == "put":
                         rows.append(tuple(m.values[o] for o in self.col_offsets))
                         handles.append(h)
-                elif h >= store.base_rows:  # base inserts already filtered
+                elif h in inserted:
+                    # covers both new handles (>= base_rows) and committed
+                    # updates of base handles: the base loop removed the old
+                    # version via `dele`, the new version is emitted here
                     rows.append(tuple(inserted[h][o] for o in self.col_offsets))
                     handles.append(h)
             if rows:

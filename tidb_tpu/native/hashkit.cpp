@@ -25,6 +25,8 @@ extern "C" {
 typedef struct {
     int64_t *slots;   // key per slot
     int64_t *codes;   // dense code per slot
+    uint8_t *used;    // occupancy per slot (no sentinel key value: every
+                      // int64 is a legal key)
     uint64_t mask;    // capacity - 1
     int64_t n;        // distinct count
 } ht64;
@@ -36,8 +38,6 @@ static inline uint64_t mix64(uint64_t x) {
     return x ^ (x >> 31);
 }
 
-static const int64_t EMPTY = INT64_MIN + 7;  // sentinel unlikely as key
-
 ht64 *ht64_new(int64_t expected) {
     uint64_t cap = 16;
     while (cap < (uint64_t)(expected * 2 + 1)) cap <<= 1;
@@ -45,8 +45,11 @@ ht64 *ht64_new(int64_t expected) {
     if (!h) return nullptr;
     h->slots = (int64_t *)malloc(cap * sizeof(int64_t));
     h->codes = (int64_t *)malloc(cap * sizeof(int64_t));
-    if (!h->slots || !h->codes) { free(h->slots); free(h->codes); free(h); return nullptr; }
-    for (uint64_t i = 0; i < cap; i++) h->slots[i] = EMPTY;
+    h->used = (uint8_t *)calloc(cap, 1);
+    if (!h->slots || !h->codes || !h->used) {
+        free(h->slots); free(h->codes); free(h->used); free(h);
+        return nullptr;
+    }
     h->mask = cap - 1;
     h->n = 0;
     return h;
@@ -56,6 +59,7 @@ void ht64_free(ht64 *h) {
     if (!h) return;
     free(h->slots);
     free(h->codes);
+    free(h->used);
     free(h);
 }
 
@@ -65,19 +69,20 @@ static int ht64_grow(ht64 *h) {
     uint64_t cap = old_cap << 1;
     int64_t *slots = (int64_t *)malloc(cap * sizeof(int64_t));
     int64_t *codes = (int64_t *)malloc(cap * sizeof(int64_t));
-    if (!slots || !codes) { free(slots); free(codes); return 0; }
-    for (uint64_t i = 0; i < cap; i++) slots[i] = EMPTY;
+    uint8_t *used = (uint8_t *)calloc(cap, 1);
+    if (!slots || !codes || !used) { free(slots); free(codes); free(used); return 0; }
     uint64_t mask = cap - 1;
     for (uint64_t i = 0; i < old_cap; i++) {
+        if (!h->used[i]) continue;
         int64_t k = h->slots[i];
-        if (k == EMPTY) continue;
         uint64_t pos = mix64((uint64_t)k) & mask;
-        while (slots[pos] != EMPTY) pos = (pos + 1) & mask;
+        while (used[pos]) pos = (pos + 1) & mask;
         slots[pos] = k;
         codes[pos] = h->codes[i];
+        used[pos] = 1;
     }
-    free(h->slots); free(h->codes);
-    h->slots = slots; h->codes = codes; h->mask = mask;
+    free(h->slots); free(h->codes); free(h->used);
+    h->slots = slots; h->codes = codes; h->used = used; h->mask = mask;
     return 1;
 }
 
@@ -94,15 +99,15 @@ int64_t ht64_upsert(ht64 *h, const int64_t *keys, const uint8_t *valid,
         int64_t k = keys[i];
         uint64_t pos = mix64((uint64_t)k) & h->mask;
         for (;;) {
-            int64_t s = h->slots[pos];
-            if (s == k) { codes_out[i] = h->codes[pos]; break; }
-            if (s == EMPTY) {
+            if (!h->used[pos]) {
                 h->slots[pos] = k;
                 h->codes[pos] = h->n;
+                h->used[pos] = 1;
                 codes_out[i] = h->n;
                 h->n++;
                 break;
             }
+            if (h->slots[pos] == k) { codes_out[i] = h->codes[pos]; break; }
             pos = (pos + 1) & h->mask;
         }
     }
@@ -117,9 +122,8 @@ void ht64_lookup(const ht64 *h, const int64_t *keys, const uint8_t *valid,
         int64_t k = keys[i];
         uint64_t pos = mix64((uint64_t)k) & h->mask;
         for (;;) {
-            int64_t s = h->slots[pos];
-            if (s == k) { codes_out[i] = h->codes[pos]; break; }
-            if (s == EMPTY) { codes_out[i] = -1; break; }
+            if (!h->used[pos]) { codes_out[i] = -1; break; }
+            if (h->slots[pos] == k) { codes_out[i] = h->codes[pos]; break; }
             pos = (pos + 1) & h->mask;
         }
     }

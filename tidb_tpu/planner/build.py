@@ -302,7 +302,18 @@ class PlanBuilder:
             aggs.append(d)
             uid = next_uid()
             agg_uids.append(uid)
-            return ColumnExpr(-1, d.ftype.with_nullable(True), str(d), uid)
+            col = ColumnExpr(-1, d.ftype.with_nullable(True), str(d), uid)
+            if name == "count":
+                # the LEFT JOIN below yields NULL for unmatched outer rows,
+                # but COUNT over an empty group must read 0 (the classic
+                # COUNT decorrelation bug; reference rule_decorrelate.go
+                # wraps count outputs the same way)
+                from ..expr.builtins import infer_ftype
+
+                zero = Constant(0, d.ftype)
+                ft = infer_ftype("ifnull", [col.ftype, zero.ftype], {})
+                return ScalarFunc("ifnull", [col, zero], ft, {})
+            return col
 
         feb = ExprBuilder(inner.schema, collector, None, [schema] + outer,
                           self.param_values)
