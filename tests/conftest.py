@@ -8,6 +8,11 @@ against in-process mocktikv (store/mockstore/tikv.go:100).
 
 import os
 
+# Small device tiles so ordinary test tables (a few thousand rows) span
+# multiple tiles AND multiple mesh shards — the cross-tile merge, deletion
+# masks beyond tile 0, and shard_map collective paths all execute under test.
+os.environ.setdefault("TIDB_TPU_TILE", "1024")
+
 # Must be set before jax is imported anywhere.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,57 @@
+"""Byte-capped FIFO cache for device-resident arrays.
+
+Shared by the per-tile device cache (jax_engine._DeviceCache) and the
+mesh-sharded column cache (parallel.MESH_CACHE) — one eviction policy, one
+bookkeeping implementation.  The role of TiKV's block cache: immutable base
+data keyed on (store_uid, base_version, ...), so a version bump naturally
+invalidates without explicit eviction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Tuple
+
+
+class ByteCapCache:
+    """key -> tuple of device arrays (anything with .nbytes)."""
+
+    def __init__(self, capacity_bytes: int):
+        self._cache: Dict[tuple, tuple] = {}
+        self._order: List[tuple] = []
+        self._bytes = 0
+        self.capacity = capacity_bytes
+        self._mu = threading.Lock()
+
+    def get_or_load(self, key: tuple, loader: Callable[[], Tuple]) -> tuple:
+        with self._mu:
+            hit = self._cache.get(key)
+            if hit is not None:
+                return hit
+        value = loader()  # outside the lock: loads transfer data
+        nbytes = sum(v.nbytes for v in value)
+        with self._mu:
+            hit = self._cache.get(key)
+            if hit is not None:  # raced with another loader; keep first
+                return hit
+            while self._bytes + nbytes > self.capacity and self._order:
+                old = self._order.pop(0)
+                ov = self._cache.pop(old)
+                self._bytes -= sum(v.nbytes for v in ov)
+            self._cache[key] = value
+            self._order.append(key)
+            self._bytes += nbytes
+        return value
+
+    def clear(self):
+        with self._mu:
+            self._cache.clear()
+            self._order.clear()
+            self._bytes = 0
+
+    def __len__(self):
+        return len(self._cache)
+
+    @property
+    def items_view(self):
+        return self._cache

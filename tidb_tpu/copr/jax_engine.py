@@ -47,7 +47,11 @@ from .ir import (
 from .jax_eval import JaxUnsupported, _np_dtype_for, compile_expr
 from .aggstate import finalize as agg_finalize
 
-TILE = 1 << 20  # rows per device dispatch
+import os as _os
+
+# rows per device dispatch; env-overridable so tests exercise multi-tile
+# paths with small tables (TIDB_TPU_TILE=1024 in tests/conftest.py)
+TILE = int(_os.environ.get("TIDB_TPU_TILE", 1 << 20))
 MAX_GROUPS = 1 << 16  # cap on dense group-code space
 
 
@@ -130,29 +134,20 @@ class _DeviceCache:
     """(table_id, base_version, store_col, tile_idx) -> (data, valid) on device."""
 
     def __init__(self, capacity_bytes: int = 8 << 30):
-        self._cache: Dict[tuple, tuple] = {}
-        self._order: List[tuple] = []
-        self._bytes = 0
-        self.capacity = capacity_bytes
+        from .cache import ByteCapCache
+
+        self._c = ByteCapCache(capacity_bytes)
 
     def get_tile(self, table, store_ci: int, tile_idx: int, start: int,
                  end: int, device=None):
-        key = (table.store_uid, table.base_version, store_ci, tile_idx)
-        hit = self._cache.get(key)
-        if hit is not None:
-            return hit
-        data, valid = _gather_tile(table, store_ci, start, end)
-        data = jax.device_put(data, device)
-        valid = jax.device_put(valid, device)
-        nbytes = data.nbytes + valid.nbytes
-        while self._bytes + nbytes > self.capacity and self._order:
-            old = self._order.pop(0)
-            od, ov = self._cache.pop(old)
-            self._bytes -= od.nbytes + ov.nbytes
-        self._cache[key] = (data, valid)
-        self._order.append(key)
-        self._bytes += nbytes
-        return data, valid
+        key = (table.store_uid, table.base_version, store_ci, tile_idx,
+               None if device is None else device.id)
+
+        def load():
+            data, valid = _gather_tile(table, store_ci, start, end)
+            return jax.device_put(data, device), jax.device_put(valid, device)
+
+        return self._c.get_or_load(key, load)
 
 
 def _gather_tile(table, store_ci: int, start: int, end: int):

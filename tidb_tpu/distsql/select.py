@@ -164,6 +164,34 @@ class SelectResult:
 
     def _run(self):
         try:
+            if self.req.engine == "tpu":
+                # mesh-parallel path: the whole base scan as ONE shard_map
+                # program over the device mesh (copr/parallel.py); falls
+                # back to per-region fan-out when ineligible or on a
+                # device failure
+                out = None
+                try:
+                    from ..copr.parallel import try_run_mesh
+
+                    out = try_run_mesh(self.storage, self.req)
+                except TiDBTPUError:
+                    raise
+                except Exception:
+                    import logging
+
+                    from ..metrics import REGISTRY
+
+                    REGISTRY.inc("mesh_scan_errors_total")
+                    logging.getLogger("tidb_tpu.distsql").warning(
+                        "mesh scan failed; falling back to per-region path",
+                        exc_info=True,
+                    )
+                    out = None
+                if out is not None:
+                    for c in out:
+                        self._put(c)
+                    self._put(_DONE)
+                    return
             # split ranges per region up front: each task is one region's clip
             tasks = []
             for kr in self.req.ranges:

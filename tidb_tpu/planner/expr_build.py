@@ -37,6 +37,40 @@ _BINOP_CANON = {
     "<>": "!=", "&&": "and", "||": "or", "<=>": "nulleq",
 }
 
+_TEMPORAL_CMP = {"=", "!=", "nulleq", "<", "<=", ">", ">=", "in"}
+
+
+def _normalize_temporal_consts(name: str,
+                               args: List[Expression]) -> List[Expression]:
+    """Fold string literals to DATE/DATETIME constants when compared against
+    a temporal expression: `l_shipdate <= '1998-09-02'` plans with an int
+    day constant, so the predicate is device-compilable (jax_eval rejects
+    raw string constants) and the CPU engine skips per-row parsing."""
+    if name not in _TEMPORAL_CMP:
+        return args
+    target = None
+    for a in args:
+        if a.ftype.kind in (TypeKind.DATE, TypeKind.DATETIME) and not (
+            isinstance(a, Constant)
+        ):
+            target = a.ftype.kind
+            break
+    if target is None:
+        return args
+    out: List[Expression] = []
+    for a in args:
+        if (isinstance(a, Constant) and a.ftype.kind == TypeKind.STRING
+                and isinstance(a.value, str)):
+            try:
+                if target == TypeKind.DATE:
+                    a = Constant(parse_date(a.value), ty_date(False))
+                else:
+                    a = Constant(parse_datetime(a.value), ty_datetime(False))
+            except (ValueError, IndexError):
+                pass  # not a temporal literal; leave for runtime semantics
+        out.append(a)
+    return out
+
 _TYPE_NAME_TO_FT = {
     "signed": lambda p, s: ty_int(),
     "unsigned": lambda p, s: ty_uint(),
@@ -169,6 +203,7 @@ class ExprBuilder:
         meta = meta or {}
         if name not in REGISTRY:
             raise PlanError(f"unknown function {name!r}")
+        args = _normalize_temporal_consts(name, args)
         ft = infer_ftype(name, [a.ftype for a in args], meta)
         return ScalarFunc(name, args, ft, meta)
 

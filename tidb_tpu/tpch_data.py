@@ -1,0 +1,51 @@
+"""Shared TPC-H-shaped data generation (bench.py + driver dryrun).
+
+One lineitem recipe so the benchmark and the multichip dryrun can never
+drift apart on schema or data distribution.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LINEITEM_DDL = (
+    "create table lineitem ("
+    " l_orderkey bigint, l_quantity decimal(15,2),"
+    " l_extendedprice double, l_discount double, l_tax double,"
+    " l_returnflag varchar(1), l_linestatus varchar(1),"
+    " l_shipdate date)"
+)
+
+
+def build_lineitem(n: int, regions: int = 8, seed: int = 7):
+    """Fresh Domain with `n` synthetic lineitem rows split over `regions`
+    regions; returns the session."""
+    from .session import Domain
+    from .types.values import parse_date
+
+    domain = Domain()
+    s = domain.new_session()
+    s.execute(LINEITEM_DDL)
+    t = domain.catalog.info_schema().table("test", "lineitem")
+    store = domain.storage.table(t.id)
+    rng = np.random.default_rng(seed)
+    base = parse_date("1992-01-01")
+    span = parse_date("1998-12-01") - base
+    flags = np.array(["A", "N", "R"], dtype=object)
+    status = np.array(["F", "O"], dtype=object)
+    CHUNK = 1 << 21
+    for s0 in range(0, n, CHUNK):
+        m = min(CHUNK, n - s0)
+        arrays = [
+            rng.integers(1, n // 4 + 2, m, dtype=np.int64),     # orderkey
+            rng.integers(100, 5100, m, dtype=np.int64),          # qty (scaled .2)
+            rng.uniform(900.0, 105000.0, m),                     # extendedprice
+            np.round(rng.uniform(0.0, 0.1, m), 2),               # discount
+            np.round(rng.uniform(0.0, 0.08, m), 2),              # tax
+            flags[rng.integers(0, 3, m)],                        # returnflag
+            status[rng.integers(0, 2, m)],                       # linestatus
+            (base + rng.integers(0, span, m)).astype(np.int32),  # shipdate
+        ]
+        store.bulk_load_arrays(arrays, ts=domain.storage.current_ts())
+    domain.storage.regions.split_even(t.id, regions, store.base_rows)
+    return s
