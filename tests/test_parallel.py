@@ -135,6 +135,76 @@ def test_mesh_first_row_groupkey(sess):
     _parity(sess, "select s, min(k) from t group by s order by s")
 
 
+@pytest.fixture(scope="module")
+def ndv_sess():
+    """High-NDV / float / NULLable group keys -> the sort-based device agg."""
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table h (k bigint, f double, g bigint, x double)")
+    t = d.catalog.info_schema().table("test", "h")
+    store = d.storage.table(t.id)
+    rng = np.random.default_rng(5)
+    n = 30_000
+    gv = rng.integers(0, 200_000, n)       # NDV far beyond the 64k dense cap
+    gvalid = rng.random(n) > 0.02          # ~2% NULL keys
+    fv = np.round(rng.uniform(0, 3, n), 1)
+    store.bulk_load_arrays(
+        [np.arange(n, dtype=np.int64), fv, gv.astype(np.int64),
+         rng.uniform(0, 10, n)],
+        valids=[None, None, gvalid, None],
+        ts=d.storage.current_ts(),
+    )
+    d.storage.regions.split_even(t.id, 5, store.base_rows)
+    return s
+
+
+def _sort_parity(sess, sql):
+    e0 = REGISTRY.snapshot().get("mesh_scan_errors_total", 0)
+    m0 = _mesh_count()
+    rows = _parity(sess, sql)
+    assert _mesh_count() > m0, f"not on the mesh path: {sql}"
+    assert REGISTRY.snapshot().get("mesh_scan_errors_total", 0) == e0
+    return rows
+
+
+def test_sort_agg_high_ndv(ndv_sess):
+    rows = _sort_parity(
+        ndv_sess,
+        "select g, count(*), sum(x), min(x), max(x), avg(x) from h "
+        "group by g order by g limit 50",
+    )
+    assert len(rows) == 50
+
+
+def test_sort_agg_null_key_group(ndv_sess):
+    """NULL is its own group and must survive the device path."""
+    rows = _sort_parity(
+        ndv_sess, "select count(*) from h where g is null")
+    assert rows[0][0] > 0
+
+
+def test_sort_agg_float_key(ndv_sess):
+    rows = _sort_parity(
+        ndv_sess, "select f, count(*), sum(x) from h group by f order by f")
+    assert len(rows) == 31
+
+
+def test_sort_agg_multi_key(ndv_sess):
+    _sort_parity(
+        ndv_sess,
+        "select f, g, count(*) from h where g < 1000 "
+        "group by f, g order by f, g",
+    )
+
+
+def test_sort_agg_first_row_key(ndv_sess):
+    """Selecting a group key column uses first_row partials."""
+    _sort_parity(
+        ndv_sess,
+        "select g, min(k) from h where g < 5000 group by g order by g",
+    )
+
+
 def test_mesh_multi_range_not_used():
     """>4 disjoint ranges falls back to the per-region path but stays
     correct."""

@@ -264,41 +264,63 @@ class _Analyzed:
         # group-key layout for device aggregation
         self.group_cols: List[int] = []  # scan-output indices
         self.group_card: List[Tuple[int, int]] = []  # (lo, card) per key
+        # 'dense': mixed-radix int codes + segment reduce (small key spaces);
+        # 'sort': per-shard lexsort + boundary segments (arbitrary NDV,
+        #         float/NULLable keys) — mesh path only
+        self.agg_mode = "dense"
         if self.agg is not None:
-            g = 1
-            for k in self.agg.group_by:
-                if not isinstance(k, ColumnExpr):
-                    raise JaxUnsupported("device group key must be a column")
-                if k.ftype.kind == TypeKind.FLOAT:
-                    # dense int codes would truncate: 1.2 and 1.4 collapse
-                    raise JaxUnsupported("float group key on device")
-                store_ci = self.scan.columns[k.index]
-                lo, hi, has_null = table.column_stats(store_ci)
-                if has_null:
-                    # NULL is its own group in SQL; the dense-code space has
-                    # no slot for it -> host fallback
-                    raise JaxUnsupported("NULLable group key on device")
-                if hi < lo:
-                    lo, hi = 0, 0
-                card = hi - lo + 1
-                if card <= 0 or card > MAX_GROUPS:
-                    raise JaxUnsupported("group key cardinality too large")
-                g *= card
-                if g > MAX_GROUPS:
-                    raise JaxUnsupported("combined group space too large")
-                self.group_cols.append(k.index)
-                self.group_card.append((lo, card))
-            self.num_groups = max(g, 1)
             for a in self.agg.aggs:
                 if a.distinct:
                     raise JaxUnsupported("distinct agg on device")
                 if a.name not in ("count", "sum", "avg", "min", "max",
                                   "first_row"):
                     raise JaxUnsupported(f"device agg {a.name}")
-                self.agg_args = None
+            try:
+                self._analyze_dense_keys(table)
+            except JaxUnsupported:
+                # high-NDV / float / NULLable / non-column keys: the mesh
+                # engine groups by sorting — keys only need to be
+                # device-compilable
+                for k in self.agg.group_by:
+                    if not can_push_expr(k, dict_cols=dict_scan_idx):
+                        raise
+                self.agg_mode = "sort"
+                self.num_groups = 0
+                self.group_cols = []
+                self.group_card = []
         if self.topn is not None:
             if len(self.topn.order_by) != 1:
                 raise JaxUnsupported("device topn supports one sort key")
+
+    def _analyze_dense_keys(self, table):
+        g = 1
+        group_cols: List[int] = []
+        group_card: List[Tuple[int, int]] = []
+        for k in self.agg.group_by:
+            if not isinstance(k, ColumnExpr):
+                raise JaxUnsupported("dense group key must be a column")
+            if k.ftype.kind == TypeKind.FLOAT:
+                # dense int codes would truncate: 1.2 and 1.4 collapse
+                raise JaxUnsupported("float group key on device")
+            store_ci = self.scan.columns[k.index]
+            lo, hi, has_null = table.column_stats(store_ci)
+            if has_null:
+                # NULL is its own group in SQL; the dense-code space has
+                # no slot for it
+                raise JaxUnsupported("NULLable group key on device")
+            if hi < lo:
+                lo, hi = 0, 0
+            card = hi - lo + 1
+            if card <= 0 or card > MAX_GROUPS:
+                raise JaxUnsupported("group key cardinality too large")
+            g *= card
+            if g > MAX_GROUPS:
+                raise JaxUnsupported("combined group space too large")
+            group_cols.append(k.index)
+            group_card.append((lo, card))
+        self.group_cols = group_cols
+        self.group_card = group_card
+        self.num_groups = max(g, 1)
 
     def needed_cols(self) -> List[int]:
         """Scan-output col indices the device actually needs."""
@@ -307,6 +329,8 @@ class _Analyzed:
             c.collect_columns(need)
         if self.agg is not None:
             need.update(self.group_cols)
+            for k in self.agg.group_by:
+                k.collect_columns(need)
             for a in self.agg.aggs:
                 for x in a.args:
                     x.collect_columns(need)
@@ -336,8 +360,10 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
     }
     if an.agg is not None:
         payload["agg"] = {
+            "mode": an.agg_mode,
             "keys": an.group_cols,
             "card": an.group_card,
+            "group_by": [serialize_expr(g) for g in an.agg.group_by],
             "aggs": [
                 {"name": a.name, "args": [serialize_expr(x) for x in a.args]}
                 for a in an.agg.aggs
@@ -505,6 +531,10 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     """Execute `dag` over base rows [start, end) on the device; returns
     result chunks (partial-agg rows, topn rows, or filtered rows)."""
     an = _Analyzed(dag, table)
+    if an.agg is not None and an.agg_mode != "dense":
+        # sort-based grouping needs the mesh program (copr/parallel.py);
+        # the per-tile fallback path hands these to the CPU engine
+        raise JaxUnsupported("sort-mode agg runs on the mesh path only")
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
     )

@@ -178,6 +178,9 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
             m = m & v & (d != 0)
         return m
 
+    if kind == "agg" and an.agg_mode == "sort":
+        return _build_sort_agg_fn(an, col_order, mesh, tiles_per_shard)
+
     if kind == "agg":
         agg_ir = an.agg
         G = an.num_groups
@@ -311,6 +314,216 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     return wrapped
 
 
+class MeshAggOverflow(Exception):
+    """Per-shard distinct-group count exceeded the static output budget;
+    the caller falls back to the host hash aggregation."""
+
+
+def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
+                       tiles_per_shard: int):
+    """Sort-based per-shard partial aggregation for arbitrary group keys
+    (any NDV, float, NULLable, expression keys).
+
+    Per shard: lexsort rows by (key bits..., null flags..., selected-last),
+    mark group boundaries, segment-reduce into a static OUT-sized budget,
+    and emit compacted (keys, partial states).  No collectives: partial
+    chunks stream back per shard and the ROOT final HashAgg merges them —
+    exactly the reference's coprocessor-partial/root-final split
+    (executor/aggregate.go:101-169) mapped onto the mesh.
+    """
+    import os as _os
+
+    S = len(mesh.devices.ravel())
+    Tl = tiles_per_shard
+    n_local = Tl * je.TILE
+    n_global = S * n_local
+    OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
+    agg_ir = an.agg
+
+    tags = []
+    for a in agg_ir.aggs:
+        if a.name == "count":
+            tags.append("count")
+        elif a.name in ("sum", "avg"):
+            tags.append("sumcount")
+        elif a.name in ("min", "max"):
+            tags.append("minmax")
+        else:
+            tags.append("argfirst")
+
+    def cols_env(datas, valids):
+        return {
+            ci: (datas[j].reshape(n_local), valids[j].reshape(n_local))
+            for j, ci in enumerate(col_order)
+        }
+
+    def shard_fn(datas, valids, del_mask, start, end):
+        cols = cols_env(datas, valids)
+        shard = jax.lax.axis_index("dp").astype(jnp.int64)
+        gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
+        m = (gofs >= start) & (gofs < end) & del_mask.reshape(n_local)
+        for c in an.conds:
+            d, v = compile_expr(c, cols, n_local)
+            m = m & v & (d != 0)
+        key_bits, key_flags = [], []
+        for g in agg_ir.group_by:
+            d, v = compile_expr(g, cols, n_local)
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                dd = jnp.where(d == 0.0, 0.0, d)  # -0.0 groups with 0.0
+                bits = jax.lax.bitcast_convert_type(
+                    dd.astype(jnp.float64), jnp.int64
+                )
+            else:
+                bits = d.astype(jnp.int64)
+            key_bits.append(jnp.where(v, bits, jnp.int64(0)))
+            key_flags.append(v.astype(jnp.int64))
+        # lexsort: LAST key is primary -> selected rows first, grouped by key
+        order = jnp.lexsort(
+            tuple(key_bits + key_flags + [(~m).astype(jnp.int64)])
+        )
+        sm = m[order]
+        sgofs = gofs[order]
+        skeys = [k[order] for k in key_bits + key_flags]
+        ar = jnp.arange(n_local, dtype=jnp.int64)
+        diff = ar == 0
+        for k in skeys:
+            diff = diff | (k != jnp.roll(k, 1))
+        boundary = sm & diff
+        n_uniq = boundary.sum().astype(jnp.int64)
+        seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0, OUT - 1)
+        pos = jnp.nonzero(boundary, size=OUT, fill_value=n_local - 1)[0]
+        out_keys = tuple(k[pos] for k in skeys)
+        results = []
+        for a in agg_ir.aggs:
+            if a.name == "count":
+                if a.args:
+                    d, v = compile_expr(a.args[0], cols, n_local)
+                    results.append(
+                        ops.masked_segment_count(seg, sm & v[order], OUT)
+                    )
+                else:
+                    results.append(ops.masked_segment_count(seg, sm, OUT))
+                continue
+            d, v = compile_expr(a.args[0], cols, n_local)
+            d, mv = d[order], sm & v[order]
+            if a.name in ("sum", "avg"):
+                st = a.partial_types()[0]
+                dd = _to_state_dtype(d, a.args[0].ftype, st)
+                results.append((
+                    ops.masked_segment_sum(dd, seg, mv, OUT),
+                    ops.masked_segment_count(seg, mv, OUT),
+                ))
+            elif a.name == "min":
+                results.append((
+                    ops.masked_segment_min(d, seg, mv, OUT),
+                    ops.masked_segment_count(seg, mv, OUT),
+                ))
+            elif a.name == "max":
+                results.append((
+                    ops.masked_segment_max(d, seg, mv, OUT),
+                    ops.masked_segment_count(seg, mv, OUT),
+                ))
+            elif a.name == "first_row":
+                contrib = jnp.where(mv, sgofs, jnp.int64(n_global))
+                results.append(
+                    jax.ops.segment_min(contrib, seg, num_segments=OUT)
+                )
+        return n_uniq.reshape(1), out_keys, tuple(results)
+
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+        out_specs=P("dp"),
+    )
+    jitted = jax.jit(fn)
+
+    def wrapped(datas, valids, del_mask, start, end):
+        n_uniq, keys, results = jitted(
+            tuple(datas), tuple(valids), del_mask,
+            jnp.int64(start), jnp.int64(end),
+        )
+        return {
+            "mode": "sort",
+            "S": S, "OUT": OUT,
+            "n_uniq": np.asarray(n_uniq),
+            "keys": [np.asarray(k) for k in keys],
+            "results": [(t, _np_tree(r)) for t, r in zip(tags, results)],
+        }
+
+    return wrapped
+
+
+def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
+    """Per-shard compacted groups -> partial chunks [keys..., states...]
+    in the same layout the CPU engine emits (root final agg merges)."""
+    from ..types import TypeKind as TK
+
+    S, OUT = out["S"], out["OUT"]
+    n_uniq = out["n_uniq"]
+    nk = len(an.agg.group_by)
+    chunks: List[Chunk] = []
+    for s in range(S):
+        k_s = int(n_uniq[s])
+        if k_s > OUT:
+            raise MeshAggOverflow(
+                f"shard {s}: {k_s} groups > budget {OUT}"
+            )
+        if k_s == 0:
+            continue
+        lo = s * OUT
+        cols: List[Column] = []
+        for i, g in enumerate(an.agg.group_by):
+            bits = out["keys"][i][lo: lo + k_s]
+            flags = out["keys"][nk + i][lo: lo + k_s].astype(np.bool_)
+            ft = g.ftype
+            if ft.kind == TK.FLOAT:
+                data = bits.view(np.float64)
+            elif ft.kind == TK.STRING:
+                from ..store.blockstore import _decode_dict
+
+                store_ci = an.scan.columns[g.index]
+                data = _decode_dict(
+                    bits.astype(np.int64), table.cols[store_ci].dictionary
+                )
+            else:
+                data = bits.astype(ft.np_dtype)
+            cols.append(Column(ft, data, flags if not flags.all() else None))
+        for a, (tag, r) in zip(an.agg.aggs, out["results"]):
+            pts = a.partial_types()
+            if tag == "count":
+                cols.append(
+                    Column(pts[0], r[lo: lo + k_s].astype(np.int64))
+                )
+            elif tag == "sumcount":
+                sm_, c = r[0][lo: lo + k_s], r[1][lo: lo + k_s]
+                sum_col = Column(pts[0], sm_.astype(pts[0].np_dtype), c > 0)
+                cols.append(sum_col)
+                if a.name == "avg":
+                    cols.append(Column(pts[1], c.astype(np.int64)))
+            elif tag == "minmax":
+                v, c = r[0][lo: lo + k_s], r[1][lo: lo + k_s]
+                arg_ft = a.args[0].ftype
+                if arg_ft.kind == TK.STRING:
+                    from ..store.blockstore import _decode_dict
+
+                    store_ci = an.scan.columns[a.args[0].index]
+                    obj = _decode_dict(
+                        v.astype(np.int64),
+                        table.cols[store_ci].dictionary,
+                    )
+                    cols.append(Column(pts[0], obj, c > 0))
+                else:
+                    cols.append(Column(pts[0], v.astype(pts[0].np_dtype), c > 0))
+            elif tag == "argfirst":
+                idx = r[lo: lo + k_s]
+                vals, valid = _gather_first_values(
+                    table, an, a.args[0], idx, k_s
+                )
+                cols.append(Column(pts[0], vals, valid))
+        chunks.append(Chunk(cols))
+    return chunks
+
+
 # ---------------------------------------------------------------------------
 # entry: run a CopRequest's base scan over the mesh
 # ---------------------------------------------------------------------------
@@ -373,7 +586,16 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
         end = min(kr.end, table.base_rows)
         if start >= end:
             continue
-        if kind == "agg":
+        if kind == "agg" and an.agg_mode == "sort":
+            try:
+                chunks.extend(_sort_agg_chunks(
+                    fn(datas, valids, del_mask, start, end), table, an,
+                ))
+            except MeshAggOverflow:
+                # data-dependent, by-design: too many distinct groups per
+                # shard — hand the whole request to the host hash agg
+                return None
+        elif kind == "agg":
             gcount, results = fn(datas, valids, del_mask, start, end)
             agg_accum = _merge_mesh_agg(
                 agg_accum, np.asarray(gcount),
@@ -513,9 +735,16 @@ def _merge_mesh_agg(accum, gcount: np.ndarray, results, table, an: _Analyzed):
 
 def _resolve_first_global(table, an: _Analyzed, arg, idx: np.ndarray):
     """Resolve global first-row indices to values (host gather)."""
+    return _gather_first_values(table, an, arg, idx, an.num_groups)
+
+
+def _gather_first_values(table, an: _Analyzed, arg, idx: np.ndarray, G: int):
+    """(values[G], valid[G]) for first_row partials: gather only the store
+    columns the argument reads, not the whole scan width."""
+    from ..expr.expression import ColumnExpr
+
     have = idx < table.base_rows
     sel = np.flatnonzero(have)
-    G = an.num_groups
     st = arg.ftype
     if st.kind == TypeKind.STRING:
         vals = np.empty(G, dtype=object)
@@ -524,8 +753,16 @@ def _resolve_first_global(table, an: _Analyzed, arg, idx: np.ndarray):
         vals = np.zeros(G, dtype=st.np_dtype)
     valid = np.zeros(G, dtype=np.bool_)
     if len(sel):
-        rows = table.gather_chunk(list(an.scan.columns), idx[sel])
-        v = arg.eval(rows)
-        vals[sel] = v.data
-        valid[sel] = v.validity()
+        if isinstance(arg, ColumnExpr):
+            rows = table.gather_chunk(
+                [an.scan.columns[arg.index]], idx[sel]
+            )
+            col = rows.col(0)
+            vals[sel] = col.data
+            valid[sel] = col.validity()
+        else:
+            rows = table.gather_chunk(list(an.scan.columns), idx[sel])
+            v = arg.eval(rows)
+            vals[sel] = v.data
+            valid[sel] = v.validity()
     return vals, valid
