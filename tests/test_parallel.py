@@ -205,6 +205,88 @@ def test_sort_agg_first_row_key(ndv_sess):
     )
 
 
+@pytest.fixture(scope="module")
+def q3_sess():
+    """customer ⋈ orders ⋈ lineitem with the fact scan on the mesh."""
+    from tidb_tpu.types.values import parse_date
+
+    d = Domain()
+    s = d.new_session()
+    rng = np.random.default_rng(2)
+    s.execute("create table customer (c_custkey bigint, c_mktsegment varchar(10))")
+    s.execute("create table orders (o_orderkey bigint, o_custkey bigint, "
+              "o_orderdate date, o_shippriority bigint)")
+    s.execute("create table lineitem (l_orderkey bigint, l_extendedprice double, "
+              "l_discount double, l_shipdate date)")
+    nc, no, nl = 1000, 4000, 20000
+    segs = np.array(["BUILDING", "AUTOMOBILE", "MACHINERY"], dtype=object)
+    base = parse_date("1995-01-01")
+    for name, arrays in (
+        ("customer", [np.arange(1, nc + 1, dtype=np.int64),
+                      segs[rng.integers(0, 3, nc)]]),
+        ("orders", [np.arange(1, no + 1, dtype=np.int64),
+                    rng.integers(1, nc + 1, no).astype(np.int64),
+                    (base + rng.integers(-200, 200, no)).astype(np.int32),
+                    rng.integers(0, 3, no).astype(np.int64)]),
+        ("lineitem", [rng.integers(1, no + 1, nl).astype(np.int64),
+                      rng.uniform(900, 100000, nl),
+                      np.round(rng.uniform(0, 0.1, nl), 2),
+                      (base + rng.integers(-200, 200, nl)).astype(np.int32)]),
+    ):
+        t = d.catalog.info_schema().table("test", name)
+        d.storage.table(t.id).bulk_load_arrays(
+            arrays, ts=d.storage.current_ts())
+    lt = d.catalog.info_schema().table("test", "lineitem")
+    d.storage.regions.split_even(lt.id, 6, d.storage.table(lt.id).base_rows)
+    return s
+
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)), o_orderdate,
+       o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < '1995-03-15' and l_shipdate > '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by 2 desc, o_orderdate limit 10
+"""
+
+
+def test_q3_plans_runtime_filter(q3_sess):
+    rs = q3_sess.execute("explain " + Q3)[0]
+    plan = "\n".join(str(r) for r in rs.rows)
+    assert "JoinProbe" in plan, plan
+    assert "runtime-filter" in plan, plan
+
+
+def test_q3_parity_with_device_probe(q3_sess):
+    e0 = REGISTRY.snapshot().get("mesh_scan_errors_total", 0)
+    _parity(q3_sess, Q3)
+    assert REGISTRY.snapshot().get("mesh_scan_errors_total", 0) == e0
+
+
+def test_runtime_filter_semi_join(q3_sess):
+    _parity(
+        q3_sess,
+        "select count(*) from lineitem where l_orderkey in "
+        "(select o_orderkey from orders where o_orderdate < '1994-09-01')",
+    )
+
+
+def test_runtime_filter_null_probe_keys():
+    """Probe rows with NULL keys never pass the device filter."""
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table bb (k bigint, v bigint)")
+    s.execute("create table pp (k bigint, w bigint)")
+    s.execute("insert into bb values (1, 1), (2, 2)")
+    s.execute("insert into pp values (1, 10), (null, 99), (2, 20)")
+    rows = sorted(s.query(
+        "select pp.w, bb.v from pp join bb on pp.k = bb.k"))
+    assert rows == [(10, 1), (20, 2)]
+
+
 def test_mesh_multi_range_not_used():
     """>4 disjoint ranges falls back to the per-region path but stays
     correct."""

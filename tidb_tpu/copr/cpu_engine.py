@@ -20,20 +20,37 @@ from . import aggstate
 from .ir import (
     DAG,
     AggregationIR,
+    JoinProbeIR,
     LimitIR,
     ProjectionIR,
     SelectionIR,
     TableScanIR,
     TopNIR,
+    key_bits_int64,
 )
 
 
-def run_dag_on_chunk(dag: DAG, chunk: Chunk) -> Chunk:
+def run_dag_on_chunk(dag: DAG, chunk: Chunk, aux: Optional[dict] = None) -> Chunk:
     """Interpret the post-scan part of `dag` over one scan-output chunk."""
     for ex in dag.executors[1:]:
         if isinstance(ex, SelectionIR):
             mask = eval_bool_mask(ex.conditions, chunk)
             chunk = chunk.filter(mask)
+        elif isinstance(ex, JoinProbeIR):
+            keys = (aux or {}).get(f"probe_keys_{ex.filter_id}")
+            if keys is None:
+                raise ExecutorError(
+                    f"missing runtime probe keys {ex.filter_id}"
+                )
+            v = ex.key.eval(chunk)
+            bits = key_bits_int64(v.data)
+            pos = np.searchsorted(keys, bits)
+            pos_c = np.clip(pos, 0, max(len(keys) - 1, 0))
+            member = (
+                (keys[pos_c] == bits) & v.validity()
+                if len(keys) else np.zeros(chunk.num_rows, dtype=np.bool_)
+            )
+            chunk = chunk.filter(member)
         elif isinstance(ex, ProjectionIR):
             chunk = Chunk([e.eval(chunk).to_column() for e in ex.exprs])
         elif isinstance(ex, AggregationIR):

@@ -29,6 +29,7 @@ from .jax_eval import JaxUnsupported
 def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
     table = storage.table(region.table_id)
     dag = DAG.from_dict(req.dag)
+    aux = req.aux
     ts = req.ts
     deleted, inserted = table.delta_overlay(ts, clipped.start, clipped.end)
 
@@ -40,15 +41,18 @@ def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
                 from .jax_engine import run_base_jax
 
                 chunks.extend(
-                    run_base_jax(table, dag, clipped.start, base_end, deleted)
+                    run_base_jax(table, dag, clipped.start, base_end, deleted,
+                                 aux=aux)
                 )
             except JaxUnsupported:
                 chunks.extend(
-                    _run_base_cpu(table, dag, clipped.start, base_end, deleted)
+                    _run_base_cpu(table, dag, clipped.start, base_end,
+                                  deleted, aux)
                 )
         else:
             chunks.extend(
-                _run_base_cpu(table, dag, clipped.start, base_end, deleted)
+                _run_base_cpu(table, dag, clipped.start, base_end, deleted,
+                              aux)
             )
     if inserted:
         handles = sorted(inserted)
@@ -59,7 +63,7 @@ def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
             vals = [inserted[h][store_ci] for h in handles]
             cols.append(Column.from_values(ft, vals))
         delta_chunk = Chunk(cols)
-        res = run_dag_on_chunk(dag, delta_chunk)
+        res = run_dag_on_chunk(dag, delta_chunk, aux)
         if res.num_rows:
             chunks.append(res)
 
@@ -68,7 +72,7 @@ def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
 
 
 def _run_base_cpu(table, dag: DAG, start: int, end: int,
-                  deleted) -> List[Chunk]:
+                  deleted, aux=None) -> List[Chunk]:
     """CPU path over base rows, tile by tile (bounded memory)."""
     TILE = 1 << 18
     del_arr = np.asarray(sorted(deleted), dtype=np.int64)
@@ -83,7 +87,7 @@ def _run_base_cpu(table, dag: DAG, start: int, end: int,
                 keep = np.ones(chunk.num_rows, dtype=np.bool_)
                 keep[dd] = False
                 chunk = chunk.filter(keep)
-        res = run_dag_on_chunk(dag, chunk)
+        res = run_dag_on_chunk(dag, chunk, aux)
         if res.num_rows:
             out.append(res)
     return out

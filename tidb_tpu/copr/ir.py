@@ -145,6 +145,42 @@ class AggregationIR:
 
 
 @dataclass
+class JoinProbeIR:
+    """Runtime semi-join filter: membership test of a probe key against the
+    join build side's key set, shipped at execution time in CopRequest.aux
+    under ``probe_keys_{filter_id}`` (sorted int64).
+
+    The device analog of the reference's IndexLookUpJoin building inner
+    requests from outer rows (executor/index_lookup_join.go): the hash
+    join drains its build side, broadcasts the distinct key set to every
+    shard, and the fact-table scan drops non-matching rows ON DEVICE before
+    they ever reach the host probe."""
+
+    key: Expression
+    filter_id: int = 0
+
+    def to_dict(self):
+        return {
+            "type": "join_probe",
+            "key": serialize_expr(self.key),
+            "filter_id": self.filter_id,
+        }
+
+
+def key_bits_int64(data, validity=None):
+    """Canonical int64 representation of join/group key values (host side):
+    float64 by bit pattern with -0.0 normalized, everything else widened to
+    int64.  Must match the device-side bitcast in copr/parallel.py."""
+    import numpy as np
+
+    if data.dtype == np.float64:
+        bits = np.where(data == 0.0, 0.0, data).view(np.int64)
+    else:
+        bits = data.astype(np.int64, copy=False)
+    return bits
+
+
+@dataclass
 class TopNIR:
     order_by: List[Tuple[Expression, bool]]  # (expr, desc)
     limit: int
@@ -212,6 +248,10 @@ class DAG:
                         ed.get("mode", "partial"),
                         ed.get("stream", False),
                     )
+                )
+            elif t == "join_probe":
+                out.append(
+                    JoinProbeIR(deserialize_expr(ed["key"]), ed["filter_id"])
                 )
             elif t == "topn":
                 out.append(

@@ -37,6 +37,7 @@ from ..types import FieldType, TypeKind, ty_int
 from .ir import (
     DAG,
     AggregationIR,
+    JoinProbeIR,
     LimitIR,
     ProjectionIR,
     SelectionIR,
@@ -198,6 +199,7 @@ class _Analyzed:
     def __init__(self, dag: DAG, table):
         self.scan: TableScanIR = dag.scan
         self.selections: List[SelectionIR] = []
+        self.probes: List[JoinProbeIR] = []
         self.projection: Optional[ProjectionIR] = None
         self.agg: Optional[AggregationIR] = None
         self.topn: Optional[TopNIR] = None
@@ -207,6 +209,10 @@ class _Analyzed:
                 if self.agg or self.topn or self.projection:
                     raise JaxUnsupported("selection after agg/topn on device")
                 self.selections.append(ex)
+            elif isinstance(ex, JoinProbeIR):
+                if self.agg or self.topn or self.projection:
+                    raise JaxUnsupported("join probe after agg/topn on device")
+                self.probes.append(ex)
             elif isinstance(ex, ProjectionIR):
                 if self.agg or self.topn:
                     raise JaxUnsupported("projection after agg/topn on device")
@@ -236,7 +242,7 @@ class _Analyzed:
         }
         all_exprs: List[Expression] = [
             c for s in self.selections for c in s.conditions
-        ]
+        ] + [p.key for p in self.probes]
         if self.projection is not None:
             all_exprs += self.projection.exprs
         if self.topn is not None:
@@ -327,6 +333,8 @@ class _Analyzed:
         need: set = set()
         for c in self.conds:
             c.collect_columns(need)
+        for p in self.probes:
+            p.key.collect_columns(need)
         if self.agg is not None:
             need.update(self.group_cols)
             for k in self.agg.group_by:
@@ -353,6 +361,7 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
     payload = {
         "kind": kind,
         "conds": [serialize_expr(c) for c in an.conds],
+        "probes": [[serialize_expr(p.key), p.filter_id] for p in an.probes],
         "proj": [serialize_expr(p) for p in an.proj_exprs]
         if an.proj_exprs is not None
         else None,
@@ -527,7 +536,7 @@ def _to_state_dtype(d, src_ft: FieldType, state_ft: FieldType):
 
 
 def run_base_jax(table, dag: DAG, start: int, end: int,
-                 deleted: Sequence[int]) -> List[Chunk]:
+                 deleted: Sequence[int], aux=None) -> List[Chunk]:
     """Execute `dag` over base rows [start, end) on the device; returns
     result chunks (partial-agg rows, topn rows, or filtered rows)."""
     an = _Analyzed(dag, table)
@@ -535,6 +544,10 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         # sort-based grouping needs the mesh program (copr/parallel.py);
         # the per-tile fallback path hands these to the CPU engine
         raise JaxUnsupported("sort-mode agg runs on the mesh path only")
+    if an.probes:
+        # runtime join filters run on the mesh path; per-region fallback
+        # evaluates them on the CPU engine
+        raise JaxUnsupported("join probe runs on the mesh path only")
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
     )

@@ -145,6 +145,32 @@ def _all_true(mesh: Mesh, n_pad: int):
 _COMPILED: Dict[str, object] = {}
 
 
+def _key_bits_device(d):
+    """Device-side canonical int64 key bits (must match ir.key_bits_int64)."""
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        dd = jnp.where(d == 0.0, 0.0, d)
+        return jax.lax.bitcast_convert_type(dd.astype(jnp.float64), jnp.int64)
+    return d.astype(jnp.int64)
+
+
+def _apply_probes(an: _Analyzed, cols, m, pargs, n_local: int):
+    """AND the runtime join-filter membership tests into the row mask:
+    sorted build keys broadcast to every shard, searchsorted probe."""
+    for i, p in enumerate(an.probes):
+        keys, kn = pargs[2 * i], pargs[2 * i + 1]
+        d, v = compile_expr(p.key, cols, n_local)
+        bits = _key_bits_device(d)
+        pos = jnp.searchsorted(keys, bits)
+        pos_c = jnp.clip(pos, 0, keys.shape[0] - 1)
+        hit = (pos < kn) & (keys[pos_c] == bits)
+        m = m & v & hit
+    return m
+
+
+def _probe_specs(an: _Analyzed):
+    return (P(), P()) * len(an.probes)
+
+
 def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
                    mesh: Mesh, tiles_per_shard: int):
     """One shard_map program over the whole table.
@@ -171,12 +197,12 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         row_mask = (gofs >= start) & (gofs < end) & del_mask.reshape(n_local)
         return gofs, row_mask
 
-    def selected(cols, row_mask):
+    def selected(cols, row_mask, pargs=()):
         m = row_mask
         for c in an.conds:
             d, v = compile_expr(c, cols, n_local)
             m = m & v & (d != 0)
-        return m
+        return _apply_probes(an, cols, m, pargs, n_local)
 
     if kind == "agg" and an.agg_mode == "sort":
         return _build_sort_agg_fn(an, col_order, mesh, tiles_per_shard)
@@ -195,10 +221,10 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
             else:
                 tags.append("argfirst")
 
-        def shard_fn(datas, valids, del_mask, start, end):
+        def shard_fn(datas, valids, del_mask, start, end, *pargs):
             cols = cols_env(datas, valids)
             gofs, row_mask = masks(del_mask, start, end)
-            m = selected(cols, row_mask)
+            m = selected(cols, row_mask, pargs)
             gidx = jnp.zeros(n_local, dtype=jnp.int64)
             stride = 1
             for kcol, (klo, card) in zip(an.group_cols, an.group_card):
@@ -250,15 +276,15 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
 
         fn = shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
             out_specs=P(),
         )
         jitted = jax.jit(fn)
 
-        def wrapped(datas, valids, del_mask, start, end):
+        def wrapped(datas, valids, del_mask, start, end, pargs=()):
             gcount, results = jitted(
                 tuple(datas), tuple(valids), del_mask,
-                jnp.int64(start), jnp.int64(end),
+                jnp.int64(start), jnp.int64(end), *pargs,
             )
             return gcount, list(zip(tags, results))
 
@@ -268,10 +294,10 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         key_expr, desc = an.topn.order_by[0]
         k = min(an.topn.limit, n_local)
 
-        def shard_fn(datas, valids, del_mask, start, end):
+        def shard_fn(datas, valids, del_mask, start, end, *pargs):
             cols = cols_env(datas, valids)
             gofs, row_mask = masks(del_mask, start, end)
-            m = selected(cols, row_mask)
+            m = selected(cols, row_mask, pargs)
             d, v = compile_expr(key_expr, cols, n_local)
             key = d.astype(jnp.float64)
             key = jnp.where(v, key, -1.7e308)  # NULL ordering (see jax_engine)
@@ -280,36 +306,36 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
 
         fn = shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
             out_specs=P("dp"),
         )
         jitted = jax.jit(fn)
 
-        def wrapped(datas, valids, del_mask, start, end):
+        def wrapped(datas, valids, del_mask, start, end, pargs=()):
             gidx, cnt = jitted(
                 tuple(datas), tuple(valids), del_mask,
-                jnp.int64(start), jnp.int64(end),
+                jnp.int64(start), jnp.int64(end), *pargs,
             )
             return np.asarray(gidx), np.asarray(cnt), k
         return wrapped
 
     # filter (with optional projection evaluated on device)
-    def shard_fn(datas, valids, del_mask, start, end):
+    def shard_fn(datas, valids, del_mask, start, end, *pargs):
         cols = cols_env(datas, valids)
         _, row_mask = masks(del_mask, start, end)
-        return selected(cols, row_mask)
+        return selected(cols, row_mask, pargs)
 
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
         out_specs=P("dp"),
     )
     jitted = jax.jit(fn)
 
-    def wrapped(datas, valids, del_mask, start, end):
+    def wrapped(datas, valids, del_mask, start, end, pargs=()):
         return np.asarray(jitted(
             tuple(datas), tuple(valids), del_mask,
-            jnp.int64(start), jnp.int64(end),
+            jnp.int64(start), jnp.int64(end), *pargs,
         ))
     return wrapped
 
@@ -357,7 +383,7 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
             for j, ci in enumerate(col_order)
         }
 
-    def shard_fn(datas, valids, del_mask, start, end):
+    def shard_fn(datas, valids, del_mask, start, end, *pargs):
         cols = cols_env(datas, valids)
         shard = jax.lax.axis_index("dp").astype(jnp.int64)
         gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
@@ -365,6 +391,7 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
         for c in an.conds:
             d, v = compile_expr(c, cols, n_local)
             m = m & v & (d != 0)
+        m = _apply_probes(an, cols, m, pargs, n_local)
         key_bits, key_flags = [], []
         for g in agg_ir.group_by:
             d, v = compile_expr(g, cols, n_local)
@@ -432,15 +459,15 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
 
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()),
+        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
         out_specs=P("dp"),
     )
     jitted = jax.jit(fn)
 
-    def wrapped(datas, valids, del_mask, start, end):
+    def wrapped(datas, valids, del_mask, start, end, pargs=()):
         n_uniq, keys, results = jitted(
             tuple(datas), tuple(valids), del_mask,
-            jnp.int64(start), jnp.int64(end),
+            jnp.int64(start), jnp.int64(end), *pargs,
         )
         return {
             "mode": "sort",
@@ -550,11 +577,34 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
     S = len(mesh.devices.ravel())
     n_tiles, n_pad, Tl = _layout(table.base_rows, S)
     col_order = an.needed_cols()
-    fp = _fingerprint(an, kind) + f"|mesh S={S} Tl={Tl} cols={col_order}"
+
+    # runtime join-filter payloads: sorted build keys, padded to a pow2
+    # bucket so compiled programs are reused across key-set sizes
+    pargs: list = []
+    kpads: List[int] = []
+    for p in an.probes:
+        arr = (req.aux or {}).get(f"probe_keys_{p.filter_id}")
+        if arr is None:
+            from ..errors import ExecutorError
+
+            raise ExecutorError(f"missing runtime probe keys {p.filter_id}")
+        k = len(arr)
+        kpad = 16
+        while kpad < k:
+            kpad <<= 1
+        padded = np.full(kpad, np.iinfo(np.int64).max, dtype=np.int64)
+        padded[:k] = arr
+        pargs.append(jnp.asarray(padded))
+        pargs.append(jnp.int64(k))
+        kpads.append(kpad)
+
+    fp = (_fingerprint(an, kind)
+          + f"|mesh S={S} Tl={Tl} cols={col_order} kpads={kpads}")
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mesh_fn(an, kind, col_order, mesh, Tl)
         _COMPILED[fp] = fn
+    pargs = tuple(pargs)
 
     # one delta pass for the whole table
     deleted, inserted = table.delta_overlay(req.ts, 0, 1 << 62)
@@ -589,20 +639,20 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
         if kind == "agg" and an.agg_mode == "sort":
             try:
                 chunks.extend(_sort_agg_chunks(
-                    fn(datas, valids, del_mask, start, end), table, an,
+                    fn(datas, valids, del_mask, start, end, pargs), table, an,
                 ))
             except MeshAggOverflow:
                 # data-dependent, by-design: too many distinct groups per
                 # shard — hand the whole request to the host hash agg
                 return None
         elif kind == "agg":
-            gcount, results = fn(datas, valids, del_mask, start, end)
+            gcount, results = fn(datas, valids, del_mask, start, end, pargs)
             agg_accum = _merge_mesh_agg(
                 agg_accum, np.asarray(gcount),
                 [(t, _np_tree(r)) for t, r in results], table, an,
             )
         elif kind == "topn":
-            gidx, cnts, k = fn(datas, valids, del_mask, start, end)
+            gidx, cnts, k = fn(datas, valids, del_mask, start, end, pargs)
             picks = []
             for s in range(S):
                 c = int(cnts[s])
@@ -614,7 +664,7 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
                     table.gather_chunk(list(an.scan.columns), handles)
                 )
         else:
-            mask = fn(datas, valids, del_mask, start, end)
+            mask = fn(datas, valids, del_mask, start, end, pargs)
             handles = np.flatnonzero(mask)
             if remaining is not None:
                 handles = handles[:remaining]
@@ -647,7 +697,7 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
                 ft = an.scan.ftypes[out_i]
                 vals = [in_range[h][store_ci] for h in handles]
                 cols.append(Column.from_values(ft, vals))
-            res = run_dag_on_chunk(dag, Chunk(cols))
+            res = run_dag_on_chunk(dag, Chunk(cols), req.aux)
             if res.num_rows:
                 if kind == "agg":
                     chunks.append(res)

@@ -134,6 +134,7 @@ class SelectResult:
                 dag=self.req.dag, ranges=[clip], ts=self.req.ts,
                 concurrency=1, keep_order=self.req.keep_order,
                 streaming=self.req.streaming, engine=engine,
+                aux=self.req.aux,
             )
             try:
                 FAILPOINTS.hit("distsql/task_error", range=clip)
@@ -273,7 +274,7 @@ class SelectResult:
 
 def select_dag(storage, dag: DAG, ranges: List[KeyRange], ts: int,
                concurrency: int = 8, keep_order: bool = False,
-               engine: str = "tpu") -> SelectResult:
+               engine: str = "tpu", aux: Optional[dict] = None) -> SelectResult:
     req = (
         RequestBuilder()
         .set_dag(dag)
@@ -284,4 +285,6 @@ def select_dag(storage, dag: DAG, ranges: List[KeyRange], ts: int,
         .set_engine(engine)
         .build()
     )
+    if aux:
+        req.aux = aux
     return SelectResult(storage, req)

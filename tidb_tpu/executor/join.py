@@ -44,8 +44,9 @@ def _key_matrix(chunk: Chunk, keys: List[Expression],
         null |= ~v.validity()
         data = v.data
         if v.ftype.kind == TypeKind.FLOAT:
-            d = np.where(data == 0.0, 0.0, data)  # normalize -0.0
-            cols.append(d.view(np.int64))
+            from ..copr.ir import key_bits_int64
+
+            cols.append(key_bits_int64(data))
         elif v.ftype.kind == TypeKind.STRING or data.dtype == object:
             codes = np.empty(n, dtype=np.int64)
             for i, s in enumerate(data):
@@ -102,7 +103,8 @@ class HashJoinExec(Executor):
     def __init__(self, ctx, build: Executor, probe: Executor, kind: str,
                  build_keys: List[Expression], probe_keys: List[Expression],
                  other_conds: List[Expression], probe_is_left: bool,
-                 plan_id: int = -1):
+                 plan_id: int = -1, rf_reader: Optional[Executor] = None,
+                 rf_key_idx: int = 0, rf_filter_id: int = 0):
         if kind in ("semi", "anti_semi"):
             ftypes = list(probe.ftypes)
         elif kind == "left_outer_semi":
@@ -128,6 +130,35 @@ class HashJoinExec(Executor):
         self._sorted_codes = None
         self._order = None
         self._str_dict: dict = {}
+        # runtime semi-join filter: after the build phase, ship the distinct
+        # build keys of eq-pair rf_key_idx to this reader's device DAG
+        # (JoinProbeIR) so the probe scan drops non-matching rows on device
+        self._rf_reader = rf_reader
+        self._rf_key_idx = rf_key_idx
+        self._rf_filter_id = rf_filter_id
+        self._probe_opened = False
+
+    def open(self):
+        # the probe child opens lazily in _next(): its scan fan-out must not
+        # start until the build side is drained and runtime-filter keys are
+        # attached (index_lookup_join.go builds inner requests the same way)
+        self.child(0).open()
+        self._open()
+        self._opened = True
+
+    def _ensure_probe_open(self):
+        if self._probe_opened:
+            return
+        if self._rf_reader is not None:
+            mat, null = self._build_mat, self._build_any_null
+            keys = np.unique(mat[~null, self._rf_key_idx]) if mat.shape[0] \
+                else np.zeros(0, dtype=np.int64)
+            self._rf_reader.set_runtime_aux({
+                f"probe_keys_{self._rf_filter_id}":
+                    np.ascontiguousarray(keys, dtype=np.int64)
+            })
+        self.child(1).open()
+        self._probe_opened = True
 
     # ---- build phase ---------------------------------------------------
     def _build_table(self):
@@ -148,6 +179,7 @@ class HashJoinExec(Executor):
         local = np.argsort(codes[nonnull], kind="stable")
         self._order = nonnull[local]
         self._sorted_codes = codes[self._order]
+        self._build_any_null = null
         self._built = True
 
     def _probe_codes(self, chunk: Chunk):
@@ -161,6 +193,7 @@ class HashJoinExec(Executor):
     def _next(self) -> Optional[Chunk]:
         if not self._built:
             self._build_table()
+        self._ensure_probe_open()
         while True:
             pc = self.child(1).next()
             if pc is None:

@@ -32,12 +32,19 @@ class TableReaderExec(Executor):
         self.ranges = ranges
         self.keep_order = keep_order
         self._result: Optional[SelectResult] = None
+        self._aux: Optional[dict] = None
+
+    def set_runtime_aux(self, aux: dict):
+        """Attach runtime payloads (e.g. join-probe key sets) before open;
+        the hash join calls this between its build and probe phases."""
+        self._aux = dict(aux) if self._aux is None else {**self._aux, **aux}
 
     def _open(self):
         self._result = select_dag(
             self.ctx.storage, self.dag, self.ranges, self.ctx.snapshot_ts(),
             concurrency=self.ctx.distsql_concurrency,
             keep_order=self.keep_order, engine=self.ctx.engine,
+            aux=self._aux,
         )
 
     def _next(self) -> Optional[Chunk]:

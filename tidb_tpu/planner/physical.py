@@ -34,7 +34,7 @@ from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
 from ..expr.pushdown import can_push_agg, can_push_expr
 from ..store.kv import KeyRange
 from ..store.regions import INF
-from ..types import FieldType, common_compare_type
+from ..types import FieldType, TypeKind, common_compare_type
 from .build import DeletePlan, InsertPlan, LoadDataPlan, UpdatePlan
 from .columns import Schema, SchemaCol
 from .logical import (
@@ -165,6 +165,11 @@ class PhysTableReader(PhysicalPlan):
                 info = f"limit:{ex.limit}"
             elif isinstance(ex, LimitIR):
                 info = f"limit:{ex.limit}"
+            else:
+                from ..copr.ir import JoinProbeIR
+
+                if isinstance(ex, JoinProbeIR):
+                    info = f"runtime filter: {ex.key} in build keys"
             lines.append((f"{pad2}{nm}", "", "cop[tpu]", info))
         return lines
 
@@ -310,13 +315,19 @@ class PhysHashJoin(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, kind: str,
                  left_keys: List[Expression], right_keys: List[Expression],
                  other_conds: List[Expression], build_right: bool,
-                 schema: Schema):
+                 schema: Schema, rf_build_key: Optional[int] = None,
+                 rf_filter_id: int = 0):
         super().__init__(schema, [left, right])
         self.kind = kind
         self.left_keys = left_keys
         self.right_keys = right_keys
         self.other_conds = other_conds
         self.build_right = build_right
+        # index of the eq-key pair whose build-side distinct values are
+        # shipped to the probe reader's device DAG as a runtime semi-join
+        # filter (JoinProbeIR); None = no runtime filter
+        self.rf_build_key = rf_build_key
+        self.rf_filter_id = rf_filter_id
 
     def info(self) -> str:
         keys = ", ".join(
@@ -324,6 +335,8 @@ class PhysHashJoin(PhysicalPlan):
         )
         side = "build:right" if self.build_right else "build:left"
         s = f"{self.kind} [{keys}] {side}"
+        if self.rf_build_key is not None:
+            s += " runtime-filter"
         if self.other_conds:
             s += " other:[" + ", ".join(map(str, self.other_conds)) + "]"
         return s
@@ -334,14 +347,18 @@ class PhysHashJoin(PhysicalPlan):
         left = self.children[0].build(ctx)
         right = self.children[1].build(ctx)
         if self.build_right:
-            return HashJoinExec(ctx, right, left, self.kind,
-                                self.right_keys, self.left_keys,
-                                self.other_conds, probe_is_left=True,
-                                plan_id=self.id)
-        return HashJoinExec(ctx, left, right, self.kind,
-                            self.left_keys, self.right_keys,
-                            self.other_conds, probe_is_left=False,
-                            plan_id=self.id)
+            build_exec, probe_exec, probe_is_left = right, left, True
+            bkeys, pkeys = self.right_keys, self.left_keys
+        else:
+            build_exec, probe_exec, probe_is_left = left, right, False
+            bkeys, pkeys = self.left_keys, self.right_keys
+        rf_reader = probe_exec if self.rf_build_key is not None else None
+        return HashJoinExec(ctx, build_exec, probe_exec, self.kind,
+                            bkeys, pkeys, self.other_conds,
+                            probe_is_left=probe_is_left, plan_id=self.id,
+                            rf_reader=rf_reader,
+                            rf_key_idx=self.rf_build_key or 0,
+                            rf_filter_id=self.rf_filter_id)
 
 
 class PhysSort(PhysicalPlan):
@@ -901,8 +918,74 @@ def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
         # EXISTS with no correlation: keys empty -> every probe row matches
         # iff build side non-empty; HashJoinExec handles empty key lists.
         pass
+    rf = _attach_runtime_filter(
+        plan.kind, left, right, lkeys, rkeys, build_right, pctx
+    )
+    rf_key, rf_id = rf if rf is not None else (None, 0)
     return PhysHashJoin(left, right, plan.kind, lkeys, rkeys, others,
-                        build_right, plan.schema)
+                        build_right, plan.schema, rf_build_key=rf_key,
+                        rf_filter_id=rf_id)
+
+
+def _attach_runtime_filter(kind, left, right, lkeys, rkeys, build_right,
+                           pctx) -> Optional[Tuple[int, int]]:
+    """Device semi-join probe (runtime filter): when the probe side is a
+    plain cop scan and a join key is device-eligible, append a JoinProbeIR
+    to the probe DAG — the hash join ships its build-side distinct keys to
+    the device so non-matching fact rows die before reaching the host.
+
+    The device analog of index_lookup_join.go building inner requests from
+    outer rows; only row-reducing join kinds qualify (inner/semi — outer
+    and anti joins need the non-matching probe rows too)."""
+    if kind not in ("inner", "semi"):
+        return None
+    if not pctx.enable_pushdown:
+        return None
+    probe = left if build_right else right
+    build = right if build_right else left
+    pkeys = lkeys if build_right else rkeys
+    if not isinstance(probe, PhysTableReader) or not pkeys:
+        return None
+    # size gate: shipping + deduping a huge build key set costs more than it
+    # filters; only worth it when the build side is clearly the small side
+    build_est = _est_rows(build, pctx)
+    probe_est = _est_rows(probe, pctx)
+    if build_est > 2_000_000 or build_est > 0.5 * max(probe_est, 1):
+        return None
+    # DAG must end at scan [+ selections]: a probe after agg/topn/proj is
+    # not row-aligned with the scan
+    from ..copr.ir import JoinProbeIR
+
+    if any(not isinstance(ex, (SelectionIR, JoinProbeIR))
+           for ex in probe.dag.executors[1:]):
+        return None
+    from ..expr.pushdown import can_push_expr
+
+    dict_cols = {
+        i for i, ci in enumerate(probe.dag.scan.columns)
+        if ci in pctx.storage.table(probe.dag.scan.table_id)
+        .dict_encoded_cols()
+    }
+    from ..copr.ir import deserialize_expr, serialize_expr
+
+    for i, pk in enumerate(pkeys):
+        if pk.ftype.kind == TypeKind.STRING:
+            continue  # dict codes are store-local; skip string keys
+        # strip planner uids: IR exprs address scan-output POSITIONS
+        pk_pos = deserialize_expr(serialize_expr(pk))
+        cols: set = set()
+        pk_pos.collect_columns(cols)
+        if any(c >= len(probe.dag.scan.columns) for c in cols):
+            continue
+        if not can_push_expr(pk_pos, pctx.pushdown_blacklist, dict_cols):
+            continue
+        # unique per reader: a second join filtering the same scan gets its
+        # own aux slot instead of colliding on probe_keys_0
+        fid = sum(1 for ex in probe.dag.executors
+                  if isinstance(ex, JoinProbeIR))
+        probe.dag.executors.append(JoinProbeIR(pk_pos, filter_id=fid))
+        return i, fid
+    return None
 
 
 def _cop_selectivity(p: "PhysTableReader", conds, pctx) -> float:

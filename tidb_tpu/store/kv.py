@@ -51,6 +51,10 @@ class CopRequest:
     # "tpu" | "cpu" — per-request engine routing, the analog of
     # kv.StoreType TiKV/TiFlash (kv/kv.go:222-232)
     engine: str = "tpu"
+    # runtime payloads resolved at execution time (numpy arrays), e.g.
+    # probe_keys_{n} for JoinProbeIR — the analog of IndexLookUpJoin
+    # building inner requests from outer rows
+    aux: Optional[dict] = None
 
 
 @dataclass

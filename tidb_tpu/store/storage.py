@@ -143,6 +143,7 @@ class CoprClient(StoreClient):
                         keep_order=req.keep_order,
                         streaming=req.streaming,
                         engine=req.engine,
+                        aux=req.aux,
                     )
                     yield from self.send(subreq)
                     break
