@@ -1,0 +1,139 @@
+"""Durability + recovery: load, kill, reopen, query — identical results.
+
+Reference model (SURVEY.md §3.4): durable state lives in the store; a
+restarting node reloads and serves.  Prewrite locks are volatile by design —
+a crash aborts in-flight transactions via lock absence.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    return str(tmp_path / "data")
+
+
+def _fresh(data_dir):
+    return Domain(data_dir=data_dir).new_session()
+
+
+def test_restart_preserves_rows(data_dir):
+    s = _fresh(data_dir)
+    s.execute("create table t (a bigint, b double, s varchar(10), d date)")
+    s.execute("insert into t values (1, 1.5, 'x', '2020-01-01'), "
+              "(2, null, null, null), (3, 3.5, 'héllo', '1999-12-31')")
+    before = s.query("select * from t order by a")
+    del s  # no clean shutdown: durability must not rely on one
+
+    s2 = _fresh(data_dir)
+    assert s2.query("select * from t order by a") == before
+    # and the reloaded store keeps working: DML + read-your-writes
+    s2.execute("insert into t values (4, 4.5, 'y', '2021-06-01')")
+    assert s2.query("select count(*) from t") == [(4,)]
+
+    s3 = _fresh(data_dir)
+    assert s3.query("select count(*) from t") == [(4,)]
+
+
+def test_restart_preserves_bulk_base_and_delta(data_dir):
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table big (k bigint, v double)")
+    t = d.catalog.info_schema().table("test", "big")
+    store = d.storage.table(t.id)
+    rng = np.random.default_rng(0)
+    store.bulk_load_arrays(
+        [np.arange(5000, dtype=np.int64), rng.uniform(0, 1, 5000)],
+        ts=d.storage.current_ts(),
+    )
+    s.execute("update big set v = 99.0 where k = 17")   # delta put
+    s.execute("delete from big where k >= 4990")        # delta deletes
+    expect_cnt = s.query("select count(*), sum(k) from big")
+    expect_17 = s.query("select v from big where k = 17")
+
+    s2 = _fresh(data_dir)
+    assert s2.query("select count(*), sum(k) from big") == expect_cnt
+    assert s2.query("select v from big where k = 17") == expect_17
+
+
+def test_restart_after_compact(data_dir):
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint, s varchar(8))")
+    s.execute("insert into t values (1, 'aa'), (2, 'bb'), (3, 'cc')")
+    s.execute("update t set s = 'zz' where a = 2")
+    t = d.catalog.info_schema().table("test", "t")
+    d.storage.maybe_compact(t.id, threshold=0)  # folds delta, rewrites base
+    before = s.query("select * from t order by a")
+
+    s2 = _fresh(data_dir)
+    assert s2.query("select * from t order by a") == before
+
+
+def test_uncommitted_txn_lost_on_restart(data_dir):
+    """Percolator semantics: prewrite locks are volatile; a crash mid-txn
+    aborts it."""
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1)")
+    s.execute("begin")
+    s.execute("insert into t values (2)")
+    # no commit: process "dies"
+    s2 = _fresh(data_dir)
+    assert s2.query("select a from t") == [(1,)]
+
+
+def test_dml_then_bulk_load_keeps_both(data_dir):
+    """A bulk load after committed DML must not drop the DML rows: the
+    base snapshot rewrite re-emits the in-memory delta log."""
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1), (2)")
+    t = d.catalog.info_schema().table("test", "t")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.array([10, 11], dtype=np.int64)], ts=d.storage.current_ts())
+    before = sorted(s.query("select a from t"))
+    s2 = _fresh(data_dir)
+    assert sorted(s2.query("select a from t")) == before == \
+        [(1,), (2,), (10,), (11,)]
+
+
+def test_alter_table_survives_restart(data_dir):
+    s = _fresh(data_dir)
+    s.execute("create table t (a bigint, b bigint)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    s.execute("alter table t add column c varchar(4) default 'x'")
+    s.execute("alter table t drop column b")
+    before = s.query("select * from t order by a")
+    s2 = _fresh(data_dir)
+    assert s2.query("select * from t order by a") == before
+
+
+def test_injected_storage_with_data_dir_rejected(tmp_path):
+    from tidb_tpu.store.storage import BlockStorage
+
+    with pytest.raises(ValueError):
+        Domain(storage=BlockStorage(), data_dir=str(tmp_path))
+
+
+def test_drop_table_removes_files(data_dir):
+    import os
+
+    s = _fresh(data_dir)
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1)")
+    tdir = os.path.join(data_dir, "tables")
+    assert os.listdir(tdir)
+    s.execute("drop table t")
+    assert not any(f.endswith((".npz", ".log")) for f in os.listdir(tdir))
+
+    s2 = _fresh(data_dir)
+    import tidb_tpu.errors as errs
+
+    with pytest.raises(errs.TiDBTPUError):
+        s2.query("select * from t")

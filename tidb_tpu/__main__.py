@@ -3,15 +3,14 @@
     python -m tidb_tpu --host 127.0.0.1 --port 4000
 
 Boots a Domain (storage + catalog + stats), then serves the MySQL wire
-protocol.  Checkpoint/resume: --data-dir persists the catalog JSON on DDL
-and reloads it at boot (storage blocks are rebuilt from LOAD DATA / inserts;
-the durable-store tier is a later-round item).
+protocol.  Checkpoint/resume: --data-dir makes the store durable — catalog
+JSON on DDL, base-block snapshots on load/compact, a committed-delta log on
+every commit; boot reloads all of it (store/persist.py).
 """
 
 from __future__ import annotations
 
 import argparse
-import os
 
 
 def main():
@@ -26,22 +25,9 @@ def main():
     from .session import Domain
     from .server import serve_forever
 
-    domain = Domain()
+    domain = Domain(data_dir=args.data_dir or None)
     if args.engine == "cpu":
         domain.global_vars["tidb_use_tpu"] = "0"
-    if args.data_dir:
-        os.makedirs(args.data_dir, exist_ok=True)
-        meta = os.path.join(args.data_dir, "catalog.json")
-        if os.path.exists(meta):
-            domain.catalog.load_json(open(meta).read())
-
-        def persist(catalog):
-            tmp = meta + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(catalog.to_json())
-            os.replace(tmp, meta)
-
-        domain.catalog.on_ddl = persist
     serve_forever(args.host, args.port, domain)
 
 

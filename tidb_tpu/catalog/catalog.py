@@ -412,13 +412,21 @@ class Catalog:
                                          retype[1])
             arrays.append(arr)
             valids.append(valid)
-        self.storage.drop_table(t.id)
+        # keep the persisted snapshot until the replacement is written:
+        # the new store's save_base atomically replaces the same files, so
+        # a crash mid-ALTER leaves the OLD consistent state (catalog.json
+        # only advances after this method returns)
+        self.storage.drop_table(t.id, keep_files=True)
         self._notify_drop(t.id)
         new_store = self.storage.create_table(
             t.id, [(c.name, c.ftype) for c in new_cols]
         )
         if n:
             new_store.bulk_load_arrays(arrays, valids, ts)
+        elif new_store.persister is not None:
+            # empty table: still replace the on-disk snapshot so the old
+            # layout can't be reloaded against the new schema
+            new_store.persister.save_base(new_store)
 
     # ------------------------------------------------------------------
     # persistence (checkpoint/resume story, SURVEY.md §5)

@@ -17,8 +17,17 @@ from .vars import SessionVars
 
 
 class Domain:
-    def __init__(self, storage: Optional[BlockStorage] = None):
-        self.storage = storage or BlockStorage()
+    def __init__(self, storage: Optional[BlockStorage] = None,
+                 data_dir: Optional[str] = None):
+        if storage is not None and data_dir is not None:
+            # an injected storage has no persisters attached — accepting
+            # data_dir here would persist the catalog but silently lose
+            # table data on restart
+            raise ValueError(
+                "pass data_dir to BlockStorage(...) when injecting storage"
+            )
+        self.data_dir = data_dir
+        self.storage = storage or BlockStorage(data_dir=data_dir)
         self.catalog = Catalog(self.storage)
         self.stats = StatsHandle(self.storage)
         self.catalog.on_table_dropped = self.stats.drop
@@ -29,7 +38,30 @@ class Domain:
         self.stmt_summary = []  # (sql, duration_s, rows) ring
         self.slow_threshold_ms = 300
         self.slow_queries = []
+        if data_dir:
+            self._recover(data_dir)
         self._bootstrap()
+
+    def _recover(self, data_dir: str):
+        """Reload catalog + table data persisted by a previous process
+        (SURVEY.md §3.4: recovery = reload; no local checkpoints beyond
+        the store itself)."""
+        import os
+
+        os.makedirs(data_dir, exist_ok=True)
+        meta = os.path.join(data_dir, "catalog.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.catalog.load_json(f.read())
+            self.storage.load_persisted()
+
+        def persist(catalog):
+            tmp = meta + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(catalog.to_json())
+            os.replace(tmp, meta)
+
+        self.catalog.on_ddl = persist
 
     def _bootstrap(self):
         """Create system schemas (session/bootstrap.go analog)."""

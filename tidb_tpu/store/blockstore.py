@@ -82,6 +82,8 @@ class TableStore:
         # bumped on bulk load / compact: device caches key on this
         self.base_version = 0
         self._col_stats: Dict[int, Tuple[int, int, bool]] = {}
+        # durability hook (store/persist.TablePersister); None = RAM-only
+        self.persister = None
         from .index import IndexManager
 
         self.indexes = IndexManager()
@@ -150,6 +152,8 @@ class TableStore:
             self.base_ts = max(self.base_ts, ts)
             self.base_version += 1
             self._col_stats.clear()
+            if self.persister is not None:
+                self.persister.save_base(self)
 
     def _append_blocks(self, ci: int, arr: np.ndarray, valid: Optional[np.ndarray]):
         blocks, valids = self._blocks[ci], self._valids[ci]
@@ -286,9 +290,10 @@ class TableStore:
             del self.locks[handle]
             if lk.op == "lock":
                 return
-            self.delta.setdefault(handle, []).append(
-                Version(commit_ts, start_ts, lk.op, lk.values)
-            )
+            ver = Version(commit_ts, start_ts, lk.op, lk.values)
+            self.delta.setdefault(handle, []).append(ver)
+            if self.persister is not None:
+                self.persister.append_delta(handle, ver)
 
     def rollback(self, handle: int, start_ts: int):
         with self._mu:

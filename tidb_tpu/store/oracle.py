@@ -41,5 +41,18 @@ class Oracle:
             self._last_physical = phys
             return compose_ts(phys, self._logical)
 
+    def advance_to(self, ts: int):
+        """Never hand out a timestamp <= ts again (recovery: the TSO must
+        move past every persisted commit, like PD restarting from etcd)."""
+        with self._lock:
+            phys = extract_physical(ts)
+            if phys > self._last_physical:
+                self._last_physical = phys
+                self._logical = ts & ((1 << _LOGICAL_BITS) - 1)
+            elif phys == self._last_physical:
+                self._logical = max(
+                    self._logical, ts & ((1 << _LOGICAL_BITS) - 1)
+                )
+
     def is_expired(self, lock_ts: int, ttl_ms: int) -> bool:
         return int(time.time() * 1000) >= extract_physical(lock_ts) + ttl_ms

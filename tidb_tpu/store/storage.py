@@ -24,12 +24,13 @@ from .txn import Transaction
 
 
 class BlockStorage(Storage):
-    def __init__(self, n_stores: int = 1):
+    def __init__(self, n_stores: int = 1, data_dir: Optional[str] = None):
         self.oracle = Oracle()
         self.regions = RegionManager(n_stores=n_stores)
         self._tables: Dict[int, TableStore] = {}
         self._mu = threading.RLock()
         self._client = CoprClient(self)
+        self.data_dir = data_dir
 
     # ---- catalog -------------------------------------------------------
     def create_table(self, table_id: int, columns: List[Tuple[str, FieldType]]) -> TableStore:
@@ -37,13 +38,41 @@ class BlockStorage(Storage):
             if table_id in self._tables:
                 raise KVError(f"table {table_id} exists in storage")
             ts = TableStore(table_id, columns)
+            if self.data_dir is not None:
+                from .persist import TablePersister
+
+                ts.persister = TablePersister(self.data_dir, table_id)
             self._tables[table_id] = ts
             self.regions.bootstrap_table(table_id)
             return ts
 
-    def drop_table(self, table_id: int):
+    def load_persisted(self):
+        """Recovery: restore every table's base+delta from data_dir.
+
+        Reference model (SURVEY.md §3.4): recovery = reload; in-flight
+        prewrite locks are volatile so crashed txns abort naturally."""
         with self._mu:
-            self._tables.pop(table_id, None)
+            max_ts = 0
+            for ts_store in self._tables.values():
+                if ts_store.persister is not None:
+                    ts_store.persister.load(ts_store)
+                max_ts = max(max_ts, ts_store.base_ts)
+                for chain in ts_store.delta.values():
+                    if chain:
+                        max_ts = max(max_ts, chain[-1].commit_ts)
+            # the TSO must move past every persisted commit
+            self.oracle.advance_to(max_ts + 1)
+
+    def drop_table(self, table_id: int, keep_files: bool = False):
+        with self._mu:
+            t = self._tables.pop(table_id, None)
+            if t is not None and t.persister is not None:
+                if keep_files:
+                    # ALTER rebuild: the replacement store atomically
+                    # overwrites the same paths; just release the handle
+                    t.persister._close_delta()
+                else:
+                    t.persister.remove()
             self.regions.drop_table(table_id)
 
     def table(self, table_id: int) -> TableStore:
