@@ -137,3 +137,27 @@ def test_drop_table_removes_files(data_dir):
 
     with pytest.raises(errs.TiDBTPUError):
         s2.query("select * from t")
+
+
+def test_committed_txn_survives_hard_kill(data_dir):
+    """kill -9 analog: a txn whose COMMIT returned must be on disk at that
+    instant — no later flush, close, or GC hook may be required.  We freeze
+    the table files right after commit and restore them over whatever the
+    dying process left behind."""
+    import os
+    import shutil
+
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("begin")
+    s.execute("insert into t values (7)")
+    s.execute("commit")
+    # snapshot the on-disk state as of commit-return
+    frozen = str(data_dir) + ".frozen"
+    shutil.copytree(data_dir, frozen)
+    # the process "dies" here; reopen from the frozen-at-commit state
+    shutil.rmtree(data_dir)
+    shutil.copytree(frozen, data_dir)
+    s2 = _fresh(data_dir)
+    assert s2.query("select a from t") == [(7,)]

@@ -136,6 +136,20 @@ class TableStore:
         with self._mu:
             n = len(arrays[0])
             assert all(len(a) == n for a in arrays), "ragged load"
+            # New base rows take handles [base_rows, base_rows+n).  Delta
+            # inserts committed before this load may already own handles in
+            # that range (alloc_handle starts at next_handle); left alone,
+            # their versions would shadow the loaded rows as phantom updates.
+            # Fold the committed delta into base first so every existing row
+            # gets a fresh sub-base_rows handle and the append region is free.
+            if self.delta and (self.next_handle > self.base_rows
+                               or any(h >= self.base_rows for h in self.delta)):
+                if self.locks:
+                    raise KVError(
+                        "bulk load would collide with uncommitted rows")
+                fold_ts = max(
+                    [ts] + [c[-1].commit_ts for c in self.delta.values() if c])
+                self.compact(fold_ts)
             for ci, (meta, arr) in enumerate(zip(self.cols, arrays)):
                 valid = valids[ci] if valids else None
                 if meta.ftype.kind == TypeKind.STRING:

@@ -66,7 +66,10 @@ class TablePersister:
         try:
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, meta=json.dumps(meta), **arrays)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.base_path)
+            self._fsync_dir()
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
@@ -85,20 +88,36 @@ class TablePersister:
                             rec = [h, ver.commit_ts, ver.start_ts, ver.op,
                                    ver.values]
                             f.write(json.dumps(rec, default=_np_scalar) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, self.delta_path)
+                self._fsync_dir()
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
                 raise
         elif os.path.exists(self.delta_path):
             os.unlink(self.delta_path)
+            self._fsync_dir()
 
     def append_delta(self, handle: int, ver: Version):
+        """Durable-on-commit: the record hits the platters before commit()
+        returns, the reference's model (mvcc_leveldb.go:39 — leveldb WAL
+        syncs per write batch)."""
         if self._delta_f is None:
             self._delta_f = open(self.delta_path, "a")
         rec = [handle, ver.commit_ts, ver.start_ts, ver.op, ver.values]
         self._delta_f.write(json.dumps(rec, default=_np_scalar) + "\n")
         self._delta_f.flush()
+        os.fsync(self._delta_f.fileno())
+
+    def _fsync_dir(self):
+        """Make a rename/unlink durable: fsync the containing directory."""
+        fd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def _close_delta(self):
         if self._delta_f is not None:
