@@ -297,3 +297,22 @@ def test_mesh_multi_range_not_used():
         f"({i}, {i * 2})" for i in range(100)
     ))
     assert s.query("select sum(b) from m") == [(sum(i * 2 for i in range(100)),)]
+
+
+def test_dense_first_row_bare_column(sess):
+    """A bare non-grouped column becomes a first_row agg: exercises the
+    dense-mode per-shard argfirst partial + host min-merge (the axon TPU
+    backend only lowers Sum all-reduces, so first_row cannot pmin)."""
+    before = REGISTRY.snapshot()
+    _parity(sess, "select g, s, min(k) from t group by g order by g")
+    after = REGISTRY.snapshot()
+    assert after.get("mesh_scans_total", 0) > before.get("mesh_scans_total", 0)
+    assert after.get("mesh_scan_errors_total", 0) == \
+        before.get("mesh_scan_errors_total", 0)
+
+
+def test_dense_minmax_partial_merge(sess):
+    """min/max partials are per-shard (host-merged): cover groups that are
+    empty on some shards via a selective filter."""
+    _parity(sess, "select g, min(d), max(d), min(x), max(x) from t "
+                  "where k < 1500 group by g order by g")

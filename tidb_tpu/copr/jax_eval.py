@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
@@ -85,6 +86,92 @@ def _to_f64(v: JVal, ft: FieldType) -> jnp.ndarray:
     return d.astype(jnp.float64)
 
 
+def _udiv_const(x: jnp.ndarray, p: int) -> jnp.ndarray:
+    """Exact trunc(x / p) for NON-NEGATIVE int64 x and a small positive
+    constant p, without integer division.
+
+    TPUs have no integer-divide unit: XLA emulates `int64 //` with a long
+    software sequence (~100ns/row measured on v5e — a single 2M-row decimal
+    rescale cost ~0.2s, dominating Q1/Q6).  Instead: split x into 32-bit
+    halves so every f64 intermediate is exact (< 2^53 needs p <= ~2e6),
+    divide with a reciprocal multiply, and absorb f64 rounding with one
+    multiply-back fixup.  Exact for all x >= 0 when p <= 1_000_000;
+    callers fall back to native emulation above that.
+    """
+    if p == 1:
+        return x
+    inv = 1.0 / p
+    hi = jax.lax.shift_right_logical(x, 32)
+    lo = jnp.bitwise_and(x, 0xFFFFFFFF)
+    # hi < 2^32 is f64-exact; q1 may still be off by 1 from inv rounding
+    q1 = jnp.floor(hi.astype(jnp.float64) * inv).astype(jnp.int64)
+    r1 = hi - q1 * p  # in (-p, 2p) even when q1 is off by one
+    rest = (r1 << 32) + lo  # |rest| < 2p*2^32 <= 2^53 for p <= 1e6
+    q2 = jnp.floor(rest.astype(jnp.float64) * inv).astype(jnp.int64)
+    q = (q1 << 32) + q2
+    rem = x - q * p
+    q = q + (rem >= p).astype(jnp.int64) - (rem < 0).astype(jnp.int64)
+    rem = x - q * p
+    return q + (rem >= p).astype(jnp.int64) - (rem < 0).astype(jnp.int64)
+
+
+def _chunk_const(p: int):
+    """Factor p into chunks each <= 1e6 (trunc division composes across
+    positive factors); None if a prime factor is too big for the f64 trick."""
+    factors = []
+    rem = p
+    for q in (2, 3, 5, 7, 11, 13):
+        while rem % q == 0:
+            factors.append(q)
+            rem //= q
+    if rem > 1:
+        if rem > 1_000_000:
+            return None
+        factors.append(rem)
+    chunks, cur = [], 1
+    for f in sorted(factors, reverse=True):
+        if cur * f <= 1_000_000:
+            cur *= f
+        else:
+            chunks.append(cur)
+            cur = f
+    chunks.append(cur)
+    return chunks
+
+
+def _utrunc_div(x: jnp.ndarray, p: int) -> jnp.ndarray:
+    """trunc(x / p) for non-negative x, chunking p when needed."""
+    if p <= 1_000_000:
+        return _udiv_const(x, p)
+    chunks = _chunk_const(p)
+    if chunks is None:
+        return x // p
+    for c in chunks:
+        x = _udiv_const(x, c)
+    return x
+
+
+def _round_div_pow10(d: jnp.ndarray, p: int) -> jnp.ndarray:
+    """round-half-away-from-zero of d / p (p = 10^k), division-free:
+    the MySQL decimal rounding rule (types/mydecimal.go analog).
+    Rounds via the remainder (not abs(d)+p/2, which overflows at int64 max)."""
+    ad = jnp.abs(d)
+    q = _utrunc_div(ad, p)
+    rem = ad - q * p
+    q = q + (2 * rem >= p).astype(jnp.int64)
+    return jnp.sign(d).astype(jnp.int64) * q
+
+
+def _floordiv_const(d: jnp.ndarray, p: int) -> jnp.ndarray:
+    """Python-semantics d // p (floor) for int64 d, division-free."""
+    if _chunk_const(p) is None:
+        return d // p
+    ad = jnp.abs(d)
+    q = _utrunc_div(ad, p)
+    rem_nz = (ad - q * p) != 0
+    return jnp.where(d >= 0, q, -q - rem_nz.astype(jnp.int64))
+
+
 def _to_scaled(v: JVal, ft: FieldType, scale: int) -> jnp.ndarray:
     d = v[0]
     if ft.kind == TypeKind.DECIMAL:
@@ -93,9 +180,7 @@ def _to_scaled(v: JVal, ft: FieldType, scale: int) -> jnp.ndarray:
             return d.astype(jnp.int64)
         if ds > 0:
             return d.astype(jnp.int64) * (10 ** ds)
-        p = 10 ** (-ds)
-        ad = jnp.abs(d.astype(jnp.int64))
-        return jnp.sign(d).astype(jnp.int64) * ((ad + p // 2) // p)
+        return _round_div_pow10(d.astype(jnp.int64), 10 ** (-ds))
     if ft.kind == TypeKind.FLOAT:
         return jnp.round(d * (10.0 ** scale)).astype(jnp.int64)
     return d.astype(jnp.int64) * (10 ** scale)
@@ -158,8 +243,7 @@ def _arith(e: ScalarFunc, args, n):
             r = x * y
             drop = sa + sb - out.scale
             if drop > 0:
-                p = 10 ** drop
-                r = jnp.sign(r) * ((jnp.abs(r) + p // 2) // p)
+                r = _round_div_pow10(r, 10 ** drop)
             elif drop < 0:
                 r = r * (10 ** (-drop))
             return r, valid
@@ -245,7 +329,7 @@ def _temporal_to(kind, v: JVal, ft: FieldType):
     d = v[0]
     if kind == TypeKind.DATE:
         if ft.kind == TypeKind.DATETIME:
-            return (d // 86_400_000_000).astype(jnp.int64)
+            return _floordiv_const(d.astype(jnp.int64), 86_400_000_000)
         return d.astype(jnp.int64)
     if ft.kind == TypeKind.DATE:
         return d.astype(jnp.int64) * 86_400_000_000
@@ -379,12 +463,13 @@ def _cast_to(v: JVal, src: FieldType, dst: FieldType) -> JVal:
             return jnp.round(d).astype(jnp.int64), valid
         if k == TypeKind.DECIMAL:
             p = 10 ** src.scale
-            ad = jnp.abs(d.astype(jnp.int64))
-            return jnp.sign(d).astype(jnp.int64) * ((ad + p // 2) // p), valid
+            return _round_div_pow10(d.astype(jnp.int64), p), valid
         return d.astype(jnp.int64), valid
     if tk == TypeKind.DATE:
         if k == TypeKind.DATETIME:
-            return (d // 86_400_000_000).astype(jnp.int32), valid
+            return _floordiv_const(
+                d.astype(jnp.int64), 86_400_000_000
+            ).astype(jnp.int32), valid
         return d.astype(jnp.int32), valid
     if tk == TypeKind.DATETIME:
         if k == TypeKind.DATE:
@@ -488,7 +573,8 @@ def _floor_ceil(e, args, n):
     if ft.kind == TypeKind.DECIMAL:
         s = 10 ** ft.scale
         d = v[0].astype(jnp.int64)
-        r = d // s if e.name == "floor" else -((-d) // s)
+        r = (_floordiv_const(d, s) if e.name == "floor"
+             else -_floordiv_const(-d, s))
         return r, v[1]
     x = _to_f64(v, ft)
     r = jnp.floor(x) if e.name == "floor" else jnp.ceil(x)
@@ -504,8 +590,7 @@ def _round(e, args, n):
         drop = ft.scale - e.ftype.scale if d >= 0 else ft.scale - d
         x = v[0].astype(jnp.int64)
         if drop > 0:
-            p = 10 ** drop
-            x = jnp.sign(x) * ((jnp.abs(x) + p // 2) // p)
+            x = _round_div_pow10(x, 10 ** drop)
         if d < 0:
             x = x * (10 ** (-d)) * (10 ** e.ftype.scale)
         return x, v[1]
@@ -575,15 +660,18 @@ def _as_us(v: JVal, ft: FieldType) -> jnp.ndarray:
 
 
 def _civil(us: jnp.ndarray):
-    days = us // 86_400_000_000
+    # all divisions are by small constants: the division-free path keeps
+    # year()/month()/extract() off XLA's int64-divide emulation
+    fd = _floordiv_const
+    days = fd(us, 86_400_000_000)
     z = days + 719468
-    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    era = fd(jnp.where(z >= 0, z, z - 146096), 146097)
     doe = z - era * 146097
-    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    yoe = fd(doe - fd(doe, 1460) + fd(doe, 36524) - fd(doe, 146096), 365)
     y = yoe + era * 400
-    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-    mp = (5 * doy + 2) // 153
-    d = doy - (153 * mp + 2) // 5 + 1
+    doy = doe - (365 * yoe + fd(yoe, 4) - fd(yoe, 100))
+    mp = fd(5 * doy + 2, 153)
+    d = doy - fd(153 * mp + 2, 5) + 1
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     y = jnp.where(m <= 2, y + 1, y)
     return y, m, d
@@ -607,36 +695,36 @@ def _day(e, args, n):
 @_reg("quarter")
 def _quarter(e, args, n):
     m = _civil(_as_us(args[0], e.args[0].ftype))[1]
-    return (m + 2) // 3, args[0][1]
+    return _floordiv_const(m + 2, 3), args[0][1]
 
 
 @_reg("dayofweek")
 def _dayofweek(e, args, n):
     us = _as_us(args[0], e.args[0].ftype)
-    return ((us // 86_400_000_000) + 4) % 7 + 1, args[0][1]
+    return (_floordiv_const(us, 86_400_000_000) + 4) % 7 + 1, args[0][1]
 
 
 @_reg("weekday")
 def _weekday(e, args, n):
     us = _as_us(args[0], e.args[0].ftype)
-    return ((us // 86_400_000_000) + 3) % 7, args[0][1]
+    return (_floordiv_const(us, 86_400_000_000) + 3) % 7, args[0][1]
 
 
 @_reg("unix_timestamp")
 def _unix_ts(e, args, n):
-    return _as_us(args[0], e.args[0].ftype) // 1_000_000, args[0][1]
+    return _floordiv_const(_as_us(args[0], e.args[0].ftype), 1_000_000), args[0][1]
 
 
 @_reg("date")
 def _datefn(e, args, n):
     us = _as_us(args[0], e.args[0].ftype)
-    return (us // 86_400_000_000).astype(jnp.int32), args[0][1]
+    return _floordiv_const(us, 86_400_000_000).astype(jnp.int32), args[0][1]
 
 
 @_reg("datediff")
 def _datediff(e, args, n):
-    a = _as_us(args[0], e.args[0].ftype) // 86_400_000_000
-    b = _as_us(args[1], e.args[1].ftype) // 86_400_000_000
+    a = _floordiv_const(_as_us(args[0], e.args[0].ftype), 86_400_000_000)
+    b = _floordiv_const(_as_us(args[1], e.args[1].ftype), 86_400_000_000)
     return a - b, _both_valid(args[0], args[1])
 
 
@@ -660,5 +748,5 @@ def _date_addsub(e, args, n):
     us = _as_us(v, e.args[0].ftype) + sign * delta[0].astype(jnp.int64) * _US_PER[unit]
     valid = _both_valid(v, delta)
     if e.ftype.kind == TypeKind.DATE:
-        return (us // 86_400_000_000).astype(jnp.int32), valid
+        return _floordiv_const(us, 86_400_000_000).astype(jnp.int32), valid
     return us, valid

@@ -145,11 +145,18 @@ def _all_true(mesh: Mesh, n_pad: int):
 _COMPILED: Dict[str, object] = {}
 
 
-def _key_bits_device(d):
-    """Device-side canonical int64 key bits (must match ir.key_bits_int64)."""
+def _key_device(d):
+    """Device-side canonical join/group key: float keys stay in VALUE domain
+    (-0.0 folded into 0.0), everything else widens to int64.
+
+    The axon TPU backend cannot lower 64-bit bitcast-convert (the x64
+    rewriter lacks it), so the host's bit-domain canonicalization
+    (ir.key_bits_int64) is translated back to values before it reaches the
+    device — see the pargs construction in try_run_mesh.  NaN keys never
+    match in value domain (SQL NULLs are tracked separately; NaN data keys
+    are pathological and excluded by contract)."""
     if jnp.issubdtype(d.dtype, jnp.floating):
-        dd = jnp.where(d == 0.0, 0.0, d)
-        return jax.lax.bitcast_convert_type(dd.astype(jnp.float64), jnp.int64)
+        return jnp.where(d == 0.0, 0.0, d).astype(jnp.float64)
     return d.astype(jnp.int64)
 
 
@@ -159,16 +166,76 @@ def _apply_probes(an: _Analyzed, cols, m, pargs, n_local: int):
     for i, p in enumerate(an.probes):
         keys, kn = pargs[2 * i], pargs[2 * i + 1]
         d, v = compile_expr(p.key, cols, n_local)
-        bits = _key_bits_device(d)
-        pos = jnp.searchsorted(keys, bits)
+        k = _key_device(d)
+        pos = jnp.searchsorted(keys, k)
         pos_c = jnp.clip(pos, 0, keys.shape[0] - 1)
-        hit = (pos < kn) & (keys[pos_c] == bits)
+        hit = (pos < kn) & (keys[pos_c] == k)
         m = m & v & hit
     return m
 
 
 def _probe_specs(an: _Analyzed):
     return (P(), P()) * len(an.probes)
+
+
+def _packed_jit(fn):
+    """jit `fn` (whose output is a pytree of 64-bit-wide arrays) so the whole
+    result crosses device->host as ONE flat float64 buffer.
+
+    Over a tunneled device every `np.asarray(leaf)` is a full network round
+    trip (~65ms measured on the axon tunnel); a Q1-shaped aggregation has ~16
+    output leaves, so per-leaf reads cost more than the scan itself.  Packing
+    on device (everything concatenated into one f64 vector) makes the
+    readback latency-bound once, not per-leaf.
+
+    Integer leaves travel as two exact f64 halves (value-split hi/lo 32 bits)
+    rather than a bitcast: the axon TPU backend's x64 rewriter cannot lower
+    bitcast-convert on 64-bit types (verified: i64->f64 bitcasts return
+    garbage, f64->u32 fails to compile), while 0 <= half < 2^32 is always
+    exactly representable in f64.
+    """
+    meta = {}
+
+    def packed(*args):
+        out = fn(*args)
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        specs, flat = [], []
+        for leaf in leaves:
+            dt = np.dtype(str(leaf.dtype))
+            specs.append((leaf.shape, dt))
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                flat.append(leaf.reshape(-1).astype(jnp.float64))
+            else:  # bool / int32 / int64 — all exact through the split
+                x = leaf.reshape(-1).astype(jnp.int64)
+                hi = (x >> 32).astype(jnp.float64)        # arithmetic shift
+                lo = (x & 0xFFFFFFFF).astype(jnp.float64)  # in [0, 2^32)
+                flat.append(hi)
+                flat.append(lo)
+        # trace-time capture: jit traces synchronously before the first
+        # execution returns, so `meta` is populated before any unpack
+        meta["treedef"] = treedef
+        meta["specs"] = specs
+        return jnp.concatenate(flat) if flat else jnp.zeros(0, jnp.float64)
+
+    jitted = jax.jit(packed)
+
+    def call(*args):
+        buf = np.asarray(jitted(*args))
+        leaves, off = [], 0
+        for shape, dt in meta["specs"]:
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if np.issubdtype(dt, np.floating):
+                seg = buf[off: off + n].astype(dt)
+                off += n
+            else:
+                hi = buf[off: off + n].astype(np.int64)
+                lo = buf[off + n: off + 2 * n].astype(np.int64)
+                off += 2 * n
+                seg = ((hi << 32) + lo).astype(dt)
+            leaves.append(seg.reshape(shape))
+        return jax.tree_util.tree_unflatten(meta["treedef"], leaves)
+
+    return call
 
 
 def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
@@ -257,36 +324,62 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
                         jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
                     ))
                 elif a.name == "min":
+                    # per-shard partial: the axon TPU compiler only lowers
+                    # Sum all-reduces ("Supported lowering only of Sum all
+                    # reduce"), so min/max merge across shards on the host
+                    # ([S, G] is tiny) — the reference's partial/final agg
+                    # split (aggregate.go:101-169) with the final on root
                     results.append((
-                        jax.lax.pmin(ops.masked_segment_min(d, gidx, mv, G), "dp"),
+                        ops.masked_segment_min(d, gidx, mv, G),
                         jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
                     ))
                 elif a.name == "max":
                     results.append((
-                        jax.lax.pmax(ops.masked_segment_max(d, gidx, mv, G), "dp"),
+                        ops.masked_segment_max(d, gidx, mv, G),
                         jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
                     ))
                 elif a.name == "first_row":
-                    # global first row per group: min global row index over
-                    # the mesh (sentinel n_global when a shard has none)
+                    # per-shard first row index (sentinel n_global when the
+                    # shard has none); host takes the min across shards
                     contrib = jnp.where(mv, gofs, n_global)
-                    local = jax.ops.segment_min(contrib, gidx, num_segments=G)
-                    results.append(jax.lax.pmin(local, "dp"))
+                    results.append(ops.segment_min(contrib, gidx, G))
             return gcount, tuple(results)
 
+        out_results = []
+        for a in agg_ir.aggs:
+            if a.name == "count":
+                out_results.append(P())
+            elif a.name in ("sum", "avg"):
+                out_results.append((P(), P()))
+            elif a.name in ("min", "max"):
+                out_results.append((P("dp"), P()))  # sharded partial, psum'd count
+            else:
+                out_results.append(P("dp"))
         fn = shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
-            out_specs=P(),
+            out_specs=(P(), tuple(out_results)),
         )
-        jitted = jax.jit(fn)
+        packed = _packed_jit(fn)
 
         def wrapped(datas, valids, del_mask, start, end, pargs=()):
-            gcount, results = jitted(
+            gcount, results = packed(
                 tuple(datas), tuple(valids), del_mask,
                 jnp.int64(start), jnp.int64(end), *pargs,
             )
-            return gcount, list(zip(tags, results))
+            merged = []
+            for tag, r in zip(tags, results):
+                if tag == "minmax":
+                    part, cnt = r  # part: [S*G] per-shard partials
+                    part = part.reshape(S, G)
+                    a = agg_ir.aggs[len(merged)]
+                    part = part.min(0) if a.name == "min" else part.max(0)
+                    merged.append((tag, (part, cnt)))
+                elif tag == "argfirst":
+                    merged.append((tag, r.reshape(S, G).min(0)))
+                else:
+                    merged.append((tag, r))
+            return gcount, merged
 
         return wrapped
 
@@ -309,17 +402,19 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
             in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
             out_specs=P("dp"),
         )
-        jitted = jax.jit(fn)
+        packed = _packed_jit(fn)
 
         def wrapped(datas, valids, del_mask, start, end, pargs=()):
-            gidx, cnt = jitted(
+            gidx, cnt = packed(
                 tuple(datas), tuple(valids), del_mask,
                 jnp.int64(start), jnp.int64(end), *pargs,
             )
-            return np.asarray(gidx), np.asarray(cnt), k
+            return gidx, cnt, k
         return wrapped
 
-    # filter (with optional projection evaluated on device)
+    # filter (with optional projection evaluated on device).  The mask comes
+    # back bit-packed: the tunnel's d2h bandwidth is low (~30MB/s measured),
+    # so 1 bit/row instead of 1 byte/row is an 8x cheaper readback.
     def shard_fn(datas, valids, del_mask, start, end, *pargs):
         cols = cols_env(datas, valids)
         _, row_mask = masks(del_mask, start, end)
@@ -330,13 +425,17 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
         out_specs=P("dp"),
     )
-    jitted = jax.jit(fn)
+    jitted = jax.jit(
+        lambda *a: jnp.packbits(fn(*a).astype(jnp.uint8))
+    )
 
     def wrapped(datas, valids, del_mask, start, end, pargs=()):
-        return np.asarray(jitted(
+        n_rows = S * n_local
+        bits = np.asarray(jitted(
             tuple(datas), tuple(valids), del_mask,
             jnp.int64(start), jnp.int64(end), *pargs,
         ))
+        return np.unpackbits(bits, count=n_rows).astype(np.bool_)
     return wrapped
 
 
@@ -395,14 +494,12 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
         key_bits, key_flags = [], []
         for g in agg_ir.group_by:
             d, v = compile_expr(g, cols, n_local)
-            if jnp.issubdtype(d.dtype, jnp.floating):
-                dd = jnp.where(d == 0.0, 0.0, d)  # -0.0 groups with 0.0
-                bits = jax.lax.bitcast_convert_type(
-                    dd.astype(jnp.float64), jnp.int64
-                )
-            else:
-                bits = d.astype(jnp.int64)
-            key_bits.append(jnp.where(v, bits, jnp.int64(0)))
+            # float keys group in VALUE domain (the backend can't lower the
+            # f64<->i64 bitcast); -0.0 folds into 0.0, and NULL rows get a
+            # fixed key so the validity flag alone separates them
+            k = _key_device(d)
+            zero = jnp.float64(0.0) if k.dtype == jnp.float64 else jnp.int64(0)
+            key_bits.append(jnp.where(v, k, zero))
             key_flags.append(v.astype(jnp.int64))
         # lexsort: LAST key is primary -> selected rows first, grouped by key
         order = jnp.lexsort(
@@ -462,19 +559,19 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
         in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
         out_specs=P("dp"),
     )
-    jitted = jax.jit(fn)
+    packed = _packed_jit(fn)
 
     def wrapped(datas, valids, del_mask, start, end, pargs=()):
-        n_uniq, keys, results = jitted(
+        n_uniq, keys, results = packed(
             tuple(datas), tuple(valids), del_mask,
             jnp.int64(start), jnp.int64(end), *pargs,
         )
         return {
             "mode": "sort",
             "S": S, "OUT": OUT,
-            "n_uniq": np.asarray(n_uniq),
-            "keys": [np.asarray(k) for k in keys],
-            "results": [(t, _np_tree(r)) for t, r in zip(tags, results)],
+            "n_uniq": n_uniq,
+            "keys": list(keys),
+            "results": [(t, r) for t, r in zip(tags, results)],
         }
 
     return wrapped
@@ -504,7 +601,7 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
             flags = out["keys"][nk + i][lo: lo + k_s].astype(np.bool_)
             ft = g.ftype
             if ft.kind == TK.FLOAT:
-                data = bits.view(np.float64)
+                data = np.asarray(bits, dtype=np.float64)  # value-domain keys
             elif ft.kind == TK.STRING:
                 from ..store.blockstore import _decode_dict
 
@@ -588,12 +685,25 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
             from ..errors import ExecutorError
 
             raise ExecutorError(f"missing runtime probe keys {p.filter_id}")
-        k = len(arr)
-        kpad = 16
-        while kpad < k:
-            kpad <<= 1
-        padded = np.full(kpad, np.iinfo(np.int64).max, dtype=np.int64)
-        padded[:k] = arr
+        if p.key.ftype.kind == TypeKind.FLOAT:
+            # aux carries canonical int64 BIT patterns (ir.key_bits_int64);
+            # the device compares float keys by VALUE (no 64-bit bitcast on
+            # this backend), so translate bits -> values here and re-sort
+            # (bit order != value order for negatives)
+            vals = np.sort(arr.view(np.float64))
+            k = len(vals)
+            kpad = 16
+            while kpad < k:
+                kpad <<= 1
+            padded = np.full(kpad, np.inf, dtype=np.float64)
+            padded[:k] = vals
+        else:
+            k = len(arr)
+            kpad = 16
+            while kpad < k:
+                kpad <<= 1
+            padded = np.full(kpad, np.iinfo(np.int64).max, dtype=np.int64)
+            padded[:k] = arr
         pargs.append(jnp.asarray(padded))
         pargs.append(jnp.int64(k))
         kpads.append(kpad)
@@ -647,9 +757,9 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
                 return None
         elif kind == "agg":
             gcount, results = fn(datas, valids, del_mask, start, end, pargs)
+            # wrapped() already unpacked to numpy and merged shard partials
             agg_accum = _merge_mesh_agg(
-                agg_accum, np.asarray(gcount),
-                [(t, _np_tree(r)) for t, r in results], table, an,
+                agg_accum, np.asarray(gcount), results, table, an,
             )
         elif kind == "topn":
             gidx, cnts, k = fn(datas, valids, del_mask, start, end, pargs)
@@ -726,12 +836,6 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
 def _eval_to_column(expr, chunk: Chunk) -> Column:
     v = expr.eval(chunk)
     return Column(expr.ftype, v.data, v.validity())
-
-
-def _np_tree(r):
-    if isinstance(r, tuple):
-        return tuple(np.asarray(x) for x in r)
-    return np.asarray(r)
 
 
 def _merge_mesh_agg(accum, gcount: np.ndarray, results, table, an: _Analyzed):

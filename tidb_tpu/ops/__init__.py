@@ -16,6 +16,7 @@ from .segment import (  # noqa: E402
     masked_segment_min,
     masked_segment_max,
     masked_segment_argfirst,
+    segment_min,
 )
 from .topk import masked_top_k  # noqa: E402
 
@@ -25,5 +26,6 @@ __all__ = [
     "masked_segment_min",
     "masked_segment_max",
     "masked_segment_argfirst",
+    "segment_min",
     "masked_top_k",
 ]
