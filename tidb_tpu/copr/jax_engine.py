@@ -286,10 +286,19 @@ class _Analyzed:
             except JaxUnsupported:
                 # high-NDV / float / NULLable / non-column keys: the mesh
                 # engine groups by sorting — keys only need to be
-                # device-compilable
+                # device-compilable.  STRING-typed keys and min/max args
+                # must still be plain columns: the sort path resolves their
+                # dict codes through scan.columns[expr.index]
+                # (_sort_agg_chunks), which a computed expression lacks.
                 for k in self.agg.group_by:
                     if not can_push_expr(k, dict_cols=dict_scan_idx):
                         raise
+                    if (k.ftype.kind == TypeKind.STRING
+                            and not isinstance(k, ColumnExpr)):
+                        raise JaxUnsupported(
+                            "string expression group key on device")
+                # (min/max STRING args need no guard here: can_push_agg
+                # already rejects non-column STRING args upstream)
                 self.agg_mode = "sort"
                 self.num_groups = 0
                 self.group_cols = []

@@ -73,7 +73,9 @@ def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
 
 
 class _MeshCache:
-    """(store_uid, base_version, store_ci, S) -> sharded [n_pad, TILE] arrays."""
+    """(store_uid, base_version, store_ci, device_ids, TILE) -> sharded
+    [n_pad, TILE] arrays; device ids in the key so a rebuilt same-size mesh
+    never serves arrays placed on a dead device set."""
 
     def __init__(self, capacity_bytes: int = 8 << 30):
         from .cache import ByteCapCache
@@ -86,7 +88,10 @@ class _MeshCache:
 
     def get_column(self, mesh: Mesh, table, store_ci: int):
         S = len(mesh.devices.ravel())
-        key = (table.store_uid, table.base_version, store_ci, S, je.TILE)
+        # device ids in the key so a rebuilt same-size mesh never serves
+        # arrays placed on a dead device set (matches _ONES_CACHE)
+        devs = tuple(d.id for d in mesh.devices.ravel())
+        key = (table.store_uid, table.base_version, store_ci, devs, je.TILE)
 
         def load():
             tile = je.TILE

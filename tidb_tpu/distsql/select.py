@@ -151,6 +151,10 @@ class SelectResult:
                 raise
             except _Closed:
                 raise
+            except (KeyboardInterrupt, SystemExit, MemoryError):
+                # fatal process conditions are not transient device errors:
+                # surface immediately instead of burning the retry budget
+                raise
             except BaseException as e:
                 if engine == "tpu":
                     # runtime device failure: this region falls back to the

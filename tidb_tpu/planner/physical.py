@@ -824,8 +824,13 @@ def _physical_agg(plan: LogicalAggregation,
                 gb = [g.remap_columns(pos) for g in plan.group_by]
                 aggs = [a.remap_columns(pos) for a in plan.aggs]
                 task.dag_execs.append(AggregationIR(gb, aggs, mode="partial"))
+                # first_row partials are position-sensitive: region chunks
+                # must merge in handle order or the "first" value depends on
+                # task completion order (the mesh path is deterministic —
+                # global min row index — so the fan-out path must match)
+                has_first = any(a.name == "first_row" for a in aggs)
                 reader = PhysTableReader(
-                    _partial_schema(plan), task, keep_order=False,
+                    _partial_schema(plan), task, keep_order=has_first,
                     ranges=child_l.ranges,
                 )
                 # final merge positions: [keys..., states...] by position
