@@ -110,3 +110,39 @@ class TestDeltaMerge:
         store = sess.domain.storage.table(t.id)
         assert len(store.delta) == 1
         assert sess.query("select name from u where id = 9999") == [("zz",)]
+
+
+class TestRangerConstantBounds:
+    """Decimal/float literal bounds against int/decimal/double index columns
+    (exact Fraction math — IEEE noise like 0.07*100 != 7.0 must not shift
+    index range boundaries)."""
+
+    @pytest.fixture(scope="class")
+    def bsess(self):
+        s = Domain().new_session()
+        s.execute("create table fb (id bigint, v double, key (v))")
+        for i in range(10):
+            s.execute(f"insert into fb values ({i}, {i + 0.5})")
+        s.execute("create table db (id bigint, w decimal(12,2), key (w))")
+        for i in range(12):
+            s.execute(f"insert into db values ({i}, {i/100.0})")
+        return s
+
+    def test_decimal_literal_on_double_index(self, bsess):
+        assert bsess.query("select id from fb where v = 1.5") == [(1,)]
+        assert sorted(bsess.query("select id from fb where v < 2.5")) == \
+            [(0,), (1,)]
+
+    def test_float_exponent_literal_on_decimal_index(self, bsess):
+        assert sorted(bsess.query(
+            "select id from db where w >= 7e-2 and w < 9e-2")) == [(7,), (8,)]
+        assert sorted(bsess.query(
+            "select id from db where w < 7e-2 and w > 5e-2")) == [(6,)]
+
+    def test_decimal_literal_fractional_on_int_index(self, bsess):
+        bsess.execute("create table ib (id bigint, key (id))")
+        for i in range(5):
+            bsess.execute(f"insert into ib values ({i})")
+        assert sorted(bsess.query("select id from ib where id > 1.5")) == \
+            [(2,), (3,), (4,)]
+        assert bsess.query("select id from ib where id = 1.5") == []

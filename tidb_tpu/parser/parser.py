@@ -454,7 +454,12 @@ class Parser:
             return ast.Literal(int(t.value))
         if t.kind == T.FLOAT:
             self.next()
-            return ast.Literal(float(t.value))
+            if "e" in t.value or "E" in t.value:
+                return ast.Literal(float(t.value))
+            # MySQL: a numeric literal with a decimal point and no exponent
+            # is a DECIMAL, not a DOUBLE (exact comparisons against decimal
+            # columns depend on this; parser repo analog: ast.NewDecimal)
+            return ast.Literal(t.value, type_hint="decimal")
         if t.kind == T.STRING:
             self.next()
             return ast.Literal(t.value)

@@ -91,6 +91,16 @@ def literal_to_constant(v, type_hint: str = "") -> Constant:
         return Constant(parse_date(str(v)), ty_date(False))
     if type_hint in ("datetime", "timestamp"):
         return Constant(parse_datetime(str(v)), ty_datetime(False))
+    if type_hint == "decimal":
+        text = str(v)
+        neg = text.startswith("-")
+        digits = text.lstrip("+-")
+        intpart, _, frac = digits.partition(".")
+        scaled = int((intpart or "0") + frac)
+        if neg:
+            scaled = -scaled
+        prec = max(len(intpart) + len(frac), 1)
+        return Constant(scaled, ty_decimal(prec, len(frac), False))
     if isinstance(v, bool):
         return Constant(int(v), ty_int(False))
     if isinstance(v, int):
@@ -226,6 +236,9 @@ class ExprBuilder:
             raise PlanError("IS requires NULL/TRUE/FALSE")
         left = self._build(e.left)
         right = self._build(e.right)
+        if op == "not like":  # NOT LIKE = not(like(...))
+            return self._make_func("not",
+                                   [self._make_func("like", [left, right])])
         return self._make_func(op, [left, right])
 
     def _unop(self, e: ast.UnaryOp) -> Expression:

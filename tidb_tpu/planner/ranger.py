@@ -90,24 +90,53 @@ def _const_key(col: ColumnExpr, const: Constant, store, store_offset: int,
         return (code, eff)
     if kind in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL, TypeKind.DATE,
                 TypeKind.DATETIME):
-        if isinstance(v, float):
-            if v != int(v):
-                import math
-
-                if op == "=":
-                    return None
-                # fractional bound: int_col > 10.5 == int_col >= 11;
-                # int_col < 2.5 == int_col <= 2 (bounds become CLOSED)
-                if op in ("<", "<="):
-                    return (math.floor(v), "<=")
-                return (math.ceil(v), ">=")
-            v = int(v)
-        return (int(v), op) if isinstance(v, int) else None
+        scaled = _exact_scaled(v, const.ftype, 0)
+        if scaled is None:
+            return None
+        return _closed_bound(*scaled, op)
     if kind == TypeKind.DECIMAL:
-        return (v, op) if isinstance(v, int) else None  # scaled-int repr
+        scaled = _exact_scaled(v, const.ftype, col.ftype.scale)
+        if scaled is None:
+            return None
+        return _closed_bound(*scaled, op)
     if kind == TypeKind.FLOAT:
+        if const.ftype.kind == TypeKind.DECIMAL and isinstance(v, int):
+            return (v / 10 ** const.ftype.scale, op)
         return (float(v), op) if isinstance(v, (int, float)) else None
     return None
+
+
+def _exact_scaled(v, const_ft, target_scale: int):
+    """(quotient, has_fraction) of the constant shifted to the column's
+    scale, computed EXACTLY (no IEEE noise: 0.07*100 != 7.0 in floats)."""
+    from fractions import Fraction
+
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, int) and const_ft.kind == TypeKind.DECIMAL:
+        f = Fraction(v, 10 ** const_ft.scale)
+    elif isinstance(v, int):
+        f = Fraction(v)
+    elif isinstance(v, float):
+        # repr() is the shortest decimal that round-trips: the value the
+        # user wrote, free of binary representation noise
+        f = Fraction(repr(v))
+    else:
+        return None
+    f *= 10 ** target_scale
+    q, r = divmod(f.numerator, f.denominator)
+    return q, r != 0
+
+
+def _closed_bound(q: int, has_frac: bool, op: str):
+    """Fractional constants make int-domain bounds CLOSED:
+    col > 10.5 == col >= 11; col < 2.5 == col <= 2; col = 10.5 matches
+    nothing.  divmod floors, so q is the floor for either sign."""
+    if not has_frac:
+        return (q, op)
+    if op == "=":
+        return None
+    return (q, "<=") if op in ("<", "<=") else (q + 1, ">=")
 
 
 def build_access_path(conds: List[Expression], index_uids: List[int],

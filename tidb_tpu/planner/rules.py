@@ -177,9 +177,15 @@ def _ppd(plan: LogicalPlan, conds: List[Expression]):
     if isinstance(plan, LogicalProjection):
         deeper, stay = [], []
         sub = {c.uid: e for c, e in zip(plan.schema.cols, plan.exprs)}
+        child_uids = set(plan.children[0].schema.uids())
         for cond in conds:
             s = _substitute(cond, sub)
-            if s is not None:
+            # only push when the rewritten condition is evaluable below the
+            # projection: a projection expr that is itself an aggregate
+            # output (derived GROUP BY tables) references columns that do
+            # not exist under the projection — pushing it produced a
+            # row-level `sum(v) = c` filter that silently dropped every row
+            if s is not None and _expr_uids([s]) <= child_uids:
                 deeper.append(s)
             else:
                 stay.append(cond)
@@ -338,7 +344,15 @@ def eliminate_projections(plan: LogicalPlan, top: bool = False) -> LogicalPlan:
     plan.children = [eliminate_projections(c) for c in plan.children]
     if isinstance(plan, LogicalProjection) and not top:
         child = plan.children[0]
-        if len(plan.exprs) == len(child.schema) and all(
+        # the relabel below only survives into the physical plan when the
+        # child OWNS its schema; passthrough nodes (Selection/Sort/Limit...)
+        # re-derive theirs from below at physical build, losing the new
+        # uids and crashing parent remaps (seen with filters over derived
+        # GROUP BY tables)
+        owns_schema = isinstance(
+            child, (LogicalDataSource, LogicalAggregation, LogicalProjection)
+        )
+        if owns_schema and len(plan.exprs) == len(child.schema) and all(
             isinstance(e, ColumnExpr) and e.unique_id == c.uid
             for e, c in zip(plan.exprs, child.schema.cols)
         ):
