@@ -50,7 +50,7 @@ class HashAggExec(Executor):
         self.ctx.mem_tracker.consume(sum(c.nbytes() for c in chunks))
         n_keys = len(self.group_by)
         if self.partial_input:
-            final = aggstate.merge_partials_to_final(n_keys, self.aggs, chunks)
+            final = self._merge_final(n_keys, chunks)
         else:
             has_distinct = any(a.distinct for a in self.aggs)
             if has_distinct:
@@ -63,19 +63,70 @@ class HashAggExec(Executor):
                     if n_keys == 0 and whole.num_rows == 0:
                         final = None
             else:
-                # chunk-wise partials, then one merge — bounded eval memory
+                # chunk-wise partials computed by a worker pool
+                # (aggregate.go:101-169 partial workers; numpy releases the
+                # GIL so the pool genuinely overlaps), then partitioned
+                # final merge
                 ir = AggregationIR(self.group_by, self.aggs, mode="partial")
-                partials = [
-                    _run_agg(ir, c) for c in chunks if c.num_rows > 0
-                ]
-                final = aggstate.merge_partials_to_final(
-                    n_keys, self.aggs, partials
-                )
+                live = [c for c in chunks if c.num_rows > 0]
+                par = self.ctx.hashagg_partial_concurrency
+                if par > 1 and len(live) > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    from ..metrics import REGISTRY
+
+                    REGISTRY.inc("executor_parallel_workers_total",
+                                 min(par, len(live)))
+                    with ThreadPoolExecutor(max_workers=par) as pool:
+                        partials = list(
+                            pool.map(lambda c: _run_agg(ir, c), live)
+                        )
+                else:
+                    partials = [_run_agg(ir, c) for c in live]
+                final = self._merge_final(n_keys, partials)
         if final is None:
             if n_keys == 0:
                 return [aggstate.empty_final_row(self.aggs)]
             return []
         return list(final.split(self.ctx.chunk_size))
+
+    def _merge_final(self, n_keys: int, partials: List[Chunk]):
+        """Final merge; with many partial rows the merge itself partitions
+        by key hash across tidb_hashagg_final_concurrency workers
+        (aggregate.go final worker ring)."""
+        fin = self.ctx.hashagg_final_concurrency
+        live = [c for c in partials if c is not None and c.num_rows > 0]
+        total = sum(c.num_rows for c in live)
+        if fin <= 1 or n_keys == 0 or total < 8192:
+            return aggstate.merge_partials_to_final(n_keys, self.aggs, live)
+        parts = [[] for _ in range(fin)]
+        for c in live:
+            h = _partition_hash(c, n_keys)
+            if h is None:  # unhashable key column (host objects): serial
+                return aggstate.merge_partials_to_final(
+                    n_keys, self.aggs, live)
+            for p in range(fin):
+                sel = h % fin == p
+                if sel.any():
+                    parts[p].append(c.filter(sel))
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("executor_parallel_workers_total", fin)
+        with ThreadPoolExecutor(max_workers=fin) as pool:
+            merged = list(pool.map(
+                lambda cs: aggstate.merge_partials_to_final(
+                    n_keys, self.aggs, cs),
+                parts,
+            ))
+        merged = [m for m in merged if m is not None]
+        if not merged:
+            return None
+        out = merged[0]
+        for m in merged[1:]:
+            out = out.append(m)
+        return out
 
     def _next(self) -> Optional[Chunk]:
         if self._result is None:
@@ -149,3 +200,26 @@ class StreamAggExec(Executor):
                 return aggstate.merge_partials_to_final(
                     n_keys, self.aggs, [closed]
                 )
+
+
+def _partition_hash(c: Chunk, n_keys: int):
+    """Vectorized per-row hash over the key columns; None when a key column
+    holds host objects (strings) — those merges stay serial."""
+    h = np.zeros(c.num_rows, dtype=np.uint64)
+    for i in range(n_keys):
+        col = c.col(i)
+        data = col.data
+        if data.dtype == object:
+            return None
+        if np.issubdtype(data.dtype, np.floating):
+            # bit view (with -0.0 folded) so fractional keys spread across
+            # partitions — value truncation would collapse [0,1) to one
+            # worker (same canonicalization as aggstate.group_indices)
+            v = np.where(data == 0.0, 0.0, data).astype(
+                np.float64).view(np.uint64)
+        else:
+            v = data.astype(np.int64, copy=False).view(np.uint64)
+        v = v * np.uint64(0x9E3779B97F4A7C15)
+        h = (h * np.uint64(31)) ^ (v >> np.uint64(7)) ^ v
+        h = h ^ (~col.validity()).astype(np.uint64)
+    return h

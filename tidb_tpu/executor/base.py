@@ -65,6 +65,33 @@ class ExecContext:
     def distsql_concurrency(self) -> int:
         return self.vars.get_int("tidb_distsql_scan_concurrency") if self.vars else 8
 
+    def _conc(self, name: str, default: int) -> int:
+        """Concurrency knob with tidb_executor_concurrency as the umbrella
+        default (tidb_vars.go semantics: per-op vars register as -1 =
+        ConcurrencyUnset, so the umbrella applies until a per-op override)."""
+        if not self.vars:
+            return default
+        v = self.vars.get_int(name)
+        if v <= 0:
+            v = self.vars.get_int("tidb_executor_concurrency")
+        return max(1, v)
+
+    @property
+    def hash_join_concurrency(self) -> int:
+        return self._conc("tidb_hash_join_concurrency", 5)
+
+    @property
+    def hashagg_partial_concurrency(self) -> int:
+        return self._conc("tidb_hashagg_partial_concurrency", 4)
+
+    @property
+    def hashagg_final_concurrency(self) -> int:
+        return self._conc("tidb_hashagg_final_concurrency", 4)
+
+    @property
+    def projection_concurrency(self) -> int:
+        return self._conc("tidb_projection_concurrency", 4)
+
     @property
     def engine(self) -> str:
         if self.vars and not self.vars.get_bool("tidb_use_tpu"):
@@ -165,3 +192,89 @@ def collect_all(exe: Executor) -> List[Chunk]:
                 out.append(c)
     finally:
         exe.close()
+
+
+class OrderedPipeline:
+    """Order-preserving worker pipeline over a chunk stream.
+
+    The TPU-first root executors are numpy-vectorized, and numpy releases
+    the GIL inside kernels — a small thread pool genuinely overlaps chunk
+    transforms.  This is the reference's projection/join worker-ring shape
+    (projection.go:185-217, join.go:307-414): up to `workers` transforms in
+    flight, results yielded in submission order so row order matches the
+    serial executor exactly.
+    """
+
+    def __init__(self, workers: int, source, fn):
+        import collections
+
+        self.workers = max(1, workers)
+        self.source = source  # () -> Optional[Chunk]
+        self.fn = fn  # Chunk -> Optional[Chunk]
+        self._pool = None  # spun up lazily: only multi-chunk streams pay
+        self._pending = collections.deque()
+        self._exhausted = False
+        self._started = False
+
+    def _pull(self):
+        while True:
+            c = self.source()
+            if c is None:
+                self._exhausted = True
+                return None
+            if c.num_rows:
+                return c
+
+    def _fill(self):
+        while (not self._exhausted
+               and len(self._pending) < self.workers * 2):
+            c = self._pull()
+            if c is None:
+                return
+            self._pending.append(self._pool.submit(self.fn, c))
+
+    def _next_raw(self):
+        if self.workers <= 1:
+            c = self._pull()
+            return None if c is None else self.fn(c)
+        if not self._started:
+            self._started = True
+            a = self._pull()
+            if a is None:
+                return None
+            b = self._pull()
+            if b is None:
+                # single-chunk stream (point lookups, small LIMITs): run
+                # inline — no threads to spawn, nothing to overlap
+                return self.fn(a)
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..metrics import REGISTRY
+
+            self._pool = ThreadPoolExecutor(max_workers=self.workers)
+            REGISTRY.inc("executor_parallel_workers_total", self.workers)
+            self._pending.append(self._pool.submit(self.fn, a))
+            self._pending.append(self._pool.submit(self.fn, b))
+        if self._pool is None:
+            return None
+        self._fill()
+        if not self._pending:
+            return None
+        return self._pending.popleft().result()
+
+    def next(self):
+        """Next transformed chunk in order; None at end of stream."""
+        while True:
+            out = self._next_raw()
+            if out is None and self._exhausted and not self._pending:
+                return None
+            if out is not None and out.num_rows:
+                return out
+
+    def close(self):
+        if self._pool is not None:
+            for f in self._pending:
+                f.cancel()
+            self._pending.clear()
+            self._pool.shutdown(wait=False)
+            self._pool = None

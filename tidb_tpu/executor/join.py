@@ -18,6 +18,7 @@ left_outer_semi (left cols + matched flag, for IN subqueries).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -31,11 +32,16 @@ from ..types import TypeKind, ty_bool
 from .base import ExecContext, Executor
 
 
+_STR_DICT_MU = threading.Lock()
+
+
 def _key_matrix(chunk: Chunk, keys: List[Expression],
                 str_dict: dict) -> Tuple[np.ndarray, np.ndarray]:
     """Evaluate key exprs -> (int64 matrix [n,k], any-null mask [n]).
 
-    Shared str_dict maps strings to stable codes across build+probe."""
+    Shared str_dict maps strings to stable codes across build+probe; the
+    lock keeps code assignment consistent under concurrent probe workers
+    (the encode loop is pure Python/GIL-bound, so the lock costs nothing)."""
     n = chunk.num_rows
     cols = []
     null = np.zeros(n, dtype=np.bool_)
@@ -49,12 +55,13 @@ def _key_matrix(chunk: Chunk, keys: List[Expression],
             cols.append(key_bits_int64(data))
         elif v.ftype.kind == TypeKind.STRING or data.dtype == object:
             codes = np.empty(n, dtype=np.int64)
-            for i, s in enumerate(data):
-                key = str(s)
-                c = str_dict.get(key)
-                if c is None:
-                    c = str_dict[key] = len(str_dict)
-                codes[i] = c
+            with _STR_DICT_MU:
+                for i, s in enumerate(data):
+                    key = str(s)
+                    c = str_dict.get(key)
+                    if c is None:
+                        c = str_dict[key] = len(str_dict)
+                    codes[i] = c
             cols.append(codes)
         else:
             cols.append(data.astype(np.int64, copy=False))
@@ -137,6 +144,7 @@ class HashJoinExec(Executor):
         self._rf_key_idx = rf_key_idx
         self._rf_filter_id = rf_filter_id
         self._probe_opened = False
+        self._probe_pipe = None
 
     def open(self):
         # the probe child opens lazily in _next(): its scan fan-out must not
@@ -145,6 +153,11 @@ class HashJoinExec(Executor):
         self.child(0).open()
         self._open()
         self._opened = True
+
+    def _close(self):
+        if self._probe_pipe is not None:
+            self._probe_pipe.close()
+            self._probe_pipe = None
 
     def _ensure_probe_open(self):
         if self._probe_opened:
@@ -183,30 +196,30 @@ class HashJoinExec(Executor):
         self._built = True
 
     def _probe_codes(self, chunk: Chunk):
+        """(codes, null, key_matrix) — mat returned (not stored) so probe
+        workers can run concurrently (join.go:307-414 probe worker pool)."""
         mat, null = _key_matrix(chunk, self.probe_keys, self._str_dict)
-        self._probe_mat = mat
         if mat.shape[1] == 0:
-            return np.zeros(chunk.num_rows, dtype=np.int64), null
-        return _hash_combine(mat), null
+            return np.zeros(chunk.num_rows, dtype=np.int64), null, mat
+        return _hash_combine(mat), null, mat
 
     # ---- probe phase ---------------------------------------------------
     def _next(self) -> Optional[Chunk]:
         if not self._built:
             self._build_table()
         self._ensure_probe_open()
-        while True:
-            pc = self.child(1).next()
-            if pc is None:
-                return None
-            if pc.num_rows == 0:
-                continue
-            out = self._join_chunk(pc)
-            if out is not None and out.num_rows:
-                return out
+        if self._probe_pipe is None:
+            from .base import OrderedPipeline
+
+            self._probe_pipe = OrderedPipeline(
+                self.ctx.hash_join_concurrency, self.child(1).next,
+                self._join_chunk,
+            )
+        return self._probe_pipe.next()
 
     def _join_chunk(self, pc: Chunk) -> Optional[Chunk]:
         bc = self._build_chunk
-        codes, null = self._probe_codes(pc)
+        codes, null, probe_mat = self._probe_codes(pc)
         ok = ~null
         probe_idx, build_idx, _ = _expand_matches(
             self._sorted_codes, self._order, codes, ok
@@ -216,7 +229,7 @@ class HashJoinExec(Executor):
             exact = np.ones(len(probe_idx), dtype=np.bool_)
             for j in range(self._build_mat.shape[1]):
                 exact &= (self._build_mat[build_idx, j]
-                          == self._probe_mat[probe_idx, j])
+                          == probe_mat[probe_idx, j])
             probe_idx = probe_idx[exact]
             build_idx = build_idx[exact]
         matched = np.zeros(pc.num_rows, dtype=np.bool_)

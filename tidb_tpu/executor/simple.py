@@ -37,15 +37,25 @@ class SelectionExec(Executor):
 
 
 class ProjectionExec(Executor):
+    """Parallel pipelined projection (projection.go:53-90,185-217): up to
+    tidb_projection_concurrency chunk evaluations in flight, results in
+    input order."""
+
     def __init__(self, ctx, child: Executor, exprs: List[Expression],
                  plan_id: int = -1):
         super().__init__(ctx, [e.ftype for e in exprs], [child], plan_id)
         self.exprs = exprs
+        self._pipe = None
 
-    def _next(self) -> Optional[Chunk]:
-        c = self.child().next()
-        if c is None:
-            return None
+    def _open(self):
+        from .base import OrderedPipeline
+
+        self._pipe = OrderedPipeline(
+            self.ctx.projection_concurrency, self.child().next,
+            self._project,
+        )
+
+    def _project(self, c: Chunk) -> Chunk:
         cols = []
         for e, ft in zip(self.exprs, self.ftypes):
             v = e.eval(c)
@@ -53,6 +63,14 @@ class ProjectionExec(Executor):
                 v = cast_vec(v, ft)
             cols.append(v.to_column())
         return Chunk(cols)
+
+    def _next(self) -> Optional[Chunk]:
+        return self._pipe.next()
+
+    def _close(self):
+        if self._pipe is not None:
+            self._pipe.close()
+            self._pipe = None
 
 
 class LimitExec(Executor):

@@ -32,10 +32,10 @@ SYSVAR_DEFAULTS = {
     "tidb_init_chunk_size": ("32", "int"),
     "tidb_distsql_scan_concurrency": ("8", "int"),
     "tidb_executor_concurrency": ("5", "int"),
-    "tidb_hash_join_concurrency": ("5", "int"),
-    "tidb_hashagg_partial_concurrency": ("4", "int"),
-    "tidb_hashagg_final_concurrency": ("4", "int"),
-    "tidb_projection_concurrency": ("4", "int"),
+    "tidb_hash_join_concurrency": ("-1", "int"),
+    "tidb_hashagg_partial_concurrency": ("-1", "int"),
+    "tidb_hashagg_final_concurrency": ("-1", "int"),
+    "tidb_projection_concurrency": ("-1", "int"),
     "tidb_index_lookup_concurrency": ("4", "int"),
     "tidb_mem_quota_query": (str(32 << 30), "int"),
     "tidb_oom_action": ("cancel", "str"),
