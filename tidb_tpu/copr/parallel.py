@@ -664,12 +664,15 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
     dag = DAG.from_dict(req.dag)
     table = storage.table(dag.scan.table_id)
     if table.base_rows == 0 or table.base_ts > req.ts:
+        req.mesh_reject_reason = "empty table or stale snapshot"
         return None
     if len(req.ranges) > 4:
+        req.mesh_reject_reason = f"{len(req.ranges)} disjoint ranges"
         return None  # many disjoint ranges: per-region fan-out handles it
     try:
         an = _Analyzed(dag, table)
-    except JaxUnsupported:
+    except JaxUnsupported as e:
+        req.mesh_reject_reason = str(e)
         return None
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
@@ -756,9 +759,10 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
                 chunks.extend(_sort_agg_chunks(
                     fn(datas, valids, del_mask, start, end, pargs), table, an,
                 ))
-            except MeshAggOverflow:
+            except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
                 # shard — hand the whole request to the host hash agg
+                req.mesh_reject_reason = str(e)
                 return None
         elif kind == "agg":
             gcount, results = fn(datas, valids, del_mask, start, end, pargs)

@@ -102,6 +102,9 @@ class SelectResult:
         self._closed = False
         self._rows_returned = 0
         self.fallback_tasks = 0  # regions that ran on the CPU engine after a device error
+        # EXPLAIN ANALYZE attribution: which engine actually served the scan
+        self.scan_engine: str = "pending"
+        self.total_tasks = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -193,10 +196,14 @@ class SelectResult:
                     )
                     out = None
                 if out is not None:
+                    self.scan_engine = "mesh"
                     for c in out:
                         self._put(c)
                     self._put(_DONE)
                     return
+                self.scan_engine = "tile-fanout"
+            else:
+                self.scan_engine = "cpu"
             # split ranges per region up front: each task is one region's clip
             tasks = []
             for kr in self.req.ranges:
@@ -205,6 +212,7 @@ class SelectResult:
             if not tasks:
                 self._put(_DONE)
                 return
+            self.total_tasks = len(tasks)
             n_workers = min(self.req.concurrency, len(tasks))
 
             if n_workers == 1:

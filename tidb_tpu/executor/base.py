@@ -21,6 +21,10 @@ class OperatorStats:
     rows: int = 0
     loops: int = 0
     time_ns: int = 0
+    # per-operator engine attribution (EXPLAIN ANALYZE honesty: which
+    # engine actually served a cop task, incl. mesh-rejection reasons —
+    # util/execdetails/execdetails.go:326-396 analog)
+    engine: str = ""
 
     def record(self, rows: int, dur_ns: int):
         self.rows += rows

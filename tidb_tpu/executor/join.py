@@ -281,25 +281,143 @@ class HashJoinExec(Executor):
 
 
 class MergeJoinExec(Executor):
-    """Sort-merge join over children already ordered on the join keys.
+    """True sort-merge join: children arrive ordered on the join keys
+    (Sort / keep-order readers); matching is a vectorized range merge.
 
-    Reference: executor/merge_join.go.  Materializes both sides (they arrive
-    sorted from Sort/keep-order readers), then does a vectorized merge via
-    the same code-space trick as HashJoinExec — the win vs hash is avoiding
-    the build hash table for pre-sorted inputs; here both collapse to
-    searchsorted, so this class mainly preserves plan/EXPLAIN parity.
+    Reference: executor/merge_join.go.  Per left row, the matching right
+    range comes from two searchsorted calls on the first key (O(n log m),
+    no hash table); extra keys verify per candidate pair.  Output preserves
+    the left side's order — the property hash join cannot give keep-order
+    pipelines.
     """
 
     def __init__(self, ctx, left: Executor, right: Executor, kind: str,
                  left_keys, right_keys, other_conds, plan_id: int = -1):
-        self._inner = HashJoinExec(
-            ctx, right, left, kind, right_keys, left_keys, other_conds,
-            probe_is_left=True, plan_id=plan_id,
-        )
-        super().__init__(ctx, self._inner.ftypes, [self._inner], plan_id)
+        if kind in ("semi", "anti_semi"):
+            ftypes = list(left.ftypes)
+        elif kind == "left_outer":
+            ftypes = list(left.ftypes) + [
+                ft.with_nullable(True) for ft in right.ftypes
+            ]
+        else:
+            ftypes = list(left.ftypes) + list(right.ftypes)
+        super().__init__(ctx, ftypes, [left, right], plan_id)
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.other_conds = other_conds
+        self._out: Optional[List[Chunk]] = None
+        self._pos = 0
+
+    def _open(self):
+        self._out = None
+        self._pos = 0
+
+    def _merge(self) -> List[Chunk]:
+        lc = concat_chunks(self.drain_child(0))
+        rc = concat_chunks(self.drain_child(1))
+        if lc is None:
+            lc = self.child(0).empty_chunk()
+        if rc is None:
+            rc = self.child(1).empty_chunk()
+        self.ctx.mem_tracker.consume(lc.nbytes() + rc.nbytes())
+        str_dict: dict = {}
+        lmat, lnull = _key_matrix(lc, self.left_keys, str_dict)
+        rmat, rnull = _key_matrix(rc, self.right_keys, str_dict)
+        # key encodings must be ORDER-preserving for searchsorted, not just
+        # equality-preserving: string codes are first-seen-ordered (re-rank
+        # by value) and float bit patterns invert for negatives (monotone
+        # IEEE transform: flip all bits when the sign bit is set)
+        rank = None
+        for j, k in enumerate(self.left_keys):
+            if k.ftype.kind == TypeKind.STRING:
+                if rank is None:
+                    rank = np.zeros(max(len(str_dict), 1), dtype=np.int64)
+                    for i, (_, c) in enumerate(sorted(str_dict.items())):
+                        rank[c] = i
+                lmat[:, j] = rank[lmat[:, j]]
+                rmat[:, j] = rank[rmat[:, j]]
+            elif k.ftype.kind == TypeKind.FLOAT:
+                lmat[:, j] = _monotone_float_bits(lmat[:, j])
+                rmat[:, j] = _monotone_float_bits(rmat[:, j])
+        lkey = lmat[:, 0] if lmat.shape[1] else np.zeros(lc.num_rows, np.int64)
+        rkey = rmat[:, 0] if rmat.shape[1] else np.zeros(rc.num_rows, np.int64)
+        rok = np.flatnonzero(~rnull)
+        rkey_ok = rkey[rok]
+        starts = np.searchsorted(rkey_ok, lkey, "left")
+        ends = np.searchsorted(rkey_ok, lkey, "right")
+        counts = np.where(lnull, 0, ends - starts)
+        total = int(counts.sum())
+        left_idx = np.repeat(np.arange(lc.num_rows), counts)
+        if total:
+            offs = np.zeros(lc.num_rows + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            right_pos = (np.arange(total)
+                         - np.repeat(offs[:-1], counts)
+                         + np.repeat(starts, counts))
+            right_idx = rok[right_pos]
+            # verify remaining keys (first-key ranges are supersets)
+            if lmat.shape[1] > 1:
+                keep = np.ones(total, dtype=np.bool_)
+                for j in range(1, lmat.shape[1]):
+                    keep &= lmat[left_idx, j] == rmat[right_idx, j]
+                left_idx, right_idx = left_idx[keep], right_idx[keep]
+        else:
+            right_idx = np.zeros(0, dtype=np.int64)
+
+        pairs = None
+        if len(left_idx):
+            pcols = [c.take(left_idx) for c in lc.columns]
+            bcols = [c.take(right_idx) for c in rc.columns]
+            if self.kind == "left_outer":
+                bcols = [Column(c.ftype.with_nullable(True), c.data, c.valid)
+                         for c in bcols]
+            pairs = Chunk(pcols + bcols)
+            if self.other_conds:
+                keep = eval_bool_mask(self.other_conds, pairs)
+                left_idx = left_idx[keep]
+                right_idx = right_idx[keep]
+                pairs = pairs.filter(keep)
+        matched = np.zeros(lc.num_rows, dtype=np.bool_)
+        if len(left_idx):
+            matched[left_idx] = True
+
+        k = self.kind
+        if k == "inner":
+            out = pairs if pairs is not None else self.empty_chunk()
+        elif k == "semi":
+            out = lc.filter(matched)
+        elif k == "anti_semi":
+            out = lc.filter(~matched)
+        elif k == "left_outer":
+            unmatched = lc.filter(~matched)
+            pad = Chunk([Column.nulls(ft.with_nullable(True), unmatched.num_rows)
+                         for ft in self.child(1).ftypes])
+            outer_rows = Chunk(unmatched.columns + pad.columns)
+            if pairs is None or pairs.num_rows == 0:
+                out = outer_rows
+            elif outer_rows.num_rows:
+                # interleave so the output keeps the LEFT side's order —
+                # the whole point of a merge join for keep-order pipelines
+                combined = pairs.append(outer_rows)
+                src_left = np.concatenate([
+                    left_idx, np.flatnonzero(~matched)])
+                order = np.argsort(src_left, kind="stable")
+                out = Chunk([c.take(order) for c in combined.columns])
+            else:
+                out = pairs
+        else:
+            raise ExecutorError(f"merge join kind {self.kind!r}")
+        return [c for c in out.split(self.ctx.chunk_size) if c.num_rows]
 
     def _next(self):
-        return self._inner.next()
+        if self._out is None:
+            self._out = self._merge()
+        if self._pos >= len(self._out):
+            return None
+        c = self._out[self._pos]
+        self._pos += 1
+        return c
 
 
 class NestedLoopApplyExec(Executor):
@@ -370,3 +488,14 @@ class NestedLoopApplyExec(Executor):
                           for c in inner.columns]
             return Chunk(rep.columns + inner_cols)
         raise ExecutorError(f"apply: unknown kind {k!r}")
+
+
+def _monotone_float_bits(bits: np.ndarray) -> np.ndarray:
+    """IEEE-754 bit pattern -> int64 that sorts in float value order:
+    negative floats have the sign bit set and compare inverted as ints, so
+    flip ALL bits when negative and only the sign bit when positive."""
+    u = bits.view(np.uint64)
+    # unsigned-order transform (neg: flip all, pos: flip sign) composed
+    # with the unsigned->signed shift (flip top bit) = neg: flip low 63
+    mask = np.where(bits < 0, np.uint64(0x7FFFFFFFFFFFFFFF), np.uint64(0))
+    return (u ^ mask).view(np.int64)

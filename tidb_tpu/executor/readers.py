@@ -52,6 +52,15 @@ class TableReaderExec(Executor):
 
     def _close(self):
         if self._result is not None:
+            if self.plan_id >= 0:
+                r = self._result
+                eng = r.scan_engine
+                if eng == "tile-fanout" and r.fallback_tasks:
+                    eng += f" ({r.fallback_tasks}/{r.total_tasks} cpu-retry)"
+                reason = getattr(r.req, "mesh_reject_reason", None)
+                if reason and eng != "mesh":
+                    eng += f" [mesh rejected: {reason}]"
+                self.ctx.op_stats(self.plan_id).engine = eng
             self._result.close()
             self._result = None
 

@@ -361,6 +361,31 @@ class PhysHashJoin(PhysicalPlan):
                             rf_filter_id=self.rf_filter_id)
 
 
+class PhysMergeJoin(PhysicalPlan):
+    """Sort-merge join over key-sorted children (merge_join.go)."""
+
+    def __init__(self, left, right, kind, left_keys, right_keys,
+                 other_conds, schema):
+        super().__init__(schema, [left, right])
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.other_conds = other_conds
+
+    def info(self) -> str:
+        keys = ", ".join(f"{l}=={r}" for l, r in
+                         zip(self.left_keys, self.right_keys))
+        return f"{self.kind} [{keys}]"
+
+    def build(self, ctx):
+        from ..executor import MergeJoinExec
+
+        return MergeJoinExec(ctx, self.children[0].build(ctx),
+                             self.children[1].build(ctx), self.kind,
+                             self.left_keys, self.right_keys,
+                             self.other_conds, self.id)
+
+
 class PhysSort(PhysicalPlan):
     def __init__(self, child: PhysicalPlan, items):
         super().__init__(child.schema, [child])
@@ -578,6 +603,7 @@ class PhysicalContext:
     pushdown_blacklist: frozenset = frozenset()
     enable_pushdown: bool = True
     stats: object = None  # StatsHandle
+    prefer_merge_join: bool = False  # tidb_opt_prefer_merge_join
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
@@ -923,6 +949,15 @@ def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
         # EXISTS with no correlation: keys empty -> every probe row matches
         # iff build side non-empty; HashJoinExec handles empty key lists.
         pass
+    if (pctx.prefer_merge_join and plan.eq_conds
+            and plan.kind in ("inner", "left_outer", "semi", "anti_semi")):
+        # sort-merge join: inject explicit sorts on the join keys (the
+        # merge exec requires ascending key order); preserves left order
+        # through the join (merge_join.go's keep-order property)
+        left_s = PhysSort(left, [(k, False) for k in lkeys])
+        right_s = PhysSort(right, [(k, False) for k in rkeys])
+        return PhysMergeJoin(left_s, right_s, plan.kind, lkeys, rkeys,
+                             others, plan.schema)
     rf = _attach_runtime_filter(
         plan.kind, left, right, lkeys, rkeys, build_right, pctx
     )

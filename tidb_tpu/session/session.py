@@ -236,6 +236,7 @@ class Session:
             pushdown_blacklist=frozenset(),
             enable_pushdown=self.vars.get_bool("tidb_enable_pushdown"),
             stats=self.domain.stats,
+            prefer_merge_join=self.vars.get_bool("tidb_opt_prefer_merge_join"),
         )
 
     def _exec_ctx(self) -> ExecContext:
@@ -331,8 +332,12 @@ class Session:
             rows = []
             for nm, est, task, info in phys.explain_tree():
                 st = ctx.stats.get(_plan_id_of(nm))
-                extra = (f"rows:{st.rows} loops:{st.loops} "
-                         f"time:{st.time_ns/1e6:.2f}ms") if st else ""
+                extra = ""
+                if st:
+                    extra = (f"rows:{st.rows} loops:{st.loops} "
+                             f"time:{st.time_ns/1e6:.2f}ms")
+                    if st.engine:
+                        extra += f" engine:{st.engine}"
                 rows.append((nm, est, task, info, extra))
             return ResultSet(
                 headers=["id", "estRows", "task", "info", "execution info"],

@@ -37,6 +37,7 @@ SYSVAR_DEFAULTS = {
     "tidb_hashagg_final_concurrency": ("-1", "int"),
     "tidb_projection_concurrency": ("-1", "int"),
     "tidb_index_lookup_concurrency": ("4", "int"),
+    "tidb_opt_prefer_merge_join": ("0", "bool"),
     "tidb_mem_quota_query": (str(32 << 30), "int"),
     "tidb_oom_action": ("cancel", "str"),
     "tidb_retry_limit": ("10", "int"),
