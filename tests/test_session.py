@@ -341,3 +341,69 @@ class TestEngineParity:
             tsess.execute("set tidb_use_tpu = 0")
             cpu_rows = q(tsess, sql)
             assert tpu_rows == cpu_rows, sql
+
+
+class TestPlanCache:
+    """Repeated identical statements reuse their physical plan
+    (planner/core/cache.go analog); DML/DDL invalidate."""
+
+    def test_repeat_hits_and_invalidation(self):
+        from tidb_tpu.metrics import REGISTRY
+        from tidb_tpu.session import Domain
+
+        s = Domain().new_session()
+        s.execute("create table pc (a bigint, b bigint)")
+        s.execute("insert into pc values (1, 2), (3, 4)")
+        q = "select a, b from pc where a > 0 order by a"
+
+        def delta(fn):
+            b = REGISTRY.snapshot()
+            fn()
+            a = REGISTRY.snapshot()
+            return (a.get("plan_cache_hits_total", 0)
+                    - b.get("plan_cache_hits_total", 0))
+
+        first = s.query(q)
+        assert delta(lambda: s.query(q)) == 1  # second run hits
+        assert s.query(q) == first
+        # DML bumps data_version -> miss, then hits again
+        s.execute("insert into pc values (5, 6)")
+        assert delta(lambda: s.query(q)) == 0
+        assert delta(lambda: s.query(q)) == 1
+        # DDL bumps schema_version -> miss
+        s.execute("alter table pc add column c bigint")
+        assert delta(lambda: s.query(q)) == 0
+        # ANALYZE bumps the stats epoch -> miss (join orders may change)
+        s.execute("analyze table pc")
+        assert delta(lambda: s.query(q)) == 0
+        assert delta(lambda: s.query(q)) == 1
+        # explicit txns never use the cache (dirty reads change pushdown)
+        s.execute("begin")
+        assert delta(lambda: s.query(q)) == 0
+        s.execute("rollback")
+
+
+def test_cost_routing_small_scan_to_host():
+    """With the dispatch-cost knob set, a small scan routes to the host
+    engine and EXPLAIN ANALYZE says so; a huge threshold never flips the
+    flagship path when dispatch cost is zero."""
+    import numpy as np
+
+    from tidb_tpu.session import Domain
+
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table cr (a bigint)")
+    t = d.catalog.info_schema().table("test", "cr")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(5000, dtype=np.int64)], ts=d.storage.current_ts())
+    s.execute("set tidb_use_tpu = 1")
+    s.execute("set tidb_opt_device_dispatch_us = 70000")
+    rows = s.execute("explain analyze select count(*) from cr")[0].rows
+    readers = [r for r in rows if "TableReader" in r[0]]
+    assert any("cost-routed" in r[4] and "engine:cpu" in r[4]
+               for r in readers), readers
+    s.execute("set tidb_opt_device_dispatch_us = 0")
+    rows = s.execute("explain analyze select count(*) from cr")[0].rows
+    readers = [r for r in rows if "TableReader" in r[0]]
+    assert any("engine:mesh" in r[4] for r in readers), readers

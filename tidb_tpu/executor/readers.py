@@ -40,12 +40,45 @@ class TableReaderExec(Executor):
         self._aux = dict(aux) if self._aux is None else {**self._aux, **aux}
 
     def _open(self):
+        engine = self.ctx.engine
+        self._cost_routed = False
+        if engine == "tpu":
+            engine = self._route(engine)
         self._result = select_dag(
             self.ctx.storage, self.dag, self.ranges, self.ctx.snapshot_ts(),
             concurrency=self.ctx.distsql_concurrency,
-            keep_order=self.keep_order, engine=self.ctx.engine,
+            keep_order=self.keep_order, engine=engine,
             aux=self._aux,
         )
+
+    def _route(self, engine: str) -> str:
+        """First cost model for TPU-vs-host routing: a device scan pays a
+        fixed dispatch+readback latency (dominant on tunneled chips), the
+        host pays per-row; route small scans to the host (the reference's
+        per-operator cop-vs-root cost split, planner/core/task.go)."""
+        v = self.ctx.vars
+        if v is None:
+            return engine
+        dispatch_us = v.get_int("tidb_opt_device_dispatch_us")
+        if dispatch_us <= 0:
+            return engine
+        rows = 0
+        for kr in self.ranges:
+            try:
+                hi = min(kr.end, self.ctx.storage.table(kr.table_id).base_rows)
+            except Exception:
+                return engine
+            rows += max(hi - kr.start, 0)
+        host_us = rows / max(v.get_int("tidb_opt_host_rows_per_us"), 1)
+        dev_us = dispatch_us + rows / max(
+            v.get_int("tidb_opt_device_rows_per_us"), 1)
+        if host_us < dev_us:
+            self._cost_routed = True
+            from ..metrics import REGISTRY
+
+            REGISTRY.inc("cost_routed_host_total")
+            return "cpu"
+        return engine
 
     def _next(self) -> Optional[Chunk]:
         return self._result.next_chunk()
@@ -60,6 +93,8 @@ class TableReaderExec(Executor):
                 reason = getattr(r.req, "mesh_reject_reason", None)
                 if reason and eng != "mesh":
                     eng += f" [mesh rejected: {reason}]"
+                if getattr(self, "_cost_routed", False):
+                    eng += " (cost-routed)"
                 self.ctx.op_stats(self.plan_id).engine = eng
             self._result.close()
             self._result = None

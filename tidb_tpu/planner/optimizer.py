@@ -38,12 +38,12 @@ def plan_statement(stmt: ast.Stmt, infoschema: InfoSchema, current_db: str,
 def finish_plan(logical, pctx: PhysicalContext) -> PhysicalPlan:
     if isinstance(logical, InsertPlan):
         if logical.select_plan is not None:
-            logical.select_plan = optimize_logical(logical.select_plan)
+            logical.select_plan = optimize_logical(logical.select_plan, pctx)
         return physical_for_stmt(logical, pctx)
     if isinstance(logical, (UpdatePlan, DeletePlan, LoadDataPlan)):
         return physical_for_stmt(logical, pctx)
     assert isinstance(logical, LogicalPlan)
-    logical = optimize_logical(logical)
+    logical = optimize_logical(logical, pctx)
     phys = physical_for_stmt(logical, pctx)
     annotate_estimates(phys, pctx)
     return phys

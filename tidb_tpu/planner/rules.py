@@ -28,12 +28,13 @@ from .logical import (
     LogicalUnion,
 )
 
-RULES = ("prune_columns", "push_predicates", "eliminate_projections",
-         "merge_limit_sort")
+RULES = ("push_predicates", "reorder_joins", "prune_columns",
+         "eliminate_projections", "merge_limit_sort")
 
 
-def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, pctx=None) -> LogicalPlan:
     plan = push_predicates(plan)
+    plan = reorder_joins(plan, pctx)  # after ppd: eq edges are populated
     prune_columns(plan, set(plan.schema.uids()))
     refresh_schemas(plan)
     plan = eliminate_projections(plan, top=True)
@@ -389,3 +390,165 @@ def merge_limit_sort(plan: LogicalPlan) -> LogicalPlan:
                                       plan.offset)]
             return c
     return plan
+
+
+# ---------------------------------------------------------------------------
+# greedy join reorder (planner/core/rule_join_reorder.go)
+# ---------------------------------------------------------------------------
+
+
+def _est_member(p: LogicalPlan, pctx) -> float:
+    """Crude cardinality estimate for a join-group member."""
+    if isinstance(p, LogicalDataSource):
+        rows = float(max(getattr(p.table, "row_count", 0) or 0, 0))
+        st = None
+        if pctx is not None and pctx.stats is not None:
+            try:
+                st = pctx.stats.get(p.table.id)
+            except Exception:
+                st = None
+        if st is not None and st.row_count:
+            rows = float(st.row_count)
+        elif rows == 0:
+            try:
+                rows = float(pctx.storage.table(p.table.id).base_rows)
+            except Exception:
+                rows = 1000.0
+        if p.pushed_conds:
+            rows *= 0.25 ** min(len(p.pushed_conds), 2)
+        return max(rows, 1.0)
+    if isinstance(p, LogicalSelection):
+        return max(_est_member(p.children[0], pctx) * 0.25, 1.0)
+    if isinstance(p, LogicalAggregation):
+        return max(_est_member(p.children[0], pctx) * 0.1, 1.0)
+    if p.children:
+        return _est_member(p.children[0], pctx)
+    return 1000.0
+
+
+def reorder_joins(plan: LogicalPlan, pctx=None,
+                  parent_inner: bool = False) -> LogicalPlan:
+    """Greedy stats-driven reorder of maximal inner-join groups
+    (rule_join_reorder.go's greedy solver): start from the smallest member,
+    repeatedly join the connected member minimizing the estimated result.
+    Left-deep output; cross joins (no connecting eq edge) go last.
+
+    The solver runs ONCE per maximal group: a join whose parent is also an
+    inner join is part of the parent's group and is skipped here."""
+    is_inner = isinstance(plan, LogicalJoin) and plan.kind == "inner"
+    if not is_inner or parent_inner:
+        plan.children = [reorder_joins(c, pctx, is_inner)
+                         for c in plan.children]
+        return plan
+
+    members: List[LogicalPlan] = []
+    eqs: List[Tuple[Expression, Expression]] = []
+    others: List[Expression] = []
+
+    def collect(p):
+        if isinstance(p, LogicalJoin) and p.kind == "inner":
+            eqs.extend(p.eq_conds)
+            others.extend(p.other_conds)
+            for c in p.children:
+                collect(c)
+        else:
+            members.append(p)
+
+    collect(plan)
+    members = [reorder_joins(m, pctx) for m in members]
+    if len(members) < 3:
+        plan.children = [reorder_joins(c, pctx, True) for c in plan.children]
+        return plan
+
+    uid_of = {}  # uid -> member index
+    for i, m in enumerate(members):
+        for u in m.schema.uids():
+            uid_of[u] = i
+
+    def side(e) -> Optional[int]:
+        us: set = set()
+        e.collect_columns(us)
+        idxs = {uid_of.get(u) for u in us}
+        idxs.discard(None)
+        return idxs.pop() if len(idxs) == 1 else None
+
+    edges = []  # (i, j, l_expr, r_expr) with l on member i
+    bad = False
+    for l, r in eqs:
+        i, j = side(l), side(r)
+        if i is None or j is None or i == j:
+            bad = True
+            break
+        edges.append((i, j, l, r))
+    if bad:
+        return plan  # unexpected shape: keep the syntactic order
+
+    est = [_est_member(m, pctx) for m in members]
+    joined = {min(range(len(members)), key=lambda i: est[i])}
+    order = [next(iter(joined))]
+    cur_rows = est[order[0]]
+    while len(order) < len(members):
+        connected = set()
+        for i, j, _, _ in edges:
+            if (i in joined) != (j in joined):
+                connected.add(j if i in joined else i)
+        if connected:
+            # eq edge: FK-ish assumption — result near the larger side
+            nxt = min(connected, key=lambda c: max(cur_rows, est[c]))
+            cur_rows = max(cur_rows, est[nxt])
+        else:
+            remaining = [i for i in range(len(members)) if i not in joined]
+            nxt = min(remaining, key=lambda c: est[c])
+            cur_rows = cur_rows * est[nxt]
+        joined.add(nxt)
+        order.append(nxt)
+
+    # rebuild left-deep
+    placed_eq = [False] * len(edges)
+    placed_other = [False] * len(others)
+    built = members[order[0]]
+    built_members = {order[0]}
+    built_uids = set(built.schema.uids())
+    for mi in order[1:]:
+        m = members[mi]
+        muids = set(m.schema.uids())
+        eq_here = []
+        for k, (i, j, l, r) in enumerate(edges):
+            if placed_eq[k]:
+                continue
+            if i in built_members and j == mi:
+                eq_here.append((l, r))
+                placed_eq[k] = True
+            elif j in built_members and i == mi:
+                eq_here.append((r, l))
+                placed_eq[k] = True
+        avail = built_uids | muids
+        oth_here = []
+        for k, c in enumerate(others):
+            if placed_other[k]:
+                continue
+            us: set = set()
+            c.collect_columns(us)
+            us &= set(uid_of)
+            if us <= avail:
+                oth_here.append(c)
+                placed_other[k] = True
+        built = LogicalJoin(
+            built, m, "inner", eq_here, oth_here,
+            Schema(list(built.schema.cols) + list(m.schema.cols)),
+        )
+        built_members.add(mi)
+        built_uids = avail
+    # anything unplaced (eq with both sides inside one step, etc.)
+    leftovers = [ScalarFunc("=", [l, r], _bool_ft(), {})
+                 for k, (i, j, l, r) in enumerate(edges) if not placed_eq[k]]
+    leftovers += [c for k, c in enumerate(others) if not placed_other[k]]
+    if leftovers:
+        built = LogicalSelection(built, leftovers)
+    return built
+
+
+def _bool_ft():
+    from ..types import ty_int
+
+    return ty_int(False)

@@ -101,13 +101,20 @@ class Session:
         self._prepared: dict = {}  # name -> sql
         self.last_exec_ctx: Optional[ExecContext] = None
         self.last_plan = None
+        from collections import OrderedDict
+
+        self._plan_cache: "OrderedDict" = OrderedDict()
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def execute(self, sql: str, params: Optional[list] = None) -> List[ResultSet]:
         out = []
-        for stmt in parse(sql):
+        stmts = parse(sql)
+        if len(stmts) == 1:
+            # plan-cache key: single-statement texts cache their plan
+            stmts[0]._sql_text = sql
+        for stmt in stmts:
             t0 = time.time()
             rs = self._execute_stmt(stmt, params)
             dur = time.time() - t0
@@ -263,10 +270,48 @@ class Session:
         return rows
 
     def _plan(self, stmt, params=None):
-        return plan_statement(
+        key = self._plan_cache_key(stmt, params)
+        if key is not None:
+            hit = self._plan_cache.get(key)
+            if hit is not None:
+                from ..metrics import REGISTRY
+
+                REGISTRY.inc("plan_cache_hits_total")
+                self._plan_cache.move_to_end(key)
+                return hit
+        phys = plan_statement(
             stmt, self.domain.catalog.info_schema(), self.current_db,
             self._pctx(), exec_subplan=self._exec_subplan,
             param_values=params,
+        )
+        if key is not None:
+            from ..metrics import REGISTRY
+
+            REGISTRY.inc("plan_cache_misses_total")
+            self._plan_cache[key] = phys
+            if len(self._plan_cache) > 128:
+                self._plan_cache.popitem(last=False)
+        return phys
+
+    def _plan_cache_key(self, stmt, params):
+        """Cache key for repeated statements (planner/core/cache.go analog:
+        keyed on text + schema version + data versions + planner vars).
+        None disables caching: txn writes change pushdown eligibility, and
+        parameterized plans bake constant ranges."""
+        if params is not None or self._txn is not None:
+            return None
+        if not isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
+            return None
+        sql = getattr(stmt, "_sql_text", None)
+        if sql is None:
+            return None
+        return (
+            sql, self.current_db,
+            self.domain.catalog.schema_version,
+            self.domain.storage.data_version(),
+            getattr(self.domain.stats, "epoch", 0),
+            self.vars.get_bool("tidb_enable_pushdown"),
+            self.vars.get_bool("tidb_opt_prefer_merge_join"),
         )
 
     def _run_query(self, stmt, params=None) -> ResultSet:

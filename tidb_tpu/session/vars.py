@@ -38,6 +38,14 @@ SYSVAR_DEFAULTS = {
     "tidb_projection_concurrency": ("-1", "int"),
     "tidb_index_lookup_concurrency": ("4", "int"),
     "tidb_opt_prefer_merge_join": ("0", "bool"),
+    # cost-based TPU-vs-host scan routing (optimizer.go:162-184 cost split
+    # analog).  Measured on the axon-tunneled v5e: one dispatch+readback
+    # round trip ~70ms; host numpy runs Q1-shaped scans ~1.3 rows/us; the
+    # warm device sustains ~50 rows/us.  dispatch_us=0 disables routing
+    # (always device) — set ~70000 on tunneled hardware.
+    "tidb_opt_device_dispatch_us": ("0", "int"),
+    "tidb_opt_host_rows_per_us": ("1", "int"),
+    "tidb_opt_device_rows_per_us": ("50", "int"),
     "tidb_mem_quota_query": (str(32 << 30), "int"),
     "tidb_oom_action": ("cancel", "str"),
     "tidb_retry_limit": ("10", "int"),

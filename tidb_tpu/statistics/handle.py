@@ -48,7 +48,13 @@ class StatsHandle:
         self.auto_analyze_ratio = 0.5
 
     # ------------------------------------------------------------------
+    epoch = 0  # bumped per analyze: plan-cache invalidation
+
     def analyze_table(self, table_id: int, n_buckets: int = 64) -> TableStats:
+        self.epoch += 1
+        return self._analyze_table(table_id, n_buckets)
+
+    def _analyze_table(self, table_id: int, n_buckets: int = 64) -> TableStats:
         store = self.storage.table(table_id)
         ts = self.storage.current_ts()
         deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)

@@ -84,6 +84,7 @@ class TableStore:
         self._col_stats: Dict[int, Tuple[int, int, bool]] = {}
         # durability hook (store/persist.TablePersister); None = RAM-only
         self.persister = None
+        self.on_mutate = None  # storage-level data-version bump (plan cache)
         from .index import IndexManager
 
         self.indexes = IndexManager()
@@ -166,6 +167,8 @@ class TableStore:
             self.base_ts = max(self.base_ts, ts)
             self.base_version += 1
             self._col_stats.clear()
+            if self.on_mutate is not None:
+                self.on_mutate()
             if self.persister is not None:
                 self.persister.save_base(self)
 
@@ -306,6 +309,8 @@ class TableStore:
                 return
             ver = Version(commit_ts, start_ts, lk.op, lk.values)
             self.delta.setdefault(handle, []).append(ver)
+            if self.on_mutate is not None:
+                self.on_mutate()
             if self.persister is not None:
                 self.persister.append_delta(handle, ver)
 

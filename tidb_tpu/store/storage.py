@@ -31,6 +31,7 @@ class BlockStorage(Storage):
         self._mu = threading.RLock()
         self._client = CoprClient(self)
         self.data_dir = data_dir
+        self._data_version = 0
 
     # ---- catalog -------------------------------------------------------
     def create_table(self, table_id: int, columns: List[Tuple[str, FieldType]]) -> TableStore:
@@ -42,6 +43,7 @@ class BlockStorage(Storage):
                 from .persist import TablePersister
 
                 ts.persister = TablePersister(self.data_dir, table_id)
+            ts.on_mutate = self._bump_data_version
             self._tables[table_id] = ts
             self.regions.bootstrap_table(table_id)
             return ts
@@ -89,6 +91,15 @@ class BlockStorage(Storage):
         return Transaction(
             self, start_ts or self.oracle.get_timestamp(), pessimistic
         )
+
+    def data_version(self) -> int:
+        """Monotonic counter bumped on bulk load, compaction, and committed
+        DML (via TableStore.on_mutate) — O(1) plan-cache invalidation with
+        no cross-lock iteration of live delta dicts."""
+        return self._data_version
+
+    def _bump_data_version(self):
+        self._data_version += 1
 
     def current_ts(self) -> int:
         return self.oracle.get_timestamp()
