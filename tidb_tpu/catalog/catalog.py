@@ -56,6 +56,28 @@ class DDLJob:
     start_time: float = 0.0
     states_walked: List[str] = field(default_factory=list)
     error: str = ""
+    # online-reorg checkpoint (ddl/reorg.go): next handle to backfill and
+    # the job's payload (index definition) so a restarted domain can resume
+    reorg_progress: int = 0
+    meta: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "typ": self.typ, "db": self.db,
+            "table": self.table, "state": self.state,
+            "schema_version": self.schema_version,
+            "states_walked": list(self.states_walked), "error": self.error,
+            "reorg_progress": self.reorg_progress, "meta": dict(self.meta),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "DDLJob":
+        j = DDLJob(d["id"], d["typ"], d["db"], d["table"], d["state"],
+                   d.get("schema_version", 0), 0.0,
+                   list(d.get("states_walked", [])), d.get("error", ""))
+        j.reorg_progress = d.get("reorg_progress", 0)
+        j.meta = dict(d.get("meta", {}))
+        return j
 
 
 class InfoSchema:
@@ -145,6 +167,10 @@ class Catalog:
                 # mutated, by DDL ops below
                 self._snapshot = InfoSchema(self.schema_version, dict(self._dbs))
             return self._snapshot
+
+    def _persist(self):
+        if getattr(self, "on_ddl", None) is not None:
+            self.on_ddl(self)
 
     def _record(self, job: DDLJob):
         job.schema_version = self.schema_version
@@ -313,9 +339,17 @@ class Catalog:
     # our indexes are materialized lazily from blocks (store side), so
     # "backfill" = first build; the state ladder is still recorded.
     # ------------------------------------------------------------------
+    BACKFILL_BATCH = 4096  # handles per reorg step (ddl/reorg.go batches)
+
     def create_index(self, db: str, table: str, name: str,
                      columns: List[str], unique: bool = False,
                      primary: bool = False):
+        """Online add-index: the F1 state ladder none -> delete-only ->
+        write-only -> write-reorg -> public (ddl_worker.go:466-469), one
+        schema-version bump per step; the write-reorg backfill runs in
+        handle ranges with progress checkpointed in the persisted job, so
+        a domain reopened mid-reorg resumes where the dead process stopped
+        (ddl/reorg.go)."""
         with self._mu:
             t = self.info_schema().table(db, table)
             if t.find_index(name) is not None:
@@ -323,15 +357,208 @@ class Catalog:
             for c in columns:
                 if t.find_column(c) is None:
                     raise KVError(f"no column {c!r} for index {name!r}")
-            job = DDLJob(self.gen_id(), "add_index", db, table)
-            job.states_walked = [STATE_NONE, STATE_DELETE_ONLY,
-                                 STATE_WRITE_ONLY, STATE_WRITE_REORG,
-                                 STATE_PUBLIC]
-            ix = IndexInfo(self.gen_id(), name, columns, unique, primary)
             if unique:
                 self._check_unique(t, columns, name)
-            self._replace_table(db, table, t, indexes=t.indexes + [ix])
-            self._record(job)
+            job = DDLJob(self.gen_id(), "add_index", db, table,
+                         state="running")
+            job.meta = {"index_id": self.gen_id(), "name": name,
+                        "columns": list(columns), "unique": unique,
+                        "primary": primary}
+            self.jobs.append(job)
+            self._persist()
+        self.run_ddl_job(job)
+
+    def run_ddl_job(self, job: DDLJob):
+        """Walk (or resume) an online DDL job to completion."""
+        if job.typ != "add_index" or job.state == "done":
+            job.state = "done"
+            return
+        m = job.meta
+        ix = IndexInfo(m["index_id"], m["name"], m["columns"],
+                       m["unique"], m["primary"], STATE_NONE)
+        ladder = [STATE_DELETE_ONLY, STATE_WRITE_ONLY, STATE_WRITE_REORG,
+                  STATE_PUBLIC]
+        done_states = set(job.states_walked)
+        try:
+            for st in ladder:
+                if st in done_states:
+                    continue
+                if st == STATE_WRITE_REORG:
+                    self._set_index_state(job, ix, st)
+                    self._backfill_index(job, ix)
+                else:
+                    self._set_index_state(job, ix, st)
+                job.states_walked.append(st)
+                with self._mu:
+                    self._persist()
+        except Exception as e:
+            # an ERROR rolls the job back (duplicate key, bad state...):
+            # remove the half-added index so the name is reusable.  A real
+            # crash never runs this handler — the persisted 'running' job
+            # resumes on the next domain open (ddl_worker rollback vs
+            # owner-resume split).
+            with self._mu:
+                t = self.info_schema().table(job.db, job.table)
+                self._replace_table(
+                    job.db, job.table, t,
+                    indexes=[i for i in t.indexes if i.name != ix.name])
+                job.state = "rollback"
+                job.error = str(e)
+                self._persist()
+            self._drop_reorg_parts(job)
+            raise
+        job.state = "done"
+        job.states_walked = [STATE_NONE] + job.states_walked
+        with self._mu:
+            self._persist()
+
+    def _set_index_state(self, job: DDLJob, ix: IndexInfo, st: str):
+        from dataclasses import replace as dc_replace
+
+        with self._mu:
+            t = self.info_schema().table(job.db, job.table)
+            others = [i for i in t.indexes if i.name != ix.name]
+            self._replace_table(job.db, job.table, t,
+                                indexes=others + [dc_replace(ix, state=st)])
+            job.schema_version = self.schema_version
+
+    def _backfill_index(self, job: DDLJob, ix: IndexInfo):
+        """Range-batched backfill of the sorted-index snapshot.  Each batch
+        checkpoints as its own self-describing npz (covered range + the
+        base_version it was scanned under), so (a) resume needs no second
+        file to agree with, (b) I/O per batch is O(batch), and (c) a
+        compaction mid-scan — which renumbers handles and dict codes —
+        invalidates the checkpoints and restarts the scan."""
+        import numpy as np
+
+        from ..store.fault import FAILPOINTS
+        from ..store.index import finalize_sorted_index
+
+        with self._mu:
+            t = self.info_schema().table(job.db, job.table)
+        store = self.storage.table(t.id)
+        offs = t.col_offsets(ix.columns)
+        parts, scan_version = self._load_reorg_parts(job, store)
+        start = job.reorg_progress
+        while start < store.base_rows:
+            if store.base_version != scan_version:
+                # compaction renumbered handles: restart the scan
+                parts, start = [], 0
+                scan_version = store.base_version
+                self._drop_reorg_parts(job)
+            end = min(start + self.BACKFILL_BATCH, store.base_rows)
+            chunk = store.base_chunk(list(offs), start, end,
+                                     decode_strings=False)
+            valid = np.ones(end - start, dtype=np.bool_)
+            cols = []
+            for i in range(len(offs)):
+                c = chunk.col(i)
+                valid &= c.validity()
+                cols.append(c.data)
+            handles = np.arange(start, end, dtype=np.int64)[valid]
+            part = [c[valid] for c in cols] + [handles]
+            self._save_reorg_part(job, len(parts), part, end, scan_version)
+            parts.append(part)
+            job.reorg_progress = end
+            FAILPOINTS.hit("ddl/backfill_batch", job=job.id, upto=end)
+            start = end
+        ncols = len(offs)
+        if parts:
+            merged = [np.concatenate([p[i] for p in parts])
+                      for i in range(ncols + 1)]
+        else:
+            merged = [np.zeros(0) for _ in range(ncols)] + [
+                np.zeros(0, dtype=np.int64)]
+        idx = finalize_sorted_index(tuple(offs), merged[:ncols],
+                                    merged[ncols], scan_version)
+        if ix.unique and len(idx.handles) > 1:
+            # recheck under the final sorted order: a duplicate written
+            # through the delete-only window must fail the DDL
+            # (the reference backfill's ErrKeyExists -> job rollback)
+            dup = np.ones(len(idx.handles) - 1, dtype=bool)
+            for k in idx.cols:
+                dup &= k[1:] == k[:-1]
+            if dup.any():
+                raise KVError(
+                    f"duplicate entry for unique index {ix.name!r}")
+        if store.base_version == scan_version:
+            store.indexes.put(tuple(offs), idx)
+        # else: leave it to the lazy builder — the scan raced a compaction
+        self._drop_reorg_parts(job)
+
+    def _reorg_dir(self):
+        return self.storage.data_dir
+
+    def _reorg_glob(self, job: DDLJob):
+        import glob
+        import os
+
+        d = self._reorg_dir()
+        if d is None:
+            return []
+        return sorted(glob.glob(os.path.join(d, f"ddl_reorg_{job.id}_*.npz")),
+                      key=lambda p: int(p.rsplit("_", 1)[1][:-4]))
+
+    def _load_reorg_parts(self, job: DDLJob, store):
+        """(parts, scan_version) from per-batch checkpoints; progress is
+        derived from the checkpoints themselves (single source of truth)."""
+        import numpy as np
+
+        files = self._reorg_glob(job)
+        parts, upto, ver = [], 0, store.base_version
+        for p in files:
+            with np.load(p, allow_pickle=False) as z:
+                v = int(z["base_version"])
+                if v != store.base_version:
+                    parts, upto = [], 0
+                    break
+                w = int(z["w"])
+                parts.append([z[f"c{j}"] for j in range(w)])
+                upto = max(upto, int(z["upto"]))
+        job.reorg_progress = upto
+        if upto == 0:
+            parts = []
+            self._drop_reorg_parts(job)
+        return parts, ver
+
+    def _save_reorg_part(self, job: DDLJob, i: int, part, upto: int,
+                         base_version: int):
+        d = self._reorg_dir()
+        if d is None:
+            return
+        import os
+
+        import numpy as np
+
+        arrays = {"upto": np.int64(upto),
+                  "base_version": np.int64(base_version),
+                  "w": np.int64(len(part))}
+        for j, arr in enumerate(part):
+            arrays[f"c{j}"] = arr
+        p = os.path.join(d, f"ddl_reorg_{job.id}_{i}.npz")
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def _drop_reorg_parts(self, job: DDLJob):
+        import os
+
+        for p in self._reorg_glob(job):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def resume_pending_jobs(self):
+        """Called by a reopened domain: finish DDL jobs a dead process left
+        mid-ladder (the re-elected owner resuming the job queue,
+        ddl_worker.go:362)."""
+        for job in list(self.jobs):
+            if job.state == "running":
+                self.run_ddl_job(job)
 
     def drop_index(self, db: str, table: str, name: str):
         with self._mu:
@@ -437,6 +664,7 @@ class Catalog:
                 "version": self.schema_version,
                 "next_id": self._next_id,
                 "dbs": {k: d.to_dict() for k, d in self._dbs.items()},
+                "jobs": [j.to_dict() for j in self.jobs[-64:]],
             })
 
     def load_json(self, blob: str):
@@ -445,6 +673,7 @@ class Catalog:
             self.schema_version = d["version"]
             self._next_id = d["next_id"]
             self._dbs = {k: DBInfo.from_dict(v) for k, v in d["dbs"].items()}
+            self.jobs = [DDLJob.from_dict(j) for j in d.get("jobs", [])]
             self._snapshot = None
             for db in self._dbs.values():
                 for t in db.tables.values():

@@ -79,7 +79,13 @@ class _DMLBase(Executor):
         store = self.ctx.storage.table(t.id)
         txn = self.ctx.txn
         sets = []
-        uniques = [ix for ix in t.indexes if ix.unique or ix.primary]
+        from ..catalog.schema import STATE_DELETE_ONLY
+
+        # online DDL: write-only/write-reorg indexes already constrain new
+        # writes (ddl_worker.go:466-469 state semantics); delete-only do not
+        uniques = [ix for ix in t.indexes
+                   if (ix.unique or ix.primary)
+                   and ix.state != STATE_DELETE_ONLY]
         if not uniques:
             return []
         ts = txn.start_ts

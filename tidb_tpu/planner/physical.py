@@ -772,7 +772,11 @@ def _try_index_path(ds: LogicalDataSource,
     by_name = {c.name.lower(): c for c in ds.schema.cols}
     uid_to_off = {c.uid: c.store_offset for c in ds.schema.cols}
     best = None  # (score, index, path)
+    from ..catalog.schema import STATE_PUBLIC as _PUB
+
     for ix in ds.table.indexes:
+        if ix.state != _PUB:
+            continue  # online DDL: only public indexes serve reads
         uids = []
         for cname in ix.columns:
             sc = by_name.get(cname.lower())

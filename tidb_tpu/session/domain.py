@@ -54,6 +54,9 @@ class Domain:
             with open(meta) as f:
                 self.catalog.load_json(f.read())
             self.storage.load_persisted()
+            resume_jobs = True
+        else:
+            resume_jobs = False
 
         def persist(catalog):
             tmp = meta + ".tmp"
@@ -62,6 +65,10 @@ class Domain:
             os.replace(tmp, meta)
 
         self.catalog.on_ddl = persist
+        if resume_jobs:
+            # finish DDL jobs a dead process left mid-ladder (owner resume,
+            # ddl_worker.go:362): backfills continue from their checkpoint
+            self.catalog.resume_pending_jobs()
 
     def _bootstrap(self):
         """Create system schemas (session/bootstrap.go analog)."""

@@ -80,6 +80,11 @@ class IndexManager:
             self._cache[key] = idx
         return idx
 
+    def put(self, col_offsets: tuple, idx: "SortedIndex"):
+        """Register a prebuilt index (online add-index backfill artifact)."""
+        with self._mu:
+            self._cache[tuple(col_offsets)] = idx
+
     def _build(self, store, col_offsets: tuple) -> SortedIndex:
         n = store.base_rows
         cols: List[np.ndarray] = []
@@ -94,10 +99,24 @@ class IndexManager:
         if n and cols:
             handles = np.arange(n, dtype=np.int64)[valid]
             kept = [c[valid] for c in cols]
-            order = np.lexsort(tuple(reversed(kept)))
-            kept = [c[order] for c in kept]
-            handles = handles[order]
         else:
             kept = [np.zeros(0) for _ in col_offsets]
             handles = np.zeros(0, dtype=np.int64)
-        return SortedIndex(col_offsets, kept, handles, store.base_version)
+        return finalize_sorted_index(col_offsets, kept, handles,
+                                     store.base_version)
+
+
+def finalize_sorted_index(col_offsets, key_cols, handles,
+                          base_version: int) -> SortedIndex:
+    """Sort collected (key, handle) arrays into a SortedIndex — shared by
+    the lazy builder above and the online add-index backfill so ordering/
+    empty-case semantics cannot diverge."""
+    if len(handles):
+        order = np.lexsort(tuple(reversed(key_cols)))
+        key_cols = [c[order] for c in key_cols]
+        handles = handles[order]
+    else:
+        key_cols = [np.asarray(c) for c in key_cols]
+        handles = np.asarray(handles, dtype=np.int64)
+    return SortedIndex(tuple(col_offsets), list(key_cols), handles,
+                       base_version)
