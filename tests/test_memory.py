@@ -71,10 +71,18 @@ class TestSpill:
         vals = [r[0] for r in rows]
         assert vals == sorted(vals, reverse=True)[:5]
 
-    def test_join_quota_cancel(self, sess):
+    def test_join_under_tiny_quota_spills_or_cancels(self, sess):
+        """Joins now SPILL under quota (grace hash join) instead of
+        cancelling; with a quota too small even for one disk partition the
+        grace sub-join cancels (sub-joins never re-spill)."""
         sess.execute("set tidb_mem_quota_query = 50000")
-        with pytest.raises(MemoryQuotaExceededError):
-            sess.query("select count(*) from big x join big y on x.a = y.a")
+        try:
+            rows = sess.query(
+                "select count(*) from big x join big y on x.a = y.a")
+            # spill path completed: the answer must still be exact
+            assert rows[0][0] >= 20000
+        except MemoryQuotaExceededError:
+            pass  # partition itself exceeded the (tiny) quota: cancelled
 
     def test_quota_log_action_keeps_running(self, sess):
         sess.execute("set tidb_mem_quota_query = 50000")
@@ -82,3 +90,71 @@ class TestSpill:
         rows = sess.query("select count(*) from big x join big y "
                           "on x.a = y.a")
         assert rows[0][0] >= 20000
+
+
+class TestPartitionedSpill:
+    """Join/agg complete under a memory quota that previously OOM-cancelled
+    (VERDICT r2 item 8): build sides and agg partials partition to disk and
+    merge per partition."""
+
+    def _sess(self):
+        import numpy as np
+
+        from tidb_tpu.session import Domain
+
+        d = Domain()
+        s = d.new_session()
+        s.execute("create table big (k bigint, g bigint, v double)")
+        t = d.catalog.info_schema().table("test", "big")
+        rng = np.random.default_rng(13)
+        n = 120_000
+        d.storage.table(t.id).bulk_load_arrays([
+            np.arange(n, dtype=np.int64),
+            rng.integers(0, 30_000, n, dtype=np.int64),
+            rng.uniform(0, 10, n)], ts=d.storage.current_ts())
+        d.storage.regions.split_even(t.id, 8, n)
+        s.execute("create table dim (k bigint, w bigint)")
+        td = d.catalog.info_schema().table("test", "dim")
+        nd = 150_000
+        d.storage.table(td.id).bulk_load_arrays([
+            np.arange(nd, dtype=np.int64) % 30_000,
+            np.arange(nd, dtype=np.int64)], ts=d.storage.current_ts())
+        d.storage.regions.split_even(td.id, 4, nd)
+        s.execute("analyze table big")
+        s.execute("analyze table dim")
+        s.execute("set tidb_use_tpu = 0")
+        return s
+
+    def test_hashagg_spills_and_matches(self):
+        from tidb_tpu.metrics import REGISTRY
+
+        s = self._sess()
+        q = ("select g, count(*), sum(v) from big group by g "
+             "order by g limit 7")
+        want = s.query(q)
+        s.execute("set tidb_mem_quota_query = 2000000")  # ~1.5MB: trips
+        before = REGISTRY.snapshot().get("hashagg_spills_total", 0)
+        got = s.query(q)
+        after = REGISTRY.snapshot().get("hashagg_spills_total", 0)
+        assert after > before, "quota did not trigger a spill"
+        import pytest as _pt
+
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g[:2] == w[:2] and g[2] == _pt.approx(w[2], rel=1e-9)
+        s.execute("set tidb_mem_quota_query = 0")
+
+    def test_hashjoin_spills_and_matches(self):
+        from tidb_tpu.metrics import REGISTRY
+
+        s = self._sess()
+        q = ("select count(*), sum(w) from big join dim on big.g = dim.k "
+             "where v < 8")
+        want = s.query(q)
+        s.execute("set tidb_mem_quota_query = 1200000")
+        before = REGISTRY.snapshot().get("hashjoin_spills_total", 0)
+        got = s.query(q)
+        after = REGISTRY.snapshot().get("hashjoin_spills_total", 0)
+        assert after > before, "quota did not trigger a join spill"
+        assert got == want
+        s.execute("set tidb_mem_quota_query = 0")

@@ -44,15 +44,72 @@ class HashAggExec(Executor):
     def _open(self):
         self._result = None
         self._pos = 0
+        self._consumed = 0
+
+    def _close(self):
+        if getattr(self, "_consumed", 0):
+            self.ctx.mem_tracker.release(self._consumed)
+            self._consumed = 0
+
+    N_SPILL_PARTS = 8  # disk partitions when the quota trips
 
     def _compute(self) -> List[Chunk]:
-        chunks = self.drain_child()
-        self.ctx.mem_tracker.consume(sum(c.nbytes() for c in chunks))
         n_keys = len(self.group_by)
+        # drain with a registered spill hook: over-quota partial chunks
+        # partition by key hash to disk and merge per partition
+        # (hash_table.go:148-179 / util/memory action.go spill analog)
+        self._spill_lists = None
+        self._buffered: List[Chunk] = []
+        self._consumed = 0
+        has_distinct = (not self.partial_input
+                        and any(a.distinct for a in self.aggs))
+        self._spill_armed = n_keys > 0 and not has_distinct
+        if self._spill_armed:
+            self.ctx.mem_tracker.register_spill(self._spill)
+        # scalar aggregation (no group keys) needs O(1) state: fold each
+        # chunk into a one-row partial immediately instead of buffering
+        # the whole input (a join's output can dwarf any quota)
+        stream_scalar = n_keys == 0 and not has_distinct
+        scalar_ir = (AggregationIR(self.group_by, self.aggs, mode="partial")
+                     if stream_scalar and not self.partial_input else None)
+        scalar_parts: List[Chunk] = []
+        while True:
+            c = self.child().next()
+            if c is None:
+                break
+            if c.num_rows == 0:
+                continue
+            if stream_scalar:
+                part = c if scalar_ir is None else _run_agg(scalar_ir, c)
+                scalar_parts.append(part)
+                if len(scalar_parts) >= 64:  # bound the partial list
+                    scalar_parts = [concat_chunks(scalar_parts)]
+                continue
+            self._buffered.append(c)
+            self._consumed += c.nbytes()
+            self.ctx.mem_tracker.consume(c.nbytes())
+        if stream_scalar:
+            whole = concat_chunks(scalar_parts)
+            if whole is None or whole.num_rows == 0:
+                return [aggstate.empty_final_row(self.aggs)]
+            final = aggstate.merge_partials_to_final(0, self.aggs, [whole])
+            return list(final.split(self.ctx.chunk_size))
+        if self._spill_lists is not None:
+            # quota tripped during the drain: push the in-memory remainder
+            # through the same partitioner so every group lives in exactly
+            # one partition, then merge partition-by-partition
+            self._spill()
+            self._spill_armed = False
+            return self._spilled_result(n_keys)
+        chunks = self._buffered
+        # ownership transfers to the merge below: disarm the hook so a
+        # later quota trip elsewhere cannot spuriously re-aggregate data
+        # whose result has already been emitted
+        self._buffered = []
+        self._spill_armed = False
         if self.partial_input:
             final = self._merge_final(n_keys, chunks)
         else:
-            has_distinct = any(a.distinct for a in self.aggs)
             if has_distinct:
                 whole = concat_chunks(chunks)
                 if whole is None:
@@ -89,6 +146,56 @@ class HashAggExec(Executor):
                 return [aggstate.empty_final_row(self.aggs)]
             return []
         return list(final.split(self.ctx.chunk_size))
+
+    def _spill(self) -> int:
+        """Memory-tracker hook: push buffered chunks to hash-partitioned
+        disk lists; returns bytes freed."""
+        if not self._spill_armed or not self._buffered:
+            return 0
+        n_keys = len(self.group_by)
+        if self.partial_input:
+            parts = self._buffered
+        else:
+            # reduce raw rows to partial states first (much smaller)
+            ir = AggregationIR(self.group_by, self.aggs, mode="partial")
+            parts = [_run_agg(ir, c) for c in self._buffered]
+        if self._spill_lists is None:
+            from ..chunk.disk import ListInDisk
+
+            self._spill_lists = [ListInDisk("hashagg")
+                                 for _ in range(self.N_SPILL_PARTS)]
+        freed = sum(c.nbytes() for c in self._buffered)
+        for c in parts:
+            h = _partition_hash(c, n_keys)
+            if h is None:
+                # object keys: single partition (still bounded: disk)
+                self._spill_lists[0].add(c)
+                continue
+            for p in range(self.N_SPILL_PARTS):
+                sel = h % self.N_SPILL_PARTS == p
+                if sel.any():
+                    self._spill_lists[p].add(c.filter(sel))
+        self._buffered.clear()
+        self.ctx.mem_tracker.release(freed)
+        self._consumed = max(self._consumed - freed, 0)
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("hashagg_spills_total")
+        return freed
+
+    def _spilled_result(self, n_keys: int):
+        """Merge each disk partition separately — peak memory is bounded by
+        the largest partition, not the whole input."""
+        out: List[Chunk] = []
+        for lst in self._spill_lists:
+            part_chunks = list(lst)
+            lst.close()
+            merged = aggstate.merge_partials_to_final(
+                n_keys, self.aggs, part_chunks)
+            if merged is not None:
+                out.extend(merged.split(self.ctx.chunk_size))
+        self._spill_lists = None
+        return out
 
     def _merge_final(self, n_keys: int, partials: List[Chunk]):
         """Final merge; with many partial rows the merge itself partitions

@@ -111,7 +111,8 @@ class HashJoinExec(Executor):
                  build_keys: List[Expression], probe_keys: List[Expression],
                  other_conds: List[Expression], probe_is_left: bool,
                  plan_id: int = -1, rf_reader: Optional[Executor] = None,
-                 rf_key_idx: int = 0, rf_filter_id: int = 0):
+                 rf_key_idx: int = 0, rf_filter_id: int = 0,
+                 allow_spill: bool = True):
         if kind in ("semi", "anti_semi"):
             ftypes = list(probe.ftypes)
         elif kind == "left_outer_semi":
@@ -145,6 +146,16 @@ class HashJoinExec(Executor):
         self._rf_filter_id = rf_filter_id
         self._probe_opened = False
         self._probe_pipe = None
+        self._grace = False
+        self._grace_iter = None
+        self._build_buf: List[Chunk] = []
+        self._build_lists = None
+        self._rf_keys_acc = None
+        self._build_consumed = 0
+        # grace sub-joins must not re-spill: the same hash + same modulo
+        # re-lands a skewed partition in one bucket forever (recursion
+        # bomb); a sub-partition that still exceeds the quota cancels
+        self._allow_spill = allow_spill
 
     def open(self):
         # the probe child opens lazily in _next(): its scan fan-out must not
@@ -158,14 +169,31 @@ class HashJoinExec(Executor):
         if self._probe_pipe is not None:
             self._probe_pipe.close()
             self._probe_pipe = None
+        if self._grace_iter is not None:
+            self._grace_iter.close()  # runs the generator's finally
+            self._grace_iter = None
+        if self._build_lists is not None:
+            for lst in self._build_lists:
+                lst.close()
+            self._build_lists = None
+        if self._build_consumed:
+            # hand tracked build memory back so sibling operators (and
+            # grace sub-joins) see real headroom
+            self.ctx.mem_tracker.release(self._build_consumed)
+            self._build_consumed = 0
+        self._build_chunk = None
 
     def _ensure_probe_open(self):
         if self._probe_opened:
             return
         if self._rf_reader is not None:
-            mat, null = self._build_mat, self._build_any_null
-            keys = np.unique(mat[~null, self._rf_key_idx]) if mat.shape[0] \
-                else np.zeros(0, dtype=np.int64)
+            if self._grace:
+                keys = (self._rf_keys_acc if self._rf_keys_acc is not None
+                        else np.zeros(0, dtype=np.int64))
+            else:
+                mat, null = self._build_mat, self._build_any_null
+                keys = np.unique(mat[~null, self._rf_key_idx]) \
+                    if mat.shape[0] else np.zeros(0, dtype=np.int64)
             self._rf_reader.set_runtime_aux({
                 f"probe_keys_{self._rf_filter_id}":
                     np.ascontiguousarray(keys, dtype=np.int64)
@@ -174,12 +202,82 @@ class HashJoinExec(Executor):
         self._probe_opened = True
 
     # ---- build phase ---------------------------------------------------
+    N_SPILL_PARTS = 8
+
+    def _spill_build(self) -> int:
+        """Memory-tracker hook: push buffered build chunks to disk,
+        hash-partitioned by join key -> grace hash join
+        (hash_table.go:148-179)."""
+        if not self._allow_spill or not self._build_buf:
+            return 0
+        if self._build_lists is None:
+            from ..chunk.disk import ListInDisk
+
+            self._build_lists = [ListInDisk("gracejoin-build")
+                                 for _ in range(self.N_SPILL_PARTS)]
+        freed = 0
+        for c in self._build_buf:
+            freed += c.nbytes()
+            self._partition_to(self._build_lists, c, self.build_keys,
+                               collect_rf=self._rf_reader is not None)
+        self._build_buf.clear()
+        self.ctx.mem_tracker.release(freed)
+        self._build_consumed = max(self._build_consumed - freed, 0)
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("hashjoin_spills_total")
+        return freed
+
+    def _partition_to(self, lists, chunk: Chunk, keys, collect_rf=False):
+        mat, null = _key_matrix(chunk, keys, self._str_dict)
+        if chunk.num_rows == 0:
+            return
+        codes = (_hash_combine(mat) if mat.shape[1]
+                 else np.zeros(chunk.num_rows, np.int64))
+        part = codes.view(np.uint64) % np.uint64(len(lists))
+        part[null] = 0  # NULL keys flow through partition 0 (never match)
+        if collect_rf:
+            ks = np.unique(mat[~null, self._rf_key_idx]) if mat.shape[0]                 else np.zeros(0, np.int64)
+            self._rf_keys_acc = (ks if self._rf_keys_acc is None else
+                                 np.union1d(self._rf_keys_acc, ks))
+        for p in range(len(lists)):
+            sel = part == p
+            if sel.any():
+                lists[p].add(chunk.filter(sel))
+
     def _build_table(self):
-        chunks = self.drain_child(0)
+        self._build_buf: List[Chunk] = []
+        self._build_lists = None
+        self._rf_keys_acc = None
+        self._grace = False
+        if self._allow_spill:
+            self.ctx.mem_tracker.register_spill(self._spill_build)
+        while True:
+            c = self.child(0).next()
+            if c is None:
+                break
+            if c.num_rows == 0:
+                continue
+            # buffer BEFORE consuming: the spill hook can then shed this
+            # very chunk when it alone exceeds the remaining quota (mesh
+            # scans deliver the whole table as one chunk)
+            self._build_buf.append(c)
+            self._build_consumed += c.nbytes()
+            self.ctx.mem_tracker.consume(c.nbytes())
+        if self._build_lists is not None:
+            self._spill_build()  # flush the in-memory remainder
+            self._grace = True
+            self._built = True
+            return
+        chunks = self._build_buf
+        # ownership moves to _build_chunk: clear the buffer and disarm the
+        # hook so a later quota trip elsewhere cannot "free" bytes that are
+        # still live (nor leak never-read disk lists)
+        self._build_buf = []
+        self._allow_spill = False
         bc = concat_chunks(chunks)
         if bc is None:
             bc = self.child(0).empty_chunk()
-        self.ctx.mem_tracker.consume(bc.nbytes())
         self._build_chunk = bc
         mat, null = _key_matrix(bc, self.build_keys, self._str_dict)
         codes = _hash_combine(mat) if bc.num_rows else np.zeros(0, np.int64)
@@ -208,6 +306,10 @@ class HashJoinExec(Executor):
         if not self._built:
             self._build_table()
         self._ensure_probe_open()
+        if self._grace:
+            if self._grace_iter is None:
+                self._grace_iter = self._run_grace()
+            return next(self._grace_iter, None)
         if self._probe_pipe is None:
             from .base import OrderedPipeline
 
@@ -216,6 +318,48 @@ class HashJoinExec(Executor):
                 self._join_chunk,
             )
         return self._probe_pipe.next()
+
+    def _run_grace(self):
+        """Grace hash join: the probe side partitions to disk by the same
+        key hash, then each partition pair joins with a fresh in-memory
+        join — peak memory ~ 1/N_SPILL_PARTS of the inputs per side."""
+        from ..chunk.disk import ListInDisk
+
+        P = len(self._build_lists)
+        probe_lists = [ListInDisk("gracejoin-probe") for _ in range(P)]
+        while True:
+            pc = self.child(1).next()
+            if pc is None:
+                break
+            if pc.num_rows:
+                self._partition_to(probe_lists, pc, self.probe_keys)
+        try:
+            for p in range(P):
+                pchunks = list(probe_lists[p])
+                if not pchunks:
+                    continue  # every join kind emits rows driven by probe
+                bchunks = list(self._build_lists[p])
+                sub = HashJoinExec(
+                    self.ctx,
+                    _ChunksExec(self.ctx, bchunks, self.child(0).ftypes),
+                    _ChunksExec(self.ctx, pchunks, self.child(1).ftypes),
+                    self.kind, self.build_keys, self.probe_keys,
+                    self.other_conds, self.probe_is_left,
+                    allow_spill=False,
+                )
+                sub.open()
+                try:
+                    while True:
+                        c = sub.next()
+                        if c is None:
+                            break
+                        yield c
+                finally:
+                    sub.close()
+        finally:
+            for lst in probe_lists + self._build_lists:
+                lst.close()
+            self._build_lists = None
 
     def _join_chunk(self, pc: Chunk) -> Optional[Chunk]:
         bc = self._build_chunk
@@ -278,6 +422,25 @@ class HashJoinExec(Executor):
         if self.probe_is_left:
             return Chunk(pcols + bcols)
         return Chunk(bcols + pcols)
+
+
+class _ChunksExec(Executor):
+    """Materialized chunk list as an executor (grace-join partitions)."""
+
+    def __init__(self, ctx, chunks, ftypes):
+        super().__init__(ctx, ftypes, [])
+        self._chunks = chunks
+        self._i = 0
+
+    def _open(self):
+        self._i = 0
+
+    def _next(self):
+        if self._i >= len(self._chunks):
+            return None
+        c = self._chunks[self._i]
+        self._i += 1
+        return c
 
 
 class MergeJoinExec(Executor):
