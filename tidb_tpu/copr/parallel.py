@@ -149,6 +149,10 @@ def _all_true(mesh: Mesh, n_pad: int):
 
 _COMPILED: Dict[str, object] = {}
 
+# max selected rows gathered host-side per streamed chunk (kv.Request
+# Streaming / distsql stream.go: bounded-memory result consumption)
+STREAM_ROWS = 1 << 16
+
 
 def _key_device(d):
     """Device-side canonical join/group key: float keys stay in VALUE domain
@@ -658,9 +662,13 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
 # ---------------------------------------------------------------------------
 
 
-def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
+def try_run_mesh(storage, req: CopRequest):
     """Run the whole request across the device mesh; None if ineligible
-    (the caller falls back to the per-region thread fan-out)."""
+    (the caller falls back to the per-region thread fan-out).
+
+    Returns an ITERABLE of chunks: a list for agg/topn, a ONE-SHOT lazy
+    generator for filters (streamed gathers — iterate exactly once; device
+    errors can surface during iteration)."""
     dag = DAG.from_dict(req.dag)
     table = storage.table(dag.scan.table_id)
     if table.base_rows == 0 or table.base_ts > req.ts:
@@ -745,6 +753,13 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
 
     REGISTRY.inc("mesh_scans_total")
 
+    if kind == "filter":
+        # large filter outputs STREAM: the generator gathers selected rows
+        # in STREAM_ROWS slices as the consumer drains the bounded queue,
+        # so peak host memory no longer scales with the selected row count
+        return _stream_filter(req, table, an, fn, datas, valids, del_mask,
+                              inserted, pargs)
+
     chunks: List[Chunk] = []
     agg_accum = None
     topn_parts: List[Chunk] = []
@@ -782,48 +797,14 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
                 topn_parts.append(
                     table.gather_chunk(list(an.scan.columns), handles)
                 )
-        else:
-            mask = fn(datas, valids, del_mask, start, end, pargs)
-            handles = np.flatnonzero(mask)
-            if remaining is not None:
-                handles = handles[:remaining]
-                remaining -= len(handles)
-            if len(handles):
-                chunk = table.gather_chunk(list(an.scan.columns), handles)
-                if an.proj_exprs is not None:
-                    # dict-rewritten exprs expect coded strings; gather
-                    # decodes, so project from the original projection IR
-                    chunk = Chunk([
-                        _eval_to_column(p, chunk)
-                        for p in an.projection.exprs
-                    ])
-                chunks.append(chunk)
-            if remaining is not None and remaining <= 0:
-                break
 
     # delta rows (committed inserts/updates) go through the CPU engine
-    if inserted:
-        in_range = {
-            h: v for h, v in inserted.items()
-            if any(kr.start <= h < kr.end for kr in req.ranges)
-        }
-        if in_range:
-            from .cpu_engine import run_dag_on_chunk
-
-            handles = sorted(in_range)
-            cols = []
-            for out_i, store_ci in enumerate(an.scan.columns):
-                ft = an.scan.ftypes[out_i]
-                vals = [in_range[h][store_ci] for h in handles]
-                cols.append(Column.from_values(ft, vals))
-            res = run_dag_on_chunk(dag, Chunk(cols), req.aux)
-            if res.num_rows:
-                if kind == "agg":
-                    chunks.append(res)
-                elif kind == "topn":
-                    topn_parts.append(res)
-                else:
-                    chunks.append(res)
+    res = _delta_chunk(req, dag, an, inserted)
+    if res is not None:
+        if kind == "topn":
+            topn_parts.append(res)
+        else:
+            chunks.append(res)
 
     if kind == "agg":
         if agg_accum is not None:
@@ -840,6 +821,68 @@ def try_run_mesh(storage, req: CopRequest) -> Optional[List[Chunk]]:
     from .engine import _merge_tail
 
     return [c for c in _merge_tail(dag, chunks) if c.num_rows > 0]
+
+
+def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
+                   pargs=()):
+    """Generator over a mesh filter's result chunks: one bit-packed mask
+    readback per range, then STREAM_ROWS-sized host gathers on demand
+    (distsql/stream.go:33-124; kv.Request.Streaming kv/kv.go:270)."""
+    from ..metrics import REGISTRY
+
+    remaining = an.limit
+    for kr in req.ranges:
+        start = max(kr.start, 0)
+        end = min(kr.end, table.base_rows)
+        if start >= end:
+            continue
+        mask = fn(datas, valids, del_mask, start, end, pargs)
+        handles = np.flatnonzero(mask)
+        if remaining is not None:
+            handles = handles[:remaining]
+            remaining -= len(handles)
+        for off in range(0, len(handles), STREAM_ROWS):
+            sub = handles[off: off + STREAM_ROWS]
+            chunk = table.gather_chunk(list(an.scan.columns), sub)
+            if an.proj_exprs is not None:
+                # dict-rewritten exprs expect coded strings; gather
+                # decodes, so project from the original projection IR
+                chunk = Chunk([
+                    _eval_to_column(p, chunk)
+                    for p in an.projection.exprs
+                ])
+            REGISTRY.inc("mesh_stream_chunks_total")
+            yield chunk
+        if remaining is not None and remaining <= 0:
+            return
+    res = _delta_chunk(req, None, an, inserted)
+    if res is not None:
+        yield res
+
+
+def _delta_chunk(req, dag, an, inserted) -> Optional[Chunk]:
+    """Committed delta rows in range, run through the CPU engine's DAG
+    interpreter (shared by the materialized and streaming paths)."""
+    if not inserted:
+        return None
+    in_range = {
+        h: v for h, v in inserted.items()
+        if any(kr.start <= h < kr.end for kr in req.ranges)
+    }
+    if not in_range:
+        return None
+    from .cpu_engine import run_dag_on_chunk
+
+    if dag is None:
+        dag = DAG.from_dict(req.dag)
+    hs = sorted(in_range)
+    cols = []
+    for out_i, store_ci in enumerate(an.scan.columns):
+        ft = an.scan.ftypes[out_i]
+        vals = [in_range[h][store_ci] for h in hs]
+        cols.append(Column.from_values(ft, vals))
+    res = run_dag_on_chunk(dag, Chunk(cols), req.aux)
+    return res if res.num_rows else None
 
 
 def _eval_to_column(expr, chunk: Chunk) -> Column:

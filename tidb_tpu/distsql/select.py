@@ -196,11 +196,34 @@ class SelectResult:
                     )
                     out = None
                 if out is not None:
+                    # filter results arrive as a LAZY generator (streamed
+                    # gathers): device failures can surface mid-iteration,
+                    # so keep the fallback for errors before the first
+                    # chunk; after rows were emitted a retry would
+                    # duplicate them, so mid-stream errors surface
                     self.scan_engine = "mesh"
-                    for c in out:
-                        self._put(c)
-                    self._put(_DONE)
-                    return
+                    emitted = False
+                    try:
+                        for c in out:
+                            self._put(c)
+                            emitted = True
+                        self._put(_DONE)
+                        return
+                    except (_Closed, TiDBTPUError):
+                        raise
+                    except Exception:
+                        if emitted:
+                            raise
+                        import logging
+
+                        from ..metrics import REGISTRY
+
+                        REGISTRY.inc("mesh_scan_errors_total")
+                        logging.getLogger("tidb_tpu.distsql").warning(
+                            "mesh stream failed before first chunk; "
+                            "falling back to per-region path",
+                            exc_info=True,
+                        )
                 self.scan_engine = "tile-fanout"
             else:
                 self.scan_engine = "cpu"

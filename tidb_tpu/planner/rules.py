@@ -389,6 +389,12 @@ def merge_limit_sort(plan: LogicalPlan) -> LogicalPlan:
             c.children = [LogicalTopN(s.children[0], s.items, plan.limit,
                                       plan.offset)]
             return c
+        if isinstance(c, LogicalProjection) and plan.offset == 0:
+            # LIMIT commutes through a row-wise projection: pushing it
+            # below lets the cop scan stop early (rule_topn_push_down's
+            # limit case) — projections cannot add or drop rows
+            c.children = [LogicalLimit(c.children[0], plan.limit, 0)]
+            return c
     return plan
 
 
