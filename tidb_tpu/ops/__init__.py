@@ -6,9 +6,32 @@ Importing this package configures jax for the framework:
   32-bit types where safe and widen only at the reduction boundary).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: compiles on the tunneled TPU go through a
+# remote AOT helper and cost seconds-to-minutes; caching them on disk makes
+# warm-up across processes ~instant (measured 67s -> 0.95s).  Opt out with
+# TIDB_TPU_COMPILE_CACHE=0 or point elsewhere with =<dir>.
+_cc = os.environ.get("TIDB_TPU_COMPILE_CACHE", "")
+if _cc != "0":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _cc or os.path.join(
+                os.path.expanduser("~"), ".cache", "tidb_tpu_xla"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as _e:  # older jax without the knobs
+        if _cc:  # the user explicitly asked for a cache dir: say why not
+            import warnings
+
+            warnings.warn(
+                f"TIDB_TPU_COMPILE_CACHE={_cc!r} requested but the jax "
+                f"persistent compilation cache could not be enabled: {_e}")
 
 from .segment import (  # noqa: E402
     masked_segment_sum,
