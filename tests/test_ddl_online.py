@@ -121,6 +121,58 @@ def test_unique_violation_fails_and_backfill_rechecks(data_dir):
         "test", "t").find_index("ua").state == STATE_PUBLIC
 
 
+def test_delete_only_window_insert_dup_fails_ddl(data_dir):
+    """A duplicate committed while the index is delete-only lives in the
+    delta overlay (dml.py skips unique maintenance for delete-only
+    indexes); the backfill recheck must still see it and roll back."""
+    d = Domain(data_dir=data_dir)
+    s = _load(d, n=100)
+    s2 = d.new_session()
+
+    def sneak(job, state):
+        if state == "delete-only":
+            # a=5 already exists in base (a = arange over 100 rows)
+            s2.execute("insert into t values (5, 999999)")
+
+    FAILPOINTS.enable("ddl/set_state", sneak)
+    try:
+        with pytest.raises(Exception, match="duplicate"):
+            s.execute("create unique index ua on t (a)")
+    finally:
+        FAILPOINTS.disable("ddl/set_state")
+    assert d.catalog.info_schema().table("test", "t").find_index("ua") is None
+    job = [j for j in d.catalog.jobs if j.typ == "add_index"][-1]
+    assert job.state == "rollback"
+
+
+def test_open_txn_straddling_ddl_conflicts_at_commit(data_dir):
+    """A txn whose buffered write executed while an index was delete-only
+    (no unique enforcement) must NOT commit blind after the index goes
+    public: the commit-time schema check forces a retry (session.go
+    checkSchemaValidity / domain/schema_validator.go analog)."""
+    from tidb_tpu.errors import SchemaChangedError
+
+    d = Domain(data_dir=data_dir)
+    s = _load(d, n=100)
+    s2 = d.new_session()
+    s2.execute("begin")
+    s2.execute("insert into t values (5, 999999)")  # dup of base a=5
+    # DDL runs while s2's write sits in its txn buffer (invisible to the
+    # backfill recheck — not yet prewritten)
+    s.execute("create unique index ua on t (a)")
+    with pytest.raises(SchemaChangedError):
+        s2.execute("commit")
+    # retry under the new schema: now the public unique index enforces
+    s2.execute("begin")
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        s2.execute("insert into t values (5, 999999)")
+    s2.execute("rollback")
+    # and a non-conflicting retry commits fine
+    s2.execute("begin")
+    s2.execute("insert into t values (100001, 999999)")
+    s2.execute("commit")
+
+
 def test_crash_mid_backfill_resumes_on_reopen(data_dir):
     d = Domain(data_dir=data_dir)
     s = _load(d)

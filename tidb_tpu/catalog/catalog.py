@@ -26,11 +26,13 @@ import numpy as np
 
 from ..errors import (
     KVError,
+    LockedError,
     TableExistsError,
     UnknownDatabaseError,
     UnknownTableError,
     PlanError,
 )
+from ..store.fault import FAILPOINTS
 from ..types import FieldType, TypeKind
 from .schema import (
     STATE_DELETE_ONLY,
@@ -134,6 +136,11 @@ class Catalog:
         self.schema_version = 0
         self.jobs: List[DDLJob] = []
         self._snapshot: Optional[InfoSchema] = None
+        # table id -> schema_version of its last DDL: the commit-time
+        # schema checker (domain/schema_validator.go) compares a txn's
+        # write set against these so a txn straddling a DDL on a table it
+        # wrote must retry under the new schema
+        self.table_versions: Dict[int, int] = {}
         # optional hook: called with a table id whenever its storage is
         # dropped/replaced (Domain wires this to StatsHandle.drop)
         self.on_table_dropped = None
@@ -159,6 +166,9 @@ class Catalog:
         self._snapshot = None
         if self.on_ddl is not None:
             self.on_ddl(self)
+
+    def _touch(self, tid: int):
+        self.table_versions[tid] = self.schema_version
 
     def info_schema(self) -> InfoSchema:
         with self._mu:
@@ -228,6 +238,7 @@ class Catalog:
             if not info.is_view:
                 self.storage.create_table(info.id, info.storage_columns())
             self._bump()
+            self._touch(info.id)
             self._record(DDLJob(self.gen_id(), "create_table", db, info.name))
             return info
 
@@ -245,6 +256,7 @@ class Catalog:
                 self.storage.drop_table(t.id)
                 self._notify_drop(t.id)
             self._bump()
+            self._touch(t.id)
             self._record(DDLJob(self.gen_id(), "drop_table", db, name))
 
     def truncate_table(self, db: str, name: str):
@@ -260,6 +272,8 @@ class Catalog:
             d.tables[name.lower()] = new
             self.storage.create_table(new.id, new.storage_columns())
             self._bump()
+            self._touch(t.id)
+            self._touch(new.id)
             self._record(DDLJob(self.gen_id(), "truncate_table", db, name))
 
     def rename_table(self, db: str, old: str, new: str):
@@ -277,6 +291,7 @@ class Catalog:
                            t.auto_inc_id)
             d.tables[new.lower()] = t2
             self._bump()
+            self._touch(t.id)
             self._record(DDLJob(self.gen_id(), "rename_table", db, new))
 
     # ------------------------------------------------------------------
@@ -385,9 +400,11 @@ class Catalog:
                     continue
                 if st == STATE_WRITE_REORG:
                     self._set_index_state(job, ix, st)
+                    FAILPOINTS.hit("ddl/set_state", job=job.id, state=st)
                     self._backfill_index(job, ix)
                 else:
                     self._set_index_state(job, ix, st)
+                    FAILPOINTS.hit("ddl/set_state", job=job.id, state=st)
                 job.states_walked.append(st)
                 with self._mu:
                     self._persist()
@@ -431,60 +448,115 @@ class Catalog:
         invalidates the checkpoints and restarts the scan."""
         import numpy as np
 
-        from ..store.fault import FAILPOINTS
         from ..store.index import finalize_sorted_index
 
         with self._mu:
             t = self.info_schema().table(job.db, job.table)
         store = self.storage.table(t.id)
         offs = t.col_offsets(ix.columns)
-        parts, scan_version = self._load_reorg_parts(job, store)
-        start = job.reorg_progress
-        while start < store.base_rows:
-            if store.base_version != scan_version:
-                # compaction renumbered handles: restart the scan
-                parts, start = [], 0
-                scan_version = store.base_version
-                self._drop_reorg_parts(job)
-            end = min(start + self.BACKFILL_BATCH, store.base_rows)
-            chunk = store.base_chunk(list(offs), start, end,
-                                     decode_strings=False)
-            valid = np.ones(end - start, dtype=np.bool_)
-            cols = []
-            for i in range(len(offs)):
-                c = chunk.col(i)
-                valid &= c.validity()
-                cols.append(c.data)
-            handles = np.arange(start, end, dtype=np.int64)[valid]
-            part = [c[valid] for c in cols] + [handles]
-            self._save_reorg_part(job, len(parts), part, end, scan_version)
-            parts.append(part)
-            job.reorg_progress = end
-            FAILPOINTS.hit("ddl/backfill_batch", job=job.id, upto=end)
-            start = end
         ncols = len(offs)
-        if parts:
-            merged = [np.concatenate([p[i] for p in parts])
-                      for i in range(ncols + 1)]
-        else:
-            merged = [np.zeros(0) for _ in range(ncols)] + [
-                np.zeros(0, dtype=np.int64)]
-        idx = finalize_sorted_index(tuple(offs), merged[:ncols],
-                                    merged[ncols], scan_version)
-        if ix.unique and len(idx.handles) > 1:
-            # recheck under the final sorted order: a duplicate written
-            # through the delete-only window must fail the DDL
-            # (the reference backfill's ErrKeyExists -> job rollback)
-            dup = np.ones(len(idx.handles) - 1, dtype=bool)
-            for k in idx.cols:
-                dup &= k[1:] == k[:-1]
-            if dup.any():
-                raise KVError(
-                    f"duplicate entry for unique index {ix.name!r}")
-        if store.base_version == scan_version:
-            store.indexes.put(tuple(offs), idx)
-        # else: leave it to the lazy builder — the scan raced a compaction
+        while True:
+            parts, scan_version = self._load_reorg_parts(job, store)
+            start = job.reorg_progress
+            while start < store.base_rows:
+                if store.base_version != scan_version:
+                    # compaction renumbered handles: restart the scan
+                    parts, start = [], 0
+                    scan_version = store.base_version
+                    self._drop_reorg_parts(job)
+                end = min(start + self.BACKFILL_BATCH, store.base_rows)
+                chunk = store.base_chunk(list(offs), start, end,
+                                         decode_strings=False)
+                valid = np.ones(end - start, dtype=np.bool_)
+                cols = []
+                for i in range(len(offs)):
+                    c = chunk.col(i)
+                    valid &= c.validity()
+                    cols.append(c.data)
+                handles = np.arange(start, end, dtype=np.int64)[valid]
+                part = [c[valid] for c in cols] + [handles]
+                self._save_reorg_part(job, len(parts), part, end, scan_version)
+                parts.append(part)
+                job.reorg_progress = end
+                FAILPOINTS.hit("ddl/backfill_batch", job=job.id, upto=end)
+                start = end
+            if parts:
+                merged = [np.concatenate([p[i] for p in parts])
+                          for i in range(ncols + 1)]
+            else:
+                merged = [np.zeros(0) for _ in range(ncols)] + [
+                    np.zeros(0, dtype=np.int64)]
+            idx = finalize_sorted_index(tuple(offs), merged[:ncols],
+                                        merged[ncols], scan_version)
+            if ix.unique and len(idx.handles) > 1:
+                # recheck under the final sorted order: a duplicate written
+                # through the delete-only window must fail the DDL
+                # (the reference backfill's ErrKeyExists -> job rollback)
+                dup = np.ones(len(idx.handles) - 1, dtype=bool)
+                for k in idx.cols:
+                    dup &= k[1:] == k[:-1]
+                if dup.any():
+                    raise KVError(
+                        f"duplicate entry for unique index {ix.name!r}")
+            if ix.unique:
+                self._recheck_unique_overlay(store, ix, offs, idx)
+            if store.base_version == scan_version:
+                store.indexes.put(tuple(offs), idx)
+                break
+            if not ix.unique:
+                # leave it to the lazy builder — the scan raced a compaction
+                # and no constraint is at stake
+                break
+            # a compaction slipped in between the scan and the rechecks:
+            # rows it folded into base may never have been seen by either
+            # check — restart so the unique scan covers them
+            job.reorg_progress = 0
+            self._drop_reorg_parts(job)
         self._drop_reorg_parts(job)
+
+    def _recheck_unique_overlay(self, store, ix: IndexInfo, offs, idx):
+        """Rows committed during the delete-only window live only in the
+        delta overlay (dml.py skips unique maintenance there), so the
+        base-only backfill scan cannot see them.  Probe just the overlay
+        rows against the freshly built index (value -> sorted-dict code; an
+        absent code matches no base row).  An in-flight commit's lock must
+        not kill the whole DDL — wait it out."""
+        for _ in range(500):
+            try:
+                deleted, inserted = store.delta_overlay(
+                    self.storage.current_ts(), 0, 1 << 62)
+                break
+            except LockedError:
+                time.sleep(0.01)
+        else:
+            raise KVError(
+                f"unique recheck for {ix.name!r} blocked on live locks")
+        dele = set(deleted)
+        seen = set()
+        dict_cols = store.dict_encoded_cols()
+        dup_err = KVError(f"duplicate entry for unique index {ix.name!r}")
+        for row in inserted.values():
+            key = tuple(row[o] for o in offs)
+            if None in key:
+                continue  # NULLs never collide
+            if key in seen:
+                raise dup_err
+            seen.add(key)
+            probe = []
+            for ci, o in enumerate(offs):
+                if o in dict_cols:
+                    code = store.encode_dict_const(o, key[ci])
+                    if code < 0:
+                        probe = None  # value not in any base row
+                        break
+                    probe.append(code)
+                else:
+                    probe.append(key[ci])
+            if probe is None:
+                continue
+            hs = idx.search_range(tuple(probe), tuple(probe))
+            if any(int(h) not in dele for h in hs):
+                raise dup_err
 
     def _reorg_dir(self):
         return self.storage.data_dir
@@ -555,10 +627,26 @@ class Catalog:
     def resume_pending_jobs(self):
         """Called by a reopened domain: finish DDL jobs a dead process left
         mid-ladder (the re-elected owner resuming the job queue,
-        ddl_worker.go:362)."""
+        ddl_worker.go:362).  A job that errors on resume (e.g. a duplicate
+        key discovered by the backfill recheck) has already been rolled back
+        and its error recorded by run_ddl_job — swallow it per job so one
+        bad job neither blocks later jobs nor fails the domain open."""
+        from ..metrics import REGISTRY
+
         for job in list(self.jobs):
             if job.state == "running":
-                self.run_ddl_job(job)
+                try:
+                    self.run_ddl_job(job)
+                except Exception as e:
+                    REGISTRY.inc("ddl_resume_failures_total")
+                    if job.state == "running":
+                        # the failure escaped run_ddl_job's rollback handler
+                        # (e.g. corrupted job meta): record it so the job
+                        # isn't silently re-tried forever
+                        job.state = "rollback"
+                        job.error = str(e)
+                        with self._mu:
+                            self._persist()
 
     def drop_index(self, db: str, table: str, name: str):
         with self._mu:
@@ -574,9 +662,19 @@ class Catalog:
     def _check_unique(self, t: TableInfo, columns: List[str], name: str):
         store = self.storage.table(t.id)
         offs = t.col_offsets(columns)
-        ts = self.storage.current_ts()
         chunk = store.base_chunk(offs, 0, store.base_rows)
-        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+        # same lock-wait as the backfill recheck: an in-flight commit must
+        # stall the check, not abort the DDL
+        for _ in range(500):
+            try:
+                deleted, inserted = store.delta_overlay(
+                    self.storage.current_ts(), 0, 1 << 62)
+                break
+            except LockedError:
+                time.sleep(0.01)
+        else:
+            raise KVError(
+                f"unique check for {name!r} blocked on live locks")
         seen = set()
         dele = set(deleted)
         for h in range(chunk.num_rows):
@@ -607,6 +705,7 @@ class Catalog:
         )
         d.tables[table.lower()] = new
         self._bump()
+        self._touch(t.id)
 
     def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
                          add_default=None, drop: str = None, retype=None):

@@ -95,6 +95,19 @@ class TxnConflictError(KVError):
         super().__init__(f"Write conflict on key {key!r}, txn must retry")
 
 
+class SchemaChangedError(TxnConflictError):
+    """DDL touched a written table between txn start and commit
+    (domain/schema_validator.go + session.go checkSchemaValidity analog).
+    Subclasses TxnConflictError so autocommit DML retries transparently
+    under the new schema."""
+
+    code = 8028  # ErrInfoSchemaChanged
+
+    def __init__(self, msg="Information schema is changed during the "
+                 "transaction; please retry"):
+        KVError.__init__(self, msg)
+
+
 class TxnAbortedError(KVError):
     code = 1105
 

@@ -50,6 +50,13 @@ class HashAggExec(Executor):
         if getattr(self, "_consumed", 0):
             self.ctx.mem_tracker.release(self._consumed)
             self._consumed = 0
+        # a cancel/error between a spill and _spilled_result would
+        # otherwise leak the ListInDisk temp files
+        lists = getattr(self, "_spill_lists", None)
+        if lists is not None:
+            for lst in lists:
+                lst.close()
+            self._spill_lists = None
 
     N_SPILL_PARTS = 8  # disk partitions when the quota trips
 

@@ -471,10 +471,16 @@ class MergeJoinExec(Executor):
         self.other_conds = other_conds
         self._out: Optional[List[Chunk]] = None
         self._pos = 0
+        self._consumed = 0
 
     def _open(self):
         self._out = None
         self._pos = 0
+
+    def _close(self):
+        if self._consumed:
+            self.ctx.mem_tracker.release(self._consumed)
+            self._consumed = 0
 
     def _merge(self) -> List[Chunk]:
         lc = concat_chunks(self.drain_child(0))
@@ -483,7 +489,8 @@ class MergeJoinExec(Executor):
             lc = self.child(0).empty_chunk()
         if rc is None:
             rc = self.child(1).empty_chunk()
-        self.ctx.mem_tracker.consume(lc.nbytes() + rc.nbytes())
+        self._consumed = lc.nbytes() + rc.nbytes()
+        self.ctx.mem_tracker.consume(self._consumed)
         str_dict: dict = {}
         lmat, lnull = _key_matrix(lc, self.left_keys, str_dict)
         rmat, rnull = _key_matrix(rc, self.right_keys, str_dict)

@@ -461,13 +461,12 @@ def reorder_joins(plan: LogicalPlan, pctx=None,
             members.append(p)
 
     collect(plan)
-    members = [reorder_joins(m, pctx) for m in members]
     if len(members) < 3:
         plan.children = [reorder_joins(c, pctx, True) for c in plan.children]
         return plan
 
-    uid_of = {}  # uid -> member index
-    for i, m in enumerate(members):
+    uid_of = {}  # uid -> member index (schemas are reorder-invariant, so
+    for i, m in enumerate(members):  # validate edges BEFORE recursing)
         for u in m.schema.uids():
             uid_of[u] = i
 
@@ -475,7 +474,10 @@ def reorder_joins(plan: LogicalPlan, pctx=None,
         us: set = set()
         e.collect_columns(us)
         idxs = {uid_of.get(u) for u in us}
-        idxs.discard(None)
+        if None in idxs:
+            # references a column no member produces (correlated outer
+            # column): not a clean edge — bail rather than misclassify
+            return None
         return idxs.pop() if len(idxs) == 1 else None
 
     edges = []  # (i, j, l_expr, r_expr) with l on member i
@@ -487,8 +489,12 @@ def reorder_joins(plan: LogicalPlan, pctx=None,
             break
         edges.append((i, j, l, r))
     if bad:
-        return plan  # unexpected shape: keep the syntactic order
+        # unexpected shape: keep the syntactic order, but still reorder
+        # nested groups past non-inner boundaries below this one
+        plan.children = [reorder_joins(c, pctx, True) for c in plan.children]
+        return plan
 
+    members = [reorder_joins(m, pctx) for m in members]
     est = [_est_member(m, pctx) for m in members]
     joined = {min(range(len(members)), key=lambda i: est[i])}
     order = [next(iter(joined))]

@@ -19,6 +19,7 @@ from ..errors import (
     ExecutorError,
     KVError,
     PlanError,
+    SchemaChangedError,
     TiDBTPUError,
     TxnConflictError,
     UnknownDatabaseError,
@@ -136,7 +137,18 @@ class Session:
     # ------------------------------------------------------------------
     def _begin_txn(self):
         if self._txn is None:
-            self._txn = self.domain.storage.begin()
+            txn = self.domain.storage.begin()
+            cat = self.domain.catalog
+            start_ver = cat.schema_version
+
+            def schema_check():
+                touched = {tid for (tid, _h) in txn.buffer.keys()}
+                if any(cat.table_versions.get(tid, 0) > start_ver
+                       for tid in touched):
+                    raise SchemaChangedError()
+
+            txn.schema_check = schema_check
+            self._txn = txn
         return self._txn
 
     def _autocommit(self) -> bool:
@@ -147,6 +159,8 @@ class Session:
             txn, self._txn = self._txn, None
             self._in_txn = False
             touched = {tid for (tid, _h) in txn.buffer.keys()}
+            # the commit-time schema check runs inside txn.commit() after
+            # prewrite (txn.schema_check, wired in _begin_txn)
             txn.commit()
             if touched:
                 for tid in touched:

@@ -33,6 +33,13 @@ class Transaction:
         self._locked: set = set()
         self.committed = False
         self.rolled_back = False
+        # optional hook run AFTER prewrite, before the decision point: the
+        # session wires the commit-time schema check here (SchemaChecker,
+        # session.go checkSchemaValidity).  Running it with prewrite locks
+        # held closes the check-then-act race against an online DDL: the
+        # DDL's unique recheck either blocks on our locks (and then sees
+        # our committed rows) or bumped the version first (and we abort).
+        self.schema_check = None
 
     # ---- buffered writes (membuffer analog, kv/memdb) ------------------
     def put(self, table_id: int, handle: int, values: tuple):
@@ -92,6 +99,14 @@ class Transaction:
                 self.storage.table(tid).rollback(h, self.start_ts)
             self.rolled_back = True
             raise
+        if self.schema_check is not None:
+            try:
+                self.schema_check()
+            except Exception:
+                for tid, h in prewritten:
+                    self.storage.table(tid).rollback(h, self.start_ts)
+                self.rolled_back = True
+                raise
         commit_ts = self.storage.oracle.get_timestamp()
         FAILPOINTS.hit("2pc/before_commit_primary", start_ts=self.start_ts)
         # phase 2: commit primary; after that the txn is decided
