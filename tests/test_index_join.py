@@ -1,0 +1,205 @@
+"""Index-join family, covering IndexReader and BatchPointGet.
+
+Reference behaviors: executor/index_lookup_join.go:1-687 (+ hash/merge
+variants), executor/distsql.go:317 (IndexReader), and
+executor/batch_point_get.go:1-176.
+"""
+
+import pytest
+
+from tidb_tpu.session import Domain
+
+
+def plan_names(sess, sql):
+    return [r[0].strip("└─ ") for r in sess.execute("explain " + sql)[0].rows]
+
+
+@pytest.fixture()
+def sess():
+    s = Domain().new_session()
+    s.execute("create table item (id bigint primary key, cat varchar(8), "
+              "price double)")
+    rows = ",".join(f"({i}, 'c{i % 40}', {i * 0.25})" for i in range(6000))
+    s.execute(f"insert into item values {rows}")
+    s.execute("create index icat on item (cat)")
+    s.execute("create table ord (oid bigint, item_id bigint, qty bigint)")
+    rows = ",".join(f"({i}, {(i * 37) % 6000}, {i % 5})" for i in range(40))
+    s.execute(f"insert into ord values {rows}")
+    s.execute("analyze table item")
+    s.execute("analyze table ord")
+    return s
+
+
+class TestBatchPointGet:
+    def test_in_on_pk_is_batch_point_get(self, sess):
+        sql = "select cat from item where id in (3, 1, 4, 1, 5)"
+        assert any("Batch_Point_Get" in n for n in plan_names(sess, sql))
+        assert sorted(sess.query(sql)) == sorted(
+            [("c3",), ("c1",), ("c4",), ("c5",)])
+
+    def test_misses_and_unrepresentable(self, sess):
+        # 2.5 can't be an int key (matches nothing); 99999 misses
+        rows = sess.query(
+            "select id from item where id in (7, 2.5, 99999)")
+        assert rows == [(7,)]
+
+    def test_residual_condition(self, sess):
+        rows = sess.query(
+            "select id from item where id in (8, 9, 10) and price > 2.2")
+        assert sorted(rows) == [(9,), (10,)]
+
+    def test_sees_txn_buffer_and_deletes(self, sess):
+        sess.execute("delete from item where id = 11")
+        sess.execute("begin")
+        sess.execute("update item set cat = 'zz' where id = 12")
+        rows = sess.query("select id, cat from item where id in (11, 12)")
+        assert rows == [(12, "zz")]
+        sess.execute("rollback")
+        rows = sess.query("select id, cat from item where id in (11, 12)")
+        assert rows == [(12, "c12")]
+
+
+class TestIndexReader:
+    def test_covering_scan_skips_table(self, sess):
+        sql = "select cat from item where cat = 'c7'"
+        names = plan_names(sess, sql)
+        assert any("IndexReader" in n for n in names)
+        assert not any("IndexLookUp" in n for n in names)
+        assert sess.query(sql) == [("c7",)] * 150
+
+    def test_non_covering_falls_back(self, sess):
+        # price is not in the index -> IndexLookUp, same rows
+        sql = "select cat, price from item where cat = 'c7'"
+        names = plan_names(sess, sql)
+        assert any("IndexLookUp" in n for n in names)
+        got = sorted(sess.query(sql))
+        assert len(got) == 150 and got[0] == ("c7", 1.75)
+
+    def test_pk_range_covering(self, sess):
+        sql = "select id from item where id >= 100 and id < 110"
+        assert any("IndexReader" in n for n in plan_names(sess, sql))
+        assert sorted(sess.query(sql)) == [(i,) for i in range(100, 110)]
+
+    def test_overlay_rows_visible(self, sess):
+        sess.execute("insert into item values (90001, 'c7', 1.0)")
+        sess.execute("delete from item where id = 7")
+        sess.execute("update item set cat = 'c7' where id = 8")
+        rows = sess.query("select cat from item where cat = 'c7'")
+        # 150 base matches - deleted(7) - but +insert(90001) +update(8)
+        assert rows == [("c7",)] * 151
+
+    def test_nullable_unconstrained_column_not_covering(self, sess):
+        # n is nullable and the index drops NULL rows: a bare scan of the
+        # index would lose rows, so the planner must not pick IndexReader
+        # unless every nullable key column is pinned by an access cond
+        sess.execute("create table nt (a bigint, n bigint, key kan (a, n))")
+        rows = ",".join(f"({i % 50}, {i})" if i % 3 else f"({i % 50}, null)"
+                        for i in range(5000))
+        sess.execute(f"insert into nt values {rows}")
+        sess.execute("analyze table nt")
+        sql = "select a, n from nt where a = 5"
+        assert not any("IndexReader" in n for n in plan_names(sess, sql))
+        rows = sess.query(sql)
+        assert len(rows) == 100 and sum(1 for r in rows if r[1] is None) > 0
+        # pinning n with a range makes it null-rejecting -> covering is safe
+        sql2 = "select a, n from nt where a = 5 and n >= 0"
+        assert any("IndexReader" in n for n in plan_names(sess, sql2))
+        assert len(sess.query(sql2)) == 100 - sum(
+            1 for r in rows if r[1] is None)
+
+
+class TestIndexLookUpJoin:
+    JOIN = ("select o.oid, i.cat from ord o join item i "
+            "on o.item_id = i.id where o.qty > 0")
+
+    def expected(self, sess):
+        sess.execute("set tidb_opt_enable_index_join = 0")
+        rows = sorted(sess.query(self.JOIN))
+        sess.execute("set tidb_opt_enable_index_join = 1")
+        return rows
+
+    def test_planner_picks_index_join(self, sess):
+        names = plan_names(sess, self.JOIN)
+        assert any("IndexLookUpJoin" in n for n in names)
+        assert not any("HashJoin" in n for n in names)
+
+    @pytest.mark.parametrize("variant", ["lookup", "hash", "merge"])
+    def test_variants_match_hash_join(self, sess, variant):
+        sess.execute(f"set tidb_index_join_variant = '{variant}'")
+        want = self.expected(sess)
+        assert sorted(sess.query(self.JOIN)) == want
+        assert len(want) == 32  # qty>0 drops i%5==0
+
+    def test_left_outer(self, sess):
+        sess.execute("insert into ord values (100, -5, 1)")  # no match
+        sql = ("select o.oid, i.price from ord o left join item i "
+               "on o.item_id = i.id")
+        assert any("IndexLookUpJoin" in n for n in plan_names(sess, sql))
+        rows = dict(sess.query(sql))
+        assert rows[100] is None and len(rows) == 41
+        assert rows[1] == 37 * 0.25
+
+    def test_semi_and_anti(self, sess):
+        sess.execute("insert into ord values (100, -5, 1)")
+        semi = ("select oid from ord o where exists "
+                "(select 1 from item i where i.id = o.item_id)")
+        anti = ("select oid from ord o where not exists "
+                "(select 1 from item i where i.id = o.item_id)")
+        assert any("IndexLookUpJoin" in n for n in plan_names(sess, semi))
+        assert len(sess.query(semi)) == 40
+        assert sess.query(anti) == [(100,)]
+
+    def test_string_key_join(self, sess):
+        sess.execute("create table want (c varchar(8))")
+        sess.execute("insert into want values ('c3'), ('c9'), ('zz')")
+        sql = ("select w.c, count(*) from want w join item i on i.cat = w.c "
+               "group by w.c")
+        assert sorted(sess.query(sql)) == [("c3", 150), ("c9", 150)]
+
+    def test_inner_conds_apply(self, sess):
+        sql = ("select o.oid from ord o join item i on o.item_id = i.id "
+               "and i.price > 100")
+        want = self_join_fallback(sess, sql)
+        assert sorted(sess.query(sql)) == want
+
+    def test_txn_overlay_on_inner(self, sess):
+        sess.execute("begin")
+        sess.execute("update item set cat = 'xx' where id = 37")
+        sess.execute("delete from item where id = 74")
+        rows = dict(sess.query(
+            "select o.oid, i.cat from ord o join item i on o.item_id = i.id"))
+        assert rows[1] == "xx"       # ord 1 -> item 37, buffered update
+        assert 2 not in rows          # ord 2 -> item 74, buffered delete
+        sess.execute("rollback")
+
+    def test_composite_key_probe(self, sess):
+        # two-column index: the probe narrows the run per trailing column
+        # (no full expansion of the low-cardinality leading run)
+        sess.execute("create table ev (kind bigint, seq bigint, "
+                     "v double, key kks (kind, seq))")
+        rows = ",".join(f"({i % 3}, {i}, {i * 1.0})" for i in range(4500))
+        sess.execute(f"insert into ev values {rows}")
+        sess.execute("create table probe (kind bigint, seq bigint)")
+        sess.execute("insert into probe values (0, 9), (1, 10), (2, 2), "
+                     "(1, 1), (2, 99999)")
+        sess.execute("analyze table ev")
+        sess.execute("analyze table probe")
+        sql = ("select p.seq, e.v from probe p join ev e "
+               "on e.kind = p.kind and e.seq = p.seq")
+        assert any("IndexLookUpJoin" in n for n in plan_names(sess, sql))
+        assert sorted(sess.query(sql)) == [
+            (1, 1.0), (2, 2.0), (9, 9.0), (10, 10.0)]
+
+    def test_outer_est_gate(self, sess):
+        # joining two big tables must NOT take the lookup path
+        sql = "select count(*) from item a join item b on a.id = b.id"
+        names = plan_names(sess, sql)
+        assert not any("IndexLookUpJoin" in n for n in names)
+        assert sess.query(sql) == [(6000,)]
+
+
+def self_join_fallback(sess, sql):
+    sess.execute("set tidb_opt_enable_index_join = 0")
+    rows = sorted(sess.query(sql))
+    sess.execute("set tidb_opt_enable_index_join = 1")
+    return rows

@@ -1,10 +1,14 @@
-"""Index readers: point get by key and index lookup.
+"""Index readers: point get by key, index lookup, covering index read and
+batch point get.
 
 Reference: executor/point_get.go:87 (PointGet bypasses distsql),
 executor/distsql.go IndexLookUpReader (index worker fetches handles, table
-workers fetch rows).  Here the "index side" is a binary search over the
-table's sorted index (store/index.py) and the "table side" is a sparse
-block gather — plus the usual base+delta(+txn buffer) overlay.
+workers fetch rows), executor/distsql.go:317 IndexReader (covering
+index-only scan — never touches the table), executor/batch_point_get.go:1-176
+(multi-key point reads in one storage round trip).  Here the "index side"
+is a binary search over the table's sorted index (store/index.py) and the
+"table side" is a sparse block gather — plus the usual base+delta(+txn
+buffer) overlay.
 """
 
 from __future__ import annotations
@@ -17,10 +21,35 @@ from ..catalog import TableInfo
 from ..chunk import Chunk, Column
 from ..expr.expression import Expression, eval_bool_mask
 from ..planner.ranger import IndexRange
+from ..types import TypeKind
 from .base import ExecContext, Executor
 
 
-class IndexLookUpExec(Executor):
+class _MaterializedExec(Executor):
+    """Leaf executors that compute all output in one `_run()` pass and
+    replay the chunk list."""
+
+    _batches: Optional[List[Chunk]] = None
+    _pos = 0
+
+    def _open(self):
+        self._batches = None
+        self._pos = 0
+
+    def _next(self) -> Optional[Chunk]:
+        if self._batches is None:
+            self._batches = self._run()
+        if self._pos >= len(self._batches):
+            return None
+        c = self._batches[self._pos]
+        self._pos += 1
+        return c
+
+    def _run(self) -> List[Chunk]:
+        raise NotImplementedError
+
+
+class IndexLookUpExec(_MaterializedExec):
     """fetch_offsets: store columns materialized for predicate evaluation
     (out columns ∪ condition columns); out_pick: positions within the fetch
     layout that form the output.  Conditions are remapped to the fetch
@@ -43,27 +72,10 @@ class IndexLookUpExec(Executor):
         # residual_conds applied to base rows fetched via the index
         self.all_conds = all_conds
         self.residual_conds = residual_conds
-        self._batches: Optional[List[Chunk]] = None
-        self._pos = 0
-
-    def _open(self):
-        self._batches = None
-        self._pos = 0
-
-    def _next(self) -> Optional[Chunk]:
-        if self._batches is None:
-            self._batches = self._run()
-        if self._pos >= len(self._batches):
-            return None
-        c = self._batches[self._pos]
-        self._pos += 1
-        return c
 
     # ------------------------------------------------------------------
     def _run(self) -> List[Chunk]:
         store = self.ctx.storage.table(self.table.id)
-        ts = self.ctx.snapshot_ts()
-        txn = self.ctx.txn
         idx = store.indexes.get(store, self.index_offsets)
         handles = idx.search_range(
             self.rng.low_tuple(), self.rng.high_tuple(),
@@ -71,20 +83,14 @@ class IndexLookUpExec(Executor):
         )
         # ---- overlay: any handle with a delta chain or txn-buffer entry
         # is re-evaluated on the row-value path
-        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
-        buffer = {}
-        if txn is not None:
-            for (tid, h), m in txn.buffer.items():
-                if tid == self.table.id:
-                    buffer[h] = m
-        overlay_handles = set(deleted) | set(inserted) | set(buffer)
+        deleted, inserted, buffer, overlay_handles = _overlay_sets(
+            self.ctx, store, self.table.id)
         if overlay_handles and len(handles):
             mask = ~np.isin(handles, np.fromiter(
                 overlay_handles, dtype=np.int64, count=len(overlay_handles)
             ))
             handles = handles[mask]
         out: List[Chunk] = []
-        n_rows = 0
         if len(handles):
             chunk = store.gather_chunk(self.fetch_offsets, np.sort(handles))
             if self.residual_conds:
@@ -93,27 +99,168 @@ class IndexLookUpExec(Executor):
                 )
             if chunk.num_rows:
                 out.append(chunk.select(self.out_pick))
-                n_rows += chunk.num_rows
         # ---- delta / buffer rows: evaluate ALL conds on materialized rows
-        rows = []
-        for h in sorted(set(inserted) | set(buffer)):
-            if h in buffer:
-                m = buffer[h]
-                if m.op != "put":
-                    continue
-                vals = m.values
-            else:
-                vals = inserted[h]
-            rows.append(tuple(vals[o] for o in self.fetch_offsets))
-        if rows:
-            cols = [
-                Column.from_values(ft, [r[i] for r in rows])
-                for i, ft in enumerate(self.fetch_ftypes)
-            ]
-            dchunk = Chunk(cols)
-            if self.all_conds:
-                dchunk = dchunk.filter(eval_bool_mask(self.all_conds, dchunk))
-            if dchunk.num_rows:
-                out.append(dchunk.select(self.out_pick))
-                n_rows += dchunk.num_rows
+        dchunk = _overlay_chunk(inserted, buffer, self.fetch_offsets,
+                                self.fetch_ftypes, self.all_conds)
+        if dchunk is not None:
+            out.append(dchunk.select(self.out_pick))
+        return out
+
+
+def _overlay_sets(ctx, store, table_id: int):
+    """(deleted, inserted, buffer, overlay_handle_set) at the statement's
+    snapshot — the shared MVCC overlay all index-side readers apply."""
+    ts = ctx.snapshot_ts()
+    deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+    buffer = {}
+    if ctx.txn is not None:
+        for (tid, h), m in ctx.txn.buffer.items():
+            if tid == table_id:
+                buffer[h] = m
+    return deleted, inserted, buffer, set(deleted) | set(inserted) | set(buffer)
+
+
+def _overlay_chunk(inserted, buffer, fetch_offsets, fetch_ftypes,
+                   all_conds) -> Optional[Chunk]:
+    """Materialize delta/txn-buffer rows and filter with the FULL condition
+    set (index access conds included — overlay rows never consulted the
+    index)."""
+    rows = []
+    for h in sorted(set(inserted) | set(buffer)):
+        if h in buffer:
+            m = buffer[h]
+            if m.op != "put":
+                continue
+            vals = m.values
+        else:
+            vals = inserted[h]
+        rows.append(tuple(vals[o] for o in fetch_offsets))
+    if not rows:
+        return None
+    cols = [
+        Column.from_values(ft, [r[i] for r in rows])
+        for i, ft in enumerate(fetch_ftypes)
+    ]
+    dchunk = Chunk(cols)
+    if all_conds:
+        dchunk = dchunk.filter(eval_bool_mask(all_conds, dchunk))
+    return dchunk if dchunk.num_rows else None
+
+
+class IndexReaderExec(_MaterializedExec):
+    """Covering index-only scan (executor/distsql.go:317 IndexReader): the
+    output columns are all index key columns, so the matching run of the
+    sorted index IS the result — no table gather at all.  Dict codes decode
+    straight off the sorted dictionary; output arrives in index-key order.
+
+    Safe only when rows excluded from the index (NULL in any key column)
+    provably cannot match — the planner guarantees each nullable index
+    column carries an access condition."""
+
+    def __init__(self, ctx, table: TableInfo, index_offsets: List[int],
+                 rng: IndexRange, out_pos: List[int],
+                 residual_conds: List[Expression],
+                 all_conds: List[Expression], plan_id: int = -1):
+        # out_pos: for each output column, its position in the index's
+        # column list (output layout == schema layout)
+        self.out_offsets = [index_offsets[p] for p in out_pos]
+        ftypes = [table.columns[o].ftype for o in self.out_offsets]
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.table = table
+        self.index_offsets = index_offsets
+        self.rng = rng
+        self.out_pos = out_pos
+        self.residual_conds = residual_conds
+        self.all_conds = all_conds
+
+    def _run(self) -> List[Chunk]:
+        store = self.ctx.storage.table(self.table.id)
+        idx = store.indexes.get(store, self.index_offsets)
+        lo, hi = idx.search_slice(
+            self.rng.low_tuple(), self.rng.high_tuple(),
+            self.rng.low_open, self.rng.high_open,
+        )
+        deleted, inserted, buffer, overlay_handles = _overlay_sets(
+            self.ctx, store, self.table.id)
+        out: List[Chunk] = []
+        if hi > lo:
+            handles = idx.handles[lo:hi]
+            keep = None
+            if overlay_handles:
+                keep = ~np.isin(handles, np.fromiter(
+                    overlay_handles, dtype=np.int64,
+                    count=len(overlay_handles)))
+            cols = []
+            for p in self.out_pos:
+                data = idx.cols[p][lo:hi]
+                if keep is not None:
+                    data = data[keep]
+                off = self.index_offsets[p]
+                meta = store.cols[off]
+                if meta.ftype.kind == TypeKind.STRING:
+                    d = np.asarray(meta.dictionary or [], dtype=object)
+                    data = d[data.astype(np.int64)]
+                cols.append(Column(meta.ftype, data, None))
+            chunk = Chunk(cols)
+            if self.residual_conds:
+                chunk = chunk.filter(
+                    eval_bool_mask(self.residual_conds, chunk))
+            if chunk.num_rows:
+                out.append(chunk)
+        dchunk = _overlay_chunk(inserted, buffer, self.out_offsets,
+                                self.ftypes, self.all_conds)
+        if dchunk is not None:
+            out.append(dchunk)
+        return out
+
+
+class BatchPointGetExec(_MaterializedExec):
+    """Multi-key point read (executor/batch_point_get.go:1-176): `col IN
+    (v1..vk)` over a unique index probes each key with one binary search
+    and fetches all matched rows in ONE sparse gather."""
+
+    def __init__(self, ctx, table: TableInfo, index_offsets: List[int],
+                 keys: List[tuple], fetch_offsets: List[int],
+                 out_pick: List[int], all_conds: List[Expression],
+                 residual_conds: List[Expression], plan_id: int = -1):
+        fetch_ftypes = [table.columns[o].ftype for o in fetch_offsets]
+        ftypes = [fetch_ftypes[i] for i in out_pick]
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.table = table
+        self.index_offsets = index_offsets
+        self.keys = keys  # index-native key tuples, pre-encoded by planner
+        self.fetch_offsets = fetch_offsets
+        self.fetch_ftypes = fetch_ftypes
+        self.out_pick = out_pick
+        self.all_conds = all_conds
+        self.residual_conds = residual_conds
+
+    def _run(self) -> List[Chunk]:
+        store = self.ctx.storage.table(self.table.id)
+        idx = store.indexes.get(store, self.index_offsets)
+        parts = []
+        for key in self.keys:
+            hs = idx.search_range(key, key)
+            if len(hs):
+                parts.append(hs)
+        handles = (np.unique(np.concatenate(parts)) if parts
+                   else np.zeros(0, dtype=np.int64))
+        deleted, inserted, buffer, overlay_handles = _overlay_sets(
+            self.ctx, store, self.table.id)
+        if overlay_handles and len(handles):
+            mask = ~np.isin(handles, np.fromiter(
+                overlay_handles, dtype=np.int64, count=len(overlay_handles)))
+            handles = handles[mask]
+        out: List[Chunk] = []
+        if len(handles):
+            chunk = store.gather_chunk(self.fetch_offsets, handles)
+            if self.residual_conds:
+                chunk = chunk.filter(
+                    eval_bool_mask(self.residual_conds, chunk))
+            if chunk.num_rows:
+                out.append(chunk.select(self.out_pick))
+        dchunk = _overlay_chunk(inserted, buffer, self.fetch_offsets,
+                                self.fetch_ftypes, self.all_conds)
+        if dchunk is not None:
+            out.append(dchunk.select(self.out_pick))
         return out

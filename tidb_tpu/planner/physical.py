@@ -216,6 +216,81 @@ class PhysIndexLookUp(PhysicalPlan):
         )
 
 
+class PhysIndexReader(PhysicalPlan):
+    """Covering index-only scan (executor/distsql.go:317 IndexReader): the
+    schema is served straight from the sorted index's key columns — the
+    table is never touched."""
+
+    def __init__(self, schema: Schema, table: TableInfo, index_name: str,
+                 index_offsets: List[int], rng, out_pos: List[int],
+                 all_conds, residual_conds):
+        super().__init__(schema, [])
+        self.table = table
+        self.index_name = index_name
+        self.index_offsets = index_offsets  # FULL index column offsets
+        self.rng = rng
+        self.out_pos = out_pos
+        self.all_conds = all_conds
+        self.residual_conds = residual_conds
+
+    @property
+    def name(self) -> str:
+        return "IndexReader"
+
+    def info(self) -> str:
+        r = self.rng
+        parts = [f"table:{self.table.name}", f"index:{self.index_name}",
+                 "covering"]
+        if r.eq_prefix:
+            parts.append(f"eq:{r.eq_prefix}")
+        if r.low is not None or r.high is not None:
+            lo = "(" if r.low_open else "["
+            hi = ")" if r.high_open else "]"
+            parts.append(f"range:{lo}{r.low}, {r.high}{hi}")
+        return ", ".join(parts)
+
+    def build(self, ctx):
+        from ..executor.index_reader import IndexReaderExec
+
+        return IndexReaderExec(ctx, self.table, list(self.index_offsets),
+                               self.rng, list(self.out_pos),
+                               self.residual_conds, self.all_conds,
+                               plan_id=self.id)
+
+
+class PhysBatchPointGet(PhysicalPlan):
+    """Multi-key point read over a unique index
+    (executor/batch_point_get.go:1-176)."""
+
+    def __init__(self, schema: Schema, table: TableInfo, index_name: str,
+                 index_offsets: List[int], keys: List[tuple],
+                 all_conds, residual_conds):
+        super().__init__(schema, [])
+        self.table = table
+        self.index_name = index_name
+        self.index_offsets = index_offsets
+        self.keys = keys
+        self.all_conds = all_conds
+        self.residual_conds = residual_conds
+
+    @property
+    def name(self) -> str:
+        return "Batch_Point_Get"
+
+    def info(self) -> str:
+        return (f"table:{self.table.name}, index:{self.index_name}, "
+                f"keys:{len(self.keys)}")
+
+    def build(self, ctx):
+        from ..executor.index_reader import BatchPointGetExec
+
+        offsets = [c.store_offset for c in self.schema.cols]
+        return BatchPointGetExec(
+            ctx, self.table, list(self.index_offsets), list(self.keys),
+            offsets, list(range(len(offsets))), self.all_conds,
+            self.residual_conds, plan_id=self.id)
+
+
 class PhysUnionScan(PhysicalPlan):
     """Dirty-table scan merging the txn buffer (no pushdown)."""
 
@@ -359,6 +434,71 @@ class PhysHashJoin(PhysicalPlan):
                             rf_reader=rf_reader,
                             rf_key_idx=self.rf_build_key or 0,
                             rf_filter_id=self.rf_filter_id)
+
+
+class PhysIndexJoin(PhysicalPlan):
+    """Index lookup join family (index_lookup_join.go:1-687,
+    index_lookup_hash_join.go, index_lookup_merge_join.go): children =
+    [outer]; the inner side is a (table, index) probe per outer batch."""
+
+    VARIANT_NAMES = {"lookup": "IndexLookUpJoin",
+                     "hash": "IndexLookUpHashJoin",
+                     "merge": "IndexLookUpMergeJoin"}
+
+    def __init__(self, outer: PhysicalPlan, kind: str, table: TableInfo,
+                 index_name: str, index_offsets: List[int],
+                 outer_keys: List[Expression], fetch_offsets: List[int],
+                 out_pick: List[int], inner_conds: List[Expression],
+                 other_conds: List[Expression], outer_is_left: bool,
+                 variant: str, schema: Schema):
+        super().__init__(schema, [outer])
+        self.kind = kind
+        self.table = table
+        self.index_name = index_name
+        self.index_offsets = index_offsets
+        self.outer_keys = outer_keys
+        self.fetch_offsets = fetch_offsets
+        self.out_pick = out_pick
+        self.inner_conds = inner_conds
+        self.other_conds = other_conds
+        self.outer_is_left = outer_is_left
+        self.variant = variant
+
+    @property
+    def name(self) -> str:
+        return self.VARIANT_NAMES.get(self.variant, "IndexLookUpJoin")
+
+    def info(self) -> str:
+        keys = ", ".join(str(k) for k in self.outer_keys)
+        s = (f"{self.kind} inner:{self.table.name}, "
+             f"index:{self.index_name}, outer key:[{keys}]")
+        if self.inner_conds:
+            s += " inner-cond:[" + ", ".join(map(str, self.inner_conds)) + "]"
+        if self.other_conds:
+            s += " other:[" + ", ".join(map(str, self.other_conds)) + "]"
+        return s
+
+    def explain_tree(self, indent: int = 0, lines=None):
+        lines = lines if lines is not None else []
+        pad = ("  " * indent + "└─") if indent else ""
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(),
+                      self.task(), self.info()))
+        pad2 = "  " * (indent + 1) + "└─"
+        lines.append((f"{pad2}IndexRangeScan(Probe)", "", "root",
+                      f"table:{self.table.name}, index:{self.index_name}"))
+        for c in self.children:
+            c.explain_tree(indent + 1, lines)
+        return lines
+
+    def build(self, ctx):
+        from ..executor.index_join import IndexLookUpJoinExec
+
+        return IndexLookUpJoinExec(
+            ctx, self.children[0].build(ctx), self.table,
+            list(self.index_offsets), self.outer_keys,
+            list(self.fetch_offsets), list(self.out_pick),
+            self.inner_conds, self.other_conds, self.kind,
+            self.outer_is_left, self.variant, self.id)
 
 
 class PhysMergeJoin(PhysicalPlan):
@@ -604,6 +744,8 @@ class PhysicalContext:
     enable_pushdown: bool = True
     stats: object = None  # StatsHandle
     prefer_merge_join: bool = False  # tidb_opt_prefer_merge_join
+    enable_index_join: bool = True  # tidb_opt_enable_index_join
+    index_join_variant: str = "lookup"  # tidb_index_join_variant
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
@@ -771,6 +913,9 @@ def _try_index_path(ds: LogicalDataSource,
     store = pctx.storage.table(ds.table.id)
     by_name = {c.name.lower(): c for c in ds.schema.cols}
     uid_to_off = {c.uid: c.store_offset for c in ds.schema.cols}
+    bpg = _try_batch_point_get(ds, store, by_name)
+    if bpg is not None:
+        return bpg
     best = None  # (score, index, path)
     from ..catalog.schema import STATE_PUBLIC as _PUB
 
@@ -819,9 +964,83 @@ def _try_index_path(ds: LogicalDataSource,
     pos = {c.uid: i for i, c in enumerate(ds.schema.cols)}
     all_conds = [c.remap_columns(pos) for c in ds.pushed_conds]
     residual = [c.remap_columns(pos) for c in path.residual_conds]
+    if not unique_full_eq:
+        cov = _try_covering_reader(ds, store, ix, path, all_conds, residual)
+        if cov is not None:
+            return cov
     return PhysIndexLookUp(ds.schema, ds.table, ix.name, index_offsets,
                            path.rng, all_conds, residual,
                            point_get=unique_full_eq)
+
+
+def _try_covering_reader(ds: LogicalDataSource, store, ix, path,
+                         all_conds, residual) -> Optional[PhysicalPlan]:
+    """Upgrade an index path to a covering IndexReader when the output is
+    served entirely by the index key columns (executor/distsql.go:317):
+    skips the table-side sparse gather altogether."""
+    name_to_ixpos = {n.lower(): i for i, n in enumerate(ix.columns)}
+    out_pos = []
+    for c in ds.schema.cols:
+        p = name_to_ixpos.get(c.name.lower())
+        if p is None:
+            return None  # not covering
+        out_pos.append(p)
+    # NULL safety: the sorted index EXCLUDES rows with NULL in any key
+    # column (store/index.py SortedIndex).  A covering read is sound only
+    # when every nullable key column is pinned by a null-rejecting access
+    # cond — i.e. sits inside the constrained prefix of the range walk.
+    constrained = path.rng.full_eq_depth + (
+        1 if path.rng.low is not None or path.rng.high is not None else 0)
+    for depth, cname in enumerate(ix.columns):
+        off = store.col_index(cname)
+        if ds.table.columns[off].ftype.nullable and depth >= constrained:
+            return None
+    full_offsets = [store.col_index(c) for c in ix.columns]
+    return PhysIndexReader(ds.schema, ds.table, ix.name, full_offsets,
+                           path.rng, out_pos, all_conds, residual)
+
+
+def _try_batch_point_get(ds: LogicalDataSource, store,
+                         by_name) -> Optional[PhysicalPlan]:
+    """`key IN (c1..ck)` over a single-column unique index becomes one
+    multi-key point read (executor/batch_point_get.go:1-176)."""
+    from ..catalog.schema import STATE_PUBLIC as _PUB
+    from .ranger import _const_key
+
+    for ix in ds.table.indexes:
+        if ix.state != _PUB or not (ix.unique or ix.primary):
+            continue
+        if len(ix.columns) != 1:
+            continue
+        sc = by_name.get(ix.columns[0].lower())
+        if sc is None:
+            continue
+        for cond in ds.pushed_conds:
+            if not (isinstance(cond, ScalarFunc) and cond.name == "in"
+                    and len(cond.args) >= 2
+                    and isinstance(cond.args[0], ColumnExpr)
+                    and all(isinstance(a, Constant) for a in cond.args[1:])):
+                continue
+            col = cond.args[0]
+            uid = col.unique_id if col.unique_id >= 0 else col.index
+            if uid != sc.uid:
+                continue
+            off = sc.store_offset
+            keys, seen = [], set()
+            for a in cond.args[1:]:
+                ke = _const_key(col, a, store, off, "=")
+                if ke is None or ke[1] != "=":
+                    continue  # NULL / unrepresentable -> matches nothing
+                if ke[0] not in seen:
+                    seen.add(ke[0])
+                    keys.append((ke[0],))
+            pos = {c.uid: i for i, c in enumerate(ds.schema.cols)}
+            all_conds = [c.remap_columns(pos) for c in ds.pushed_conds]
+            residual = [c.remap_columns(pos) for c in ds.pushed_conds
+                        if c is not cond]
+            return PhysBatchPointGet(ds.schema, ds.table, ix.name, [off],
+                                     keys, all_conds, residual)
+    return None
 
 
 def _physical_agg(plan: LogicalAggregation,
@@ -926,7 +1145,116 @@ def _try_push_limit(plan: LogicalLimit, pctx: PhysicalContext):
     return to_physical(child_l, pctx), None
 
 
+def _try_index_join(plan: LogicalJoin,
+                    pctx: PhysicalContext) -> Optional[PhysicalPlan]:
+    """Choose an index lookup join when the inner side is a datasource with
+    a usable index on the join keys and the outer side is small (the
+    reference's index-join path in planner/core/exhaust_physical_plans.go;
+    executors match index_lookup_join.go / _hash_ / _merge_)."""
+    if not pctx.enable_index_join or not plan.eq_conds:
+        return None
+    if plan.kind not in ("inner", "left_outer", "semi", "anti_semi"):
+        return None
+    from ..catalog.schema import STATE_PUBLIC as _PUB
+    from .rules import _bool_ft, _est_member
+
+    sides = [1] + ([0] if plan.kind == "inner" else [])
+    for inner_pos in sides:
+        inner_l = plan.children[inner_pos]
+        outer_l = plan.children[1 - inner_pos]
+        if not isinstance(inner_l, LogicalDataSource):
+            continue
+        inner_cols = {c.uid: c for c in inner_l.schema.cols}
+        eqmap = {}  # inner col uid -> (outer_expr, compare type, pair)
+        for le, re in plan.eq_conds:
+            ie, oe = (re, le) if inner_pos == 1 else (le, re)
+            ct = common_compare_type(le.ftype, re.ftype)
+            if (isinstance(ie, ColumnExpr)
+                    and ie.unique_id in inner_cols
+                    and ie.unique_id not in eqmap
+                    and _ij_type_ok(ct, inner_cols[ie.unique_id].ftype)):
+                eqmap[ie.unique_id] = (oe, ct, (le, re))
+        if not eqmap:
+            continue
+        store = pctx.storage.table(inner_l.table.id)
+        by_name = {c.name.lower(): c for c in inner_l.schema.cols}
+        best = None  # ((prefix_len, unique_full), ix, prefix schema cols)
+        for ix in inner_l.table.indexes:
+            if ix.state != _PUB:
+                continue
+            prefix = []
+            for cname in ix.columns:
+                sc = by_name.get(cname.lower())
+                if sc is None or sc.uid not in eqmap:
+                    break
+                prefix.append(sc)
+            if not prefix:
+                continue
+            score = (len(prefix),
+                     1 if ix.unique and len(prefix) == len(ix.columns) else 0)
+            if best is None or score > best[0]:
+                best = (score, ix, prefix)
+        if best is None:
+            continue
+        _, ix, prefix = best
+        # cost gate: the lookup path wins only when the outer side is small
+        # relative to the inner table (otherwise the device scan + hash
+        # join lane is faster); mirrors the small-outer heuristic of the
+        # reference's index-join cost
+        outer_est = _est_member(outer_l, pctx)
+        inner_rows = store.base_rows + len(store.delta)
+        if outer_est > 4096 or outer_est * 16 > max(inner_rows, 1):
+            continue
+        outer_phys = to_physical(outer_l, pctx)
+        omap = outer_phys.schema.position_map()
+        outer_keys, index_offsets, chosen = [], [], []
+        for sc in prefix:
+            oe, ct, pair = eqmap[sc.uid]
+            outer_keys.append(_maybe_cast(oe.remap_columns(omap), ct))
+            index_offsets.append(sc.store_offset)
+            chosen.append(pair)
+        outer_is_left = inner_pos == 1
+        if outer_is_left:
+            pair_cols = list(outer_phys.schema.cols) + list(inner_l.schema.cols)
+        else:
+            pair_cols = list(inner_l.schema.cols) + list(outer_phys.schema.cols)
+        pair_map = {c.uid: i for i, c in enumerate(pair_cols)}
+        others = [c.remap_columns(pair_map) for c in plan.other_conds]
+        for le, re in plan.eq_conds:
+            if any(p[0] is le and p[1] is re for p in chosen):
+                continue
+            others.append(ScalarFunc(
+                "=", [le.remap_columns(pair_map), re.remap_columns(pair_map)],
+                _bool_ft(), {}))
+        fetch_offsets = [c.store_offset for c in inner_l.schema.cols]
+        fmap = {c.uid: i for i, c in enumerate(inner_l.schema.cols)}
+        inner_conds = [c.remap_columns(fmap) for c in inner_l.pushed_conds]
+        return PhysIndexJoin(
+            outer_phys, plan.kind, inner_l.table, ix.name, index_offsets,
+            outer_keys, fetch_offsets, list(range(len(fetch_offsets))),
+            inner_conds, others, outer_is_left,
+            pctx.index_join_variant, plan.schema)
+    return None
+
+
+def _ij_type_ok(ct: FieldType, inner_ft: FieldType) -> bool:
+    """The probe compares outer keys (cast to `ct`) against the inner
+    index's NATIVE key arrays — only exact-domain matches are safe."""
+    intk = (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL,
+            TypeKind.DATE, TypeKind.DATETIME)
+    if ct.kind != inner_ft.kind and not (
+            ct.kind in intk and inner_ft.kind in intk):
+        return False
+    if inner_ft.kind == TypeKind.DECIMAL and ct.scale != inner_ft.scale:
+        return False
+    return True
+
+
 def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
+    if not pctx.prefer_merge_join:
+        ij = _try_index_join(plan, pctx)
+        if ij is not None:
+            return ij
     left = to_physical(plan.children[0], pctx)
     right = to_physical(plan.children[1], pctx)
     lmap = left.schema.position_map()
@@ -1072,8 +1400,15 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
         if p.kind in ("semi", "anti_semi", "left_outer_semi"):
             return l
         return max(l, r)  # FK-join heuristic
-    if isinstance(p, PhysIndexLookUp):
-        if p.point_get:
+    if isinstance(p, PhysIndexJoin):
+        o = _est_rows(p.children[0], pctx)
+        if p.kind in ("semi", "anti_semi"):
+            return o
+        return max(o, 1.0)  # FK lookup: ~one inner row per outer row
+    if isinstance(p, PhysBatchPointGet):
+        return float(max(len(p.keys), 1))
+    if isinstance(p, (PhysIndexLookUp, PhysIndexReader)):
+        if isinstance(p, PhysIndexLookUp) and p.point_get:
             return 1.0
         store = pctx.storage.table(p.table.id)
         total = float(store.base_rows + len(store.delta))

@@ -258,6 +258,9 @@ class Session:
             enable_pushdown=self.vars.get_bool("tidb_enable_pushdown"),
             stats=self.domain.stats,
             prefer_merge_join=self.vars.get_bool("tidb_opt_prefer_merge_join"),
+            enable_index_join=self.vars.get_bool("tidb_opt_enable_index_join"),
+            index_join_variant=(self.vars.get("tidb_index_join_variant")
+                                or "lookup").lower(),
         )
 
     def _exec_ctx(self) -> ExecContext:

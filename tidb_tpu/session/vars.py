@@ -37,7 +37,13 @@ SYSVAR_DEFAULTS = {
     "tidb_hashagg_final_concurrency": ("-1", "int"),
     "tidb_projection_concurrency": ("-1", "int"),
     "tidb_index_lookup_concurrency": ("4", "int"),
+    "tidb_index_lookup_join_concurrency": ("4", "int"),
     "tidb_opt_prefer_merge_join": ("0", "bool"),
+    "tidb_opt_enable_index_join": ("1", "bool"),
+    # index join scheduling variant: lookup (ordered, sequential batches) |
+    # hash (concurrent batch workers) | merge (key-ordered probes) —
+    # INL_JOIN / INL_HASH_JOIN / INL_MERGE_JOIN hint analog
+    "tidb_index_join_variant": ("lookup", "str"),
     # cost-based TPU-vs-host scan routing (optimizer.go:162-184 cost split
     # analog).  Measured on the axon-tunneled v5e: one dispatch+readback
     # round trip ~70ms; host numpy runs Q1-shaped scans ~1.3 rows/us; the

@@ -37,16 +37,22 @@ class SortedIndex:
                      high_open: bool = False) -> np.ndarray:
         """Handles of rows with low <(=) key <(=) high; bounds are value
         tuples over a PREFIX of the index columns (None = unbounded)."""
+        lo_i, hi_i = self.search_slice(low, high, low_open, high_open)
+        return self.handles[lo_i:hi_i]
+
+    def search_slice(self, low: Optional[tuple], high: Optional[tuple],
+                     low_open: bool = False,
+                     high_open: bool = False) -> Tuple[int, int]:
+        """(lo, hi) positions of the matching run — the covering
+        IndexReader serves key columns straight from cols[j][lo:hi]."""
         n = len(self.handles)
         if n == 0:
-            return self.handles[:0]
+            return 0, 0
         lo_i = self._bound(low, "right" if low_open else "left") \
             if low is not None else 0
         hi_i = self._bound(high, "left" if high_open else "right") \
             if high is not None else n
-        if lo_i >= hi_i:
-            return self.handles[:0]
-        return self.handles[lo_i:hi_i]
+        return (0, 0) if lo_i >= hi_i else (lo_i, hi_i)
 
     def _bound(self, key: tuple, side: str) -> int:
         lo, hi = 0, len(self.handles)
