@@ -97,6 +97,10 @@ class InfoSchema:
         for db in dbs.values():
             for t in db.tables.values():
                 self._by_id[t.id] = t
+                if t.partition_info is not None:
+                    # partition physical id -> owning logical table
+                    for pd in t.partition_info.defs:
+                        self._by_id[pd.id] = t
 
     def schema_names(self) -> List[str]:
         return sorted(db.name for db in self._dbs.values())
@@ -170,6 +174,14 @@ class Catalog:
     def _touch(self, tid: int):
         self.table_versions[tid] = self.schema_version
 
+    def _touch_info(self, t):
+        """Touch the logical id AND every partition's physical id: txn
+        write-sets key on physical ids, so the commit-time schema check
+        (domain/schema_validator.go analog) must see partition bumps."""
+        self._touch(t.id)
+        for pid in t.physical_ids():
+            self._touch(pid)
+
     def info_schema(self) -> InfoSchema:
         with self._mu:
             if self._snapshot is None:
@@ -211,8 +223,9 @@ class Catalog:
                 raise UnknownDatabaseError(name)
             for t in db.tables.values():
                 if not t.is_view:
-                    self.storage.drop_table(t.id)
-                    self._notify_drop(t.id)
+                    for pid in t.physical_ids():
+                        self.storage.drop_table(pid)
+                        self._notify_drop(pid)
             del self._dbs[key]
             self._bump()
             self._record(DDLJob(self.gen_id(), "drop_schema", name, ""))
@@ -236,7 +249,15 @@ class Catalog:
                 c.offset = i
             d.tables[info.name.lower()] = info
             if not info.is_view:
-                self.storage.create_table(info.id, info.storage_columns())
+                if info.partition_info is not None:
+                    for pd in info.partition_info.defs:
+                        if pd.id == 0:
+                            pd.id = self.gen_id()
+                        self.storage.create_table(pd.id,
+                                                  info.storage_columns())
+                        self._touch(pd.id)
+                else:
+                    self.storage.create_table(info.id, info.storage_columns())
             self._bump()
             self._touch(info.id)
             self._record(DDLJob(self.gen_id(), "create_table", db, info.name))
@@ -253,10 +274,11 @@ class Catalog:
                 raise UnknownTableError(f"{db}.{name}")
             del d.tables[name.lower()]
             if not t.is_view:
-                self.storage.drop_table(t.id)
-                self._notify_drop(t.id)
+                for pid in t.physical_ids():
+                    self.storage.drop_table(pid)
+                    self._notify_drop(pid)
             self._bump()
-            self._touch(t.id)
+            self._touch_info(t)
             self._record(DDLJob(self.gen_id(), "drop_table", db, name))
 
     def truncate_table(self, db: str, name: str):
@@ -264,16 +286,28 @@ class Catalog:
         with self._mu:
             t = self.info_schema().table(db, name)
             d = self._dbs[db.lower()]
-            self.storage.drop_table(t.id)
-            self._notify_drop(t.id)
+            for pid in t.physical_ids():
+                self.storage.drop_table(pid)
+                self._notify_drop(pid)
             new = TableInfo(
                 self.gen_id(), t.name, t.columns, t.indexes, t.pk_is_handle, 1
             )
             d.tables[name.lower()] = new
-            self.storage.create_table(new.id, new.storage_columns())
+            if t.partition_info is not None:
+                from .schema import PartitionDef, PartitionInfo
+
+                new.partition_info = PartitionInfo(
+                    t.partition_info.kind, t.partition_info.column,
+                    [PartitionDef(self.gen_id(), p.name, p.less_than)
+                     for p in t.partition_info.defs])
+                for pd in new.partition_info.defs:
+                    self.storage.create_table(pd.id, new.storage_columns())
+                    self._touch(pd.id)
+            else:
+                self.storage.create_table(new.id, new.storage_columns())
             self._bump()
-            self._touch(t.id)
-            self._touch(new.id)
+            self._touch_info(t)
+            self._touch_info(new)
             self._record(DDLJob(self.gen_id(), "truncate_table", db, name))
 
     def rename_table(self, db: str, old: str, new: str):
@@ -288,10 +322,11 @@ class Catalog:
                 raise TableExistsError(f"{db}.{new}")
             del d.tables[old.lower()]
             t2 = TableInfo(t.id, new, t.columns, t.indexes, t.pk_is_handle,
-                           t.auto_inc_id)
+                           t.auto_inc_id, t.comment, t.is_view,
+                           t.view_select, t.partition_info)
             d.tables[new.lower()] = t2
             self._bump()
-            self._touch(t.id)
+            self._touch_info(t)
             self._record(DDLJob(self.gen_id(), "rename_table", db, new))
 
     # ------------------------------------------------------------------
@@ -372,6 +407,25 @@ class Catalog:
             for c in columns:
                 if t.find_column(c) is None:
                     raise KVError(f"no column {c!r} for index {name!r}")
+            if t.is_partitioned:
+                # partitioned path: every unique key must embed the
+                # partition column (MySQL 1503), so uniqueness is local to
+                # each partition; sorted indexes materialize lazily per
+                # partition store, so no eager backfill ladder is needed.
+                pi = t.partition_info
+                if unique and pi.column.lower() not in [c.lower()
+                                                        for c in columns]:
+                    raise KVError(
+                        f"a UNIQUE INDEX must include the partitioning "
+                        f"column {pi.column!r}")
+                if unique:
+                    for pd in pi.defs:
+                        self._check_unique(t, columns, name, store_id=pd.id)
+                ix = IndexInfo(self.gen_id(), name, list(columns), unique,
+                               primary, STATE_PUBLIC)
+                self._replace_table(db, table, t, indexes=t.indexes + [ix])
+                self._record(DDLJob(self.gen_id(), "add_index", db, table))
+                return
             if unique:
                 self._check_unique(t, columns, name)
             job = DDLJob(self.gen_id(), "add_index", db, table,
@@ -659,8 +713,9 @@ class Catalog:
             )
             self._record(DDLJob(self.gen_id(), "drop_index", db, table))
 
-    def _check_unique(self, t: TableInfo, columns: List[str], name: str):
-        store = self.storage.table(t.id)
+    def _check_unique(self, t: TableInfo, columns: List[str], name: str,
+                      store_id: Optional[int] = None):
+        store = self.storage.table(store_id if store_id is not None else t.id)
         offs = t.col_offsets(columns)
         chunk = store.base_chunk(offs, 0, store.base_rows)
         # same lock-wait as the backfill recheck: an in-flight commit must
@@ -702,16 +757,25 @@ class Catalog:
             overrides.get("columns", t.columns),
             overrides.get("indexes", t.indexes),
             t.pk_is_handle, t.auto_inc_id, t.comment, t.is_view, t.view_select,
+            t.partition_info,
         )
         d.tables[table.lower()] = new
         self._bump()
-        self._touch(t.id)
+        self._touch_info(new)
 
     def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
                          add_default=None, drop: str = None, retype=None):
         """Rewrite the TableStore for a column-layout change.  Committed
-        delta folds in (compact), so the new store is base-only."""
-        store = self.storage.table(t.id)
+        delta folds in (compact), so the new store is base-only.  For a
+        partitioned table every partition store is rebuilt."""
+        for pid in t.physical_ids():
+            self._rebuild_one_store(pid, t, new_cols, add_default, drop,
+                                    retype)
+
+    def _rebuild_one_store(self, store_id: int, t: TableInfo,
+                           new_cols: List[ColumnInfo],
+                           add_default=None, drop: str = None, retype=None):
+        store = self.storage.table(store_id)
         ts = self.storage.current_ts()
         store.compact(ts)
         old_names = [c.name for c in t.columns]
@@ -742,10 +806,10 @@ class Catalog:
         # the new store's save_base atomically replaces the same files, so
         # a crash mid-ALTER leaves the OLD consistent state (catalog.json
         # only advances after this method returns)
-        self.storage.drop_table(t.id, keep_files=True)
-        self._notify_drop(t.id)
+        self.storage.drop_table(store_id, keep_files=True)
+        self._notify_drop(store_id)
         new_store = self.storage.create_table(
-            t.id, [(c.name, c.ftype) for c in new_cols]
+            store_id, [(c.name, c.ftype) for c in new_cols]
         )
         if n:
             new_store.bulk_load_arrays(arrays, valids, ts)
@@ -776,8 +840,12 @@ class Catalog:
             self._snapshot = None
             for db in self._dbs.values():
                 for t in db.tables.values():
-                    if not t.is_view and not self.storage.has_table(t.id):
-                        self.storage.create_table(t.id, t.storage_columns())
+                    if t.is_view:
+                        continue
+                    for pid in t.physical_ids():
+                        if not self.storage.has_table(pid):
+                            self.storage.create_table(pid,
+                                                      t.storage_columns())
 
 
 def _convert_array(arr, valid, old_ft: FieldType, new_ft: FieldType):

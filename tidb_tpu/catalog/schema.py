@@ -60,6 +60,81 @@ class ColumnInfo:
 
 
 @dataclass
+class PartitionDef:
+    """One partition of a partitioned table.  Each partition owns a full
+    physical table id -> its own TableStore + region set, so a partition IS
+    a shard group (SURVEY.md §2.6): per-partition scans fan out over mesh
+    tiles exactly like independent tables.
+
+    Reference: model.PartitionDefinition as used by table/tables/partition.go
+    (each partition has its own physical table ID there too)."""
+
+    id: int
+    name: str
+    # RANGE: exclusive upper bound; None = MAXVALUE.  Unused for HASH.
+    less_than: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "name": self.name, "less_than": self.less_than}
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionDef":
+        return PartitionDef(d["id"], d["name"], d.get("less_than"))
+
+
+@dataclass
+class PartitionInfo:
+    """RANGE / HASH partitioning over a single column.
+
+    Reference: model.PartitionInfo + the pruning contract of
+    planner/core/rule_partition_processor.go (single-column partition
+    expressions are the prunable subset there as well)."""
+
+    kind: str  # "range" | "hash"
+    column: str
+    defs: List[PartitionDef] = field(default_factory=list)
+
+    def ids(self) -> List[int]:
+        return [p.id for p in self.defs]
+
+    def find(self, name: str) -> Optional[PartitionDef]:
+        lname = name.lower()
+        for p in self.defs:
+            if p.name.lower() == lname:
+                return p
+        return None
+
+    def partition_for_value(self, v) -> PartitionDef:
+        """Route a partition-column value to its partition (write path).
+        NULL sorts below every value: lowest RANGE partition / hash bucket 0
+        (MySQL partitioning NULL handling)."""
+        if self.kind == "hash":
+            if v is None:
+                return self.defs[0]
+            return self.defs[int(v) % len(self.defs)]
+        if v is None:
+            return self.defs[0]
+        v = int(v)
+        for p in self.defs:
+            if p.less_than is None or v < p.less_than:
+                return p
+        from ..errors import KVError
+
+        raise KVError(
+            f"Table has no partition for value {v}"
+        )
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "column": self.column,
+                "defs": [p.to_dict() for p in self.defs]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionInfo":
+        return PartitionInfo(d["kind"], d["column"],
+                             [PartitionDef.from_dict(p) for p in d["defs"]])
+
+
+@dataclass
 class IndexInfo:
     id: int
     name: str
@@ -93,6 +168,23 @@ class TableInfo:
     comment: str = ""
     is_view: bool = False
     view_select: str = ""  # original SELECT text for views
+    partition_info: Optional[PartitionInfo] = None
+
+    @property
+    def is_partitioned(self) -> bool:
+        return self.partition_info is not None
+
+    def partition_table(self, pd: PartitionDef) -> "TableInfo":
+        """A view of one partition as its own physical table (executors and
+        the txn layer address partitions by their physical id, like
+        table/tables/partition.go's partition objects)."""
+        return TableInfo(pd.id, self.name, self.columns, self.indexes,
+                         self.pk_is_handle, self.auto_inc_id, self.comment)
+
+    def physical_ids(self) -> List[int]:
+        if self.partition_info is not None:
+            return self.partition_info.ids()
+        return [self.id]
 
     def public_columns(self) -> List[ColumnInfo]:
         return [c for c in self.columns if c.state == STATE_PUBLIC]
@@ -133,10 +225,13 @@ class TableInfo:
             "auto_inc_id": self.auto_inc_id,
             "is_view": self.is_view,
             "view_select": self.view_select,
+            "partition_info": (self.partition_info.to_dict()
+                               if self.partition_info else None),
         }
 
     @staticmethod
     def from_dict(d: dict) -> "TableInfo":
+        pi = d.get("partition_info")
         return TableInfo(
             d["id"], d["name"],
             [ColumnInfo.from_dict(c) for c in d["columns"]],
@@ -144,6 +239,7 @@ class TableInfo:
             d.get("pk_is_handle", -1), d.get("auto_inc_id", 1),
             is_view=d.get("is_view", False),
             view_select=d.get("view_select", ""),
+            partition_info=PartitionInfo.from_dict(pi) if pi else None,
         )
 
 

@@ -662,7 +662,31 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
 # ---------------------------------------------------------------------------
 
 
-def try_run_mesh(storage, req: CopRequest):
+def _mesh_over_partitions(storage, req: CopRequest, tids):
+    """One mesh program per partition store; empty/stale partitions
+    contribute nothing; any ineligible non-empty partition rejects the
+    whole request (the fan-out path then covers every partition)."""
+    import dataclasses
+    import itertools
+
+    outs = []
+    for tid in tids:
+        sub = dataclasses.replace(
+            req, ranges=[kr for kr in req.ranges if kr.table_id == tid])
+        table = storage.table(tid)
+        if table.base_rows == 0 and not table.delta:
+            continue
+        out = try_run_mesh(storage, sub, table_id=tid)
+        if out is None:
+            req.mesh_reject_reason = (
+                f"partition {tid}: "
+                f"{getattr(sub, 'mesh_reject_reason', 'ineligible')}")
+            return None
+        outs.append(out)
+    return itertools.chain.from_iterable(outs)
+
+
+def try_run_mesh(storage, req: CopRequest, table_id=None):
     """Run the whole request across the device mesh; None if ineligible
     (the caller falls back to the per-region thread fan-out).
 
@@ -670,7 +694,15 @@ def try_run_mesh(storage, req: CopRequest):
     generator for filters (streamed gathers — iterate exactly once; device
     errors can surface during iteration)."""
     dag = DAG.from_dict(req.dag)
-    table = storage.table(dag.scan.table_id)
+    tid = table_id if table_id is not None else dag.scan.table_id
+    range_tids = sorted({kr.table_id for kr in req.ranges})
+    if range_tids and (len(range_tids) > 1 or range_tids[0] != tid):
+        # partitioned table: ranges address partition stores, not the
+        # logical id in the DAG — run one mesh program per partition and
+        # chain results (partials/topn re-merge root-side, same as the
+        # per-region fan-out contract)
+        return _mesh_over_partitions(storage, req, range_tids)
+    table = storage.table(tid)
     if table.base_rows == 0 or table.base_ts > req.ts:
         req.mesh_reject_reason = "empty table or stale snapshot"
         return None

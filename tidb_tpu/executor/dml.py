@@ -72,11 +72,34 @@ class _DMLBase(Executor):
         super().__init__(ctx, [], children or [], plan_id)
         self.table = table
 
+    @property
+    def _part_off(self) -> int:
+        """Partition-column offset, resolved once per statement."""
+        off = getattr(self, "_part_off_cache", None)
+        if off is None:
+            pi = self.table.partition_info
+            off = (self.table.find_column(pi.column).offset
+                   if pi is not None else -1)
+            self._part_off_cache = off
+        return off
+
+    def _route(self, row: list):
+        """(physical table id, store) for a full row — partition routing on
+        the write path (table/tables/partition.go locatePartition)."""
+        t = self.table
+        pi = t.partition_info
+        if pi is None:
+            return t.id, self.ctx.storage.table(t.id)
+        pd = pi.partition_for_value(row[self._part_off])
+        return pd.id, self.ctx.storage.table(pd.id)
+
     def _unique_key_sets(self):
-        """Materialize existing key sets for each unique index (incl. PK).
+        """Materialize existing key sets for each unique index (incl. PK),
+        mapping key -> (physical table id, handle).  Spans every partition
+        (unique keys embed the partition column, so collisions are always
+        partition-local — but the shared map keeps callers uniform).
         Reference: executor/batch_checker.go."""
         t = self.table
-        store = self.ctx.storage.table(t.id)
         txn = self.ctx.txn
         sets = []
         from ..catalog.schema import STATE_DELETE_ONLY
@@ -89,33 +112,39 @@ class _DMLBase(Executor):
         if not uniques:
             return []
         ts = txn.start_ts
-        full = store.base_chunk(range(store.n_cols), 0, store.base_rows)
-        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
-        dele = set(deleted)
+        pids = t.physical_ids()
+        pid_set = set(pids)
         buf_rows = {}
         for (tid, h), m in txn.buffer.items():
-            if tid == t.id:
-                buf_rows[h] = m
+            if tid in pid_set:
+                buf_rows[(tid, h)] = m
+        per_store = []
+        for pid in pids:
+            store = self.ctx.storage.table(pid)
+            full = store.base_chunk(range(store.n_cols), 0, store.base_rows)
+            deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+            per_store.append((pid, full, set(deleted), inserted))
         for ix in uniques:
             offs = t.col_offsets(ix.columns)
             seen = {}
-            for h in range(full.num_rows):
-                if h in dele or h in buf_rows:
-                    continue
-                key = tuple(full.row(h)[o] for o in offs)
-                if None not in key:
-                    seen[key] = h
-            for h, row in inserted.items():
-                if h in buf_rows:
-                    continue
-                key = tuple(row[o] for o in offs)
-                if None not in key:
-                    seen[key] = h
-            for h, m in buf_rows.items():
+            for pid, full, dele, inserted in per_store:
+                for h in range(full.num_rows):
+                    if h in dele or (pid, h) in buf_rows:
+                        continue
+                    key = tuple(full.row(h)[o] for o in offs)
+                    if None not in key:
+                        seen[key] = (pid, h)
+                for h, row in inserted.items():
+                    if (pid, h) in buf_rows:
+                        continue
+                    key = tuple(row[o] for o in offs)
+                    if None not in key:
+                        seen[key] = (pid, h)
+            for (pid, h), m in buf_rows.items():
                 if m.op == "put":
                     key = tuple(m.values[o] for o in offs)
                     if None not in key:
-                        seen[key] = h
+                        seen[key] = (pid, h)
             sets.append((ix, offs, seen))
         return sets
 
@@ -149,7 +178,6 @@ class InsertExec(_DMLBase):
         if txn is None:
             raise ExecutorError("INSERT requires a transaction")
         t = self.table
-        store = self.ctx.storage.table(t.id)
         uniq = self._unique_key_sets()
         inserted = 0
 
@@ -183,11 +211,11 @@ class InsertExec(_DMLBase):
                 dup = seen.get(key)
                 if dup is not None:
                     if self.replace:
-                        txn.delete(t.id, dup)
+                        txn.delete(dup[0], dup[1])
                         del seen[key]
                         inserted += 1  # MySQL counts replace-delete
                     elif self.on_dup_update:
-                        self._apply_on_dup(dup, row)
+                        self._apply_on_dup(dup, row, uniq)
                         inserted += 1
                         return
                     elif self.ignore:
@@ -196,12 +224,23 @@ class InsertExec(_DMLBase):
                         raise KVError(
                             f"Duplicate entry for key {ix.name!r}"
                         )
+            try:
+                pid, store = self._route(row)
+            except KVError:
+                if self.ignore:
+                    # MySQL IGNORE: no-partition-for-value downgrades to a
+                    # warning and skips the row (executor/insert_common.go
+                    # handleWarning path)
+                    self.ctx.warnings.append(
+                        "Table has no partition for value; row skipped")
+                    return
+                raise
             h = store.alloc_handle()
-            txn.put(t.id, h, tuple(row))
+            txn.put(pid, h, tuple(row))
             for ix, offs, seen in uniq:
                 key = tuple(row[o] for o in offs)
                 if None not in key:
-                    seen[key] = h
+                    seen[key] = (pid, h)
             inserted += 1
 
         if self.rows is not None:
@@ -222,14 +261,19 @@ class InsertExec(_DMLBase):
         self.table.auto_inc_id = aid + 1
         return aid
 
-    def _apply_on_dup(self, handle: int, new_row: list):
+    def _apply_on_dup(self, dup: Tuple[int, int], new_row: list, uniq):
         """ON DUPLICATE KEY UPDATE: evaluate assignments against the existing
-        row (VALUES(col) resolves to the would-be inserted value)."""
+        row (VALUES(col) resolves to the would-be inserted value).  Keeps the
+        callers' unique-key `seen` maps current — the update can change key
+        values or move the row to another partition, and a later row in the
+        same statement must see the post-update locations."""
         txn = self.ctx.txn
         t = self.table
-        old = txn.get(t.id, handle)
+        pid, handle = dup
+        old = txn.get(pid, handle)
         if old is None:
-            return
+            raise KVError(
+                "on-duplicate target row vanished (stale unique-key map)")
         row = list(old)
         chunk = Chunk([
             Column.from_values(c.ftype, [row[c.offset]]) for c in t.columns
@@ -245,16 +289,40 @@ class InsertExec(_DMLBase):
                 else val.item(),
                 t.columns[off].ftype,
             )
-        txn.put(t.id, handle, tuple(row))
+        new_pid, new_store = self._route(row)
+        moved = new_pid != pid
+        new_h = new_store.alloc_handle() if moved else handle
+        for ix, offs, seen in uniq:
+            key = tuple(row[o] for o in offs)
+            if None not in key:
+                clash = seen.get(key)
+                if clash is not None and clash != (pid, handle):
+                    raise KVError(f"Duplicate entry for key {ix.name!r}")
+            old_key = tuple(old[o] for o in offs)
+            if None not in old_key:
+                seen.pop(old_key, None)
+            if None not in key:
+                seen[key] = (new_pid, new_h)
+        if moved:
+            # the update moved the row across partitions: delete + reinsert
+            txn.delete(pid, handle)
+            txn.put(new_pid, new_h, tuple(row))
+        else:
+            txn.put(pid, handle, tuple(row))
 
 
 class UpdateExec(_DMLBase):
-    """Child yields (handle, full row cols...) — assignments produce the new
-    row; write through the txn buffer."""
+    """Each child reader yields (handle, full row cols...) for one physical
+    table (the table itself, or one partition); assignments produce the new
+    row, written through the txn buffer.  An update that changes the
+    partition column moves the row: delete + reinsert in the target
+    partition (table/tables/partition.go UpdateRecord semantics)."""
 
-    def __init__(self, ctx, table: TableInfo, child: Executor,
+    def __init__(self, ctx, table: TableInfo, readers,
                  assignments: List[Tuple[int, Expression]], plan_id: int = -1):
-        super().__init__(ctx, table, [child], plan_id)
+        # readers: list of (physical table id, Executor)
+        super().__init__(ctx, table, [r for _, r in readers], plan_id)
+        self.readers = readers
         self.assignments = assignments
 
     def _next(self) -> Optional[Chunk]:
@@ -264,12 +332,19 @@ class UpdateExec(_DMLBase):
         t = self.table
         changed = 0
         uniq = self._unique_key_sets()
-        while True:
-            c = self.child().next()
-            if c is None:
-                break
-            if c.num_rows == 0:
-                continue
+        # Materialize EVERY reader's matching rows before writing anything:
+        # a row moved into a later partition must not be re-read by that
+        # partition's (lazily built) scan and updated again — the Halloween
+        # problem the reference avoids by snapshotting reads at start_ts.
+        batches = []
+        for pid, reader in self.readers:
+            while True:
+                c = reader.next()
+                if c is None:
+                    break
+                if c.num_rows:
+                    batches.append((pid, c))
+        for pid, c in batches:
             row_chunk = Chunk(c.columns[1:])  # drop handle col for eval
             handles = c.col(0).data
             new_cols = {}
@@ -292,40 +367,50 @@ class UpdateExec(_DMLBase):
                 if tuple(row) == old:
                     continue
                 h = int(handles[i])
+                new_pid, new_store = self._route(row)
+                moved = new_pid != pid
+                new_h = new_store.alloc_handle() if moved else h
                 for ix, offs, seen in uniq:
                     key = tuple(row[o] for o in offs)
                     if None in key:
                         continue
                     dup = seen.get(key)
-                    if dup is not None and dup != h:
-                        raise KVError(f"Duplicate entry for key {ix.name!r}")
+                    if dup is not None and dup != (pid, h):
+                        raise KVError(
+                            f"Duplicate entry for key {ix.name!r}")
                     old_key = tuple(old[o] for o in offs)
                     if None not in old_key:
                         seen.pop(old_key, None)
-                    seen[key] = h
-                txn.put(t.id, h, tuple(row))
+                    seen[key] = (new_pid, new_h)
+                if moved:
+                    txn.delete(pid, h)
+                    txn.put(new_pid, new_h, tuple(row))
+                else:
+                    txn.put(pid, h, tuple(row))
                 changed += 1
         self.ctx.affected_rows += changed
         return None
 
 
 class DeleteExec(_DMLBase):
-    def __init__(self, ctx, table: TableInfo, child: Executor,
-                 plan_id: int = -1):
-        super().__init__(ctx, table, [child], plan_id)
+    def __init__(self, ctx, table: TableInfo, readers, plan_id: int = -1):
+        # readers: list of (physical table id, Executor)
+        super().__init__(ctx, table, [r for _, r in readers], plan_id)
+        self.readers = readers
 
     def _next(self) -> Optional[Chunk]:
         txn = self.ctx.txn
         if txn is None:
             raise ExecutorError("DELETE requires a transaction")
         deleted = 0
-        while True:
-            c = self.child().next()
-            if c is None:
-                break
-            for h in c.col(0).data:
-                txn.delete(self.table.id, int(h))
-                deleted += 1
+        for pid, reader in self.readers:
+            while True:
+                c = reader.next()
+                if c is None:
+                    break
+                for h in c.col(0).data:
+                    txn.delete(pid, int(h))
+                    deleted += 1
         self.ctx.affected_rows += deleted
         return None
 
@@ -346,7 +431,6 @@ class LoadDataExec(_DMLBase):
 
     def _next(self) -> Optional[Chunk]:
         t = self.table
-        store = self.ctx.storage.table(t.id)
         fts = [c.ftype for c in t.columns]
         cols: List[list] = [[] for _ in fts]
         with open(self.path, "r", newline="") as f:
@@ -358,14 +442,30 @@ class LoadDataExec(_DMLBase):
                     raw = rec[j] if j < len(rec) else None
                     cols[j].append(_parse_field(raw, ft))
         n = len(cols[0]) if cols else 0
-        arrays, valids = [], []
-        for vals, ft in zip(cols, fts):
-            col = Column.from_values(ft, vals)
-            arrays.append(col.data)
-            valids.append(col.validity())
-        if n:
-            store.bulk_load_arrays(arrays, valids,
-                                   self.ctx.storage.current_ts())
+        ts = self.ctx.storage.current_ts()
+        if n and t.is_partitioned:
+            # route rows to partitions, then one columnar bulk load each
+            pi = t.partition_info
+            off = t.find_column(pi.column).offset
+            groups: dict = {}
+            for r in range(n):
+                pd = pi.partition_for_value(cols[off][r])
+                groups.setdefault(pd.id, []).append(r)
+            for pid, rows in groups.items():
+                arrays, valids = [], []
+                for vals, ft in zip(cols, fts):
+                    col = Column.from_values(ft, [vals[r] for r in rows])
+                    arrays.append(col.data)
+                    valids.append(col.validity())
+                self.ctx.storage.table(pid).bulk_load_arrays(
+                    arrays, valids, ts)
+        elif n:
+            arrays, valids = [], []
+            for vals, ft in zip(cols, fts):
+                col = Column.from_values(ft, vals)
+                arrays.append(col.data)
+                valids.append(col.validity())
+            self.ctx.storage.table(t.id).bulk_load_arrays(arrays, valids, ts)
         self.ctx.affected_rows += n
         return None
 

@@ -47,9 +47,11 @@ def _tables(domain, isc):
                 rows.append((dbn, t.name, "VIEW", 0, 0, t.id))
                 continue
             try:
-                store = domain.storage.table(t.id)
-                n = store.base_rows + len(store.delta)
-                nbytes = store.nbytes()
+                n = nbytes = 0
+                for pid in t.physical_ids():
+                    store = domain.storage.table(pid)
+                    n += store.base_rows + len(store.delta)
+                    nbytes += store.nbytes()
             except Exception:
                 n, nbytes = 0, 0
             rows.append((dbn, t.name, "BASE TABLE", n, nbytes, t.id))

@@ -241,11 +241,26 @@ class IndexDef(Node):
 
 
 @dataclass
+class PartitionDefAst(Node):
+    name: str
+    less_than: Optional[int] = None  # None = MAXVALUE
+
+
+@dataclass
+class PartitionByAst(Node):
+    kind: str  # "range" | "hash"
+    column: str
+    defs: List[PartitionDefAst] = field(default_factory=list)
+    num: int = 0  # HASH ... PARTITIONS n
+
+
+@dataclass
 class CreateTableStmt(Stmt):
     table: TableName
     columns: List[ColumnDef]
     indexes: List[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
+    partition_by: Optional[PartitionByAst] = None
 
 
 @dataclass

@@ -736,11 +736,54 @@ class Parser:
                 break
         self.expect_op(")")
         # swallow table options (ENGINE=..., CHARSET=..., etc.)
-        while self.peek().kind == T.IDENT and not self.at_op(";"):
+        while (self.peek().kind == T.IDENT and not self.at_op(";")
+               and not self.at_kw("partition")):
             self.next()
             if self.accept_op("="):
                 self.next()
-        return ast.CreateTableStmt(table, cols, indexes, ine)
+        part = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            part = self._parse_partition_by()
+        return ast.CreateTableStmt(table, cols, indexes, ine, part)
+
+    def _parse_partition_by(self) -> "ast.PartitionByAst":
+        """PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n)|
+        MAXVALUE, ...) | PARTITION BY HASH (col) PARTITIONS n"""
+        if self.accept_kw("hash"):
+            self.expect_op("(")
+            col = self.ident("column")
+            self.expect_op(")")
+            self.expect_kw("partitions")
+            n = int(self.next().value)
+            if n < 1:
+                t = self.peek()
+                raise ParseError("PARTITIONS must be >= 1", t.line, t.col)
+            return ast.PartitionByAst("hash", col, num=n)
+        self.expect_kw("range")
+        self.expect_op("(")
+        col = self.ident("column")
+        self.expect_op(")")
+        self.expect_op("(")
+        defs: List[ast.PartitionDefAst] = []
+        while True:
+            self.expect_kw("partition")
+            name = self.ident("partition")
+            self.expect_kw("values")
+            self.expect_kw("less")
+            self.expect_kw("than")
+            if self.accept_kw("maxvalue"):
+                defs.append(ast.PartitionDefAst(name, None))
+            else:
+                self.expect_op("(")
+                neg = bool(self.accept_op("-"))
+                v = int(self.next().value)
+                self.expect_op(")")
+                defs.append(ast.PartitionDefAst(name, -v if neg else v))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.PartitionByAst("range", col, defs)
 
     def _skip_balanced_until_comma(self):
         depth = 0

@@ -1,0 +1,164 @@
+"""Partition pruning: drop partitions a scan's predicates cannot touch.
+
+Reference: planner/core/rule_partition_processor.go:1-249 (the partition
+processor rewrites a partitioned DataSource into a union of per-partition
+accesses, pruning by the partition expression's range).  Here the pruned
+partition list becomes extra KeyRanges on one PhysTableReader — every
+surviving partition's regions fan out over the same device mesh, so
+"partition = shard group" (SURVEY.md §2.6) costs no extra plan nodes.
+
+Only single-column RANGE / HASH partitioning exists (catalog/schema.py),
+which is exactly the statically-prunable subset.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..catalog.schema import PartitionDef, PartitionInfo, TableInfo
+from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
+
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+
+
+def _cid(col: ColumnExpr, by_offset: bool) -> int:
+    return col.index if by_offset else col.unique_id
+
+
+def _col_op_const(cond: Expression, by_offset: bool = False):
+    """(col id, op, value) for `col op const` / `const op col`, else None."""
+    if not isinstance(cond, ScalarFunc) or cond.name not in _FLIP:
+        return None
+    if len(cond.args) != 2:
+        return None
+    a, b = cond.args
+    if isinstance(a, ColumnExpr) and isinstance(b, Constant):
+        return _cid(a, by_offset), cond.name, b.value
+    if isinstance(b, ColumnExpr) and isinstance(a, Constant):
+        return _cid(b, by_offset), _FLIP[cond.name], a.value
+    return None
+
+
+def _in_list(cond: Expression, by_offset: bool = False):
+    """(col id, values) for `col IN (consts...)`, else None."""
+    if not isinstance(cond, ScalarFunc) or cond.name != "in":
+        return None
+    if not cond.args or not isinstance(cond.args[0], ColumnExpr):
+        return None
+    vals = []
+    for a in cond.args[1:]:
+        if not isinstance(a, Constant):
+            return None
+        vals.append(a.value)
+    return _cid(cond.args[0], by_offset), vals
+
+
+def prune_partitions(table: TableInfo, conds: List[Expression],
+                     part_uid: int,
+                     by_offset: bool = False) -> List[PartitionDef]:
+    """Partitions that can hold rows satisfying the conjunction `conds`.
+
+    Bounds semantics: interval [lo, hi] with open flags, NULL handled by
+    the write-route rule (NULL lives in the first partition and no
+    col-op-const cond matches NULL, so eq/range conds never keep it)."""
+    pi = table.partition_info
+    assert pi is not None
+    lo = hi = None
+    lo_open = hi_open = False
+    in_vals: Optional[List[object]] = None
+    for c in conds:
+        cc = _col_op_const(c, by_offset)
+        if cc is not None and cc[0] == part_uid:
+            _, op, v = cc
+            if v is None:
+                return []  # col op NULL matches nothing
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                continue
+            if op == "=":
+                if (lo is not None and (v < lo or (v == lo and lo_open))) or \
+                   (hi is not None and (v > hi or (v == hi and hi_open))):
+                    return []
+                lo = hi = v
+                lo_open = hi_open = False
+            elif op in (">", ">="):
+                o = op == ">"
+                if lo is None or v > lo or (v == lo and o and not lo_open):
+                    lo, lo_open = v, o
+            elif op in ("<", "<="):
+                o = op == "<"
+                if hi is None or v < hi or (v == hi and o and not hi_open):
+                    hi, hi_open = v, o
+            continue
+        il = _in_list(c, by_offset)
+        if il is not None and il[0] == part_uid:
+            vals = []
+            for v in il[1]:
+                if v is None:
+                    continue
+                try:
+                    vals.append(int(v))
+                except (TypeError, ValueError):
+                    vals = None
+                    break
+            if vals is not None:
+                in_vals = vals if in_vals is None else \
+                    [v for v in in_vals if v in set(vals)]
+    if lo is not None and hi is not None and \
+            (lo > hi or (lo == hi and (lo_open or hi_open))):
+        return []  # contradictory conjunction: empty interval
+    if in_vals is not None:
+        # apply the interval to the IN list, then prune per value
+        keep = []
+        for v in in_vals:
+            if lo is not None and (v < lo or (v == lo and lo_open)):
+                continue
+            if hi is not None and (v > hi or (v == hi and hi_open)):
+                continue
+            keep.append(v)
+        if not keep:
+            return []
+        ids = set()
+        out = []
+        for v in keep:
+            try:
+                pd = pi.partition_for_value(v)
+            except Exception:
+                continue  # out-of-range value matches no partition
+            if pd.id not in ids:
+                ids.add(pd.id)
+                out.append(pd)
+        return sorted(out, key=lambda p: pi.defs.index(p))
+    if pi.kind == "hash":
+        if lo is not None and lo == hi and not lo_open and not hi_open:
+            return [pi.defs[lo % len(pi.defs)]]
+        return list(pi.defs)
+    # RANGE: keep defs whose [prev_bound, less_than) intersects [lo, hi]
+    out = []
+    prev = None  # inclusive lower bound of this partition's range
+    for pd in pi.defs:
+        p_lo, p_hi = prev, pd.less_than  # [p_lo, p_hi)
+        prev = pd.less_than
+        if lo is not None and p_hi is not None and \
+                (lo > p_hi - 1 or (lo == p_hi - 1 and lo_open)):
+            continue
+        if hi is not None and p_lo is not None and \
+                (hi < p_lo or (hi == p_lo and hi_open)):
+            continue
+        out.append(pd)
+    return out
+
+
+def partition_uid(table: TableInfo, schema) -> Optional[int]:
+    """uid of the partition column in this DataSource's schema."""
+    pi = table.partition_info
+    if pi is None:
+        return None
+    col = table.find_column(pi.column)
+    if col is None:
+        return None
+    for c in schema.cols:
+        if c.store_offset == col.offset:
+            return c.uid
+    return None

@@ -108,6 +108,9 @@ class CopTask:
     dag_execs: List = dc_field(default_factory=list)  # IR nodes after scan
     out_schema: Schema = None  # current output schema of the DAG
     partial_agg: Optional[Tuple[List[Expression], List[AggDesc]]] = None
+    # partitioned tables: pruned per-partition key ranges + names (EXPLAIN)
+    ranges: Optional[List[KeyRange]] = None
+    partitions: Optional[List[str]] = None
 
     def scan_pos_map(self) -> dict:
         return {c.uid: i for i, c in enumerate(self.scan_cols)}
@@ -121,7 +124,10 @@ class PhysTableReader(PhysicalPlan):
         super().__init__(schema, [])
         self.cop = task
         self.keep_order = keep_order
-        self.ranges = ranges or [KeyRange(task.table.id, 0, INF)]
+        if ranges is None:
+            ranges = task.ranges  # pruned partition ranges ([] = all pruned)
+        self.ranges = (ranges if ranges is not None
+                       else [KeyRange(task.table.id, 0, INF)])
         scan = TableScanIR(
             task.table.id,
             [c.store_offset for c in task.scan_cols],
@@ -134,6 +140,8 @@ class PhysTableReader(PhysicalPlan):
 
     def info(self) -> str:
         parts = [f"table:{self.cop.table.name}"]
+        if self.cop.partitions is not None:
+            parts.append("partition:" + ",".join(self.cop.partitions))
         if self.keep_order:
             parts.append("keep-order")
         return ", ".join(parts)
@@ -689,14 +697,11 @@ class PhysUpdate(PhysicalPlan):
         return f"table:{self.plan.table.name}"
 
     def build(self, ctx):
-        from ..executor import UnionScanExec, UpdateExec
+        from ..executor import UpdateExec
 
         t = self.plan.table
-        reader = UnionScanExec(
-            ctx, t, [c.offset for c in t.columns], self.plan.conditions,
-            with_handle=True, plan_id=self.id,
-        )
-        return UpdateExec(ctx, t, reader, self.plan.assignments, self.id)
+        readers = _dml_readers(ctx, t, self.plan.conditions, self.id)
+        return UpdateExec(ctx, t, readers, self.plan.assignments, self.id)
 
 
 class PhysDelete(PhysicalPlan):
@@ -708,14 +713,32 @@ class PhysDelete(PhysicalPlan):
         return f"table:{self.plan.table.name}"
 
     def build(self, ctx):
-        from ..executor import DeleteExec, UnionScanExec
+        from ..executor import DeleteExec
 
         t = self.plan.table
-        reader = UnionScanExec(
-            ctx, t, [c.offset for c in t.columns], self.plan.conditions,
-            with_handle=True, plan_id=self.id,
-        )
-        return DeleteExec(ctx, t, reader, self.id)
+        readers = _dml_readers(ctx, t, self.plan.conditions, self.id)
+        return DeleteExec(ctx, t, readers, self.id)
+
+
+def _dml_readers(ctx, t: TableInfo, conditions, plan_id: int):
+    """(physical id, handle-scan) pairs feeding UPDATE/DELETE: one per
+    pruned partition (conditions are full-row-offset exprs, so pruning
+    matches by store offset)."""
+    from ..executor import UnionScanExec
+
+    offsets = [c.offset for c in t.columns]
+    if not t.is_partitioned:
+        return [(t.id, UnionScanExec(ctx, t, offsets, conditions,
+                                     with_handle=True, plan_id=plan_id))]
+    from .partition import prune_partitions
+
+    part_off = t.find_column(t.partition_info.column).offset
+    parts = prune_partitions(t, conditions, part_off, by_offset=True)
+    return [
+        (pd.id, UnionScanExec(ctx, t.partition_table(pd), offsets,
+                              conditions, with_handle=True, plan_id=plan_id))
+        for pd in parts
+    ]
 
 
 class PhysLoadData(PhysicalPlan):
@@ -853,8 +876,9 @@ def physical_for_stmt(plan, pctx: PhysicalContext) -> PhysicalPlan:
 
 
 def _dict_uids(ds: LogicalDataSource, pctx: PhysicalContext) -> set:
-    store = pctx.storage.table(ds.table.id)
-    dict_cols = store.dict_encoded_cols()
+    dict_cols = set()
+    for pid in ds.table.physical_ids():
+        dict_cols |= pctx.storage.table(pid).dict_encoded_cols()
     return {c.uid for c in ds.schema.cols if c.store_offset in dict_cols}
 
 
@@ -867,11 +891,16 @@ def _split_pushable(conds, blacklist, dict_uids):
 
 def _start_cop(ds: LogicalDataSource, pctx: PhysicalContext):
     """Build the cop task skeleton: scan + pushable selection; return
-    (CopTask, residual_conds)."""
+    (CopTask, residual_conds).  For a partitioned table the task carries the
+    pruned per-partition ranges (rule_partition_processor.go analog)."""
     task = CopTask(ds.table, list(ds.schema.cols))
-    dirty = ds.table.id in pctx.dirty_tables
+    dirty = any(pid in pctx.dirty_tables for pid in ds.table.physical_ids())
     if dirty or not pctx.enable_pushdown:
         return None, list(ds.pushed_conds)
+    if ds.table.is_partitioned:
+        parts = _pruned_partitions(ds)
+        task.ranges = [KeyRange(pd.id, 0, INF) for pd in parts]
+        task.partitions = [pd.name for pd in parts]
     dict_uids = _dict_uids(ds, pctx)
     push, residual = _split_pushable(
         ds.pushed_conds, pctx.pushdown_blacklist, dict_uids
@@ -885,13 +914,36 @@ def _start_cop(ds: LogicalDataSource, pctx: PhysicalContext):
     return task, residual
 
 
+def _pruned_partitions(ds: LogicalDataSource):
+    from .partition import partition_uid, prune_partitions
+
+    puid = partition_uid(ds.table, ds.schema)
+    if puid is None:
+        return list(ds.table.partition_info.defs)
+    return prune_partitions(ds.table, ds.pushed_conds, puid)
+
+
 def _finish_datasource(ds: LogicalDataSource,
                        pctx: PhysicalContext) -> PhysicalPlan:
     ix = _try_index_path(ds, pctx)
     if ix is not None:
         return ix
     task, residual = _start_cop(ds, pctx)
+    if task is not None and task.ranges == []:
+        return PhysDual(ds.schema, 0)  # every partition pruned
     if task is None:
+        if ds.table.is_partitioned:
+            # dirty/no-pushdown partitioned scan: one UnionScan per pruned
+            # partition, concatenated (each partition is its own physical
+            # table to the txn buffer and store)
+            parts = _pruned_partitions(ds)
+            if not parts:
+                return PhysDual(ds.schema, 0)
+            kids = [PhysUnionScan(ds.schema, ds.table.partition_table(pd),
+                                  list(ds.pushed_conds)) for pd in parts]
+            if len(kids) == 1:
+                return kids[0]
+            return PhysUnion(ds.schema, kids)
         return PhysUnionScan(ds.schema, ds.table, list(ds.pushed_conds))
     reader = PhysTableReader(Schema(task.scan_cols), task, keep_order=False,
                              ranges=ds.ranges)
@@ -907,6 +959,11 @@ def _try_index_path(ds: LogicalDataSource,
     unique key or stats say the range is very selective (find_best_task's
     index-path choice, rule-based)."""
     if not ds.pushed_conds or not ds.table.indexes:
+        return None
+    if ds.table.is_partitioned:
+        # sorted indexes are per-partition stores; the index read path
+        # addresses a single store — partitioned tables take the pruned
+        # mesh-scan path instead
         return None
     from .ranger import build_access_path
 
@@ -1058,6 +1115,8 @@ def _physical_agg(plan: LogicalAggregation,
     # collapsed by rules into ds.pushed_conds)
     if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
         task, residual = _start_cop(child_l, pctx)
+        if task is not None and task.ranges == []:
+            task = None  # every partition pruned: plan over an empty Dual
         if task is not None and not residual and plan.aggs:
             dict_uids = _dict_uids(child_l, pctx)
             ok = all(
@@ -1113,6 +1172,8 @@ def _physical_topn(plan: LogicalTopN, pctx: PhysicalContext) -> PhysicalPlan:
     k = plan.limit + plan.offset
     if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
         task, residual = _start_cop(child_l, pctx)
+        if task is not None and task.ranges == []:
+            task = None
         if task is not None and not residual:
             dict_uids = _dict_uids(child_l, pctx)
             if all(can_push_expr(e, pctx.pushdown_blacklist, dict_uids)
@@ -1137,6 +1198,8 @@ def _try_push_limit(plan: LogicalLimit, pctx: PhysicalContext):
     child_l = plan.children[0]
     if isinstance(child_l, LogicalDataSource) and pctx.enable_pushdown:
         task, residual = _start_cop(child_l, pctx)
+        if task is not None and task.ranges == []:
+            task = None
         if task is not None and not residual:
             task.dag_execs.append(LimitIR(plan.limit + plan.offset))
             reader = PhysTableReader(Schema(task.scan_cols), task,
@@ -1164,6 +1227,8 @@ def _try_index_join(plan: LogicalJoin,
         outer_l = plan.children[1 - inner_pos]
         if not isinstance(inner_l, LogicalDataSource):
             continue
+        if inner_l.table.is_partitioned:
+            continue  # index lookups address one partition store
         inner_cols = {c.uid: c for c in inner_l.schema.cols}
         eqmap = {}  # inner col uid -> (outer_expr, compare type, pair)
         for le, re in plan.eq_conds:
@@ -1373,8 +1438,13 @@ def _cop_selectivity(p: "PhysTableReader", conds, pctx) -> float:
 def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
     if isinstance(p, PhysTableReader):
         st = pctx.stats.get(p.cop.table.id) if pctx.stats else None
-        store = pctx.storage.table(p.cop.table.id)
-        rows = float(st.row_count if st else store.base_rows + len(store.delta))
+        if st is not None:
+            rows = float(st.row_count)
+        else:
+            rows = 0.0
+            for pid in {kr.table_id for kr in p.ranges}:
+                store = pctx.storage.table(pid)
+                rows += store.base_rows + len(store.delta)
         for ex in p.dag.executors[1:]:
             if isinstance(ex, SelectionIR):
                 rows *= _cop_selectivity(p, ex.conditions, pctx)
@@ -1421,8 +1491,11 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
             )
         return max(total * 0.01, 1.0)
     if isinstance(p, PhysUnionScan):
-        store = pctx.storage.table(p.table.id)
-        return float(store.base_rows + len(store.delta))
+        total = 0.0
+        for pid in p.table.physical_ids():
+            store = pctx.storage.table(pid)
+            total += store.base_rows + len(store.delta)
+        return total
     if isinstance(p, PhysUnion):
         return sum(_est_rows(c, pctx) for c in p.children)
     if p.children:

@@ -417,7 +417,8 @@ def _est_member(p: LogicalPlan, pctx) -> float:
             rows = float(st.row_count)
         elif rows == 0:
             try:
-                rows = float(pctx.storage.table(p.table.id).base_rows)
+                rows = float(sum(pctx.storage.table(pid).base_rows
+                                 for pid in p.table.physical_ids()))
             except Exception:
                 rows = 1000.0
         if p.pushed_conds:

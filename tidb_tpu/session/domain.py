@@ -92,10 +92,21 @@ class Domain:
 
     def maybe_auto_analyze(self, table_ids):
         """Post-DML auto-analyze check (update.go:621-639 analog, run inline
-        instead of on a background ticker)."""
+        instead of on a background ticker).  A touched partition refreshes
+        the whole partitioned table so the merged logical-id row count the
+        planner reads stays current."""
+        isc = self.catalog.info_schema()
+        done = set()
         for tid in table_ids:
             try:
-                if self.stats.need_auto_analyze(tid):
+                if not self.stats.need_auto_analyze(tid):
+                    continue
+                owner = isc.table_by_id(tid)
+                if owner is not None and owner.partition_info is not None \
+                        and owner.id not in done:
+                    done.add(owner.id)
+                    self.stats.analyze(owner)
+                else:
                     self.stats.analyze_table(tid)
             except Exception:
                 pass  # stats are advisory; never fail the statement

@@ -502,10 +502,12 @@ class Session:
         if kind == "regions":
             db = s.db or self.current_db
             t = isc.table(db, s.target)
-            regions = self.domain.storage.regions.regions_of(t.id)
-            rows = [(r.region_id, t.name, r.start,
-                     "inf" if r.end >= (1 << 62) else r.end, r.epoch,
-                     r.leader_store) for r in regions]
+            rows = []
+            for pid in t.physical_ids():
+                for r in self.domain.storage.regions.regions_of(pid):
+                    rows.append((r.region_id, t.name, r.start,
+                                 "inf" if r.end >= (1 << 62) else r.end,
+                                 r.epoch, r.leader_store))
             return ResultSet(
                 ["Region_id", "Table", "Start", "End", "Epoch", "Leader"],
                 rows, is_query=True)
@@ -515,9 +517,13 @@ class Session:
                 for t in isc.tables(db):
                     if t.is_view:
                         continue
-                    store = self.domain.storage.table(t.id)
-                    rows.append((db, t.name, store.base_rows,
-                                 len(store.delta), store.nbytes()))
+                    base = delta = nbytes = 0
+                    for pid in t.physical_ids():
+                        store = self.domain.storage.table(pid)
+                        base += store.base_rows
+                        delta += len(store.delta)
+                        nbytes += store.nbytes()
+                    rows.append((db, t.name, base, delta, nbytes))
             return ResultSet(
                 ["Db_name", "Table_name", "Base_rows", "Delta_rows", "Bytes"],
                 rows, is_query=True)
@@ -553,21 +559,24 @@ class Session:
             t = self.domain.catalog.info_schema().table(
                 tn.db or self.current_db, tn.name
             )
-            store = self.domain.storage.table(t.id)
-            for ci in range(store.n_cols):
-                store.column_stats(ci)  # warm min/max cache (device engine)
-            self.domain.stats.analyze_table(t.id)
+            for pid in t.physical_ids():
+                store = self.domain.storage.table(pid)
+                for ci in range(store.n_cols):
+                    store.column_stats(ci)  # warm min/max (device engine)
+            self.domain.stats.analyze(t)
         return ResultSet()
 
     def _run_split(self, s: ast.SplitRegionStmt) -> ResultSet:
         t = self.domain.catalog.info_schema().table(
             s.table.db or self.current_db, s.table.name
         )
-        store = self.domain.storage.table(t.id)
-        self.domain.storage.regions.split_even(
-            t.id, s.num, max(store.base_rows, store.next_handle)
-        )
-        n = len(self.domain.storage.regions.regions_of(t.id))
+        n = 0
+        for pid in t.physical_ids():
+            store = self.domain.storage.table(pid)
+            self.domain.storage.regions.split_even(
+                pid, s.num, max(store.base_rows, store.next_handle)
+            )
+            n += len(self.domain.storage.regions.regions_of(pid))
         return ResultSet(["TOTAL_SPLIT_REGION"], [(n,)], is_query=True)
 
     def _run_admin(self, s: ast.AdminStmt) -> ResultSet:
@@ -585,7 +594,8 @@ class Session:
                 t = self.domain.catalog.info_schema().table(
                     tn.db or self.current_db, tn.name
                 )
-                self.domain.storage.table(t.id)  # existence check
+                for pid in t.physical_ids():
+                    self.domain.storage.table(pid)  # existence check
             return ResultSet()
         raise PlanError(f"ADMIN {s.kind} not supported")
 
@@ -707,7 +717,52 @@ class Session:
                           ix.columns, ix.unique, ix.primary)
             )
             idx_id += 1
+        if s.partition_by is not None:
+            info.partition_info = self._partition_info(s.partition_by, info)
         return info
+
+    def _partition_info(self, pb, info: TableInfo):
+        """Validate + build PartitionInfo (ddl_api.go buildTablePartitionInfo
+        + checkPartitionKeysConstraint analogs)."""
+        from ..catalog.schema import PartitionDef, PartitionInfo
+
+        col = info.find_column(pb.column)
+        if col is None:
+            raise PlanError(f"unknown partition column {pb.column!r}")
+        if col.ftype.kind not in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL,
+                                  TypeKind.DATE, TypeKind.DATETIME):
+            raise PlanError(
+                f"partition column {pb.column!r} must be integer-valued")
+        # MySQL 1503: every unique key must use the partitioning column,
+        # so uniqueness stays partition-local (no cross-shard checks)
+        for ix in info.indexes:
+            if (ix.unique or ix.primary) and \
+                    pb.column.lower() not in [c.lower() for c in ix.columns]:
+                raise PlanError(
+                    f"a {'PRIMARY KEY' if ix.primary else 'UNIQUE INDEX'} "
+                    f"must include all columns in the table's partitioning "
+                    f"function")
+        if pb.kind == "hash":
+            defs = [PartitionDef(0, f"p{i}") for i in range(pb.num)]
+            return PartitionInfo("hash", col.name, defs)
+        # RANGE: bounds must be strictly increasing; MAXVALUE only last
+        defs, prev = [], None
+        seen = set()
+        for i, pd in enumerate(pb.defs):
+            if pd.name.lower() in seen:
+                raise PlanError(f"duplicate partition name {pd.name!r}")
+            seen.add(pd.name.lower())
+            if pd.less_than is None:
+                if i != len(pb.defs) - 1:
+                    raise PlanError(
+                        "MAXVALUE can only be used in the last partition")
+            else:
+                if prev is not None and pd.less_than <= prev:
+                    raise PlanError(
+                        "VALUES LESS THAN must be strictly increasing")
+                prev = pd.less_than
+            defs.append(PartitionDef(0, pd.name, pd.less_than))
+        return PartitionInfo("range", col.name, defs)
 
 
 # ---------------------------------------------------------------------------
@@ -759,4 +814,16 @@ def _show_create(t: TableInfo) -> str:
         else:
             lines.append(f"  KEY `{ix.name}` (`{'`,`'.join(ix.columns)}`)")
     body = ",\n".join(lines)
-    return f"CREATE TABLE `{t.name}` (\n{body}\n)"
+    out = f"CREATE TABLE `{t.name}` (\n{body}\n)"
+    pi = t.partition_info
+    if pi is not None:
+        if pi.kind == "hash":
+            out += (f"\nPARTITION BY HASH (`{pi.column}`) "
+                    f"PARTITIONS {len(pi.defs)}")
+        else:
+            parts = ", ".join(
+                f"PARTITION `{p.name}` VALUES LESS THAN "
+                + ("MAXVALUE" if p.less_than is None else f"({p.less_than})")
+                for p in pi.defs)
+            out += f"\nPARTITION BY RANGE (`{pi.column}`) ({parts})"
+    return out

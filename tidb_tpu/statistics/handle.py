@@ -54,6 +54,26 @@ class StatsHandle:
         self.epoch += 1
         return self._analyze_table(table_id, n_buckets)
 
+    def analyze(self, table_info, n_buckets: int = 64) -> TableStats:
+        """ANALYZE entry taking schema metadata: partitioned tables analyze
+        every partition store (stats cached per physical id) plus a merged
+        row-count entry under the logical id for planner cardinality
+        (statistics/handle.go's partition-table GlobalStats, row-count
+        level)."""
+        if table_info.partition_info is None:
+            return self.analyze_table(table_info.id, n_buckets)
+        self.epoch += 1
+        total, version = 0, 0
+        for pd in table_info.partition_info.defs:
+            st = self._analyze_table(pd.id, n_buckets)
+            total += st.row_count
+            version = version * 1_000_003 + st.version
+        merged = TableStats(table_info.id, version, total,
+                            build_time=time.time())
+        with self._mu:
+            self._cache[table_info.id] = merged
+        return merged
+
     def _analyze_table(self, table_id: int, n_buckets: int = 64) -> TableStats:
         store = self.storage.table(table_id)
         ts = self.storage.current_ts()
