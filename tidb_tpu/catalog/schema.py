@@ -40,7 +40,8 @@ class ColumnInfo:
         return {
             "name": self.name,
             "ftype": [int(self.ftype.kind), self.ftype.nullable,
-                      self.ftype.precision, self.ftype.scale],
+                      self.ftype.precision, self.ftype.scale,
+                      list(self.ftype.elems)],
             "offset": self.offset,
             "default": self.default,
             "has_default": self.has_default,
@@ -51,9 +52,11 @@ class ColumnInfo:
 
     @staticmethod
     def from_dict(d: dict) -> "ColumnInfo":
-        k, nl, p, s = d["ftype"]
+        ft = d["ftype"]
+        k, nl, p, s = ft[:4]
+        elems = tuple(ft[4]) if len(ft) > 4 else ()
         return ColumnInfo(
-            d["name"], FieldType(TypeKind(k), nl, p, s), d["offset"],
+            d["name"], FieldType(TypeKind(k), nl, p, s, elems), d["offset"],
             d["default"], d["has_default"], d["auto_increment"],
             d["primary_key"], d.get("state", STATE_PUBLIC),
         )

@@ -30,6 +30,7 @@ def _col_header(c: Column) -> dict:
         "nullable": c.ftype.nullable,
         "precision": c.ftype.precision,
         "scale": c.ftype.scale,
+        "elems": list(c.ftype.elems),
         "has_valid": c.valid is not None,
     }
 
@@ -42,8 +43,10 @@ def encode_chunk(chunk: Chunk) -> bytes:
         "cols": [_col_header(c) for c in chunk.columns],
     }
     for c in chunk.columns:
-        if c.ftype.kind == TypeKind.STRING:
-            # Arrow-style varlen layout: int64 offsets (n+1) + utf-8 data buffer.
+        if c.data.dtype == object:
+            # Arrow-style varlen layout: int64 offsets (n+1) + utf-8 data
+            # buffer.  Covers STRING, JSON texts, and wide-decimal Python
+            # ints (as decimal digit strings).
             encs = [str(x).encode("utf-8") for x in c.data]
             offsets = np.zeros(len(encs) + 1, dtype=np.int64)
             np.cumsum([len(e) for e in encs], out=offsets[1:])
@@ -85,18 +88,21 @@ def decode_chunk(buf: bytes) -> Chunk:
 
     for ch in header["cols"]:
         ft = FieldType(
-            TypeKind(ch["kind"]), ch["nullable"], ch["precision"], ch["scale"]
+            TypeKind(ch["kind"]), ch["nullable"], ch["precision"],
+            ch["scale"], tuple(ch.get("elems", ())),
         )
         raw = read_part()
-        if ft.kind == TypeKind.STRING:
+        if ft.np_dtype == object:
             data = np.empty(rows, dtype=object)
+            wide_dec = ft.kind == TypeKind.DECIMAL
             if rows:
                 off_end = (rows + 1) * 8
                 offsets = np.frombuffer(raw[:off_end], dtype=np.int64)
                 sbuf = raw[off_end:]
                 assert offsets[-1] == len(sbuf), "string column buffer mismatch"
                 for i in range(rows):
-                    data[i] = sbuf[offsets[i] : offsets[i + 1]].decode("utf-8")
+                    txt = sbuf[offsets[i] : offsets[i + 1]].decode("utf-8")
+                    data[i] = int(txt) if wide_dec else txt
         else:
             data = np.frombuffer(raw, dtype=ft.np_dtype).copy()
         vraw = read_part()

@@ -36,17 +36,26 @@ class Column:
 
     # ---- constructors -------------------------------------------------
     @staticmethod
+    def _object_fill(ftype: FieldType) -> object:
+        """NULL placeholder inside object-dtype data arrays."""
+        if ftype.kind == TypeKind.DECIMAL:
+            return 0  # wide decimal: exact Python ints
+        return ""  # STRING / JSON
+
+    @staticmethod
     def from_values(ftype: FieldType, values: Sequence) -> "Column":
-        """Build from a python sequence; None entries become NULLs."""
+        """Build from a python sequence of PHYSICAL-repr values (scaled ints
+        for decimals, member indexes for enums, ...); None entries -> NULL."""
         n = len(values)
         valid = np.fromiter((v is not None for v in values), dtype=np.bool_, count=n)
         all_valid = bool(valid.all())
-        if ftype.kind == TypeKind.STRING:
+        dt = ftype.np_dtype
+        if dt == object:
+            fill = Column._object_fill(ftype)
             data = np.empty(n, dtype=object)
             for i, v in enumerate(values):
-                data[i] = v if v is not None else ""
+                data[i] = v if v is not None else fill
         else:
-            dt = ftype.np_dtype
             data = np.zeros(n, dtype=dt)
             if all_valid:
                 data[:] = np.asarray(values, dtype=dt)
@@ -58,9 +67,9 @@ class Column:
 
     @staticmethod
     def nulls(ftype: FieldType, n: int) -> "Column":
-        if ftype.kind == TypeKind.STRING:
+        if ftype.np_dtype == object:
             data = np.empty(n, dtype=object)
-            data[:] = ""
+            data[:] = Column._object_fill(ftype)
         else:
             data = np.zeros(n, dtype=ftype.np_dtype)
         return Column(ftype, data, np.zeros(n, dtype=np.bool_))
@@ -69,7 +78,7 @@ class Column:
     def constant(ftype: FieldType, value, n: int) -> "Column":
         if value is None:
             return Column.nulls(ftype, n)
-        if ftype.kind == TypeKind.STRING:
+        if ftype.np_dtype == object:
             data = np.empty(n, dtype=object)
             data[:] = value
         else:

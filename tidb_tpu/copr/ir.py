@@ -27,11 +27,15 @@ from ..types import FieldType, TypeKind
 
 
 def serialize_ftype(ft: FieldType) -> list:
-    return [int(ft.kind), bool(ft.nullable), ft.precision, ft.scale]
+    out = [int(ft.kind), bool(ft.nullable), ft.precision, ft.scale]
+    if ft.elems:
+        out.append(list(ft.elems))
+    return out
 
 
 def deserialize_ftype(v: list) -> FieldType:
-    return FieldType(TypeKind(v[0]), v[1], v[2], v[3])
+    elems = tuple(v[4]) if len(v) > 4 else ()
+    return FieldType(TypeKind(v[0]), v[1], v[2], v[3], elems)
 
 
 # ---- Expression codec ------------------------------------------------------

@@ -31,6 +31,10 @@ JVal = Tuple[jnp.ndarray, jnp.ndarray]  # (data, valid)
 
 
 def _np_dtype_for(ft: FieldType):
+    if ft.kind == TypeKind.JSON or (ft.kind == TypeKind.DECIMAL
+                                    and ft.is_wide_decimal):
+        # object-dtype host representations never land on the device
+        raise JaxUnsupported(f"{ft.sql_name()} column is host-only")
     if ft.kind == TypeKind.FLOAT:
         return jnp.float64
     if ft.kind == TypeKind.DATE:

@@ -25,43 +25,30 @@ from .base import ExecContext, Executor
 
 
 def _coerce_value(v, ft: FieldType):
-    """Python literal -> storage representation for ftype (host-side cast)."""
+    """Python literal -> storage representation for ftype (host-side cast).
+    Literal typing delegates to the planner's literal_to_constant so INSERT
+    values and planner constants can never drift apart."""
     if v is None:
         return None
-    col = Column.from_values(ft, [None])  # probe repr
-    vec = Vec(_literal_ftype(v), _literal_array(v), None)
+    from ..planner.expr_build import literal_to_constant
+
+    const = literal_to_constant(v)
+    vec = Vec(const.ftype, _one_elem_array(const.value, const.ftype), None)
     out = cast_vec(vec, ft)
     if out.valid is not None and not out.valid[0]:
         return None
     x = out.data[0]
-    if ft.kind == TypeKind.STRING:
+    if ft.kind in (TypeKind.STRING, TypeKind.JSON):
         return str(x)
     if ft.kind == TypeKind.FLOAT:
         return float(x)
     return int(x)
 
 
-def _literal_ftype(v) -> FieldType:
-    from ..types import ty_float, ty_int, ty_string
-
-    if isinstance(v, bool):
-        return ty_int()
-    if isinstance(v, int):
-        return ty_int()
-    if isinstance(v, float):
-        return ty_float()
-    return ty_string()
-
-
-def _literal_array(v) -> np.ndarray:
-    if isinstance(v, bool):
-        return np.array([int(v)], dtype=np.int64)
-    if isinstance(v, int):
-        return np.array([v], dtype=np.int64)
-    if isinstance(v, float):
-        return np.array([v], dtype=np.float64)
-    a = np.empty(1, dtype=object)
-    a[0] = str(v)
+def _one_elem_array(v, ft: FieldType) -> np.ndarray:
+    dt = ft.np_dtype
+    a = np.empty(1, dtype=dt)
+    a[0] = v
     return a
 
 
@@ -371,6 +358,11 @@ class UpdateExec(_DMLBase):
                 moved = new_pid != pid
                 new_h = new_store.alloc_handle() if moved else h
                 for ix, offs, seen in uniq:
+                    # drop the OLD key first: a new key containing NULL
+                    # still frees the old slot (matching _apply_on_dup)
+                    old_key = tuple(old[o] for o in offs)
+                    if None not in old_key:
+                        seen.pop(old_key, None)
                     key = tuple(row[o] for o in offs)
                     if None in key:
                         continue
@@ -378,9 +370,6 @@ class UpdateExec(_DMLBase):
                     if dup is not None and dup != (pid, h):
                         raise KVError(
                             f"Duplicate entry for key {ix.name!r}")
-                    old_key = tuple(old[o] for o in offs)
-                    if None not in old_key:
-                        seen.pop(old_key, None)
                     seen[key] = (new_pid, new_h)
                 if moved:
                     txn.delete(pid, h)

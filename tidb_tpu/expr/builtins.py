@@ -31,8 +31,10 @@ from ..types import (
     ty_decimal,
     ty_float,
     ty_int,
+    ty_json,
     ty_string,
     ty_null,
+    ty_time,
     ty_uint,
 )
 from ..types.values import (
@@ -40,9 +42,13 @@ from ..types.values import (
     decimal_round_half_up,
     format_date,
     format_datetime,
+    format_decimal,
+    format_time,
     micros_to_datetime,
     parse_date,
     parse_datetime,
+    parse_decimal_exact,
+    parse_time,
 )
 from .vec import Vec, combined_valid
 
@@ -104,19 +110,96 @@ def _to_float(v: Vec) -> np.ndarray:
     return v.data.astype(np.float64)
 
 
+_I64_SAFE = (1 << 62)
+
+
+def _maxabs(arr: np.ndarray) -> int:
+    """max |value| of an int64/object array (0 for empty), exact."""
+    if len(arr) == 0:
+        return 0
+    if arr.dtype == object:
+        return max(abs(int(x)) for x in arr)
+    return int(np.abs(arr).max())
+
+
+def _scale_up(arr: np.ndarray, pow10: int) -> np.ndarray:
+    """arr * pow10 without silent int64 wrap: escalates to exact Python-int
+    (object dtype) arithmetic when the product may exceed int64.  This is
+    what replaces mydecimal.go's 9-digit-limb wide arithmetic: the narrow
+    path stays dense int64 (device-shaped), the wide path is exact."""
+    if pow10 == 1:
+        return arr
+    if arr.dtype == object:
+        return arr * pow10
+    if _maxabs(arr) <= _I64_SAFE // pow10:
+        return arr * pow10
+    return arr.astype(object) * pow10
+
+
+def _add_safe(x: np.ndarray, y: np.ndarray, sub: bool = False) -> np.ndarray:
+    if x.dtype == object or y.dtype == object:
+        x = x.astype(object) if x.dtype != object else x
+        y = y.astype(object) if y.dtype != object else y
+        return x - y if sub else x + y
+    if _maxabs(x) + _maxabs(y) >= _I64_SAFE:
+        return (x.astype(object) - y.astype(object)) if sub else (
+            x.astype(object) + y.astype(object))
+    return x - y if sub else x + y
+
+
+def _mul_safe(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    if x.dtype == object or y.dtype == object:
+        x = x.astype(object) if x.dtype != object else x
+        y = y.astype(object) if y.dtype != object else y
+        return x * y
+    mx, my = _maxabs(x), _maxabs(y)
+    if mx and my and mx > _I64_SAFE // my:
+        return x.astype(object) * y.astype(object)
+    return x * y
+
+
+def _narrow_if_safe(arr: np.ndarray) -> np.ndarray:
+    """object array whose values all fit int64 -> dense int64 (keeps the
+    downstream fast paths hot when escalation was transient)."""
+    if arr.dtype != object or len(arr) == 0:
+        return arr
+    if _maxabs(arr) < (1 << 63) - 1:
+        return arr.astype(np.int64)
+    return arr
+
+
 def _to_scaled_int(v: Vec, scale: int) -> np.ndarray:
-    """Value of v at decimal scale `scale` as int64."""
+    """Value of v at decimal scale `scale` (int64, or object when wide)."""
     k = v.ftype.kind
     if k == TypeKind.DECIMAL:
         ds = scale - v.ftype.scale
         if ds == 0:
             return v.data
         if ds > 0:
-            return v.data * (10 ** ds)
+            return _scale_up(v.data, 10 ** ds)
         return decimal_round_half_up(v.data, -ds)
     if k == TypeKind.FLOAT:
         return np.round(v.data * (10.0 ** scale)).astype(np.int64)
-    return v.data.astype(np.int64) * (10 ** scale)
+    return _scale_up(v.data.astype(np.int64), 10 ** scale)
+
+
+def _div_round(num: np.ndarray, den: np.ndarray) -> np.ndarray:
+    """round-half-away-from-zero(num / den) as integers, elementwise.
+    int64 fast path when 2|num|+|den| fits; exact object math otherwise."""
+    obj = num.dtype == object or den.dtype == object
+    if not obj and (_maxabs(num) >= _I64_SAFE // 2
+                    or _maxabs(den) >= _I64_SAFE // 2):
+        obj = True
+    if obj:
+        num = num.astype(object) if num.dtype != object else num
+        den = den.astype(object) if den.dtype != object else den
+    an, ad = np.abs(num), np.abs(den)
+    q = (2 * an + ad) // (2 * ad)
+    if obj:
+        neg = np.array([(x < 0) != (y < 0) for x, y in zip(num, den)],
+                       dtype=np.bool_)
+        return np.where(neg, -q, q)
+    return np.sign(num) * np.sign(den) * q
 
 
 def _str_data(v: Vec) -> np.ndarray:
@@ -127,9 +210,23 @@ def _str_data(v: Vec) -> np.ndarray:
     if k == TypeKind.DECIMAL:
         s = v.ftype.scale
         for i, x in enumerate(v.data):
-            sign = "-" if x < 0 else ""
-            ax = abs(int(x))
-            out[i] = f"{sign}{ax // 10**s}.{ax % 10**s:0{s}d}" if s else str(int(x))
+            out[i] = format_decimal(int(x), s)
+    elif k == TypeKind.TIME:
+        for i, x in enumerate(v.data):
+            out[i] = format_time(int(x))
+    elif k == TypeKind.ENUM:
+        el = v.ftype.elems
+        for i, x in enumerate(v.data):
+            xi = int(x)
+            out[i] = el[xi - 1] if 1 <= xi <= len(el) else ""
+    elif k == TypeKind.SET:
+        el = v.ftype.elems
+        for i, x in enumerate(v.data):
+            xi = int(x)
+            out[i] = ",".join(e for j, e in enumerate(el) if xi >> j & 1)
+    elif k == TypeKind.JSON:
+        for i, x in enumerate(v.data):
+            out[i] = str(x)
     elif k == TypeKind.DATE:
         for i, x in enumerate(v.data):
             out[i] = format_date(int(x))
@@ -145,6 +242,21 @@ def _str_data(v: Vec) -> np.ndarray:
     return out
 
 
+def _fit_decimal(arr: np.ndarray, target: FieldType) -> np.ndarray:
+    """Fit scaled values into the target's physical layout.  A narrow
+    (int64) target saturates out-of-range values at +-(10^p - 1), MySQL's
+    non-strict out-of-range truncation, so object arrays can never leak
+    onto int64-typed columns."""
+    if target.is_wide_decimal:
+        return arr
+    arr = _narrow_if_safe(arr)
+    limit = 10 ** min(max(target.precision, 1), 18) - 1
+    if arr.dtype == object:
+        arr = np.array([min(max(int(x), -limit), limit) for x in arr],
+                       dtype=np.int64)
+    return arr
+
+
 def _cast_data_to(v: Vec, target: FieldType) -> np.ndarray:
     """Physical data of v converted to target's representation (no null change)."""
     k, tk = v.ftype.kind, target.kind
@@ -154,9 +266,15 @@ def _cast_data_to(v: Vec, target: FieldType) -> np.ndarray:
         return _to_float(v)
     if tk == TypeKind.DECIMAL:
         if k == TypeKind.STRING:
-            f = _to_float(Vec(ty_string(), v.data, None))
-            return np.round(f * 10.0 ** target.scale).astype(np.int64)
-        return _to_scaled_int(v, target.scale)
+            # exact parse (no float round-trip): mydecimal FromString
+            out = np.empty(len(v.data), dtype=object)
+            for i, sv in enumerate(v.data):
+                try:
+                    out[i] = parse_decimal_exact(str(sv), target.scale)
+                except (ValueError, TypeError):
+                    out[i] = 0
+            return _fit_decimal(out, target)
+        return _fit_decimal(_to_scaled_int(v, target.scale), target)
     if tk in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
         if k == TypeKind.FLOAT:
             return np.round(v.data).astype(np.int64)
@@ -191,6 +309,66 @@ def _cast_data_to(v: Vec, target: FieldType) -> np.ndarray:
         if k == TypeKind.DATE:
             return v.data.astype(np.int64) * 86_400_000_000
         return v.data.astype(np.int64)
+    if tk == TypeKind.TIME:
+        if k == TypeKind.STRING:
+            out = np.zeros(len(v.data), dtype=np.int64)
+            for i, sv in enumerate(v.data):
+                try:
+                    out[i] = parse_time(str(sv))
+                except (ValueError, IndexError):
+                    out[i] = 0
+            return out
+        if k == TypeKind.DATETIME:
+            return v.data.astype(np.int64) % 86_400_000_000
+        if k in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
+            # numeric HHMMSS (types/time.go number->Duration)
+            out = np.zeros(len(v.data), dtype=np.int64)
+            for i, x in enumerate(v.data):
+                out[i] = parse_time(str(int(x)))
+            return out
+        return v.data.astype(np.int64)
+    if tk == TypeKind.ENUM:
+        el = [e.lower() for e in target.elems]
+        out = np.zeros(len(v.data), dtype=np.int64)
+        if k == TypeKind.STRING:
+            for i, sv in enumerate(v.data):
+                try:
+                    out[i] = el.index(str(sv).lower()) + 1
+                except ValueError:
+                    out[i] = 0  # MySQL non-strict: '' (index 0)
+            return out
+        return v.data.astype(np.int64)  # numeric = index directly
+    if tk == TypeKind.SET:
+        el = [e.lower() for e in target.elems]
+        out = np.zeros(len(v.data), dtype=np.int64)
+        if k == TypeKind.STRING:
+            for i, sv in enumerate(v.data):
+                mask = 0
+                for part in str(sv).split(","):
+                    part = part.strip().lower()
+                    if part and part in el:
+                        mask |= 1 << el.index(part)
+                out[i] = mask
+            return out
+        return v.data.astype(np.int64)  # numeric = bitmask directly
+    if tk == TypeKind.BIT:
+        return v.data.astype(np.int64)
+    if tk == TypeKind.JSON:
+        out = np.empty(len(v.data), dtype=object)
+        if k == TypeKind.STRING:
+            import json as _json
+
+            for i, sv in enumerate(v.data):
+                try:
+                    out[i] = _json.dumps(_json.loads(str(sv)),
+                                         separators=(",", ":"))
+                except (ValueError, TypeError):
+                    # MySQL: invalid text errors; non-strict -> store quoted
+                    out[i] = _json.dumps(str(sv))
+            return out
+        for i, x in enumerate(_str_data(v)):
+            out[i] = x
+        return out
     raise TypeError_(f"unsupported cast {v.ftype} -> {target}")
 
 
@@ -242,27 +420,43 @@ def _arith(op: str):
             if op in ("+", "-"):
                 s = out_t.scale
                 x, y = _to_scaled_int(a, s), _to_scaled_int(b, s)
-                r = x + y if op == "+" else x - y
-                return Vec(out_t, r, valid)
+                r = _add_safe(x, y, sub=(op == "-"))
+                return Vec(out_t, _narrow_if_safe(r), valid)
             if op == "*":
-                # product of scaled ints is naturally at scale sa+sb
+                # product of scaled ints is naturally at scale sa+sb;
+                # escalate to exact Python-int math past int64 range
                 x = _to_scaled_int(a, sa)
                 y = _to_scaled_int(b, sb)
-                r = x * y
+                r = _mul_safe(x, y)
                 drop = sa + sb - out_t.scale
                 if drop > 0:
                     r = decimal_round_half_up(r, drop)
                 elif drop < 0:
-                    r = r * (10 ** (-drop))
-                return Vec(out_t, r, valid)
-            if op in ("/", "%"):
+                    r = _scale_up(r, 10 ** (-drop))
+                return Vec(out_t, _narrow_if_safe(r), valid)
+            if op == "/":
+                # EXACT division: round-half-away-from-zero on the integer
+                # quotient (mydecimal.go DecimalDiv), never through float64
+                x = _to_scaled_int(a, sa)
+                y = _to_scaled_int(b, sb)
+                bad = (y == 0)
+                if bad.dtype == object:
+                    bad = bad.astype(np.bool_)
+                if bad.any():
+                    valid = (valid if valid is not None
+                             else np.ones(n, bool)) & ~bad
+                    y = np.where(bad, 1, y)
+                num = _scale_up(x, 10 ** (out_t.scale - sa + sb))
+                r = _div_round(num, y)
+                return Vec(out_t, _narrow_if_safe(r), valid)
+            if op == "%":
                 x = _to_scaled_int(a, sa).astype(np.float64) / 10.0 ** sa
                 y = _to_scaled_int(b, sb).astype(np.float64) / 10.0 ** sb
                 bad = y == 0.0
                 if bad.any():
                     valid = (valid if valid is not None else np.ones(n, bool)) & ~bad
                     y = np.where(bad, 1.0, y)
-                r = x / y if op == "/" else np.fmod(x, y)
+                r = np.fmod(x, y)
                 return Vec(out_t, np.round(r * 10.0 ** out_t.scale).astype(np.int64), valid)
         # integer domain
         x = a.data.astype(np.int64) if a.ftype.kind != TypeKind.INT else a.data
@@ -404,15 +598,45 @@ def _compare_arrays(a: Vec, b: Vec, op: str) -> np.ndarray:
             a.ftype.scale if a.ftype.kind == TypeKind.DECIMAL else 0,
             b.ftype.scale if b.ftype.kind == TypeKind.DECIMAL else 0,
         )
-        fa = a.ftype.kind in (TypeKind.FLOAT, TypeKind.STRING)
-        fb = b.ftype.kind in (TypeKind.FLOAT, TypeKind.STRING)
-        if fa or fb:
+        if TypeKind.FLOAT in (a.ftype.kind, b.ftype.kind):
             return _CMP_NP[op](_to_float(a), _to_float(b))
-        return _CMP_NP[op](_to_scaled_int(a, s), _to_scaled_int(b, s))
-    if ct.kind in (TypeKind.DATE, TypeKind.DATETIME):
+        if TypeKind.STRING in (a.ftype.kind, b.ftype.kind):
+            # exact: parse the string side as a decimal at a scale wide
+            # enough for its fractional digits (float64 would collapse
+            # distinct wide values onto one double)
+            sv = a if a.ftype.kind == TypeKind.STRING else b
+            frac = 0
+            for x in sv.data:
+                _, _, f = str(x).partition(".")
+                frac = max(frac, len(f.rstrip("0")))
+            s = max(s, min(frac, 30))
+
+            def side(v):
+                if v.ftype.kind != TypeKind.STRING:
+                    return _to_scaled_int(v, s)
+                out = np.empty(len(v.data), dtype=object)
+                for i, x in enumerate(v.data):
+                    try:
+                        out[i] = parse_decimal_exact(str(x), s)
+                    except (ValueError, TypeError):
+                        out[i] = 0
+                return _narrow_if_safe(out)
+
+            r = _CMP_NP[op](side(a), side(b))
+            return np.asarray(r, dtype=np.bool_)
+        r = _CMP_NP[op](_to_scaled_int(a, s), _to_scaled_int(b, s))
+        return np.asarray(r, dtype=np.bool_)  # object inputs -> bool array
+    if ct.kind in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME,
+                   TypeKind.ENUM, TypeKind.SET):
+        # ENUM/SET: string side coerces into the member domain via the
+        # common type's elems; comparisons run on indexes/bitmasks (MySQL
+        # compares enum-vs-literal by member, sorts by index)
         ta = cast_vec(a, ct)
         tb = cast_vec(b, ct)
         return _CMP_NP[op](ta.data, tb.data)
+    if ct.kind == TypeKind.JSON:
+        x, y = _str_data(a), _str_data(b)
+        return np.asarray(_CMP_NP[op](x, y), dtype=np.bool_)
     if ct.kind == TypeKind.FLOAT:
         return _CMP_NP[op](_to_float(a), _to_float(b))
     return _CMP_NP[op](a.data.astype(np.int64), b.data.astype(np.int64))
@@ -1503,3 +1727,303 @@ def _sleep(func, args, n):
     if n:
         time.sleep(float(max(_to_float(args[0]).max(), 0)))
     return Vec(func.ftype, np.zeros(n, dtype=np.int64), None)
+
+
+# ---------------------------------------------------------------------------
+# JSON functions (host oracle path; never device-pushed).
+# Reference: types/json/binary.go:1-618 path extraction semantics +
+# expression/builtin_json_vec.go.  Docs are serialized compact-JSON strings
+# in object arrays (the binary format's role is interchange; columnar object
+# storage already gives O(1) row access, so the byte-level layout is not
+# reproduced).
+# ---------------------------------------------------------------------------
+
+import json as _json
+
+
+_JSON_PATH_RE = re.compile(
+    r"""\.(?:"((?:[^"\\]|\\.)*)"|([A-Za-z_][A-Za-z0-9_]*))|\[(\d+)\]""",
+)
+
+
+def _parse_json_path(path: str):
+    """'$.a.b[2]."c d"' -> ['a', 'b', 2, 'c d'].  None on bad path."""
+    path = path.strip()
+    if not path.startswith("$"):
+        return None
+    segs = []
+    pos = 1
+    while pos < len(path):
+        m = _JSON_PATH_RE.match(path, pos)
+        if m is None:
+            return None
+        if m.group(3) is not None:
+            segs.append(int(m.group(3)))
+        elif m.group(1) is not None:
+            segs.append(m.group(1).replace('\\"', '"'))
+        else:
+            segs.append(m.group(2))
+        pos = m.end()
+    return segs
+
+
+def _json_get(doc, segs):
+    """Walk parsed JSON; _MISSING when the path does not exist."""
+    cur = doc
+    for sg in segs:
+        if isinstance(sg, int):
+            if isinstance(cur, list) and 0 <= sg < len(cur):
+                cur = cur[sg]
+            else:
+                return _MISSING
+        else:
+            if isinstance(cur, dict) and sg in cur:
+                cur = cur[sg]
+            else:
+                return _MISSING
+    return cur
+
+
+_MISSING = object()
+
+
+def _json_docs(v: Vec):
+    """Iterate parsed docs of a JSON/STRING vec.  _MISSING marks NULL rows
+    and unparseable text; a parsed JSON `null` is Python None (distinct)."""
+    valid = v.valid
+    for i, raw in enumerate(v.data):
+        if valid is not None and not valid[i]:
+            yield _MISSING
+            continue
+        try:
+            yield _json.loads(str(raw))
+        except (ValueError, TypeError):
+            yield _MISSING
+
+
+@register("json_extract", lambda t, m: ty_json(True))
+def _json_extract(func, args, n):
+    doc_v, path_v = args[0], args[1]
+    paths = [_parse_json_path(str(p)) for p in path_v.data]
+    out = np.empty(n, dtype=object)
+    valid = np.ones(n, dtype=np.bool_)
+    multi = len(args) > 2
+    extra = [( [_parse_json_path(str(p)) for p in a.data], a) for a in args[2:]]
+    for i, doc in enumerate(_json_docs(doc_v)):
+        out[i] = ""
+        if doc is _MISSING or paths[i] is None:
+            valid[i] = False
+            continue
+        hits = []
+        for segs, _a in [(paths[i], path_v)] + [(e[0][i], e[1]) for e in extra]:
+            if segs is None:
+                continue
+            got = _json_get(doc, segs)
+            if got is not _MISSING:
+                hits.append(got)
+        if not hits:
+            valid[i] = False
+        elif multi:
+            out[i] = _json.dumps(hits, separators=(",", ":"))
+        else:
+            out[i] = _json.dumps(hits[0], separators=(",", ":"))
+    return Vec(func.ftype, out, valid)
+
+
+@register("json_unquote", lambda t, m: ty_string(True))
+def _json_unquote(func, args, n):
+    v = args[0]
+    out = np.empty(n, dtype=object)
+    valid = v.validity().copy()
+    for i, raw in enumerate(v.data):
+        out[i] = ""
+        if not valid[i]:
+            continue
+        sv = str(raw)
+        if sv.startswith('"') and sv.endswith('"') and len(sv) >= 2:
+            try:
+                out[i] = str(_json.loads(sv))
+                continue
+            except ValueError:
+                pass
+        out[i] = sv
+    return Vec(func.ftype, out, valid)
+
+
+@register("json_valid", lambda t, m: ty_bool(True))
+def _json_valid(func, args, n):
+    v = args[0]
+    out = np.zeros(n, dtype=np.int64)
+    for i, doc in enumerate(_json_docs(v)):
+        out[i] = int(doc is not _MISSING)
+    return Vec(func.ftype, out, v.valid)
+
+
+@register("json_type", lambda t, m: ty_string(True))
+def _json_type(func, args, n):
+    v = args[0]
+    out = np.empty(n, dtype=object)
+    valid = v.validity().copy()
+    for i, doc in enumerate(_json_docs(v)):
+        out[i] = ""
+        if not valid[i]:
+            continue
+        if doc is _MISSING:
+            valid[i] = False
+        elif isinstance(doc, bool):
+            out[i] = "BOOLEAN"
+        elif isinstance(doc, dict):
+            out[i] = "OBJECT"
+        elif isinstance(doc, list):
+            out[i] = "ARRAY"
+        elif isinstance(doc, str):
+            out[i] = "STRING"
+        elif isinstance(doc, int):
+            out[i] = "INTEGER"
+        elif isinstance(doc, float):
+            out[i] = "DOUBLE"
+        else:
+            out[i] = "NULL"
+    return Vec(func.ftype, out, valid)
+
+
+@register("json_length", lambda t, m: ty_int(True))
+def _json_length(func, args, n):
+    v = args[0]
+    segs = None
+    if len(args) > 1:
+        segs = [_parse_json_path(str(p)) for p in args[1].data]
+    out = np.zeros(n, dtype=np.int64)
+    valid = v.validity().copy()
+    for i, doc in enumerate(_json_docs(v)):
+        if not valid[i]:
+            continue
+        if doc is _MISSING:
+            valid[i] = False
+            continue
+        if segs is not None:
+            if segs[i] is None:
+                valid[i] = False
+                continue
+            doc = _json_get(doc, segs[i])
+            if doc is _MISSING:
+                valid[i] = False
+                continue
+        if isinstance(doc, dict) or isinstance(doc, list):
+            out[i] = len(doc)
+        else:
+            out[i] = 1
+    return Vec(func.ftype, out, valid)
+
+
+def _json_value_at(va: Vec, i: int):
+    """SQL value -> the JSON value it contributes (decimals unscale,
+    temporal/enum/set render as strings, JSON docs nest parsed)."""
+    if va.valid is not None and not va.valid[i]:
+        return None
+    x = va.data[i]
+    if isinstance(x, np.generic):
+        x = x.item()
+    k = va.ftype.kind
+    if k == TypeKind.JSON:
+        try:
+            return _json.loads(str(x))
+        except ValueError:
+            return str(x)
+    if k == TypeKind.DECIMAL:
+        sc = va.ftype.scale
+        return int(x) if sc == 0 else int(x) / 10 ** sc
+    return x  # temporal/enum/set callers pre-render via _str_data
+
+
+@register("json_object", lambda t, m: ty_json(False))
+def _json_object(func, args, n):
+    out = np.empty(n, dtype=object)
+    keys = [_str_data(a) for a in args[0::2]]
+    vals = [a for a in args[1::2]]
+    val_strs = [_str_data(va) if va.ftype.kind in (
+        TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME, TypeKind.ENUM,
+        TypeKind.SET) else None for va in vals]
+    for i in range(n):
+        obj = {}
+        for j, (k_arr, va) in enumerate(zip(keys, vals)):
+            if val_strs[j] is not None:
+                x = None if (va.valid is not None and not va.valid[i])                     else str(val_strs[j][i])
+            else:
+                x = _json_value_at(va, i)
+            obj[str(k_arr[i])] = x
+        out[i] = _json.dumps(obj, separators=(",", ":"))
+    return Vec(func.ftype, out, None)
+
+
+@register("json_array", lambda t, m: ty_json(False))
+def _json_array(func, args, n):
+    out = np.empty(n, dtype=object)
+    val_strs = [_str_data(va) if va.ftype.kind in (
+        TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME, TypeKind.ENUM,
+        TypeKind.SET) else None for va in args]
+    for i in range(n):
+        arr = []
+        for j, va in enumerate(args):
+            if val_strs[j] is not None:
+                arr.append(None if (va.valid is not None and not va.valid[i])
+                           else str(val_strs[j][i]))
+            else:
+                arr.append(_json_value_at(va, i))
+        out[i] = _json.dumps(arr, separators=(",", ":"))
+    return Vec(func.ftype, out, None)
+
+
+# ---------------------------------------------------------------------------
+# TIME (Duration) functions — types/time.go Duration + builtin_time_vec.go
+# ---------------------------------------------------------------------------
+
+
+@register("sec_to_time", lambda t, m: ty_time(True))
+def _sec_to_time(func, args, n):
+    secs = _to_float(args[0])
+    us = np.round(secs * 1_000_000).astype(np.int64)
+    from ..types.values import MAX_TIME_US
+
+    us = np.clip(us, -MAX_TIME_US, MAX_TIME_US)
+    return Vec(func.ftype, us, args[0].valid)
+
+
+@register("time_to_sec", lambda t, m: ty_int(True))
+def _time_to_sec(func, args, n):
+    v = args[0]
+    if v.ftype.kind == TypeKind.TIME:
+        data = v.data
+    else:
+        data = _cast_data_to(v, ty_time())
+    return Vec(func.ftype, data // 1_000_000, v.valid)
+
+
+@register("maketime", lambda t, m: ty_time(True))
+def _maketime(func, args, n):
+    h = _to_float(args[0]).astype(np.int64)
+    mi = _to_float(args[1]).astype(np.int64)
+    sec = _to_float(args[2])
+    sign = np.where(h < 0, -1, 1)
+    us = sign * ((np.abs(h) * 3600 + mi * 60) * 1_000_000
+                 + np.round(sec * 1_000_000).astype(np.int64))
+    valid = combined_valid(*args)
+    from ..types.values import MAX_TIME_US
+
+    us = np.clip(us, -MAX_TIME_US, MAX_TIME_US)
+    return Vec(func.ftype, us, valid)
+
+
+@register("find_in_set", lambda t, m: ty_int(True))
+def _find_in_set(func, args, n):
+    """FIND_IN_SET(needle, set_string_or_SET_column) -> 1-based position."""
+    needle = _str_data(args[0])
+    hay = _str_data(args[1])
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        parts = str(hay[i]).split(",") if hay[i] else []
+        try:
+            out[i] = parts.index(str(needle[i])) + 1
+        except ValueError:
+            out[i] = 0
+    return Vec(func.ftype, out, combined_valid(*args))

@@ -58,10 +58,14 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
     the block store (equality/IN on them compiles to code comparison).
     """
     if isinstance(e, Constant):
+        if e.ftype.kind == TypeKind.DECIMAL and e.ftype.is_wide_decimal:
+            return False
         return e.ftype.kind in DEVICE_KINDS or e.value is None or isinstance(
             e.value, str
         )
     if isinstance(e, ColumnExpr):
+        if e.ftype.kind == TypeKind.DECIMAL and e.ftype.is_wide_decimal:
+            return False  # object storage: exact host path only
         if e.ftype.kind in DEVICE_KINDS:
             return True
         key = e.unique_id if e.unique_id >= 0 else e.index
@@ -80,8 +84,12 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
                 if len(col_args) != 1 or len(const_args) != len(e.args) - 1:
                     return False
                 c = col_args[0]
+                if c.ftype.kind != TypeKind.STRING:
+                    # ENUM/SET/temporal vs string literal: member/temporal
+                    # coercion is host-side semantics — don't push
+                    return False
                 key = c.unique_id if c.unique_id >= 0 else c.index
-                if c.ftype.kind == TypeKind.STRING and key not in dict_cols:
+                if key not in dict_cols:
                     return False
                 return True
         elif any(a.ftype.kind == TypeKind.STRING for a in e.args):

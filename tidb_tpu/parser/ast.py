@@ -230,6 +230,7 @@ class ColumnDef(Node):
     unique: bool = False
     default: Optional[Expr] = None
     auto_increment: bool = False
+    elems: List[str] = field(default_factory=list)  # ENUM/SET members
 
 
 @dataclass

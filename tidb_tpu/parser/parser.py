@@ -28,6 +28,8 @@ _TYPE_ALIASES = {
     "tinytext": "varchar", "mediumtext": "varchar", "longtext": "varchar",
     "blob": "varchar", "string": "varchar",
     "date": "date", "datetime": "datetime", "timestamp": "datetime",
+    "time": "time", "year": "bigint",
+    "enum": "enum", "set": "set", "bit": "bit", "json": "json",
 }
 
 
@@ -809,12 +811,19 @@ class Parser:
         if tname is None:
             raise ParseError(f"unsupported column type {tname_raw!r}")
         prec = scale = 0
-        if self.accept_op("("):
+        elems: List[str] = []
+        if tname in ("enum", "set"):
+            self.expect_op("(")
+            elems.append(str(self.next().value))
+            while self.accept_op(","):
+                elems.append(str(self.next().value))
+            self.expect_op(")")
+        elif self.accept_op("("):
             prec = int(self.next().value)
             if self.accept_op(","):
                 scale = int(self.next().value)
             self.expect_op(")")
-        col = ast.ColumnDef(name, tname, prec, scale)
+        col = ast.ColumnDef(name, tname, prec, scale, elems=elems)
         # unsigned marker folds into bigint
         while True:
             if self.accept_kw("unsigned", "signed", "zerofill"):

@@ -1109,7 +1109,11 @@ def _eval_const(e: Expression):
         from ..types import TypeKind
 
         if v is not None and e.ftype.kind == TypeKind.DECIMAL:
-            return v / (10 ** e.ftype.scale)
+            from ..types.values import format_decimal
+
+            # exact decimal text (a float here silently drops digits past
+            # 2^53 — the wide-decimal path depends on this staying exact)
+            return format_decimal(int(v), e.ftype.scale)
         return v
     # non-foldable (now(), rand()): evaluate over a 1-row dual
     dual = Chunk([Column.from_values(ty_int(False), [0])])

@@ -104,6 +104,11 @@ def literal_to_constant(v, type_hint: str = "") -> Constant:
     if isinstance(v, bool):
         return Constant(int(v), ty_int(False))
     if isinstance(v, int):
+        if abs(v) >= (1 << 63):
+            # past BIGINT range: exact wide-decimal literal (mydecimal's
+            # 65-digit domain), host-evaluated
+            return Constant(v, ty_decimal(max(len(str(abs(v))), 19), 0,
+                                          False))
         return Constant(v, ty_int(False))
     if isinstance(v, float):
         return Constant(v, ty_float(False))

@@ -37,15 +37,25 @@ from ..planner.rules import optimize_logical
 from ..types import (
     FieldType,
     TypeKind,
+    ty_bit,
     ty_date,
     ty_datetime,
     ty_decimal,
+    ty_enum,
     ty_float,
     ty_int,
+    ty_json,
+    ty_set,
     ty_string,
+    ty_time,
     ty_uint,
 )
-from ..types.values import format_date, format_datetime
+from ..types.values import (
+    format_date,
+    format_datetime,
+    format_decimal,
+    format_time,
+)
 from .domain import Domain
 from .vars import SYSVAR_DEFAULTS, SessionVars
 
@@ -86,6 +96,9 @@ _TYPE_MAP = {
     "date": lambda p, s: ty_date(),
     "datetime": lambda p, s: ty_datetime(),
     "timestamp": lambda p, s: ty_datetime(),
+    "time": lambda p, s: ty_time(),
+    "bit": lambda p, s: ty_bit(p or 1),
+    "json": lambda p, s: ty_json(),
 }
 
 
@@ -675,10 +688,27 @@ class Session:
         raise PlanError(f"ALTER {s.action} not supported")
 
     def _column_info(self, cd: ast.ColumnDef) -> ColumnInfo:
-        mk = _TYPE_MAP.get(cd.type_name.lower())
-        if mk is None:
-            raise PlanError(f"unknown column type {cd.type_name!r}")
-        ft = mk(cd.precision, cd.scale)
+        tn = cd.type_name.lower()
+        if tn == "enum":
+            if not cd.elems:
+                raise PlanError("ENUM requires at least one member")
+            ft = ty_enum(cd.elems)
+        elif tn == "set":
+            if len(cd.elems) > 64:
+                raise PlanError("SET supports at most 64 members")
+            ft = ty_set(cd.elems)
+        else:
+            mk = _TYPE_MAP.get(tn)
+            if mk is None:
+                raise PlanError(f"unknown column type {cd.type_name!r}")
+            ft = mk(cd.precision, cd.scale)
+        from ..types import MAX_DECIMAL_PRECISION
+
+        if ft.kind == TypeKind.DECIMAL and (
+                ft.precision > MAX_DECIMAL_PRECISION
+                or ft.scale > 30 or ft.scale > ft.precision):
+            raise PlanError(
+                f"invalid DECIMAL({ft.precision},{ft.scale})")
         if cd.not_null or cd.primary_key:
             ft = ft.not_null()
         default = None
@@ -774,11 +804,28 @@ def _format_row(row: tuple, fts: List[FieldType]) -> tuple:
         if v is None:
             out.append(None)
         elif ft.kind == TypeKind.DECIMAL:
-            out.append(v / (10 ** ft.scale) if ft.scale else int(v))
+            iv = int(v)
+            if abs(iv) <= (1 << 53):
+                # exactly float-representable: keep the numeric result shape
+                out.append(iv / (10 ** ft.scale) if ft.scale else iv)
+            else:
+                # past 2^53 a float silently drops digits — exact string
+                out.append(format_decimal(iv, ft.scale))
         elif ft.kind == TypeKind.DATE:
             out.append(format_date(v))
         elif ft.kind == TypeKind.DATETIME:
             out.append(format_datetime(v))
+        elif ft.kind == TypeKind.TIME:
+            out.append(format_time(int(v)))
+        elif ft.kind == TypeKind.ENUM:
+            i = int(v)
+            out.append(ft.elems[i - 1] if 1 <= i <= len(ft.elems) else "")
+        elif ft.kind == TypeKind.SET:
+            i = int(v)
+            out.append(",".join(e for j, e in enumerate(ft.elems)
+                                if i >> j & 1))
+        elif ft.kind == TypeKind.JSON:
+            out.append(str(v))
         elif isinstance(v, np.generic):
             out.append(v.item())
         else:

@@ -51,7 +51,12 @@ class TablePersister:
             blocks = store._blocks[ci]
             valids = store._valids[ci]
             if blocks:
-                arrays[f"d{ci}"] = np.concatenate(blocks)
+                cat = np.concatenate(blocks)
+                if cat.dtype == object:
+                    # JSON / wide-decimal columns: pickle-free persistence
+                    # as unicode (wide decimals as digit strings)
+                    cat = np.array([str(x) for x in cat])
+                arrays[f"d{ci}"] = cat
             else:
                 arrays[f"d{ci}"] = np.zeros(0, dtype=np.int64)
             vparts = [
@@ -160,6 +165,13 @@ class TablePersister:
             for ci, colmeta in enumerate(store.cols):
                 data = z[f"d{ci}"]
                 valid = z[f"v{ci}"]
+                if (colmeta.ftype.np_dtype == object
+                        and data.dtype.kind == "U"):
+                    wide_dec = colmeta.ftype.kind == TypeKind.DECIMAL
+                    obj = np.empty(len(data), dtype=object)
+                    for i, txt in enumerate(data):
+                        obj[i] = int(txt) if wide_dec else str(txt)
+                    data = obj
                 store._blocks[ci] = []
                 store._valids[ci] = []
                 if len(data):

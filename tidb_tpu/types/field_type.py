@@ -33,6 +33,13 @@ class TypeKind(enum.IntEnum):
     DATE = 6
     DATETIME = 7
     BOOL = 8
+    # round-4 surface types (reference: types/time.go Duration, ENUM/SET in
+    # types/etc.go, BIT in types/binary_literal.go, JSON in types/json/)
+    TIME = 9      # int64 signed microseconds (MySQL TIME, range +-838:59:59)
+    ENUM = 10     # int64 1-based member index (FieldType.elems holds members)
+    SET = 11      # int64 bitmask over FieldType.elems (max 64 members)
+    BIT = 12      # int64 holding up to 64 bits
+    JSON = 13     # host object array of compact-serialized JSON strings
 
     @property
     def is_numeric(self) -> bool:
@@ -42,11 +49,12 @@ class TypeKind(enum.IntEnum):
             TypeKind.FLOAT,
             TypeKind.DECIMAL,
             TypeKind.BOOL,
+            TypeKind.BIT,
         )
 
     @property
     def is_temporal(self) -> bool:
-        return self in (TypeKind.DATE, TypeKind.DATETIME)
+        return self in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIME)
 
 
 # numpy physical dtype per kind (host representation).
@@ -60,7 +68,19 @@ _NP_DTYPE = {
     TypeKind.DATE: np.int32,
     TypeKind.DATETIME: np.int64,
     TypeKind.BOOL: np.int64,
+    TypeKind.TIME: np.int64,
+    TypeKind.ENUM: np.int64,
+    TypeKind.SET: np.int64,
+    TypeKind.BIT: np.int64,
+    TypeKind.JSON: object,
 }
+
+# widest decimal precision whose scaled value always fits int64 (2^63 ~
+# 9.2e18): the device fast path.  Past this the host computes with exact
+# Python ints in object arrays (mydecimal.go's 65-digit range, minus the
+# 9-digit-limb machinery XLA has no use for).
+DECIMAL_INT64_DIGITS = 18
+MAX_DECIMAL_PRECISION = 65  # types/mydecimal.go notDefinedPrecision bound
 
 
 @dataclass(frozen=True)
@@ -68,13 +88,23 @@ class FieldType:
     kind: TypeKind
     nullable: bool = True
     # decimal: precision/scale.  scale is also used by DATETIME for fsp (unused
-    # in arithmetic; micros are always stored).
+    # in arithmetic; micros are always stored) and by BIT for declared width.
     precision: int = 0
     scale: int = 0
+    # ENUM/SET member names, in definition order (1-based index / bit order)
+    elems: tuple = ()
 
     @property
     def np_dtype(self):
+        if self.kind == TypeKind.DECIMAL and self.is_wide_decimal:
+            return object
         return _NP_DTYPE[self.kind]
+
+    @property
+    def is_wide_decimal(self) -> bool:
+        """True when scaled values may exceed int64 — exact host path."""
+        return (self.kind == TypeKind.DECIMAL
+                and self.precision > DECIMAL_INT64_DIGITS)
 
     @property
     def is_numeric(self) -> bool:
@@ -94,6 +124,12 @@ class FieldType:
         k = self.kind
         if k == TypeKind.DECIMAL:
             return f"DECIMAL({self.precision},{self.scale})"
+        if k == TypeKind.ENUM:
+            return "ENUM(" + ",".join(f"'{e}'" for e in self.elems) + ")"
+        if k == TypeKind.SET:
+            return "SET(" + ",".join(f"'{e}'" for e in self.elems) + ")"
+        if k == TypeKind.BIT:
+            return f"BIT({self.precision or 1})"
         return {
             TypeKind.NULLTYPE: "NULL",
             TypeKind.INT: "BIGINT",
@@ -103,6 +139,8 @@ class FieldType:
             TypeKind.DATE: "DATE",
             TypeKind.DATETIME: "DATETIME",
             TypeKind.BOOL: "TINYINT",
+            TypeKind.TIME: "TIME",
+            TypeKind.JSON: "JSON",
         }[k]
 
     def __repr__(self):  # compact for plan dumps
@@ -148,6 +186,26 @@ def ty_datetime(nullable: bool = True) -> FieldType:
     return FieldType(TypeKind.DATETIME, nullable)
 
 
+def ty_time(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.TIME, nullable)
+
+
+def ty_enum(elems, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.ENUM, nullable, elems=tuple(elems))
+
+
+def ty_set(elems, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.SET, nullable, elems=tuple(elems))
+
+
+def ty_bit(width: int = 1, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.BIT, nullable, precision=width)
+
+
+def ty_json(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.JSON, nullable)
+
+
 def merge_types(a: FieldType, b: FieldType) -> FieldType:
     """Result type when values of both types flow into one column (UNION /
     CASE / COALESCE).  MySQL-ish widening lattice."""
@@ -160,8 +218,16 @@ def merge_types(a: FieldType, b: FieldType) -> FieldType:
         if a.kind == TypeKind.DECIMAL:
             scale = max(a.scale, b.scale)
             prec = max(a.precision - a.scale, b.precision - b.scale) + scale
-            return ty_decimal(min(prec, 38), scale, nullable)
+            return ty_decimal(min(prec, MAX_DECIMAL_PRECISION), scale,
+                              nullable)
+        if a.kind in (TypeKind.ENUM, TypeKind.SET) and a.elems != b.elems:
+            return ty_string(nullable)  # different member sets: text
         return a.with_nullable(nullable)
+    # ENUM/SET/JSON mixed with anything else merge as text (MySQL casts
+    # the member name / JSON text, never the index/bitmask)
+    if TypeKind.ENUM in (a.kind, b.kind) or TypeKind.SET in (a.kind, b.kind) \
+            or TypeKind.JSON in (a.kind, b.kind):
+        return ty_string(nullable)
     ka, kb = a.kind, b.kind
     ints = (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL)
     if ka in ints and kb in ints:
@@ -220,10 +286,25 @@ def common_compare_type(a: FieldType, b: FieldType) -> FieldType:
         return b
     if kb == TypeKind.NULLTYPE:
         return a
+    # ENUM/SET against a string literal compare in the member domain (the
+    # constant is translated to an index/bitmask at build time)
+    if ka in (TypeKind.ENUM, TypeKind.SET) and kb == TypeKind.STRING:
+        return a
+    if kb in (TypeKind.ENUM, TypeKind.SET) and ka == TypeKind.STRING:
+        return b
+    if TypeKind.JSON in (ka, kb):
+        return ty_string()
     if ka.is_temporal and kb == TypeKind.STRING:
         return a
     if kb.is_temporal and ka == TypeKind.STRING:
         return b
+    # DECIMAL vs string literal: compare in the decimal domain (exact —
+    # a float64 detour collapses distinct wide values; see
+    # builtins._compare_arrays' exact string-side parse)
+    if ka == TypeKind.DECIMAL and kb == TypeKind.STRING:
+        return a.with_nullable(True)
+    if kb == TypeKind.DECIMAL and ka == TypeKind.STRING:
+        return b.with_nullable(True)
     if ka == TypeKind.STRING and kb == TypeKind.STRING:
         return ty_string()
     return common_arith_type(a, b)

@@ -83,16 +83,108 @@ def decimal_round_half_up(x: np.ndarray | int, ndigits_drop: int):
     e.g. value 12345 at scale 3 -> scale 1: decimal_round_half_up(12345, 2)
     == 123 (12.345 -> 12.3); 12355 -> 124 (12.355 -> 12.4 -> wait: 12.36?).
     Half-up on the dropped part: sign(x) * ((|x| + 5*10^(d-1)) // 10^d).
+    Works on int64 AND object (exact Python int) arrays — np.sign has no
+    object loop, so the sign comes from comparisons there.
     """
     if ndigits_drop <= 0:
         return x
     p = 10 ** ndigits_drop
     half = p // 2
     if isinstance(x, np.ndarray):
+        if x.dtype == object:
+            neg = np.array([v < 0 for v in x], dtype=np.bool_)
+            mag = np.array([(abs(int(v)) + half) // p for v in x],
+                           dtype=object)
+            return np.where(neg, -mag, mag)
         sign = np.sign(x)
         return sign * ((np.abs(x) + half) // p)
     sign = -1 if x < 0 else 1
     return sign * ((abs(x) + half) // p)
+
+
+def parse_decimal_exact(s: str, scale: int) -> int:
+    """Decimal literal -> exact scaled Python int at `scale` (no float
+    round-trip — mydecimal.go FromString's exactness contract), MySQL
+    half-away-from-zero rounding of excess fractional digits."""
+    s = str(s).strip()
+    neg = s.startswith("-")
+    if s and s[0] in "+-":
+        s = s[1:]
+    if "e" in s or "E" in s:
+        # scientific notation: exact via Decimal-free integer math
+        mant, _, exp = s.replace("E", "e").partition("e")
+        exp = int(exp or 0)
+        intp, _, frac = mant.partition(".")
+        digits = (intp + frac) or "0"
+        eff_scale = len(frac) - exp
+        v = int(digits or "0")
+    else:
+        intp, _, frac = s.partition(".")
+        v = int((intp or "0") + frac or "0")
+        eff_scale = len(frac)
+    if eff_scale < scale:
+        v *= 10 ** (scale - eff_scale)
+    elif eff_scale > scale:
+        v = decimal_round_half_up(v, eff_scale - scale)
+    return -v if neg else v
+
+
+def format_decimal(v: int, scale: int) -> str:
+    """Scaled int -> MySQL decimal string ('-12.30' keeps trailing zeros)."""
+    v = int(v)
+    sign = "-" if v < 0 else ""
+    a = abs(v)
+    if scale <= 0:
+        return f"{sign}{a}"
+    return f"{sign}{a // 10**scale}.{a % 10**scale:0{scale}d}"
+
+
+# ---------------------------------------------------------------------------
+# TIME (MySQL Duration): int64 signed microseconds, range +-838:59:59
+# ---------------------------------------------------------------------------
+
+MAX_TIME_US = (838 * 3600 + 59 * 60 + 59) * 1_000_000
+
+
+def parse_time(s: str) -> int:
+    """'[-]HH:MM:SS[.frac]' / '[-]HHMMSS' / '[-]D HH:MM:SS' -> signed us,
+    clamped to the MySQL TIME range (types/time.go Duration parsing)."""
+    s = str(s).strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    days = 0
+    if " " in s:
+        d, s = s.split(" ", 1)
+        days = int(d)
+    if ":" in s:
+        parts = s.split(":")
+        h = int(parts[0]) if parts[0] else 0
+        mi = int(parts[1]) if len(parts) > 1 and parts[1] else 0
+        sec = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+    else:
+        # compact HHMMSS (MySQL numeric time)
+        body, _, frac = s.partition(".")
+        x = int(body or "0")
+        h, mi, sec = x // 10000, (x // 100) % 100, float(x % 100)
+        if frac:
+            sec += float("0." + frac)
+    us = ((days * 24 + h) * 3600 + mi * 60) * 1_000_000 + int(
+        round(sec * 1_000_000))
+    us = min(us, MAX_TIME_US)
+    return -us if neg else us
+
+
+def format_time(us: int) -> str:
+    us = int(us)
+    sign = "-" if us < 0 else ""
+    a = abs(us)
+    h, rem = divmod(a, 3_600_000_000)
+    mi, rem = divmod(rem, 60_000_000)
+    sec, frac = divmod(rem, 1_000_000)
+    if frac:
+        return f"{sign}{h:02d}:{mi:02d}:{sec:02d}.{frac:06d}"
+    return f"{sign}{h:02d}:{mi:02d}:{sec:02d}"
 
 
 def scale_factor(scale: int) -> int:
