@@ -1224,6 +1224,8 @@ class Parser:
             stmt.kind = "charset"
         elif self.accept_kw("grants"):
             stmt.kind = "grants"
+            if self.accept_kw("for"):
+                stmt.target = self._parse_user_name()
         elif self.accept_kw("stats_meta"):
             stmt.kind = "stats_meta"
         elif self.accept_kw("stats_histograms"):
@@ -1357,11 +1359,23 @@ class Parser:
             num = int(self.next().value)
         return ast.SplitRegionStmt(table, num)
 
+    def _parse_priv_name(self) -> str:
+        p = self.ident().lower()
+        if p == "all" and self.accept_kw("privileges"):
+            return "all"
+        if p == "create" and self.accept_kw("user"):
+            return "create user"
+        if p == "create" and self.accept_kw("view"):
+            return "create view"
+        if p == "grant" and self.accept_kw("option"):
+            return "grant option"
+        return p
+
     def _parse_grant(self) -> ast.GrantStmt:
         self.expect_kw("grant")
-        privs = [self.ident().lower()]
+        privs = [self._parse_priv_name()]
         while self.accept_op(","):
-            privs.append(self.ident().lower())
+            privs.append(self._parse_priv_name())
         self.expect_kw("on")
         level = ""
         while not self.at_kw("to"):
@@ -1371,9 +1385,9 @@ class Parser:
 
     def _parse_revoke(self) -> ast.RevokeStmt:
         self.expect_kw("revoke")
-        privs = [self.ident().lower()]
+        privs = [self._parse_priv_name()]
         while self.accept_op(","):
-            privs.append(self.ident().lower())
+            privs.append(self._parse_priv_name())
         self.expect_kw("on")
         level = ""
         while not self.at_kw("from"):

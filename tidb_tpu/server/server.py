@@ -67,12 +67,21 @@ class MySQLServer:
             await pw.send(P.handshake_v10(sess.conn_id, salt))
             resp = await pr.recv()
             hs = P.parse_handshake_response(resp)
+            pw.seq = pr.seq
+            # mysql_native_password verification against the grant tables
+            # (server/conn.go openSessionAndDoAuth analog)
+            if not self.domain.priv.auth(hs["user"], hs["auth"], salt):
+                await pw.send(P.err_packet(
+                    1045,
+                    f"Access denied for user '{hs['user']}'",
+                    "28000"))
+                return
+            sess.user = f"{hs['user']}@%"
             if hs["db"]:
                 try:
                     sess.execute(f"use {hs['db']}")
                 except TiDBTPUError:
                     pass
-            pw.seq = pr.seq
             await pw.send(P.ok_packet())
 
             while True:
@@ -149,7 +158,8 @@ class MySQLServer:
                 self.pool, lambda: sess.execute(sql, params)
             )
         except TiDBTPUError as e:
-            await pw.send(P.err_packet(1105, str(e)))
+            # typed errors carry their MySQL code (errors.py hierarchy)
+            await pw.send(P.err_packet(getattr(e, "code", 1105), str(e)))
             return
         except Exception as e:  # pragma: no cover - defensive
             await pw.send(P.err_packet(1105, f"internal error: {e}"))

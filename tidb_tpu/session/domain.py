@@ -30,6 +30,9 @@ class Domain:
         self.storage = storage or BlockStorage(data_dir=data_dir)
         self.catalog = Catalog(self.storage)
         self.stats = StatsHandle(self.storage)
+        from .priv import PrivManager
+
+        self.priv = PrivManager(data_dir)
         self.catalog.on_table_dropped = self.stats.drop
         self.global_vars: Dict[str, str] = {}
         self._mu = threading.RLock()

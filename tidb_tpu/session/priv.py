@@ -1,47 +1,395 @@
-"""Privilege statements (minimal RBAC surface).
+"""Privileges: user registry, grant tables, authentication, and the
+plan-time privilege check.
 
-Reference: privilege/privileges (MySQL-compatible priv tables cached in
-Handle, cache.go:1037) and executor/grant.go / revoke.go / simple.go user
-management.  Round-1 scope: user registry + global grants recorded on the
-domain; enforcement hooks come with the server layer.
+Reference: privilege/privileges/cache.go:1037 (MySQLPrivilege request
+check over cached user/db/table_priv rows), planner/optimize.go:128-131
+(CheckPrivilege on the visitInfo list before planning), server/conn.go
+(mysql_native_password handshake), executor/grant.go / revoke.go /
+simple.go (user management).
+
+Shape here: one PrivManager on the Domain holding
+``user@host -> {password_stage2, global privs, per-db privs, per-table
+privs}``; sessions carry ``session.user`` and every statement passes
+through :func:`check_stmt` before dispatch — the optimize.go choke point.
+In-process sessions default to root (trusted), the wire server
+authenticates and sets the real user.
 """
 
 from __future__ import annotations
 
-from ..errors import KVError
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..errors import KVError, PrivilegeError
 from ..parser import ast
 
+# statement privilege names (mysql.user column surface subset)
+DML_PRIVS = {"select", "insert", "update", "delete"}
+DDL_PRIVS = {"create", "drop", "alter", "index", "create view"}
+ADMIN_PRIVS = {"create user", "super", "process", "grant option"}
+KNOWN_PRIVS = DML_PRIVS | DDL_PRIVS | ADMIN_PRIVS | {"all"}
 
-def _users(domain) -> dict:
-    if not hasattr(domain, "users"):
-        domain.users = {"root@%": {"password": "", "privs": {"ALL"}}}
-    return domain.users
+
+def _norm_user(u: str) -> str:
+    return u if "@" in u else f"{u}@%"
+
+
+def _stage2(password: str) -> str:
+    """mysql_native_password stored hash: SHA1(SHA1(password)), hex."""
+    if not password:
+        return ""
+    return hashlib.sha1(
+        hashlib.sha1(password.encode()).digest()).hexdigest()
+
+
+class PrivManager:
+    def __init__(self, data_dir: Optional[str] = None):
+        self.data_dir = data_dir
+        self._mu = threading.RLock()  # server pool runs GRANTs concurrently
+        self.users: Dict[str, dict] = {}
+        if data_dir is not None:
+            self._load()
+        if "root@%" not in self.users:
+            self.users["root@%"] = self._new_user("")
+            self.users["root@%"]["global"].add("all")
+
+    @staticmethod
+    def _new_user(password: str) -> dict:
+        return {"password": _stage2(password), "global": set(),
+                "dbs": {}, "tables": {}}
+
+    # ---- persistence (mysql.* system tables analog) -------------------
+    def _path(self) -> Optional[str]:
+        if self.data_dir is None:
+            return None
+        return os.path.join(self.data_dir, "users.json")
+
+    def _save(self):
+        p = self._path()
+        if p is None:
+            return
+        blob = {}
+        for k, u in self.users.items():
+            blob[k] = {
+                "password": u["password"],
+                "global": sorted(u["global"]),
+                "dbs": {d: sorted(v) for d, v in u["dbs"].items()},
+                "tables": {f"{d} {t}": sorted(v)
+                           for (d, t), v in u["tables"].items()},
+            }
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+        os.replace(tmp, p)
+
+    def _load(self):
+        p = self._path()
+        if p is None or not os.path.exists(p):
+            return
+        with open(p) as f:
+            blob = json.load(f)
+        for k, u in blob.items():
+            self.users[k] = {
+                "password": u["password"],
+                "global": set(u["global"]),
+                "dbs": {d: set(v) for d, v in u["dbs"].items()},
+                "tables": {tuple(key.split(" ", 1)): set(v)
+                           for key, v in u["tables"].items()},
+            }
+
+    # ---- user management ----------------------------------------------
+    def create_user(self, user: str, password: str, if_not_exists: bool):
+        key = _norm_user(user)
+        with self._mu:
+            return self._create_user_locked(key, user, password,
+                                            if_not_exists)
+
+    def _create_user_locked(self, key, user, password, if_not_exists):
+        if key in self.users:
+            if if_not_exists:
+                return
+            raise KVError(f"user {user!r} exists")
+        self.users[key] = self._new_user(password)
+        self._save()
+
+    def drop_user(self, user: str, if_exists: bool):
+        key = _norm_user(user)
+        with self._mu:
+            if key not in self.users and not if_exists:
+                raise KVError(f"user {user!r} does not exist")
+            self.users.pop(key, None)
+            self._save()
+
+    def set_password(self, user: str, password: str):
+        key = _norm_user(user)
+        with self._mu:
+            u = self.users.get(key)
+            if u is None:
+                raise KVError(f"user {user!r} does not exist")
+            u["password"] = _stage2(password)
+            self._save()
+
+    def grant(self, user: str, privs: List[str], level: str):
+        key = _norm_user(user)
+        with self._mu:
+            u = self.users.get(key)
+            if u is None:
+                # NO_AUTO_CREATE_USER (MySQL 5.7+): a typo'd grantee must
+                # not become a password-less login
+                raise KVError(
+                    f"user {user!r} does not exist (create it first)")
+            privset = {p.lower() for p in privs}
+            bad = privset - KNOWN_PRIVS
+            if bad:
+                raise KVError(f"unknown privilege(s) {sorted(bad)}")
+            db, table = _parse_level(level)
+            if db is None:
+                u["global"] |= privset
+            elif table is None:
+                u["dbs"].setdefault(db, set()).update(privset)
+            else:
+                u["tables"].setdefault((db, table), set()).update(privset)
+            self._save()
+
+    def revoke(self, user: str, privs: List[str], level: str):
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            if u is None:
+                return
+            privset = {p.lower() for p in privs}
+            db, table = _parse_level(level)
+            if db is None:
+                tgt = u["global"]
+            elif table is None:
+                tgt = u["dbs"].get(db)
+            else:
+                tgt = u["tables"].get((db, table))
+            if tgt is not None:
+                _revoke_from(tgt, privset)
+            self._save()
+
+    # ---- checks --------------------------------------------------------
+    def auth(self, user: str, token: bytes, salt: bytes) -> bool:
+        """mysql_native_password: token = SHA1(pw) XOR
+        SHA1(salt + SHA1(SHA1(pw))); verify against the stored stage2."""
+        u = self.users.get(_norm_user(user))
+        if u is None:
+            return False
+        stored = u["password"]
+        if not stored:
+            return len(token) == 0
+        if len(token) != 20:
+            return False
+        stage2 = bytes.fromhex(stored)
+        mix = hashlib.sha1(salt + stage2).digest()
+        stage1 = bytes(a ^ b for a, b in zip(token, mix))
+        return hashlib.sha1(stage1).digest() == stage2
+
+    def check(self, user: str, priv: str, db: Optional[str] = None,
+              table: Optional[str] = None) -> bool:
+        u = self.users.get(_norm_user(user))
+        if u is None:
+            return False
+        priv = priv.lower()
+        g = u["global"]
+        if "all" in g or priv in g:
+            return True
+        if db is not None:
+            dbl = db.lower()
+            dp = u["dbs"].get(dbl, ())
+            if "all" in dp or priv in dp:
+                return True
+            if table is not None:
+                tp = u["tables"].get((dbl, table.lower()), ())
+                if "all" in tp or priv in tp:
+                    return True
+        return False
+
+    def require(self, user: str, priv: str, db: Optional[str] = None,
+                table: Optional[str] = None):
+        if not self.check(user, priv, db, table):
+            target = f"{db}.{table}" if table else (db or "*")
+            raise PrivilegeError(priv.upper(), user, target)
+
+    def show_grants(self, user: str) -> List[str]:
+        key = _norm_user(user)
+        with self._mu:
+            return self._show_grants_locked(key, user)
+
+    def _show_grants_locked(self, key, user) -> List[str]:
+        u = self.users.get(key)
+        if u is None:
+            raise KVError(f"user {user!r} does not exist")
+        name, host = key.rsplit("@", 1)
+        ident = f"'{name}'@'{host}'"
+        out = []
+        g = u["global"]
+        if g:
+            out.append(f"GRANT {_fmt(g)} ON *.* TO {ident}")
+        else:
+            out.append(f"GRANT USAGE ON *.* TO {ident}")
+        for db in sorted(u["dbs"]):
+            if u["dbs"][db]:
+                out.append(f"GRANT {_fmt(u['dbs'][db])} ON `{db}`.* "
+                           f"TO {ident}")
+        for (db, t) in sorted(u["tables"]):
+            privs = u["tables"][(db, t)]
+            if privs:
+                out.append(f"GRANT {_fmt(privs)} ON `{db}`.`{t}` "
+                           f"TO {ident}")
+        return out
+
+
+def _fmt(privs: Set[str]) -> str:
+    if "all" in privs:
+        return "ALL PRIVILEGES"
+    return ", ".join(p.upper() for p in sorted(privs))
+
+
+def _revoke_from(held: Set[str], revoked: Set[str]):
+    """MySQL revoke semantics at one grant level: REVOKE ALL empties the
+    level; revoking a specific privilege from a holder of ALL first expands
+    ALL into its constituent privileges (grant.go/revoke.go behavior)."""
+    if "all" in revoked:
+        held.clear()
+        return
+    if "all" in held:
+        held.discard("all")
+        held.update(KNOWN_PRIVS - {"all"})
+    held -= revoked
+
+
+def _parse_level(level: str) -> Tuple[Optional[str], Optional[str]]:
+    """'*.*' -> (None, None); 'db.*' -> (db, None); 'db.t' -> (db, t)."""
+    level = (level or "*.*").strip()
+    if level in ("*.*", "*", ""):
+        return None, None
+    if "." in level:
+        db, t = level.split(".", 1)
+        db = db.strip("`").lower()
+        t = t.strip("`").lower()
+        return (db, None) if t == "*" else (db, t)
+    return level.strip("`").lower(), None
+
+
+# ---------------------------------------------------------------------------
+# plan-time statement check (planner/optimize.go:128-131 analog)
+# ---------------------------------------------------------------------------
+
+
+def _walk_tables(node, out: List[ast.TableName]):
+    """Generic AST walk collecting every referenced TableName (covers
+    subqueries/joins/unions via dataclass-field recursion)."""
+    if isinstance(node, ast.TableName):
+        out.append(node)
+        return
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            _walk_tables(x, out)
+        return
+    if isinstance(node, ast.Node):
+        for f in getattr(node, "__dataclass_fields__", {}):
+            _walk_tables(getattr(node, f), out)
+
+
+def check_stmt(session, s) -> None:
+    """Raise PrivilegeError unless session.user may run statement `s`.
+    root (ALL at global scope) short-circuits — the common in-process
+    path costs one dict lookup."""
+    pm = session.domain.priv
+    user = session.user
+    u = pm.users.get(_norm_user(user))
+    if u is not None and "all" in u["global"]:
+        return
+    def db_of(tn: ast.TableName) -> str:
+        return (tn.db or session.current_db).lower()
+
+    def tables_of(node) -> List[ast.TableName]:
+        out: List[ast.TableName] = []
+        _walk_tables(node, out)
+        return out
+
+    if isinstance(s, (ast.SelectStmt, ast.UnionStmt, ast.ExplainStmt,
+                      ast.TraceStmt)):
+        for tn in tables_of(s):
+            pm.require(user, "select", db_of(tn), tn.name.lower())
+        return
+    if isinstance(s, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
+                      ast.LoadDataStmt)):
+        need = {ast.InsertStmt: "insert", ast.UpdateStmt: "update",
+                ast.DeleteStmt: "delete", ast.LoadDataStmt: "insert"}[
+                    type(s)]
+        target = s.table
+        pm.require(user, need, db_of(target), target.name.lower())
+        for tn in tables_of(s):
+            if tn is target:
+                continue
+            pm.require(user, "select", db_of(tn), tn.name.lower())
+        return
+    if isinstance(s, ast.CreateTableStmt):
+        pm.require(user, "create", db_of(s.table))
+        return
+    if isinstance(s, ast.CreateViewStmt):
+        pm.require(user, "create view", db_of(s.name))
+        return
+    if isinstance(s, (ast.DropTableStmt, ast.TruncateTableStmt)):
+        tns = s.tables if isinstance(s, ast.DropTableStmt) else [s.table]
+        for tn in tns:
+            pm.require(user, "drop", db_of(tn))
+        return
+    if isinstance(s, (ast.AlterTableStmt, ast.RenameTableStmt)):
+        tn = s.table if isinstance(s, ast.AlterTableStmt) else s.old
+        pm.require(user, "alter", db_of(tn))
+        return
+    if isinstance(s, (ast.CreateIndexStmt, ast.DropIndexStmt)):
+        pm.require(user, "index", db_of(s.table))
+        return
+    if isinstance(s, ast.CreateDatabaseStmt):
+        pm.require(user, "create", s.name.lower())
+        return
+    if isinstance(s, ast.DropDatabaseStmt):
+        pm.require(user, "drop", s.name.lower())
+        return
+    if isinstance(s, (ast.CreateUserStmt, ast.DropUserStmt,
+                      ast.SetPasswordStmt)):
+        pm.require(user, "create user")
+        return
+    if isinstance(s, (ast.GrantStmt, ast.RevokeStmt)):
+        # MySQL: granting needs GRANT OPTION (plus the privileges held);
+        # the admin CREATE USER privilege also suffices here
+        if not (pm.check(user, "grant option")
+                or pm.check(user, "create user")):
+            pm.require(user, "grant option")
+        return
+    if isinstance(s, (ast.KillStmt, ast.AdminStmt, ast.SplitRegionStmt)):
+        pm.require(user, "super")
+        return
+    if isinstance(s, ast.ShowStmt) and s.kind == "grants" and s.target:
+        from .session import Session  # typing only; avoid cycle at import
+
+        if _norm_user(s.target) != _norm_user(user):
+            pm.require(user, "create user")  # enumerate others: admin-only
+        return
+    # SET / SHOW / USE / txn control / PREPARE-EXECUTE: unrestricted
+    # (EXECUTE re-enters check_stmt with the underlying statement)
 
 
 def handle(session, s):
-    users = _users(session.domain)
+    """Execute a privilege statement (already authorized by check_stmt)."""
+    pm = session.domain.priv
     if isinstance(s, ast.CreateUserStmt):
-        key = s.user
-        if key in users and not s.if_not_exists:
-            raise KVError(f"user {s.user!r} exists")
-        users.setdefault(key, {"password": s.password, "privs": set()})
+        pm.create_user(s.user, s.password, s.if_not_exists)
     elif isinstance(s, ast.DropUserStmt):
-        if s.user not in users and not s.if_exists:
-            raise KVError(f"user {s.user!r} does not exist")
-        users.pop(s.user, None)
+        pm.drop_user(s.user, s.if_exists)
     elif isinstance(s, ast.SetPasswordStmt):
-        u = users.get(s.user)
-        if u is None:
-            raise KVError(f"user {s.user!r} does not exist")
-        u["password"] = s.password
+        pm.set_password(s.user, s.password)
     elif isinstance(s, ast.GrantStmt):
-        u = users.setdefault(s.user, {"password": "", "privs": set()})
-        u["privs"].update(p.upper() for p in s.privs)
+        pm.grant(s.user, s.privs, s.level)
     elif isinstance(s, ast.RevokeStmt):
-        u = users.get(s.user)
-        if u is not None:
-            for p in s.privs:
-                u["privs"].discard(p.upper())
+        pm.revoke(s.user, s.privs, s.level)
     elif isinstance(s, ast.FlushStmt):
         pass
     from .session import ResultSet

@@ -108,6 +108,9 @@ class Session:
         self.conn_id = conn_id
         self.vars = SessionVars(domain.global_vars)
         self.current_db = "test"
+        # authenticated identity; in-process sessions are trusted as root,
+        # the wire server overwrites this after the auth handshake
+        self.user = "root@%"
         self._txn = None  # explicit txn (BEGIN..COMMIT)
         self._in_txn = False
         self._killed = False
@@ -196,6 +199,9 @@ class Session:
     def _execute_stmt(self, stmt: ast.Stmt, params=None) -> ResultSet:
         self._warnings = []
         s = stmt
+        from . import priv as _priv
+
+        _priv.check_stmt(self, s)  # optimize.go:128-131 choke point
         if isinstance(s, (ast.SelectStmt, ast.UnionStmt)):
             return self._run_query(s, params)
         if isinstance(s, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
@@ -498,6 +504,13 @@ class Session:
             return ResultSet(
                 ["Table", "Non_unique", "Key_name", "Seq_in_index",
                  "Column_name"], rows, is_query=True)
+        if kind == "grants":
+            user = s.target or self.user
+            rows = [(g,) for g in self.domain.priv.show_grants(user)]
+            from .priv import _norm_user
+
+            return ResultSet([f"Grants for {_norm_user(user)}"], rows,
+                             is_query=True)
         if kind == "variables":
             allv = self.vars.all_vars()
             names = like_filter(sorted(allv))
