@@ -1,0 +1,242 @@
+"""Pessimistic SQL surface: SELECT ... FOR UPDATE row locks, blocking lock
+waits, wait-for-graph deadlock detection with requester-as-victim, REPLACE.
+
+Reference: executor/adapter.go:338-372 (SelectLockExec wiring),
+store/tikv/2pc.go:668 (pessimistic lock_keys), util/deadlock/deadlock.go:
+22-130 (Detect: the requesting txn whose edge closes a cycle aborts)."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import DeadlockError, LockWaitTimeoutError
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def d():
+    dom = Domain()
+    s = dom.new_session()
+    s.execute("create table acc (id bigint primary key, bal bigint)")
+    s.execute("insert into acc values (1, 100), (2, 200), (3, 300)")
+    return dom
+
+
+def test_for_update_takes_row_locks(d):
+    a = d.new_session()
+    a.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    t = d.catalog.info_schema().table("test", "acc")
+    store = d.storage.table(t.id)
+    assert len(store.locks) == 1  # exactly the matched row
+    a.execute("rollback")
+    assert len(store.locks) == 0
+
+
+def test_for_update_outside_txn_is_snapshot_read(d):
+    a = d.new_session()  # autocommit: locks would release immediately
+    assert a.query("select bal from acc where id = 1 for update") == [(100,)]
+    t = d.catalog.info_schema().table("test", "acc")
+    assert len(d.storage.table(t.id).locks) == 0
+
+
+def test_lock_wait_blocks_until_release(d):
+    a, b = d.new_session(), d.new_session()
+    a.execute("begin")
+    b.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    acquired = []
+
+    def b_wait():
+        b.execute("select * from acc where id = 1 for update")
+        acquired.append(time.monotonic())
+
+    th = threading.Thread(target=b_wait)
+    th.start()
+    time.sleep(0.25)
+    assert not acquired  # still blocked
+    release_at = time.monotonic()
+    a.execute("commit")
+    th.join(5)
+    assert acquired and acquired[0] >= release_at
+    b.execute("rollback")
+
+
+def test_deadlock_aborts_requester_deterministically(d):
+    """A holds r1 + wants r2; B holds r2 + wants r1 -> B (whose request
+    closes the cycle) gets ER_LOCK_DEADLOCK; A then proceeds."""
+    a, b = d.new_session(), d.new_session()
+    a.execute("begin")
+    b.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    b.execute("select * from acc where id = 2 for update")
+    results = {}
+
+    def a_then():
+        try:
+            a.execute("select * from acc where id = 2 for update")
+            results["a"] = "ok"
+        except Exception as e:
+            results["a"] = type(e).__name__
+
+    def b_then():
+        time.sleep(0.2)  # ensure A is already waiting
+        try:
+            b.execute("select * from acc where id = 1 for update")
+            results["b"] = "ok"
+        except Exception as e:
+            results["b"] = type(e).__name__
+
+    ta = threading.Thread(target=a_then)
+    tb = threading.Thread(target=b_then)
+    ta.start()
+    tb.start()
+    tb.join(10)
+    assert results.get("b") == "DeadlockError", results
+    b.execute("rollback")  # victim restarts; A's wait resolves
+    ta.join(10)
+    assert results.get("a") == "ok", results
+    a.execute("update acc set bal = bal - 10 where id = 2")
+    a.execute("commit")
+    chk = d.new_session()
+    assert chk.query("select bal from acc where id = 2") == [(190,)]
+
+
+def test_write_waits_for_for_update_lock(d):
+    """An autocommit UPDATE's 2PC prewrite waits out a FOR UPDATE lock
+    rather than erroring (prewrite backoff)."""
+    a = d.new_session()
+    a.execute("begin")
+    a.execute("select * from acc where id = 3 for update")
+    w = d.new_session()
+    done = []
+
+    def upd():
+        w.execute("update acc set bal = 0 where id = 3")
+        done.append(time.monotonic())
+
+    th = threading.Thread(target=upd)
+    th.start()
+    time.sleep(0.25)
+    assert not done
+    rel = time.monotonic()
+    a.execute("commit")
+    th.join(5)
+    assert done and done[0] >= rel
+    assert w.query("select bal from acc where id = 3") == [(0,)]
+
+
+def test_lock_wait_timeout(d):
+    from tidb_tpu.store.txn import Transaction
+
+    a, b = d.new_session(), d.new_session()
+    a.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    b.execute("begin")
+    old = Transaction.LOCK_WAIT_TIMEOUT_S
+    Transaction.LOCK_WAIT_TIMEOUT_S = 0.2
+    try:
+        with pytest.raises(LockWaitTimeoutError):
+            b.execute("select * from acc where id = 1 for update")
+    finally:
+        Transaction.LOCK_WAIT_TIMEOUT_S = old
+        a.execute("rollback")
+        b.execute("rollback")
+
+
+def test_live_holder_keeps_lock_past_ttl(d):
+    """A LIVE txn never loses its locks to a waiter — TTL resolution only
+    covers txns this process no longer tracks (crash recovery)."""
+    a = d.new_session()
+    a.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    time.sleep(3.2)  # beyond the 3s lock TTL
+    b = d.new_session()
+    done = []
+
+    def upd():
+        b.execute("update acc set bal = 777 where id = 1")
+        done.append(1)
+
+    th = threading.Thread(target=upd)
+    th.start()
+    time.sleep(0.3)
+    assert not done  # still excluded despite TTL expiry
+    a.execute("update acc set bal = 111 where id = 1")
+    a.execute("commit")
+    th.join(10)
+    chk = d.new_session()
+    assert chk.query("select bal from acc where id = 1") == [(777,)]
+
+
+def test_for_update_is_current_read_no_lost_update(d):
+    """FOR UPDATE locks and reads the LATEST committed version
+    (for_update_ts), so increments never overwrite concurrent commits."""
+    p = d.new_session()
+    p.execute("begin")
+    p.execute("select 1")  # pin start_ts
+    q = d.new_session()
+    q.execute("update acc set bal = 555 where id = 2")
+    assert p.query("select bal from acc where id = 2 for update") == [(555,)]
+    p.execute("update acc set bal = bal + 1 where id = 2")
+    p.execute("commit")
+    chk = d.new_session()
+    assert chk.query("select bal from acc where id = 2") == [(556,)]
+    # plain SELECT in a txn still reads its snapshot
+    r = d.new_session()
+    r.execute("begin")
+    r.execute("select 1")
+    q.execute("update acc set bal = 999 where id = 1")
+    assert r.query("select bal from acc where id = 1") == [(100,)]
+    r.execute("rollback")
+
+
+def test_for_update_locks_buffered_rows(d):
+    """Rows the txn itself modified still take the KV lock so a second
+    session's FOR UPDATE blocks instead of double-granting."""
+    m = d.new_session()
+    m.execute("begin")
+    m.execute("update acc set bal = 1 where id = 1")
+    m.execute("select * from acc where id = 1 for update")
+    n = d.new_session()
+    n.execute("begin")
+    got = []
+
+    def n_lock():
+        n.execute("select * from acc where id = 1 for update")
+        got.append(time.monotonic())
+
+    th = threading.Thread(target=n_lock)
+    th.start()
+    time.sleep(0.3)
+    assert not got  # blocked on m's lock
+    rel = time.monotonic()
+    m.execute("rollback")
+    th.join(10)
+    assert got and got[0] >= rel
+    n.execute("rollback")
+
+
+def test_for_update_alias_and_subquery_fallback(d):
+    a = d.new_session()
+    a.execute("begin")
+    assert a.query("select * from acc x where x.id = 1 for update") == [
+        (1, 100)]
+    t = d.catalog.info_schema().table("test", "acc")
+    assert len(d.storage.table(t.id).locks) == 1
+    rs = a.execute("select * from acc where id in (select id from acc)"
+                   " for update")[-1]
+    assert any("snapshot" in w for w in rs.warnings)
+    a.execute("rollback")
+
+
+def test_replace_and_multi_table_warning(d):
+    s = d.new_session()
+    s.execute("replace into acc values (1, 999)")
+    assert s.query("select bal from acc where id = 1") == [(999,)]
+    s.execute("create table other (x bigint)")
+    s.execute("begin")
+    rs = s.execute("select * from acc, other for update")[-1]
+    assert any("snapshot" in w for w in rs.warnings)
+    s.execute("rollback")

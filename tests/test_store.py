@@ -102,7 +102,9 @@ def test_lock_blocks_reader_until_resolved(storage):
     t = make_table(storage)
     txn = storage.begin()
     txn.put(1, 3, (3, 0.0, "locked"))
-    # simulate prewrite done but commit hanging
+    # simulate prewrite done but the OWNER PROCESS dead: drop it from the
+    # live-txn registry (a real crash restarts with an empty registry)
+    storage.txn_finished(txn.start_ts)
     keys = sorted(txn.buffer.keys())
     primary = keys[0]
     for tid, h in keys:
@@ -122,6 +124,7 @@ def test_resolve_lock_rolls_forward_after_primary_commit(storage):
     h_new = t.alloc_handle()
     txn.put(1, 3, (3, 0.0, "A"))
     txn.put(1, h_new, (200, 1.0, "B"))
+    storage.txn_finished(txn.start_ts)  # owner process died mid-commit
     keys = sorted(txn.buffer.keys())
     primary = keys[0]
     for tid, h in keys:

@@ -122,6 +122,26 @@ class LockedError(KVError):
         super().__init__(f"Key {key!r} locked by txn start_ts={owner_ts}")
 
 
+class DeadlockError(KVError):
+    """Pessimistic lock wait would close a wait-for cycle; the requesting
+    txn is chosen as victim (util/deadlock/deadlock.go policy)."""
+
+    code = 1213  # ER_LOCK_DEADLOCK
+
+    def __init__(self):
+        super().__init__(
+            "Deadlock found when trying to get lock; try restarting "
+            "transaction")
+
+
+class LockWaitTimeoutError(KVError):
+    code = 1205  # ER_LOCK_WAIT_TIMEOUT
+
+    def __init__(self):
+        super().__init__("Lock wait timeout exceeded; try restarting "
+                         "transaction")
+
+
 class RegionError(KVError):
     """Stale region epoch / not leader — caller must refresh routing and retry.
 

@@ -112,8 +112,18 @@ class ExecContext:
             st = self.stats[plan_id] = OperatorStats()
         return st
 
+    # current-read statements (DML, SELECT FOR UPDATE) read at the txn's
+    # pessimistic lock horizon when it advanced past start_ts — the
+    # for_update_ts current-read rule (executor/adapter.go pessimistic
+    # statement retry semantics); plain SELECTs keep the snapshot.
+    current_read = False
+
     def snapshot_ts(self) -> int:
         if self.txn is not None:
+            if self.current_read:
+                return max(self.txn.start_ts,
+                           getattr(self.txn, "for_update_ts",
+                                   self.txn.start_ts))
             return self.txn.start_ts
         return self.read_ts
 

@@ -837,15 +837,16 @@ class PlanBuilder:
                 plan.on_dup_update.append((c.offset, e))
         return plan
 
-    def _full_row_schema(self, t: TableInfo) -> Schema:
+    def _full_row_schema(self, t: TableInfo, qualifier: str = "") -> Schema:
+        q = qualifier or t.name
         return Schema([
-            SchemaCol(next_uid(), c.name, c.ftype, t.name, c.name, c.offset)
+            SchemaCol(next_uid(), c.name, c.ftype, q, c.name, c.offset)
             for c in t.columns
         ])
 
     def build_update(self, st: ast.UpdateStmt) -> UpdatePlan:
         t = self._table_info(st.table)
-        sch = self._full_row_schema(t)
+        sch = self._full_row_schema(t, st.table.alias)
         pos = {sc.uid: i for i, sc in enumerate(sch.cols)}
         eb = ExprBuilder(sch, None, None, [], self.param_values)
         assigns = []
@@ -863,7 +864,7 @@ class PlanBuilder:
 
     def build_delete(self, st: ast.DeleteStmt) -> DeletePlan:
         t = self._table_info(st.table)
-        sch = self._full_row_schema(t)
+        sch = self._full_row_schema(t, st.table.alias)
         pos = {sc.uid: i for i, sc in enumerate(sch.cols)}
         eb = ExprBuilder(sch, None, None, [], self.param_values)
         conds = []

@@ -282,7 +282,7 @@ class Session:
                                 or "lookup").lower(),
         )
 
-    def _exec_ctx(self) -> ExecContext:
+    def _exec_ctx(self, current_read: bool = False) -> ExecContext:
         txn = self._txn if self._in_txn or self._txn is not None else None
         ctx = ExecContext(
             self.domain.storage,
@@ -291,6 +291,7 @@ class Session:
             txn=txn,
             read_ts=self.domain.storage.current_ts() if txn is None else 0,
         )
+        ctx.current_read = current_read
         ctx.killed = self._killed
         ctx.domain = self.domain  # memtable providers read live state
         self.last_exec_ctx = ctx
@@ -351,9 +352,12 @@ class Session:
         )
 
     def _run_query(self, stmt, params=None) -> ResultSet:
+        for_update = getattr(stmt, "for_update", False)
+        if for_update:
+            self._select_for_update_lock(stmt, params)
         phys = self._plan(stmt, params)
         self.last_plan = phys
-        ctx = self._exec_ctx()
+        ctx = self._exec_ctx(current_read=for_update)
         exe = phys.build(ctx)
         chunks = collect_all(exe)
         headers = phys.schema.headers() if len(phys.schema) else []
@@ -363,7 +367,65 @@ class Session:
             for r in c.to_pylist():
                 rows.append(_format_row(r, fts))
         return ResultSet(headers=headers, rows=rows, is_query=True,
-                         warnings=list(ctx.warnings), ftypes=fts)
+                         warnings=self._warnings + list(ctx.warnings),
+                         ftypes=fts)
+
+    def _select_for_update_lock(self, stmt, params=None):
+        """SELECT ... FOR UPDATE: pessimistically lock the matching rows
+        before the read runs (executor/adapter.go:338-372 SelectLockExec
+        path).  Scope: single-table FROM (the reference locks each table's
+        handles; joins fall back to snapshot reads with a warning)."""
+        if not isinstance(stmt, ast.SelectStmt) or stmt.from_clause is None:
+            return
+        if not isinstance(stmt.from_clause, ast.TableName):
+            self._warnings.append(
+                "FOR UPDATE on multi-table queries reads at snapshot "
+                "(row locks not taken)")
+            return
+        t = self.domain.catalog.info_schema().table(
+            stmt.from_clause.db or self.current_db, stmt.from_clause.name)
+        if t.is_view:
+            return
+        if self._autocommit():
+            # autocommit FOR UPDATE: locks would release at statement end
+            # anyway (MySQL semantics) — read at snapshot, take none
+            return
+        # reuse the DELETE condition builder: conditions over full-row
+        # offsets, then the handle scan locates matching (pid, handle)s.
+        # Shapes the row-locator cannot express (subqueries in WHERE, ...)
+        # degrade to a snapshot read with a warning rather than erroring.
+        fake = ast.DeleteStmt(stmt.from_clause, stmt.where)
+        pb = PlanBuilder(self.domain.catalog.info_schema(), self.current_db,
+                         param_values=params)
+        try:
+            plan = pb.build_delete(fake)
+        except TiDBTPUError as e:
+            self._warnings.append(
+                f"FOR UPDATE reads at snapshot (row locks not taken: {e})")
+            return
+        from ..planner.physical import _dml_readers
+
+        txn = self._begin_txn()
+        # FOR UPDATE is a current read: take the lock horizon at statement
+        # start so rows committed after txn start are seen and locked
+        txn.for_update_ts = max(txn.for_update_ts,
+                                self.domain.storage.current_ts())
+        ctx = self._exec_ctx(current_read=True)
+        keys = []
+        for pid, reader in _dml_readers(ctx, plan.table, plan.conditions,
+                                        -1):
+            reader.open()
+            try:
+                while True:
+                    c = reader.next()
+                    if c is None:
+                        break
+                    for h in c.col(0).data:
+                        keys.append((pid, int(h)))
+            finally:
+                reader.close()
+        if keys:
+            txn.lock_keys(*keys)
 
     def _run_dml(self, stmt, params=None) -> ResultSet:
         retries = max(self.vars.get_int("tidb_retry_limit", 10), 0)
@@ -372,7 +434,7 @@ class Session:
             attempt += 1
             auto = self._autocommit() and self._txn is None
             txn = self._begin_txn()
-            ctx = self._exec_ctx()
+            ctx = self._exec_ctx(current_read=True)
             try:
                 phys = self._plan(stmt, params)
                 self.last_plan = phys

@@ -284,13 +284,19 @@ class TableStore:
     # MVCC delta (Percolator)
     # ------------------------------------------------------------------
     def prewrite(self, handle: int, op: str, values: Optional[tuple],
-                 primary: Tuple[int, int], start_ts: int, ttl_ms: int = 3000):
+                 primary: Tuple[int, int], start_ts: int, ttl_ms: int = 3000,
+                 check_ts: Optional[int] = None):
+        """check_ts: conflict horizon — defaults to start_ts (optimistic);
+        pessimistic lock acquisition and lock-upgrade pass for_update_ts so
+        a commit between txn start and lock time is not a conflict
+        (2pc.go pessimistic for_update_ts semantics)."""
         with self._mu:
             lk = self.locks.get(handle)
             if lk is not None and lk.start_ts != start_ts:
                 raise LockedError((self.table_id, handle), lk.start_ts)
             chain = self.delta.get(handle)
-            if chain and chain[-1].commit_ts > start_ts:
+            horizon = check_ts if check_ts is not None else start_ts
+            if chain and chain[-1].commit_ts > horizon:
                 raise TxnConflictError((self.table_id, handle))
             self.locks[handle] = Lock(start_ts, primary, op, values, ttl_ms)
 

@@ -1,0 +1,53 @@
+"""Wait-for-graph deadlock detection for pessimistic lock waits.
+
+Reference: util/deadlock/deadlock.go:22-130 — a Detector keyed by
+transaction start_ts; Detect(txn, waitFor) walks the existing edges and
+reports a cycle before the edge is inserted, so the REQUESTING transaction
+is the victim (ErrDeadlock), matching the reference's first-detected-aborts
+policy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set
+
+
+class DeadlockDetector:
+    def __init__(self):
+        self._mu = threading.Lock()
+        # waiter start_ts -> set of holder start_ts it waits for
+        self._edges: Dict[int, Set[int]] = {}
+
+    def detect(self, waiter: int, holder: int) -> bool:
+        """Register waiter->holder; True (and no edge) if that would close
+        a cycle — the caller must abort as the deadlock victim."""
+        if waiter == holder:
+            return False
+        with self._mu:
+            # DFS from holder through existing edges looking for waiter
+            stack, seen = [holder], set()
+            while stack:
+                t = stack.pop()
+                if t == waiter:
+                    return True
+                if t in seen:
+                    continue
+                seen.add(t)
+                stack.extend(self._edges.get(t, ()))
+            self._edges.setdefault(waiter, set()).add(holder)
+            return False
+
+    def clean_up_wait_for(self, waiter: int, holder: int):
+        """Drop one edge after the wait ends (lock acquired or aborted)."""
+        with self._mu:
+            s = self._edges.get(waiter)
+            if s is not None:
+                s.discard(holder)
+                if not s:
+                    del self._edges[waiter]
+
+    def clean_up(self, txn: int):
+        """Txn finished: drop every edge it owns (detector CleanUp)."""
+        with self._mu:
+            self._edges.pop(txn, None)

@@ -27,6 +27,13 @@ class BlockStorage(Storage):
     def __init__(self, n_stores: int = 1, data_dir: Optional[str] = None):
         self.oracle = Oracle()
         self.regions = RegionManager(n_stores=n_stores)
+        from .deadlock import DeadlockDetector
+
+        self.deadlock = DeadlockDetector()
+        # live in-process txns: a LIVE holder's locks are never resolved by
+        # waiters (the TTL path only covers txns this process no longer
+        # tracks — crashed processes start with an empty registry)
+        self._live_txns: set = set()
         self._tables: Dict[int, TableStore] = {}
         self._mu = threading.RLock()
         self._client = CoprClient(self)
@@ -88,9 +95,17 @@ class BlockStorage(Storage):
 
     # ---- kv.Storage interface ------------------------------------------
     def begin(self, start_ts: Optional[int] = None, pessimistic: bool = False) -> Transaction:
-        return Transaction(
+        txn = Transaction(
             self, start_ts or self.oracle.get_timestamp(), pessimistic
         )
+        self._live_txns.add(txn.start_ts)
+        return txn
+
+    def txn_alive(self, start_ts: int) -> bool:
+        return start_ts in self._live_txns
+
+    def txn_finished(self, start_ts: int):
+        self._live_txns.discard(start_ts)
 
     def data_version(self) -> int:
         """Monotonic counter bumped on bulk load, compaction, and committed

@@ -12,7 +12,13 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
-from ..errors import KVError, LockedError, TxnConflictError
+from ..errors import (
+    DeadlockError,
+    KVError,
+    LockedError,
+    LockWaitTimeoutError,
+    TxnConflictError,
+)
 from .fault import FAILPOINTS
 
 RowKey = Tuple[int, int]  # (table_id, handle)
@@ -33,6 +39,10 @@ class Transaction:
         self._locked: set = set()
         self.committed = False
         self.rolled_back = False
+        # pessimistic conflict horizon: advanced past newer commits when a
+        # FOR UPDATE lock is taken (2pc.go for_update_ts); locked keys
+        # prewrite against this at commit instead of start_ts
+        self.for_update_ts = start_ts
         # optional hook run AFTER prewrite, before the decision point: the
         # session wires the commit-time schema check here (SchemaChecker,
         # session.go checkSchemaValidity).  Running it with prewrite locks
@@ -54,16 +64,83 @@ class Transaction:
             return m.values if m.op == "put" else None
         return self.storage.table(table_id).read_row(handle, self.start_ts)
 
+    # pessimistic lock-wait knobs (innodb_lock_wait_timeout analog)
+    LOCK_WAIT_TIMEOUT_S = 5.0
+    LOCK_WAIT_POLL_S = 0.005
+
     def lock_keys(self, *keys: RowKey, ttl_ms: int = 3000):
-        """Pessimistic locks taken during execution (2pc.go:668)."""
+        """Pessimistic locks taken during execution (2pc.go:668).
+
+        A held lock blocks (MySQL row-lock wait) instead of erroring:
+        the wait registers an edge in the storage-wide wait-for graph and
+        the REQUESTER aborts as victim if the edge closes a cycle
+        (util/deadlock/deadlock.go Detect)."""
         if not keys:
             return
         primary = keys[0]
         for tid, h in keys:
-            self.storage.table(tid).prewrite(
-                h, "lock", None, primary, self.start_ts, ttl_ms
-            )
+            if (tid, h) in self._locked:
+                continue
+            # rows already in our write buffer still need the KV lock:
+            # without it a second session's FOR UPDATE would succeed
+            # concurrently and both would "hold" the row
+            self._prewrite_waiting(tid, h, "lock", None, primary, ttl_ms,
+                                   pessimistic=True)
             self._locked.add((tid, h))
+
+    def _prewrite_waiting(self, tid: int, h: int, op: str, values,
+                          primary: RowKey, ttl_ms: int = 3000,
+                          pessimistic: bool = False, check_ts=None):
+        """Prewrite that WAITS on a foreign lock (MySQL row-lock wait)
+        with deadlock detection, instead of failing fast.
+
+        pessimistic=True additionally refreshes for_update_ts past a newer
+        committed version instead of failing: a FOR UPDATE lock targets the
+        CURRENT row, not the txn snapshot (pessimistic for_update_ts)."""
+        import time as _time
+
+        detector = self.storage.deadlock
+        deadline = _time.monotonic() + self.LOCK_WAIT_TIMEOUT_S
+        waiting_on = None
+        try:
+            while True:
+                try:
+                    self.storage.table(tid).prewrite(
+                        h, op, values, primary, self.start_ts, ttl_ms,
+                        check_ts=(self.for_update_ts if pessimistic
+                                  else check_ts),
+                    )
+                    return
+                except TxnConflictError:
+                    if not pessimistic:
+                        raise
+                    # a commit landed after for_update_ts: lock the newer
+                    # version (advance the horizon) and retry
+                    self.for_update_ts = self.storage.oracle.get_timestamp()
+                except LockedError as e:
+                    holder = e.owner_ts
+                    if waiting_on != holder:
+                        if waiting_on is not None:
+                            detector.clean_up_wait_for(
+                                self.start_ts, waiting_on)
+                        if detector.detect(self.start_ts, holder):
+                            raise DeadlockError()
+                        waiting_on = holder
+                    # resolvable only when the holder is BOTH untracked by
+                    # this process (crashed/foreign) and TTL-expired: a
+                    # live txn never loses its locks to a waiter
+                    if not self.storage.txn_alive(holder) and                             self.storage.oracle.is_expired(holder, ttl_ms):
+                        try:
+                            resolve_lock(self.storage, tid, h)
+                            continue
+                        except LockedError:
+                            pass
+                    if _time.monotonic() >= deadline:
+                        raise LockWaitTimeoutError()
+                    _time.sleep(self.LOCK_WAIT_POLL_S)
+        finally:
+            if waiting_on is not None:
+                detector.clean_up_wait_for(self.start_ts, waiting_on)
 
     # ---- 2PC -----------------------------------------------------------
     def commit(self) -> int:
@@ -71,12 +148,14 @@ class Transaction:
             raise KVError("txn already finished")
         if not self.buffer and not self._locked:
             self.committed = True
+            self.storage.txn_finished(self.start_ts)
             return self.start_ts
         keys = sorted(self.buffer.keys())
         if not keys:  # lock-only txn
             for tid, h in self._locked:
                 self.storage.table(tid).rollback(h, self.start_ts)
             self.committed = True
+            self.storage.txn_finished(self.start_ts)
             return self.start_ts
         primary = keys[0]
         # release pessimistic-only locks that have no mutation (they are
@@ -90,11 +169,20 @@ class Transaction:
                 FAILPOINTS.hit("2pc/prewrite", table_id=tid, handle=h)
                 m = self.buffer[(tid, h)]
                 store = self.storage.table(tid)
-                if (tid, h) in self._locked:
+                pess = (tid, h) in self._locked
+                if pess:
                     store.rollback(h, self.start_ts)  # upgrade pessimistic lock
-                store.prewrite(h, m.op, m.values, primary, self.start_ts)
+                # wait out foreign pessimistic/prewrite locks (the
+                # reference's prewrite backoff); a post-release newer
+                # commit still surfaces as TxnConflictError below.
+                # Keys we hold pessimistic locks on conflict-check at
+                # for_update_ts (the lock horizon), not start_ts.
+                self._prewrite_waiting(
+                    tid, h, m.op, m.values, primary,
+                    check_ts=(self.for_update_ts if pess else None))
                 prewritten.append((tid, h))
-        except (LockedError, TxnConflictError):
+        except (LockedError, TxnConflictError, DeadlockError,
+                LockWaitTimeoutError):
             for tid, h in prewritten:
                 self.storage.table(tid).rollback(h, self.start_ts)
             self.rolled_back = True
@@ -117,6 +205,8 @@ class Transaction:
             FAILPOINTS.hit("2pc/commit_secondary", table_id=tid, handle=h)
             self.storage.table(tid).commit(h, self.start_ts, commit_ts)
         self.committed = True
+        self.storage.deadlock.clean_up(self.start_ts)
+        self.storage.txn_finished(self.start_ts)
         return commit_ts
 
     def rollback(self):
@@ -126,6 +216,8 @@ class Transaction:
             self.storage.table(tid).rollback(h, self.start_ts)
         self.buffer.clear()
         self.rolled_back = True
+        self.storage.deadlock.clean_up(self.start_ts)
+        self.storage.txn_finished(self.start_ts)
 
 
 def resolve_lock(storage, table_id: int, handle: int, ttl_expired_only: bool = True):
@@ -137,6 +229,9 @@ def resolve_lock(storage, table_id: int, handle: int, ttl_expired_only: bool = T
     lk = store.locks.get(handle)
     if lk is None:
         return
+    if storage.txn_alive(lk.start_ts):
+        # live owner: not an orphan, never resolvable
+        raise LockedError((table_id, handle), lk.start_ts)
     if ttl_expired_only and not storage.oracle.is_expired(lk.start_ts, lk.ttl_ms):
         raise LockedError((table_id, handle), lk.start_ts)
     ptid, ph = lk.primary
