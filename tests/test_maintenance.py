@@ -1,0 +1,162 @@
+"""Background maintenance: GC worker, compaction scheduling, expensive-query
+watchdog.
+
+Reference: store/tikv/gcworker/gc_worker.go:213-289 (safepoint = now -
+gc_life_time, bounded by live txn min start_ts), TiFlash delta-merge
+scheduling, util/expensivequery/expensivequery.go:50-154 (threshold logs +
+max_execution_time kill)."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import QueryKilledError, TiDBTPUError
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def d():
+    dom = Domain()
+    dom.maintenance.stop()  # tests drive tick() deterministically
+    yield dom
+    dom.maintenance.stop()
+
+
+def _chain_len(d, name="t"):
+    t = d.catalog.info_schema().table("test", name)
+    store = d.storage.table(t.id)
+    return sum(len(c) for c in store.delta.values())
+
+
+def test_gc_prunes_version_chains_under_sustained_dml(d):
+    s = d.new_session()
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values (1, 0)")
+    for i in range(12):
+        s.execute(f"update t set v = {i} where id = 1")
+    assert _chain_len(d) >= 12  # one version per update
+    d.global_vars["tidb_gc_life_time"] = "0"
+    time.sleep(0.01)  # let the safepoint's physical ms pass the commits
+    d.maintenance.tick()
+    assert _chain_len(d) <= 1  # only the newest survives
+    # the row itself is intact
+    assert s.query("select v from t") == [(11,)]
+
+
+def test_gc_respects_live_transaction_snapshot(d):
+    s = d.new_session()
+    s.execute("create table t (id bigint primary key, v bigint)")
+    s.execute("insert into t values (1, 0)")
+    reader = d.new_session()
+    reader.execute("begin")
+    assert reader.query("select v from t") == [(0,)]  # pins start_ts
+    for i in range(5):
+        s.execute(f"update t set v = {i + 1} where id = 1")
+    d.global_vars["tidb_gc_life_time"] = "0"
+    d.maintenance.tick()
+    # versions the live reader can see survived
+    assert reader.query("select v from t") == [(0,)]
+    reader.execute("commit")
+    time.sleep(0.01)
+    d.maintenance.tick()
+    assert _chain_len(d) <= 1
+
+
+def test_compaction_scheduled_by_worker(d):
+    """Delta written through the raw txn API (no session commit hooks)
+    is folded by the background worker."""
+    s = d.new_session()
+    s.execute("create table t (id bigint, v bigint)")
+    t = d.catalog.info_schema().table("test", "t")
+    store = d.storage.table(t.id)
+    txn = d.storage.begin()
+    for i in range(5000):
+        txn.put(t.id, store.alloc_handle(), (i, i))
+    txn.commit()
+    assert len(store.delta) > 4096  # over the compaction threshold
+    d.maintenance.tick()
+    assert len(store.delta) == 0  # folded into base blocks
+    assert store.base_rows == 5000
+
+
+def test_expensive_query_flagged(d):
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1), (2), (3)")
+    d.global_vars["tidb_expensive_query_time_threshold"] = "0.05"
+    before = REGISTRY.snapshot().get("expensive_queries_total", 0)
+    done = []
+
+    def slow():
+        s.execute("select sleep(0.15) from t")  # ~0.45s across chunks
+        done.append(1)
+
+    th = threading.Thread(target=slow)
+    th.start()
+    time.sleep(0.1)
+    d.maintenance.tick()  # statement still running and past threshold
+    th.join(10)
+    after = REGISTRY.snapshot().get("expensive_queries_total", 0)
+    assert after > before
+
+
+def test_max_execution_time_kills_runaway(d):
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values " +
+              ", ".join(f"({i})" for i in range(20)))
+    s.execute("set max_execution_time = 100")
+    errs = []
+
+    def runaway():
+        try:
+            # kill flag is checked between executor next() calls: the
+            # query surfaces QueryKilled right after the sleep returns
+            s.execute("select sleep(1.5) from t limit 1")
+            errs.append("completed")
+        except QueryKilledError:
+            errs.append("killed")
+        except TiDBTPUError as e:
+            errs.append(type(e).__name__)
+
+    th = threading.Thread(target=runaway)
+    th.start()
+    for _ in range(60):
+        time.sleep(0.05)
+        d.maintenance.tick()
+        if errs:
+            break
+    th.join(10)
+    assert errs and errs[0] == "killed", errs
+    # the session survives (KILL QUERY, not KILL CONNECTION)
+    assert s.query("select count(*) from t") == [(20,)]
+
+
+def test_worker_thread_runs(d):
+    before = REGISTRY.snapshot().get("maintenance_ticks_total", 0)
+    w = d.maintenance
+    w.stop()
+    w.interval_s = 0.05
+    w.start()
+    time.sleep(0.3)
+    w.stop()
+    assert REGISTRY.snapshot().get("maintenance_ticks_total", 0) > before
+
+
+def test_conflict_aborted_txn_does_not_pin_safepoint(d):
+    """A commit that aborts on write-write conflict must leave the live-txn
+    registry (else the GC safepoint is pinned forever)."""
+    s = d.new_session()
+    s.execute("create table cc (id bigint primary key, v bigint)")
+    s.execute("insert into cc values (1, 0)")
+    a, b = d.new_session(), d.new_session()
+    a.execute("begin")
+    a.execute("update cc set v = 1 where id = 1")
+    b.execute("begin")
+    b.execute("update cc set v = 2 where id = 1")
+    a.execute("commit")
+    with pytest.raises(TiDBTPUError):
+        b.execute("commit")
+    assert not d.storage._live_txns

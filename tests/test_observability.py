@@ -1,0 +1,120 @@
+"""Ops/observability shell: HTTP /metrics + /status + /schema, per-digest
+statement summary, SHOW STATS_* / PROCESSLIST.
+
+Reference: server/http_status.go:74-115 (status port),
+util/stmtsummary/statement_summary.go:59,213 (digest aggregation),
+executor/show_stats.go (SHOW STATS_META/_HISTOGRAMS/_BUCKETS)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tidb_tpu.session import Domain
+from tidb_tpu.session.domain import sql_digest
+
+
+@pytest.fixture()
+def d():
+    dom = Domain()
+    dom.maintenance.stop()
+    yield dom
+
+
+def test_sql_digest_normalizes_literals():
+    a = sql_digest("SELECT * FROM t WHERE a = 5 AND b = 'x' AND c IN (1,2)")
+    b = sql_digest("select *  from t where a=9 and b='zz' and c in (3,4,5)")
+    assert a == b == "select * from t where a = ? and b = ? and c in (...)"
+
+
+def test_statement_summary_aggregates_by_digest(d):
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    for i in range(5):
+        s.execute(f"insert into t values ({i})")
+    for i in range(3):
+        s.execute(f"select * from t where a = {i}")
+    rows = s.query("select digest_text, exec_count, sum_rows from"
+                   " information_schema.statements_summary"
+                   " where digest_text like '%where a =%'")
+    assert rows == [("select * from t where a = ?", 3, 3)]
+    ins = s.query("select exec_count from"
+                  " information_schema.statements_summary"
+                  " where digest_text like 'insert%'")
+    assert ins == [(5,)]
+
+
+def test_show_stats_surface(d):
+    s = d.new_session()
+    s.execute("create table st (a bigint, b varchar(4))")
+    s.execute("insert into st values (1,'x'), (2,'y'), (3,'x')")
+    s.execute("analyze table st")
+    meta = s.query("show stats_meta")
+    assert any(r[1] == "st" and r[5] == 3 for r in meta)
+    hist = s.query("show stats_histograms")
+    assert {r[3] for r in hist if r[1] == "st"} == {"a", "b"}
+    buckets = s.query("show stats_buckets")
+    assert any(r[1] == "st" and r[3] == "a" for r in buckets)
+
+
+def test_show_stats_covers_partitions(d):
+    s = d.new_session()
+    s.execute("create table pt (k bigint) partition by hash (k) partitions 2")
+    s.execute("insert into pt values (1), (2), (3)")
+    s.execute("analyze table pt")
+    meta = s.query("show stats_meta")
+    parts = {r[2] for r in meta if r[1] == "pt"}
+    assert parts == {"", "p0", "p1"}  # logical + both partitions
+
+
+def test_processlist_shows_running_statement(d):
+    import threading
+    import time
+
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    s.execute("insert into t values (1)")
+    watcher = d.new_session()
+
+    got = {}
+
+    def slow():
+        s.execute("select sleep(0.4) from t")
+
+    th = threading.Thread(target=slow)
+    th.start()
+    time.sleep(0.15)
+    rows = watcher.query("show processlist")
+    th.join(5)
+    running = [r for r in rows if r[4] == "Query" and "sleep" in r[6]]
+    assert running, rows
+    assert running[0][5] > 0  # elapsed time
+
+
+def test_http_endpoints(d):
+    from tidb_tpu.server import StatusServer
+
+    s = d.new_session()
+    s.execute("create table ht (a bigint)"
+              " partition by hash (a) partitions 2")
+    s.execute("insert into ht values (1)")
+    srv = StatusServer(d, port=0)
+    host, port = srv.start()
+    try:
+        base = f"http://{host}:{port}"
+        metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "tidb_tpu_statements_total" in metrics
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["version"].endswith("tidb-tpu-0.1.0")
+        assert status["connections"] >= 1
+        schema = json.loads(urllib.request.urlopen(base + "/schema").read())
+        t = [x for x in schema["test"] if x["name"] == "ht"][0]
+        assert t["partitions"] == ["p0", "p1"]
+        # 404 for unknown paths
+        try:
+            urllib.request.urlopen(base + "/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()

@@ -240,3 +240,61 @@ def test_replace_and_multi_table_warning(d):
     rs = s.execute("select * from acc, other for update")[-1]
     assert any("snapshot" in w for w in rs.warnings)
     s.execute("rollback")
+
+
+def test_deadlock_victim_rolls_back_so_survivor_proceeds(d):
+    """The deadlock victim's transaction rolls back automatically: the
+    surviving waiter acquires the lock immediately, not after a lock-wait
+    timeout (MySQL victim semantics)."""
+    a, b = d.new_session(), d.new_session()
+    a.execute("begin")
+    b.execute("begin")
+    a.execute("select * from acc where id = 1 for update")
+    b.execute("select * from acc where id = 2 for update")
+    res = {}
+    t0 = time.monotonic()
+
+    def a_then():
+        a.execute("select * from acc where id = 2 for update")
+        res["a_time"] = time.monotonic() - t0
+
+    def b_then():
+        time.sleep(0.2)
+        try:
+            b.execute("select * from acc where id = 1 for update")
+        except DeadlockError:
+            res["b"] = "victim"
+
+    ta = threading.Thread(target=a_then)
+    tb = threading.Thread(target=b_then)
+    ta.start()
+    tb.start()
+    tb.join(10)
+    ta.join(10)
+    assert res.get("b") == "victim"
+    assert res["a_time"] < 2.0  # did not ride out the 5s timeout
+    a.execute("rollback")
+    b.execute("rollback")
+
+
+def test_atomic_lock_upgrade_under_contention(d):
+    """Commit upgrades a pessimistic lock in place: a polling waiter can
+    never steal the row between lock release and prewrite."""
+    s0 = d.new_session()
+    for _ in range(15):
+        x, y = d.new_session(), d.new_session()
+        x.execute("begin")
+        x.execute("select * from acc where id = 3 for update")
+        x.execute("update acc set bal = bal + 1 where id = 3")
+        done = []
+
+        def contend():
+            y.execute("update acc set bal = bal + 1 where id = 3")
+            done.append(1)
+
+        th = threading.Thread(target=contend)
+        th.start()
+        x.execute("commit")
+        th.join(5)
+        assert done
+    assert s0.query("select bal from acc where id = 3") == [(330,)]

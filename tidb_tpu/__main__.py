@@ -18,16 +18,20 @@ def main():
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=4000)
     ap.add_argument("--data-dir", default="")
+    ap.add_argument("--status-port", type=int, default=10080,
+                    help="HTTP /metrics + /status port (0 disables)")
     ap.add_argument("--engine", default="tpu", choices=["tpu", "cpu"],
                     help="default coprocessor engine routing")
     args = ap.parse_args()
 
     from .session import Domain
-    from .server import serve_forever
+    from .server import StatusServer, serve_forever
 
     domain = Domain(data_dir=args.data_dir or None)
     if args.engine == "cpu":
         domain.global_vars["tidb_use_tpu"] = "0"
+    if args.status_port:
+        StatusServer(domain, args.host, args.status_port).start()
     serve_forever(args.host, args.port, domain)
 
 

@@ -96,13 +96,24 @@ def _statistics(domain, isc):
 
 @_register("processlist", [
     ("id", ty_int()), ("user", ty_string()), ("host", ty_string()),
-    ("db", ty_string()), ("command", ty_string()),
+    ("db", ty_string()), ("command", ty_string()), ("time", ty_float()),
+    ("info", ty_string()),
 ])
 def _processlist(domain, isc):
-    return [
-        (cid, "root", "localhost", s.current_db, "Sleep")
-        for cid, s in domain.sessions.items()
-    ]
+    import time as _time
+
+    rows = []
+    now = _time.time()
+    for cid, s in domain.sessions.items():
+        start = getattr(s, "stmt_start", None)
+        user = getattr(s, "user", "root@%")
+        if start is not None:
+            rows.append((cid, user, "localhost", s.current_db, "Query",
+                         now - start, getattr(s, "stmt_sql", "")[:256]))
+        else:
+            rows.append((cid, user, "localhost", s.current_db, "Sleep",
+                         0.0, ""))
+    return rows
 
 
 @_register("slow_query", [
@@ -115,17 +126,19 @@ def _slow_query(domain, isc):
 @_register("statements_summary", [
     ("digest_text", ty_string()), ("exec_count", ty_int()),
     ("sum_latency", ty_float()), ("avg_latency", ty_float()),
-    ("sum_rows", ty_int()),
+    ("max_latency", ty_float()), ("sum_rows", ty_int()),
+    ("sample_text", ty_string()),
 ])
 def _statements_summary(domain, isc):
-    agg: dict = {}
-    for sql, dur, rows in domain.stmt_summary:
-        key = sql.strip()[:256].lower()
-        c, t, r = agg.get(key, (0, 0.0, 0))
-        agg[key] = (c + 1, t + dur, r + rows)
-    return [
-        (k, c, t, t / c, r) for k, (c, t, r) in sorted(agg.items())
-    ]
+    """Per-digest aggregates (util/stmtsummary/statement_summary.go:59,213):
+    literals normalized away, so every execution of a statement shape lands
+    in one row."""
+    out = []
+    for digest, st in sorted(domain.digest_summary.items()):
+        out.append((digest, st["count"], st["sum_latency"],
+                    st["sum_latency"] / max(st["count"], 1),
+                    st["max_latency"], st["sum_rows"], st["sample"]))
+    return out
 
 
 @_register("tidb_regions", [

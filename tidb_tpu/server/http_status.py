@@ -1,0 +1,100 @@
+"""HTTP status/metrics endpoint.
+
+Reference: server/http_status.go:74-115 — the tidb-server status port
+(default 10080) serving /metrics (Prometheus), /status (JSON build/
+connection info), and the /schema inspector.  Stdlib http.server in a
+daemon thread; no new dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..metrics import REGISTRY
+
+VERSION = "8.0.11-tidb-tpu-0.1.0"
+
+
+class StatusServer:
+    def __init__(self, domain, host: str = "127.0.0.1", port: int = 10080):
+        self.domain = domain
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        domain = self.domain
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    lines = []
+                    for name, val in sorted(REGISTRY.snapshot().items()):
+                        metric = "tidb_tpu_" + name
+                        lines.append(f"{metric} {val}")
+                    body = ("\n".join(lines) + "\n").encode()
+                    self._send(200, body, "text/plain; version=0.0.4")
+                    return
+                if path in ("/status", "/"):
+                    running = sum(
+                        1 for s in domain.sessions.values()
+                        if getattr(s, "stmt_start", None) is not None)
+                    body = json.dumps({
+                        "version": VERSION,
+                        "git_hash": "",
+                        "ddl_schema_version":
+                            domain.catalog.schema_version,
+                        "connections": len(domain.sessions),
+                        "running_statements": running,
+                        "gc_safe_point":
+                            domain.maintenance.last_safepoint,
+                    }).encode()
+                    self._send(200, body, "application/json")
+                    return
+                if path == "/schema":
+                    isc = domain.catalog.info_schema()
+                    out = {}
+                    for db in isc.schema_names():
+                        out[db] = [
+                            {"name": t.name, "id": t.id,
+                             "is_view": t.is_view,
+                             "partitions": [p.name for p in
+                                            t.partition_info.defs]
+                             if t.partition_info else None}
+                            for t in isc.tables(db)
+                        ]
+                    self._send(200, json.dumps(out).encode(),
+                               "application/json")
+                    return
+                self._send(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tidb-tpu-status",
+            daemon=True)
+        self._thread.start()
+        return self.host, self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

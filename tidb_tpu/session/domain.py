@@ -16,6 +16,26 @@ from ..store.storage import BlockStorage
 from .vars import SessionVars
 
 
+import re as _re
+
+_NUM_RE = _re.compile(r"\b\d+(?:\.\d+)?\b")
+_STR_RE = _re.compile(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"")
+_WS_RE = _re.compile(r"\s+")
+_OP_RE = _re.compile(r"\s*(<=|>=|<>|!=|=|<|>)\s*")
+_IN_RE = _re.compile(r"in\s*\((?:\s*\?\s*,?)+\)")
+
+
+def sql_digest(sql: str) -> str:
+    """Normalized statement text: literals -> ?, IN lists collapsed,
+    whitespace folded, lowercased (parser.Normalize + DigestHash role)."""
+    s = _STR_RE.sub("?", sql)
+    s = _NUM_RE.sub("?", s)
+    s = _OP_RE.sub(r" \1 ", s)
+    s = _WS_RE.sub(" ", s).strip().lower()
+    s = _IN_RE.sub("in (...)", s)
+    return s[:512]
+
+
 class Domain:
     def __init__(self, storage: Optional[BlockStorage] = None,
                  data_dir: Optional[str] = None):
@@ -38,12 +58,16 @@ class Domain:
         self._mu = threading.RLock()
         self._conn_counter = 0
         self.sessions: Dict[int, object] = {}  # conn_id -> Session (weak-ish)
-        self.stmt_summary = []  # (sql, duration_s, rows) ring
+        self.digest_summary = {}  # digest -> per-statement-shape aggregates
         self.slow_threshold_ms = 300
         self.slow_queries = []
         if data_dir:
             self._recover(data_dir)
         self._bootstrap()
+        from .maintenance import MaintenanceWorker
+
+        self.maintenance = MaintenanceWorker(self)
+        self.maintenance.start()
 
     def _recover(self, data_dir: str):
         """Reload catalog + table data persisted by a previous process
@@ -91,7 +115,7 @@ class Domain:
     def kill(self, conn_id: int, query_only: bool = True):
         s = self.sessions.get(conn_id)
         if s is not None:
-            s.kill()
+            s.kill(query_only)
 
     def maybe_auto_analyze(self, table_ids):
         """Post-DML auto-analyze check (update.go:621-639 analog, run inline
@@ -119,10 +143,22 @@ class Domain:
 
         REGISTRY.inc("statements_total")
         REGISTRY.observe("statement_duration_seconds", dur_s)
+        digest = sql_digest(sql)
         with self._mu:
-            self.stmt_summary.append((sql, dur_s, rows))
-            if len(self.stmt_summary) > 1000:
-                self.stmt_summary = self.stmt_summary[-500:]
+            # per-digest aggregates (util/stmtsummary/statement_summary.go
+            # :59,:213 — keyed on the normalized statement)
+            st = self.digest_summary.get(digest)
+            if st is None:
+                if len(self.digest_summary) >= 5000:
+                    self.digest_summary.clear()  # bounded, like the ref cap
+                st = self.digest_summary[digest] = {
+                    "count": 0, "sum_latency": 0.0, "max_latency": 0.0,
+                    "sum_rows": 0, "sample": sql[:256],
+                }
+            st["count"] += 1
+            st["sum_latency"] += dur_s
+            st["max_latency"] = max(st["max_latency"], dur_s)
+            st["sum_rows"] += rows
             if dur_s * 1000 >= self.slow_threshold_ms:
                 self.slow_queries.append((sql, dur_s))
                 if len(self.slow_queries) > 100:

@@ -1,0 +1,145 @@
+"""Domain background maintenance: GC worker, compaction scheduling, and
+the expensive-query watchdog.
+
+Reference:
+- store/tikv/gcworker/gc_worker.go:213-289 — the GC leader computes a
+  safepoint (now - gc_life_time), then drives version GC; here the version
+  chains live in each TableStore's delta, so GC prunes them directly.
+- util/expensivequery/expensivequery.go:50-154 — a ticker that logs
+  statements running past a threshold and enforces max_execution_time.
+- TiFlash's delta-merge compaction scheduling (maybe_compact here).
+
+One daemon thread per Domain; `tick()` is public and synchronous so tests
+drive maintenance deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..metrics import REGISTRY
+from ..store.oracle import compose_ts
+
+log = logging.getLogger("tidb_tpu.maintenance")
+
+
+class MaintenanceWorker:
+    def __init__(self, domain, interval_s: float = 10.0):
+        self.domain = domain
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_safepoint = 0
+        self.flagged: dict = {}  # (conn_id, stmt_start) -> True (log once)
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tidb-tpu-maintenance", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("maintenance tick failed")
+
+    # ---- one maintenance round ----------------------------------------
+    def tick(self):
+        self.run_gc()
+        self.run_compaction()
+        self.watch_expensive()
+        REGISTRY.inc("maintenance_ticks_total")
+
+    def _gc_life_s(self) -> float:
+        raw = self.domain.global_vars.get("tidb_gc_life_time", "600")
+        try:
+            return float(raw)
+        except ValueError:
+            return 600.0
+
+    def run_gc(self):
+        """Prune MVCC version chains below the safepoint.  The safepoint
+        never passes a live transaction's start_ts — a reader at start_ts
+        must keep seeing its snapshot (gc_worker.go calcSafePoint checks
+        active txns via PD's min-start-ts the same way)."""
+        storage = self.domain.storage
+        now_ms = int(time.time() * 1000)
+        safepoint = compose_ts(now_ms - int(self._gc_life_s() * 1000), 0)
+        floor = storage.live_txn_floor()
+        if floor is not None:
+            safepoint = min(safepoint, floor - 1)
+        if safepoint <= self.last_safepoint:
+            return
+        self.last_safepoint = safepoint
+        REGISTRY.set("gc_safe_point", safepoint)
+        pruned = 0
+        for tid in list(storage.table_ids()):
+            try:
+                pruned += storage.table(tid).gc(safepoint)
+            except Exception:
+                continue  # dropped concurrently
+        if pruned:
+            REGISTRY.inc("gc_versions_pruned_total", pruned)
+
+    def run_compaction(self):
+        """Delta-merge scheduling: fold oversized deltas into base blocks
+        so scans stay columnar (TiFlash background delta-merge)."""
+        storage = self.domain.storage
+        for tid in list(storage.table_ids()):
+            try:
+                storage.maybe_compact(tid)
+            except Exception:
+                pass  # raced a drop/lock; next tick retries
+
+    def watch_expensive(self):
+        """Flag statements running past tidb_expensive_query_time_threshold
+        (log + metric, once per statement) and kill those exceeding the
+        session's max_execution_time (expensivequery.go:50-154)."""
+        try:
+            thresh = float(self.domain.global_vars.get(
+                "tidb_expensive_query_time_threshold", "60"))
+        except ValueError:
+            thresh = 60.0
+        now = time.time()
+        for conn_id, sess in list(self.domain.sessions.items()):
+            start = getattr(sess, "stmt_start", None)
+            sql = getattr(sess, "stmt_sql", "")
+            if start is None:
+                continue
+            elapsed = now - start
+            key = (conn_id, start)
+            if elapsed >= thresh and key not in self.flagged:
+                self.flagged[key] = True
+                REGISTRY.inc("expensive_queries_total")
+                log.warning("expensive query (%.1fs, conn %s): %.200s",
+                            elapsed, conn_id, sql)
+            max_ms = 0
+            try:
+                max_ms = sess.vars.get_int("max_execution_time")
+            except Exception:
+                pass
+            if max_ms > 0 and elapsed * 1000 >= max_ms:
+                REGISTRY.inc("expensive_queries_killed_total")
+                log.warning("killing over-time query (conn %s): %.200s",
+                            conn_id, sql)
+                sess.kill()
+        # bounded memory for the once-per-statement markers
+        if len(self.flagged) > 1024:
+            dead = [k for k in self.flagged
+                    if getattr(self.domain.sessions.get(k[0]), "stmt_start",
+                               None) != k[1]]
+            for k in dead:
+                del self.flagged[k]

@@ -133,7 +133,11 @@ class Session:
             stmts[0]._sql_text = sql
         for stmt in stmts:
             t0 = time.time()
-            rs = self._execute_stmt(stmt, params)
+            self.stmt_start, self.stmt_sql = t0, sql  # watchdog visibility
+            try:
+                rs = self._execute_stmt(stmt, params)
+            finally:
+                self.stmt_start = None
             dur = time.time() - t0
             self.domain.record_stmt(sql, dur, len(rs.rows))
             out.append(rs)
@@ -143,8 +147,11 @@ class Session:
         """Convenience: rows of the last result set."""
         return self.execute(sql, params)[-1].rows
 
-    def kill(self):
-        self._killed = True
+    def kill(self, query_only: bool = True):
+        """KILL QUERY (default): cancel the in-flight statement only.
+        KILL CONNECTION (query_only=False): poison the session."""
+        if not query_only:
+            self._killed = True
         if self.last_exec_ctx is not None:
             self.last_exec_ctx.killed = True
 
@@ -202,6 +209,17 @@ class Session:
         from . import priv as _priv
 
         _priv.check_stmt(self, s)  # optimize.go:128-131 choke point
+        from ..errors import DeadlockError
+
+        try:
+            return self._dispatch_stmt(s, params)
+        except DeadlockError:
+            # the victim's whole transaction rolls back so the surviving
+            # waiter proceeds immediately (MySQL/TiDB deadlock handling)
+            self.rollback()
+            raise
+
+    def _dispatch_stmt(self, s, params=None) -> ResultSet:
         if isinstance(s, (ast.SelectStmt, ast.UnionStmt)):
             return self._run_query(s, params)
         if isinstance(s, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
@@ -583,10 +601,15 @@ class Session:
                              [("Warning", 0, w) for w in self._warnings],
                              is_query=True)
         if kind == "processlist":
-            rows = [(cid, "user", "localhost", sess.current_db, "Sleep")
-                    for cid, sess in self.domain.sessions.items()]
-            return ResultSet(["Id", "User", "Host", "db", "Command"], rows,
+            # single source of truth: the information_schema provider
+            from ..infoschema_tables import MEMTABLES
+
+            cols, provider = MEMTABLES["processlist"]
+            rows = provider(self.domain, isc)
+            return ResultSet([c[0].title() for c in cols], rows,
                              is_query=True)
+        if kind in ("stats_meta", "stats_histograms", "stats_buckets"):
+            return self._show_stats(kind)
         if kind == "regions":
             db = s.db or self.current_db
             t = isc.table(db, s.target)
@@ -616,6 +639,57 @@ class Session:
                 ["Db_name", "Table_name", "Base_rows", "Delta_rows", "Bytes"],
                 rows, is_query=True)
         raise PlanError(f"SHOW {kind} not supported")
+
+    def _show_stats(self, kind: str) -> ResultSet:
+        """SHOW STATS_META / STATS_HISTOGRAMS / STATS_BUCKETS over the
+        stats cache (statistics/handle + executor/show_stats.go)."""
+        import time as _time
+
+        isc = self.domain.catalog.info_schema()
+        stats = self.domain.stats
+        meta_rows, hist_rows, bucket_rows = [], [], []
+        for dbn in isc.schema_names():
+            for t in isc.tables(dbn):
+                if t.is_view:
+                    continue
+                targets = [("", t.id)]
+                if t.partition_info is not None:
+                    targets += [(p.name, p.id)
+                                for p in t.partition_info.defs]
+                for part_name, tid in targets:
+                    st = stats.get(tid)
+                    if st is None:
+                        continue
+                    mtime = _time.strftime(
+                        "%Y-%m-%d %H:%M:%S",
+                        _time.localtime(st.build_time or 0))
+                    meta_rows.append((dbn, t.name, part_name, mtime,
+                                      st.modify_count, st.row_count))
+                    for ci, cs in sorted(st.columns.items()):
+                        if ci >= len(t.columns):
+                            continue
+                        cname = t.columns[ci].name
+                        hist_rows.append((
+                            dbn, t.name, part_name, cname, 0,
+                            mtime, cs.ndv, cs.null_count,
+                            len(cs.hist.buckets)))
+                        for bi, b in enumerate(cs.hist.buckets):
+                            bucket_rows.append((
+                                dbn, t.name, part_name, cname, bi,
+                                b.count, b.repeat, b.lower, b.upper))
+        if kind == "stats_meta":
+            return ResultSet(
+                ["Db_name", "Table_name", "Partition_name", "Update_time",
+                 "Modify_count", "Row_count"], meta_rows, is_query=True)
+        if kind == "stats_histograms":
+            return ResultSet(
+                ["Db_name", "Table_name", "Partition_name", "Column_name",
+                 "Is_index", "Update_time", "Distinct_count", "Null_count",
+                 "Buckets"], hist_rows, is_query=True)
+        return ResultSet(
+            ["Db_name", "Table_name", "Partition_name", "Column_name",
+             "Bucket_id", "Count", "Repeats", "Lower_Bound", "Upper_Bound"],
+            bucket_rows, is_query=True)
 
     def _desc_table(self, tn: ast.TableName) -> ResultSet:
         t = self.domain.catalog.info_schema().table(

@@ -15,6 +15,10 @@ SYSVAR_DEFAULTS = {
     "autocommit": ("1", "bool"),
     "sql_mode": ("ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES", "str"),
     "max_execution_time": ("0", "int"),
+    # GC retention (seconds; gc_worker.go gcDefaultLifeTime is 10m) and
+    # the expensive-query log threshold (seconds, expensivequery.go)
+    "tidb_gc_life_time": ("600", "str"),
+    "tidb_expensive_query_time_threshold": ("60", "str"),
     "tx_isolation": ("REPEATABLE-READ", "str"),
     "transaction_isolation": ("REPEATABLE-READ", "str"),
     "time_zone": ("SYSTEM", "str"),

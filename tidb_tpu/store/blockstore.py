@@ -436,10 +436,12 @@ class TableStore:
             self.bulk_load_arrays(arrays, valids, ts)
             self.next_handle = self.base_rows
 
-    def gc(self, safepoint: int):
-        """Drop versions no reader at ts >= safepoint can see.
+    def gc(self, safepoint: int) -> int:
+        """Drop versions no reader at ts >= safepoint can see; returns the
+        number of versions pruned (counted under the store lock).
 
         Reference: store/tikv/gcworker (gc_worker.go:213-289)."""
+        pruned = 0
         with self._mu:
             for h in list(self.delta):
                 chain = self.delta[h]
@@ -448,7 +450,9 @@ class TableStore:
                 for i, v in enumerate(chain):
                     if v.commit_ts <= safepoint:
                         keep_from = i
+                pruned += keep_from
                 self.delta[h] = chain[keep_from:]
+        return pruned
 
     def column_stats(self, ci: int) -> Tuple[int, int, bool]:
         """(min, max, has_null) over base blocks for numeric/dict columns.

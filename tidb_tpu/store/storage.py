@@ -93,19 +93,30 @@ class BlockStorage(Storage):
     def has_table(self, table_id: int) -> bool:
         return table_id in self._tables
 
+    def table_ids(self):
+        with self._mu:
+            return list(self._tables.keys())
+
     # ---- kv.Storage interface ------------------------------------------
     def begin(self, start_ts: Optional[int] = None, pessimistic: bool = False) -> Transaction:
         txn = Transaction(
             self, start_ts or self.oracle.get_timestamp(), pessimistic
         )
-        self._live_txns.add(txn.start_ts)
+        with self._mu:
+            self._live_txns.add(txn.start_ts)
         return txn
 
     def txn_alive(self, start_ts: int) -> bool:
         return start_ts in self._live_txns
 
     def txn_finished(self, start_ts: int):
-        self._live_txns.discard(start_ts)
+        with self._mu:
+            self._live_txns.discard(start_ts)
+
+    def live_txn_floor(self):
+        """Oldest live txn start_ts, or None (snapshot under the lock)."""
+        with self._mu:
+            return min(self._live_txns) if self._live_txns else None
 
     def data_version(self) -> int:
         """Monotonic counter bumped on bulk load, compaction, and committed

@@ -170,11 +170,9 @@ class Transaction:
                 m = self.buffer[(tid, h)]
                 store = self.storage.table(tid)
                 pess = (tid, h) in self._locked
-                if pess:
-                    store.rollback(h, self.start_ts)  # upgrade pessimistic lock
-                # wait out foreign pessimistic/prewrite locks (the
-                # reference's prewrite backoff); a post-release newer
-                # commit still surfaces as TxnConflictError below.
+                # upgrade IN PLACE: prewrite overwrites our own lock
+                # atomically (blockstore allows same-start_ts rewrite), so
+                # no waiter can steal the row between release and rewrite.
                 # Keys we hold pessimistic locks on conflict-check at
                 # for_update_ts (the lock horizon), not start_ts.
                 self._prewrite_waiting(
@@ -186,6 +184,8 @@ class Transaction:
             for tid, h in prewritten:
                 self.storage.table(tid).rollback(h, self.start_ts)
             self.rolled_back = True
+            self.storage.deadlock.clean_up(self.start_ts)
+            self.storage.txn_finished(self.start_ts)
             raise
         if self.schema_check is not None:
             try:
@@ -194,6 +194,8 @@ class Transaction:
                 for tid, h in prewritten:
                     self.storage.table(tid).rollback(h, self.start_ts)
                 self.rolled_back = True
+                self.storage.deadlock.clean_up(self.start_ts)
+                self.storage.txn_finished(self.start_ts)
                 raise
         commit_ts = self.storage.oracle.get_timestamp()
         FAILPOINTS.hit("2pc/before_commit_primary", start_ts=self.start_ts)
