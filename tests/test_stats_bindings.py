@@ -1,0 +1,220 @@
+"""Stats sharpening (range intersection, index NDV, per-table plan cache)
+and SQL plan management (bindinfo-lite).
+
+Reference: statistics/selectivity.go (conjunct estimation),
+statistics/index.go (index NDV), planner/core/cache.go (plan cache key),
+bindinfo/handle.go:122,545 (bind-record match before planning)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def d():
+    dom = Domain()
+    dom.maintenance.stop()
+    return dom
+
+
+def _est(s, q):
+    for r in s.execute("explain " + q)[0].rows:
+        if "TableReader" in r[0] or "IndexLookUp" in r[0]:
+            return float(r[1])
+    return None
+
+
+@pytest.fixture()
+def loaded(d):
+    s = d.new_session()
+    s.execute("create table f (a bigint, b bigint, c bigint)")
+    t = d.catalog.info_schema().table("test", "f")
+    rng = np.random.default_rng(1)
+    n = 50_000
+    combo = rng.integers(0, 100, n)
+    d.storage.table(t.id).bulk_load_arrays(
+        [rng.integers(0, 1000, n), combo, combo * 7],
+        ts=d.storage.current_ts())
+    s.execute("create index iab on f (b, c)")
+    s.execute("analyze table f")
+    return s
+
+
+def test_range_conjunction_intersects(loaded):
+    """a > 100 AND a < 200 estimates as ONE interval (~5k of 50k), not as
+    two independent quarter-selective conds."""
+    e = _est(loaded, "select * from f where a > 100 and a < 200")
+    assert 3000 < e < 8000, e
+
+
+def test_correlated_eq_uses_index_ndv(loaded):
+    """b and c are perfectly correlated (c = 7b, 100 combos); the (b,c)
+    index NDV estimates ~500 rows where independence would say ~5."""
+    e = _est(loaded, "select * from f where b = 5 and c = 35")
+    assert 200 < e < 1500, e
+
+
+def test_estimates_move_with_analyze(d):
+    s = d.new_session()
+    s.execute("create table g (a bigint)")
+    t = d.catalog.info_schema().table("test", "g")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(1000, dtype=np.int64)], ts=d.storage.current_ts())
+    s.execute("analyze table g")
+    e1 = _est(s, "select * from g where a < 100")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.zeros(9000, dtype=np.int64)], ts=d.storage.current_ts())
+    s.execute("analyze table g")
+    e2 = _est(s, "select * from g where a < 100")
+    assert e2 > e1 * 5  # the new skew shows up in the estimate
+
+
+def test_plan_cache_per_table_versions(d):
+    s = d.new_session()
+    s.execute("create table pa (x bigint)")
+    s.execute("create table pb (y bigint)")
+    s.execute("insert into pa values (1)")
+    s.execute("insert into pb values (1)")
+    s.query("select * from pa")
+
+    def hits():
+        return REGISTRY.snapshot().get("plan_cache_hits_total", 0)
+
+    base = hits()
+    s.query("select * from pa")
+    assert hits() == base + 1  # repeat hits
+    s.execute("insert into pb values (2)")  # unrelated DML
+    s.query("select * from pa")
+    assert hits() == base + 2  # survives
+    s.execute("analyze table pb")  # unrelated ANALYZE
+    s.query("select * from pa")
+    assert hits() == base + 3  # survives
+    s.execute("insert into pa values (2)")  # related DML
+    s.query("select * from pa")
+    assert hits() == base + 3  # invalidated (miss)
+    assert s.query("select count(*) from pa") == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# bindinfo
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def joined(d):
+    s = d.new_session()
+    s.execute("create table big (id bigint, v bigint)")
+    s.execute("create table small (id bigint primary key, x bigint)")
+    t = d.catalog.info_schema().table("test", "big")
+    rng = np.random.default_rng(0)
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(20_000) % 500, rng.integers(0, 9, 20_000)],
+        ts=d.storage.current_ts())
+    s.execute("insert into small values " +
+              ", ".join(f"({i},{i})" for i in range(500)))
+    s.execute("analyze table big")
+    s.execute("analyze table small")
+    return s
+
+
+_Q = ("select count(*) from big join small on big.id = small.id"
+      " where small.x < 10")
+
+
+def _ops(s, q):
+    return [r[0] for r in s.execute("explain " + q)[0].rows]
+
+
+def test_binding_flips_join_algorithm(joined):
+    s = joined
+    assert any("HashJoin" in op for op in _ops(s, _Q))
+    s.execute(f"create session binding for {_Q} using "
+              f"select /*+ MERGE_JOIN */ count(*) from big join small"
+              f" on big.id = small.id where small.x < 10")
+    assert any("MergeJoin" in op for op in _ops(s, _Q))
+    # literals normalize away: a different constant still matches
+    q2 = _Q.replace("< 10", "< 7")
+    assert any("MergeJoin" in op for op in _ops(s, q2))
+    # execution uses the bound plan and stays correct
+    assert s.query(_Q) == [(400,)]
+    s.execute(f"drop session binding for {_Q}")
+    assert any("HashJoin" in op for op in _ops(s, _Q))
+
+
+def test_global_binding_and_show(joined, d):
+    s = joined
+    s.execute(f"create global binding for {_Q} using "
+              f"select /*+ MERGE_JOIN */ count(*) from big join small"
+              f" on big.id = small.id where small.x < 10")
+    # a different session sees the global binding
+    s2 = d.new_session()
+    assert any("MergeJoin" in op for op in _ops(s2, _Q))
+    rows = s.query("show bindings")
+    assert rows and rows[0][2] == "global"
+    s.execute(f"drop global binding for {_Q}")
+    assert s.query("show bindings") == []
+
+
+def test_binding_applies_to_for_join_using_clause(d):
+    """JOIN ... USING (col) in the original must not confuse the USING
+    splitter."""
+    s = d.new_session()
+    s.execute("create table u1 (k bigint)")
+    s.execute("create table u2 (k bigint)")
+    q = "select count(*) from u1 join u2 using (k)"
+    s.execute(f"create session binding for {q} using "
+              f"select /*+ MERGE_JOIN */ count(*) from u1 join u2 using (k)")
+    assert any("MergeJoin" in op for op in _ops(s, q))
+
+
+def test_compaction_deferred_under_open_snapshot(d):
+    """Background compaction must not fold the delta while a transaction
+    holds an older snapshot (it would see an empty table mid-txn)."""
+    s = d.new_session()
+    s.execute("create table sn (id bigint, v bigint)")
+    t = d.catalog.info_schema().table("test", "sn")
+    store = d.storage.table(t.id)
+    txn = d.storage.begin()
+    for i in range(5000):
+        txn.put(t.id, store.alloc_handle(), (i, i))
+    txn.commit()
+    reader = d.new_session()
+    reader.execute("begin")
+    assert reader.query("select count(*) from sn") == [(5000,)]
+    d.maintenance.tick()
+    assert reader.query("select count(*) from sn") == [(5000,)]
+    reader.execute("commit")
+    d.maintenance.tick()
+    assert len(store.delta) == 0  # folded once the snapshot closed
+
+
+def test_index_join_toggle_invalidates_cache(d):
+    s = d.new_session()
+    s.execute("create table jb (id bigint, v bigint)")
+    s.execute("create table js (id bigint primary key, x bigint)")
+    s.execute("insert into js values (1,1)")
+    s.execute("insert into jb values (1,1)")
+    q = "select count(*) from js join jb on js.id = jb.id"
+    s.query(q)
+    s.query(q)  # cached
+    s.execute("set tidb_opt_enable_index_join = 0")
+    plan = [r[0] for r in s.execute("explain " + q)[0].rows]
+    assert not any("IndexJoin" in x for x in plan), plan
+
+
+def test_index_ndv_survives_auto_analyze_and_string_deltas(d):
+    s = d.new_session()
+    s.execute("create table ixs (a varchar(4), b varchar(4))")
+    s.execute("insert into ixs values ('x','y'), ('x','y'), ('p','q')")
+    s.execute("create index iab on ixs (a, b)")
+    s.execute("analyze table ixs")
+    tid = d.catalog.info_schema().table("test", "ixs").id
+    assert list(d.stats.get(tid).index_ndv.values()) == [2]
+    # heavy churn triggers auto-analyze; delta strings must encode into
+    # the same dictionary domain as base codes (no double counting)
+    s.execute("insert into ixs values " +
+              ", ".join("('x','y')" for _ in range(10)))
+    st = d.stats.get(tid)
+    assert st.index_ndv and list(st.index_ndv.values()) == [2]

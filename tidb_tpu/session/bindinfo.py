@@ -1,0 +1,164 @@
+"""SQL plan management (bindinfo-lite).
+
+Reference: bindinfo/handle.go:122 (the bind-record cache consulted before
+planning), :545 (capture), bindinfo/session_handle.go (SESSION scope).
+Grammar matches the reference:
+
+    CREATE [GLOBAL | SESSION] BINDING FOR <stmt> USING <hinted stmt>
+    DROP   [GLOBAL | SESSION] BINDING FOR <stmt>
+    SHOW   [GLOBAL | SESSION] BINDINGS
+
+Bindings key on the normalized digest of the original statement; when a
+statement's digest matches, the HINTED statement's AST is planned instead
+and its /*+ ... */ hints override the optimizer knobs for that plan only
+(the planner consults them through Session._pctx).  Supported hints:
+MERGE_JOIN, HASH_JOIN, INL_JOIN / INDEX_JOIN, INL_HASH_JOIN,
+NO_INDEX_JOIN.  Global bindings live on the Domain, session bindings on
+the Session; SESSION shadows GLOBAL (bindinfo/session_handle.go order).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+from ..errors import PlanError
+from ..parser import parse
+from .domain import sql_digest
+
+_BINDING_RE = re.compile(
+    r"^\s*(create|drop)\s+(?:(global|session)\s+)?binding\s+for\s",
+    re.I | re.S)
+_SHOW_RE = re.compile(
+    r"^\s*show\s+(?:(global|session)\s+)?bindings\s*;?\s*$", re.I)
+_HINT_RE = re.compile(r"/\*\+(.*?)\*/", re.S)
+
+
+def is_binding_stmt(sql: str) -> bool:
+    return bool(_BINDING_RE.match(sql) or _SHOW_RE.match(sql))
+
+
+def extract_hints(sql: str) -> frozenset:
+    names = set()
+    for body in _HINT_RE.findall(sql):
+        for tok in re.split(r"[\s,()]+", body):
+            if tok:
+                names.add(tok.lower())
+    return frozenset(names)
+
+
+def _split_for_using(tail: str) -> Tuple[str, str]:
+    """'<orig> USING <hinted>' -> (orig, hinted): the splitting USING is at
+    paren-depth 0, outside quotes, followed by a statement keyword (so JOIN
+    ... USING (cols) never matches)."""
+    low = tail.lower()
+    depth = 0
+    i, n = 0, len(tail)
+    while i < n:
+        c = tail[i]
+        if c in "'\"":
+            q = c
+            i += 1
+            while i < n and tail[i] != q:
+                i += 2 if tail[i] == "\\" else 1
+            i += 1
+            continue
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif depth == 0 and low.startswith("using", i) and \
+                (i == 0 or not low[i - 1].isalnum()) and \
+                (i + 5 >= n or not low[i + 5].isalnum()):
+            rest = low[i + 5:].lstrip()
+            if re.match(r"(/\*|select|insert|update|delete)\b", rest) or \
+                    rest.startswith("/*"):
+                return tail[:i].strip(), tail[i + 5:].strip()
+        i += 1
+    raise PlanError("CREATE BINDING requires USING <hinted statement>")
+
+
+def _store(session, is_global: bool) -> dict:
+    if is_global:
+        if not hasattr(session.domain, "bindings"):
+            session.domain.bindings = {}
+        return session.domain.bindings
+    if not hasattr(session, "_bindings"):
+        session._bindings = {}
+    return session._bindings
+
+
+def _bump(session, is_global: bool):
+    if is_global:
+        session.domain.bindings_version = getattr(
+            session.domain, "bindings_version", 0) + 1
+    else:
+        session._bindings_version = getattr(
+            session, "_bindings_version", 0) + 1
+
+
+def handle(session, sql: str):
+    from .session import ResultSet
+
+    m = _SHOW_RE.match(sql)
+    if m:
+        scope = (m.group(1) or "session").lower()
+        rows = []
+        for scope_name, store in (("session", _store(session, False)),
+                                  ("global", _store(session, True))):
+            if scope in (scope_name,) or m.group(1) is None:
+                for digest, b in sorted(store.items()):
+                    rows.append((b["original"], b["hinted"], scope_name))
+        return ResultSet(["Original_sql", "Bind_sql", "Scope"], rows,
+                         is_query=True)
+    m = _BINDING_RE.match(sql)
+    verb = m.group(1).lower()
+    is_global = (m.group(2) or "session").lower() == "global"
+    tail = sql[m.end():].strip().rstrip(";")
+    if verb == "create":
+        orig, hinted = _split_for_using(tail)
+        # both sides must parse; the hinted side is what gets planned
+        parse(orig)
+        parse(re.sub(r"/\*.*?\*/", " ", hinted, flags=re.S))
+        store = _store(session, is_global)
+        store[sql_digest(orig)] = {
+            "original": orig,
+            "hinted": hinted,
+            "hints": extract_hints(hinted),
+        }
+        _bump(session, is_global)
+        return ResultSet()
+    # DROP
+    digest = sql_digest(tail)
+    store = _store(session, is_global)
+    if store.pop(digest, None) is not None:
+        _bump(session, is_global)
+    return ResultSet()
+
+
+def apply_binding(session, stmt) -> Tuple[object, Optional[frozenset]]:
+    """Swap a statement for its bound hinted form (handle.go:122 — the
+    match runs on the normalized digest before planning)."""
+    sql = getattr(stmt, "_sql_text", None)
+    if sql is None:
+        return stmt, None
+    # EXPLAIN wraps the statement: bindings match the inner text
+    probe = re.sub(r"^\s*(explain|trace)\s+(analyze\s+)?", "", sql,
+                   flags=re.I)
+    digest = sql_digest(probe)
+    b = _store(session, False).get(digest) or \
+        _store(session, True).get(digest)
+    if b is None:
+        return stmt, None
+    from ..metrics import REGISTRY
+
+    REGISTRY.inc("binding_hits_total")
+    clean = re.sub(r"/\*.*?\*/", " ", b["hinted"], flags=re.S)
+    bound = parse(clean)[0]
+    bound._sql_text = sql  # cache key stays on the original text
+    # EXPLAIN/TRACE plan the target, not the wrapper
+    target = getattr(stmt, "target", None)
+    if target is not None and not isinstance(bound, type(stmt)):
+        stmt.target = bound
+        return stmt, b["hints"]
+    return bound, b["hints"]

@@ -129,8 +129,9 @@ class Domain:
                 if not self.stats.need_auto_analyze(tid):
                     continue
                 owner = isc.table_by_id(tid)
-                if owner is not None and owner.partition_info is not None \
-                        and owner.id not in done:
+                if owner is not None and owner.id not in done:
+                    # schema-aware analyze keeps index NDV stats fresh
+                    # (a bare analyze_table would silently drop them)
                     done.add(owner.id)
                     self.stats.analyze(owner)
                 else:

@@ -126,6 +126,10 @@ class Session:
     # public API
     # ------------------------------------------------------------------
     def execute(self, sql: str, params: Optional[list] = None) -> List[ResultSet]:
+        from . import bindinfo
+
+        if bindinfo.is_binding_stmt(sql):
+            return [bindinfo.handle(self, sql)]
         out = []
         stmts = parse(sql)
         if len(stmts) == 1:
@@ -284,20 +288,34 @@ class Session:
     # ------------------------------------------------------------------
     # queries
     # ------------------------------------------------------------------
-    def _pctx(self) -> PhysicalContext:
+    def _pctx(self, hints=None) -> PhysicalContext:
         dirty = frozenset(
             tid for (tid, _h) in (self._txn.buffer.keys() if self._txn else ())
         )
+        prefer_merge = self.vars.get_bool("tidb_opt_prefer_merge_join")
+        enable_ij = self.vars.get_bool("tidb_opt_enable_index_join")
+        variant = (self.vars.get("tidb_index_join_variant") or "lookup").lower()
+        if hints:
+            # per-statement optimizer hints (binding USING /*+ ... */)
+            if "merge_join" in hints:
+                prefer_merge, enable_ij = True, False
+            if "hash_join" in hints:
+                prefer_merge, enable_ij = False, False
+            if "inl_join" in hints or "index_join" in hints:
+                enable_ij, prefer_merge = True, False
+            if "inl_hash_join" in hints:
+                enable_ij, prefer_merge, variant = True, False, "hash"
+            if "no_index_join" in hints:
+                enable_ij = False
         return PhysicalContext(
             storage=self.domain.storage,
             dirty_tables=dirty,
             pushdown_blacklist=frozenset(),
             enable_pushdown=self.vars.get_bool("tidb_enable_pushdown"),
             stats=self.domain.stats,
-            prefer_merge_join=self.vars.get_bool("tidb_opt_prefer_merge_join"),
-            enable_index_join=self.vars.get_bool("tidb_opt_enable_index_join"),
-            index_join_variant=(self.vars.get("tidb_index_join_variant")
-                                or "lookup").lower(),
+            prefer_merge_join=prefer_merge,
+            enable_index_join=enable_ij,
+            index_join_variant=variant,
         )
 
     def _exec_ctx(self, current_read: bool = False) -> ExecContext:
@@ -325,6 +343,9 @@ class Session:
         return rows
 
     def _plan(self, stmt, params=None):
+        from . import bindinfo
+
+        stmt, hints = bindinfo.apply_binding(self, stmt)
         key = self._plan_cache_key(stmt, params)
         if key is not None:
             hit = self._plan_cache.get(key)
@@ -336,7 +357,7 @@ class Session:
                 return hit
         phys = plan_statement(
             stmt, self.domain.catalog.info_schema(), self.current_db,
-            self._pctx(), exec_subplan=self._exec_subplan,
+            self._pctx(hints), exec_subplan=self._exec_subplan,
             param_values=params,
         )
         if key is not None:
@@ -350,7 +371,8 @@ class Session:
 
     def _plan_cache_key(self, stmt, params):
         """Cache key for repeated statements (planner/core/cache.go analog:
-        keyed on text + schema version + data versions + planner vars).
+        keyed on text + schema version + PER-TABLE data versions + planner
+        vars) — DML against unrelated tables leaves cached plans valid.
         None disables caching: txn writes change pushdown eligibility, and
         parameterized plans bake constant ranges."""
         if params is not None or self._txn is not None:
@@ -360,13 +382,52 @@ class Session:
         sql = getattr(stmt, "_sql_text", None)
         if sql is None:
             return None
+        from .priv import _walk_tables
+
+        refs: list = []
+        _walk_tables(stmt, refs)
+        isc = self.domain.catalog.info_schema()
+        vers = []
+        seen = set()
+        for tn in refs:
+            db = (tn.db or self.current_db).lower()
+            name = tn.name.lower()
+            if (db, name) in seen:
+                continue
+            seen.add((db, name))
+            if db in ("information_schema", "performance_schema"):
+                return None  # memtables: live state, never cache
+            if not isc.has_table(db, name):
+                return None
+            t = isc.table(db, name)
+            if t.is_view:
+                # views hide their base tables from the AST walk: fall
+                # back to the global version (always-correct, coarser)
+                vers.append(("__global__",
+                             self.domain.storage.data_version()))
+                continue
+            for pid in (t.physical_ids() + [t.id]
+                        if t.partition_info else [t.id]):
+                st = self.domain.stats.get(pid)
+                stats_ver = (st.version, st.build_time) if st else None
+                if pid == t.id and t.partition_info:
+                    vers.append((pid, 0, stats_ver))
+                    continue
+                try:
+                    store = self.domain.storage.table(pid)
+                except KVError:
+                    return None
+                vers.append((pid, store.mutations, stats_ver))
         return (
             sql, self.current_db,
             self.domain.catalog.schema_version,
-            self.domain.storage.data_version(),
-            getattr(self.domain.stats, "epoch", 0),
+            tuple(vers),
+            getattr(self.domain, "bindings_version", 0),
+            getattr(self, "_bindings_version", 0),
             self.vars.get_bool("tidb_enable_pushdown"),
             self.vars.get_bool("tidb_opt_prefer_merge_join"),
+            self.vars.get_bool("tidb_opt_enable_index_join"),
+            self.vars.get("tidb_index_join_variant"),
         )
 
     def _run_query(self, stmt, params=None) -> ResultSet:
@@ -477,6 +538,10 @@ class Session:
         if isinstance(s.target, (ast.SelectStmt, ast.UnionStmt,
                                  ast.InsertStmt, ast.UpdateStmt,
                                  ast.DeleteStmt)):
+            outer = getattr(s, "_sql_text", None)
+            if outer is not None:
+                # bindings match on the inner statement's digest
+                s.target._sql_text = outer
             phys = self._plan(s.target)
         else:
             raise PlanError("EXPLAIN supports SELECT/DML only")

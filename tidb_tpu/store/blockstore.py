@@ -85,6 +85,7 @@ class TableStore:
         # durability hook (store/persist.TablePersister); None = RAM-only
         self.persister = None
         self.on_mutate = None  # storage-level data-version bump (plan cache)
+        self.mutations = 0  # per-store committed-write counter (plan cache)
         from .index import IndexManager
 
         self.indexes = IndexManager()
@@ -167,6 +168,7 @@ class TableStore:
             self.base_ts = max(self.base_ts, ts)
             self.base_version += 1
             self._col_stats.clear()
+            self.mutations += 1
             if self.on_mutate is not None:
                 self.on_mutate()
             if self.persister is not None:
@@ -315,6 +317,7 @@ class TableStore:
                 return
             ver = Version(commit_ts, start_ts, lk.op, lk.values)
             self.delta.setdefault(handle, []).append(ver)
+            self.mutations += 1
             if self.on_mutate is not None:
                 self.on_mutate()
             if self.persister is not None:

@@ -145,6 +145,11 @@ class BlockStorage(Storage):
         t = self._tables.get(table_id)
         if t is None or t.locks:
             return
+        if self.live_txn_floor() is not None:
+            # compaction advances base_ts and folds the delta: an open
+            # snapshot reader would see an empty table mid-transaction.
+            # Defer until no transaction is pinned (same rule as GC).
+            return
         if len(t.delta) > max(threshold, t.base_rows // 10):
             try:
                 t.compact(self.current_ts())
