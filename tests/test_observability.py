@@ -118,3 +118,47 @@ def test_http_endpoints(d):
             assert e.code == 404
     finally:
         srv.stop()
+
+
+def test_infoschema_breadth(d):
+    s = d.new_session()
+    s.execute("create table ib (k bigint primary key, v varchar(4))"
+              " partition by range (k) ("
+              " partition p0 values less than (10),"
+              " partition p1 values less than maxvalue)")
+    s.execute("insert into ib values (1, 'a'), (50, 'b')")
+    s.execute("create view vv as select k from ib")
+    parts = s.query("select partition_name, partition_method,"
+                    " partition_description, table_rows from"
+                    " information_schema.partitions"
+                    " where table_name = 'ib' order by partition_name")
+    assert parts == [("p0", "RANGE", "10", 1), ("p1", "RANGE", "MAXVALUE", 1)]
+    assert s.query("select table_name from information_schema.views") == [
+        ("vv",)]
+    idx = s.query("select key_name, column_name from"
+                  " information_schema.tidb_indexes"
+                  " where table_name = 'ib'")
+    assert ("PRIMARY", "k") in idx
+    assert s.query("select constraint_name from"
+                   " information_schema.key_column_usage"
+                   " where table_name = 'ib'") == [("PRIMARY",)]
+    assert s.query("select engine from information_schema.engines") == [
+        ("tidb-tpu",)]
+
+
+def test_hash_and_encoding_functions(d):
+    import hashlib
+    import zlib
+
+    s = d.new_session()
+    (md5, sha, sha2, crc, hx, unhx, b64, unb64), = s.query(
+        "select md5('abc'), sha1('abc'), sha2('abc', 512), crc32('abc'),"
+        " hex(255), unhex('4869'), to_base64('hi'), from_base64('aGk=')")
+    assert md5 == hashlib.md5(b"abc").hexdigest()
+    assert sha == hashlib.sha1(b"abc").hexdigest()
+    assert sha2 == hashlib.sha512(b"abc").hexdigest()
+    assert crc == zlib.crc32(b"abc")
+    assert (hx, unhx, b64, unb64) == ("FF", "Hi", "aGk=", "hi")
+    assert s.query("select sha2('x', 3)") == [(None,)]  # bad bits -> NULL
+    assert s.query("select uncompress(compress('roundtrip'))") == [
+        ("roundtrip",)]

@@ -2027,3 +2027,132 @@ def _find_in_set(func, args, n):
         except ValueError:
             out[i] = 0
     return Vec(func.ftype, out, combined_valid(*args))
+
+
+# ---------------------------------------------------------------------------
+# encryption / encoding functions — expression/builtin_encryption_vec.go
+# (md5/sha/sha2/crc32) + builtin_string_vec.go hex/unhex/to_base64
+# ---------------------------------------------------------------------------
+
+
+@register("md5", lambda t, m: ty_string(True))
+def _md5(func, args, n):
+    import hashlib
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    for i, x in enumerate(data):
+        out[i] = hashlib.md5(str(x).encode()).hexdigest()
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("sha1", lambda t, m: ty_string(True))
+@register("sha", lambda t, m: ty_string(True))
+def _sha1(func, args, n):
+    import hashlib
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    for i, x in enumerate(data):
+        out[i] = hashlib.sha1(str(x).encode()).hexdigest()
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("sha2", lambda t, m: ty_string(True))
+def _sha2(func, args, n):
+    import hashlib
+
+    data = _str_data(args[0])
+    bits = _to_float(args[1]).astype(np.int64) if len(args) > 1 else \
+        np.full(n, 256, dtype=np.int64)
+    algos = {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384",
+             512: "sha512"}
+    out = np.empty(n, dtype=object)
+    cv = combined_valid(*args)
+    valid = cv.copy() if cv is not None else np.ones(n, dtype=np.bool_)
+    for i, x in enumerate(data):
+        if not valid[i]:
+            out[i] = ""
+            continue
+        algo = algos.get(int(bits[i]))
+        if algo is None:
+            out[i] = ""
+            valid[i] = False  # MySQL: invalid length -> NULL
+            continue
+        out[i] = hashlib.new(algo, str(x).encode()).hexdigest()
+    return Vec(func.ftype, out, valid)
+
+
+@register("unhex", lambda t, m: ty_string(True))
+def _unhex(func, args, n):
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    valid = args[0].validity().copy()
+    for i, x in enumerate(data):
+        try:
+            out[i] = bytes.fromhex(str(x)).decode("utf-8", "replace")
+        except ValueError:
+            out[i] = ""
+            valid[i] = False
+    return Vec(func.ftype, out, valid)
+
+
+@register("to_base64", lambda t, m: ty_string(True))
+def _to_base64(func, args, n):
+    import base64
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    for i, x in enumerate(data):
+        out[i] = base64.b64encode(str(x).encode()).decode()
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("from_base64", lambda t, m: ty_string(True))
+def _from_base64(func, args, n):
+    import base64
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    valid = args[0].validity().copy()
+    for i, x in enumerate(data):
+        try:
+            out[i] = base64.b64decode(str(x)).decode("utf-8", "replace")
+        except Exception:
+            out[i] = ""
+            valid[i] = False
+    return Vec(func.ftype, out, valid)
+
+
+@register("compress", lambda t, m: ty_string(True))
+def _compress(func, args, n):
+    import zlib
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    for i, x in enumerate(data):
+        raw = str(x).encode()
+        out[i] = (len(raw).to_bytes(4, "little") + zlib.compress(raw)).hex() \
+            if raw else ""
+    return Vec(func.ftype, out, args[0].valid)
+
+
+@register("uncompress", lambda t, m: ty_string(True))
+def _uncompress(func, args, n):
+    import zlib
+
+    data = _str_data(args[0])
+    out = np.empty(n, dtype=object)
+    valid = args[0].validity().copy()
+    for i, x in enumerate(data):
+        sv = str(x)
+        if sv == "":
+            out[i] = ""  # MySQL: UNCOMPRESS('') is ''
+            continue
+        try:
+            blob = bytes.fromhex(sv)
+            out[i] = zlib.decompress(blob[4:]).decode("utf-8", "replace")
+        except Exception:
+            out[i] = ""
+            valid[i] = False
+    return Vec(func.ftype, out, valid)

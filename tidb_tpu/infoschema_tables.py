@@ -164,3 +164,125 @@ def _metrics(domain, isc):
     from .metrics import REGISTRY
 
     return sorted(REGISTRY.snapshot().items())
+
+
+@_register("views", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("view_definition", ty_string()),
+])
+def _views(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            if t.is_view:
+                sel = t.view_select
+                rows.append((dbn, t.name,
+                             sel if isinstance(sel, str) else "<ast>"))
+    return rows
+
+
+@_register("partitions", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("partition_name", ty_string()), ("partition_method", ty_string()),
+    ("partition_expression", ty_string()),
+    ("partition_description", ty_string()), ("table_rows", ty_int()),
+])
+def _partitions(domain, isc):
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            if t.is_view:
+                continue
+            pi = t.partition_info
+            if pi is None:
+                rows.append((dbn, t.name, "", "", "", "", 0))
+                continue
+            for pd in pi.defs:
+                try:
+                    store = domain.storage.table(pd.id)
+                    n = store.base_rows + len(store.delta)
+                except Exception:
+                    n = 0
+                desc = ("MAXVALUE" if pd.less_than is None
+                        else str(pd.less_than)) if pi.kind == "range" else ""
+                rows.append((dbn, t.name, pd.name, pi.kind.upper(),
+                             pi.column, desc, n))
+    return rows
+
+
+@_register("tidb_indexes", [
+    ("table_schema", ty_string()), ("table_name", ty_string()),
+    ("key_name", ty_string()), ("non_unique", ty_int()),
+    ("seq_in_index", ty_int()), ("column_name", ty_string()),
+    ("index_id", ty_int()),
+])
+def _tidb_indexes(domain, isc):
+    from .catalog.schema import STATE_PUBLIC
+
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            for ix in t.indexes:
+                if ix.state != STATE_PUBLIC:
+                    continue  # half-built online-DDL indexes stay hidden
+                for seq, col in enumerate(ix.columns):
+                    rows.append((dbn, t.name, ix.name,
+                                 0 if ix.unique else 1, seq + 1, col, ix.id))
+    return rows
+
+
+@_register("engines", [
+    ("engine", ty_string()), ("support", ty_string()),
+    ("comment", ty_string()),
+])
+def _engines(domain, isc):
+    return [("tidb-tpu", "DEFAULT",
+             "columnar MVCC block store, TPU coprocessor")]
+
+
+@_register("collations", [
+    ("collation_name", ty_string()), ("character_set_name", ty_string()),
+    ("is_default", ty_string()),
+])
+def _collations(domain, isc):
+    return [("utf8mb4_bin", "utf8mb4", "Yes"),
+            ("utf8mb4_general_ci", "utf8mb4", "")]
+
+
+@_register("character_sets", [
+    ("character_set_name", ty_string()),
+    ("default_collate_name", ty_string()), ("maxlen", ty_int()),
+])
+def _character_sets(domain, isc):
+    return [("utf8mb4", "utf8mb4_bin", 4)]
+
+
+@_register("key_column_usage", [
+    ("constraint_name", ty_string()), ("table_schema", ty_string()),
+    ("table_name", ty_string()), ("column_name", ty_string()),
+    ("ordinal_position", ty_int()),
+])
+def _key_column_usage(domain, isc):
+    from .catalog.schema import STATE_PUBLIC
+
+    rows = []
+    for dbn in isc.schema_names():
+        for t in isc.tables(dbn):
+            for ix in t.indexes:
+                if not (ix.primary or ix.unique):
+                    continue
+                if ix.state != STATE_PUBLIC:
+                    continue
+                name = "PRIMARY" if ix.primary else ix.name
+                for seq, col in enumerate(ix.columns):
+                    rows.append((name, dbn, t.name, col, seq + 1))
+    return rows
+
+
+@_register("cluster_info", [
+    ("type", ty_string()), ("instance", ty_string()),
+    ("status_address", ty_string()), ("version", ty_string()),
+])
+def _cluster_info(domain, isc):
+    return [("tidb-tpu", "in-process", "127.0.0.1:10080",
+             "8.0.11-tidb-tpu-0.1.0")]
