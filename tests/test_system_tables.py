@@ -1,0 +1,131 @@
+"""mysql.* system tables (internal-SQL surface) and LOCK/UNLOCK TABLES.
+
+Reference: session/bootstrap.go (mysql.user/db/tables_priv/bind_info/
+stats_meta bootstrap tables), ddl/table_lock.go + MySQL LOCK TABLES
+semantics."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBTPUError
+from tidb_tpu.session import Domain
+
+
+@pytest.fixture()
+def d():
+    dom = Domain()
+    dom.maintenance.stop()
+    return dom
+
+
+def test_mysql_grant_tables_reflect_priv_state(d):
+    s = d.new_session()
+    s.execute("create user app identified by 'pw'")
+    s.execute("grant select on test.t1 to app")
+    s.execute("grant insert, delete on appdb.* to app")
+    users = dict((u, p) for _h, u, _a, p in
+                 s.query("select * from mysql.user"))
+    assert users["root"] == "ALL"
+    assert users["app"] == "USAGE"
+    assert s.query("select db, priv from mysql.db where user = 'app'") == [
+        ("appdb", "DELETE,INSERT")]
+    assert s.query("select table_name, table_priv from mysql.tables_priv"
+                   " where user = 'app'") == [("t1", "SELECT")]
+    # passwords stored as stage2 hashes, never plaintext
+    (auth,), = s.query("select authentication_string from mysql.user"
+                       " where user = 'app'")
+    assert auth and "pw" not in auth
+
+
+def test_mysql_bind_info_and_stats_meta(d):
+    s = d.new_session()
+    s.execute("create table bt (a bigint)")
+    s.execute("insert into bt values (1), (2)")
+    s.execute("create global binding for select * from bt using"
+              " select /*+ HASH_JOIN */ * from bt")
+    assert s.query("select status from mysql.bind_info") == [("using",)]
+    s.execute("analyze table bt")
+    rows = s.query("select count from mysql.stats_meta")
+    assert (2,) in rows
+
+
+def test_mysql_tables_priv_protected(d):
+    from tidb_tpu.errors import PrivilegeError
+
+    s = d.new_session()
+    s.execute("create user peek")
+    peek = d.new_session()
+    peek.user = "peek@%"
+    with pytest.raises(PrivilegeError):
+        peek.execute("select * from mysql.user")
+
+
+def test_lock_tables_semantics(d):
+    a, b = d.new_session(), d.new_session()
+    a.execute("create table lt (x bigint)")
+    a.execute("insert into lt values (1)")
+    a.execute("create table other (y bigint)")
+    a.execute("lock tables lt read")
+    assert a.query("select * from lt") == [(1,)]
+    with pytest.raises(TiDBTPUError):  # READ lock: owner can't write
+        a.execute("insert into lt values (2)")
+    with pytest.raises(TiDBTPUError):  # unlocked table inaccessible
+        a.query("select * from other")
+    assert b.query("select * from lt") == [(1,)]  # READ is shared
+    with pytest.raises(TiDBTPUError):  # ...but blocks foreign writes
+        b.execute("insert into lt values (3)")
+    a.execute("unlock tables")
+    a.execute("lock tables lt write")
+    with pytest.raises(TiDBTPUError):  # WRITE excludes foreign reads
+        b.query("select * from lt")
+    a.execute("insert into lt values (9)")  # owner writes fine
+    a.execute("unlock tables")
+    assert sorted(b.query("select * from lt")) == [(1,), (9,)]
+
+
+def test_lock_tables_released_by_relock(d):
+    a = d.new_session()
+    a.execute("create table r1 (x bigint)")
+    a.execute("create table r2 (x bigint)")
+    a.execute("lock tables r1 write")
+    a.execute("lock tables r2 write")  # implicitly releases r1
+    b = d.new_session()
+    assert b.query("select * from r1") == []  # r1 free again
+    with pytest.raises(TiDBTPUError):
+        b.query("select * from r2")
+    a.execute("unlock tables")
+
+
+def test_shared_read_locks_track_owners(d):
+    a, b, c = d.new_session(), d.new_session(), d.new_session()
+    a.execute("create table sr (x bigint)")
+    a.execute("insert into sr values (1)")
+    a.execute("lock tables sr read")
+    b.execute("lock tables sr read")  # shared
+    b.execute("unlock tables")  # must not drop A's hold
+    assert a.query("select * from sr") == [(1,)]
+    with pytest.raises(TiDBTPUError):
+        c.execute("insert into sr values (2)")
+    a.execute("unlock tables")
+    c.execute("insert into sr values (2)")  # free now
+
+
+def test_foreign_lock_blocks_ddl(d):
+    a, b = d.new_session(), d.new_session()
+    a.execute("create table dl (x bigint)")
+    a.execute("lock tables dl read")
+    for q in ("drop table dl", "truncate table dl",
+              "alter table dl add column y bigint",
+              "create index i on dl (x)"):
+        with pytest.raises(TiDBTPUError):
+            b.execute(q)
+    a.execute("unlock tables")
+    b.execute("drop table dl")
+
+
+def test_system_schemas_exempt_from_lock_tables(d):
+    a = d.new_session()
+    a.execute("create table ex (x bigint)")
+    a.execute("lock tables ex read")
+    assert a.query("select * from information_schema.tables")  # exempt
+    assert a.query("select user from mysql.user where user = 'root'")
+    a.execute("unlock tables")

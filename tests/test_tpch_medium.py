@@ -1,0 +1,87 @@
+"""Medium-scale (1M-row) TPC-H parity with spill exercised.
+
+VERDICT weak #7: the 8k-row parity suite proves engine-diff correctness but
+never runs streaming/spill at sizes where they matter.  This module loads
+1M lineitem rows once, asserts device/oracle parity on aggregation-heavy
+shapes, and re-runs a grouping query under a memory quota small enough to
+force hash-agg spill — results must match the unconstrained run."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.tpch_data import build_lineitem
+
+N = 1_000_000
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = build_lineitem(N, regions=8)
+    s.domain.maintenance.stop()
+    return s
+
+
+def _norm(rows):
+    # 10 significant digits: float64 reduction order differs between the
+    # mesh tree-sum and numpy's pairwise sum; last-ulp noise is expected
+    out = []
+    for r in rows:
+        out.append(tuple(float(f"{v:.10g}") if isinstance(v, float) else v
+                         for v in r))
+    return out
+
+
+def _parity(sess, sql):
+    sess.execute("set tidb_use_tpu = 1")
+    dev = _norm(sess.query(sql))
+    sess.execute("set tidb_use_tpu = 0")
+    cpu = _norm(sess.query(sql))
+    sess.execute("set tidb_use_tpu = 1")
+    assert dev == cpu, (sql, dev[:3], cpu[:3])
+    return dev
+
+
+def test_q1_parity_at_1m(sess):
+    rows = _parity(sess, """
+        select l_returnflag, l_linestatus,
+               sum(l_quantity), sum(l_extendedprice),
+               sum(l_extendedprice * (1 - l_discount)),
+               avg(l_quantity), count(*)
+        from lineitem
+        where l_shipdate <= '1998-09-02'
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus""")
+    assert len(rows) == 6  # 3 flags x 2 statuses
+    assert sum(r[6] for r in rows) > 0.9 * N
+
+
+def test_q6_parity_at_1m(sess):
+    _parity(sess, """
+        select sum(l_extendedprice * l_discount)
+        from lineitem
+        where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
+          and l_discount between 0.05 and 0.07 and l_quantity < 24""")
+
+
+def test_high_ndv_group_parity_at_1m(sess):
+    """~100k groups: exercises the streaming device->host merge path."""
+    _parity(sess, """
+        select l_orderkey % 100000 as k, count(*), sum(l_quantity)
+        from lineitem group by k order by k limit 50""")
+
+
+def test_spill_produces_identical_results(sess):
+    """A grouping query under a tiny memory quota must spill (host
+    partial/final pools) and still match the unconstrained answer."""
+    sql = ("select l_orderkey % 50000 as k, count(*),"
+           " sum(l_extendedprice) from lineitem group by k")
+    sess.execute("set tidb_use_tpu = 0")  # host path owns the spill code
+    sess.execute("set tidb_mem_quota_query = 0")
+    sess.execute("set tidb_oom_action = 'spill'")
+    free = sorted(_norm(sess.query(sql)))
+    sess.execute("set tidb_mem_quota_query = 4000000")  # 4MB: forces spill
+    spilled = sorted(_norm(sess.query(sql)))
+    sess.execute("set tidb_mem_quota_query = 0")
+    sess.execute("set tidb_use_tpu = 1")
+    assert len(free) == 50_000
+    assert spilled == free

@@ -286,3 +286,82 @@ def _key_column_usage(domain, isc):
 def _cluster_info(domain, isc):
     return [("tidb-tpu", "in-process", "127.0.0.1:10080",
              "8.0.11-tidb-tpu-0.1.0")]
+
+
+# ---------------------------------------------------------------------------
+# mysql.* system tables (the reference's bootstrap tables, session/
+# bootstrap.go; served live from the owning subsystem instead of stored
+# rows — the util/sqlexec internal-SQL surface, inverted)
+# ---------------------------------------------------------------------------
+
+
+@_register("mysql.user", [
+    ("host", ty_string()), ("user", ty_string()),
+    ("authentication_string", ty_string()), ("priv", ty_string()),
+])
+def _mysql_user(domain, isc):
+    rows = []
+    for key, u in sorted(domain.priv.users.items()):
+        name, host = key.rsplit("@", 1)
+        privs = ",".join(sorted(p.upper() for p in u["global"])) or "USAGE"
+        rows.append((host, name, u["password"], privs))
+    return rows
+
+
+@_register("mysql.db", [
+    ("host", ty_string()), ("db", ty_string()), ("user", ty_string()),
+    ("priv", ty_string()),
+])
+def _mysql_db(domain, isc):
+    rows = []
+    for key, u in sorted(domain.priv.users.items()):
+        name, host = key.rsplit("@", 1)
+        for db, privs in sorted(u["dbs"].items()):
+            if privs:
+                rows.append((host, db, name,
+                             ",".join(sorted(p.upper() for p in privs))))
+    return rows
+
+
+@_register("mysql.tables_priv", [
+    ("host", ty_string()), ("db", ty_string()), ("user", ty_string()),
+    ("table_name", ty_string()), ("table_priv", ty_string()),
+])
+def _mysql_tables_priv(domain, isc):
+    rows = []
+    for key, u in sorted(domain.priv.users.items()):
+        name, host = key.rsplit("@", 1)
+        for (db, tbl), privs in sorted(u["tables"].items()):
+            if privs:
+                rows.append((host, db, name, tbl,
+                             ",".join(sorted(p.upper() for p in privs))))
+    return rows
+
+
+@_register("mysql.bind_info", [
+    ("original_sql", ty_string()), ("bind_sql", ty_string()),
+    ("status", ty_string()),
+])
+def _mysql_bind_info(domain, isc):
+    rows = []
+    for digest, b in sorted(getattr(domain, "bindings", {}).items()):
+        rows.append((b["original"], b["hinted"], "using"))
+    return rows
+
+
+@_register("mysql.stats_meta", [
+    ("table_id", ty_int()), ("count", ty_int()),
+    ("modify_count", ty_int()),
+])
+def _mysql_stats_meta(domain, isc):
+    rows = []
+    for tid, st in sorted(domain.stats._cache.items()):
+        rows.append((tid, st.row_count, st.modify_count))
+    return rows
+
+
+@_register("mysql.global_variables", [
+    ("variable_name", ty_string()), ("variable_value", ty_string()),
+])
+def _mysql_global_variables(domain, isc):
+    return sorted(domain.global_vars.items())

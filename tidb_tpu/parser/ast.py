@@ -483,6 +483,16 @@ class SetPasswordStmt(Stmt):
 
 
 @dataclass
+class LockTablesStmt(Stmt):
+    items: List[Tuple[TableName, str]] = field(default_factory=list)  # (t, read|write)
+
+
+@dataclass
+class UnlockTablesStmt(Stmt):
+    pass
+
+
+@dataclass
 class FlushStmt(Stmt):
     what: str = "privileges"
 

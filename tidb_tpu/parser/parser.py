@@ -1395,6 +1395,28 @@ class Parser:
         self.expect_kw("from")
         return ast.RevokeStmt(privs, level, self._parse_user_name())
 
+    def _parse_lock(self) -> ast.LockTablesStmt:
+        self.expect_kw("lock")
+        self.accept_kw("tables", "table") or self.expect_kw("tables")
+        items = []
+        while True:
+            tn = self._parse_table_name()
+            if self.accept_kw("write"):
+                mode = "write"
+            else:
+                self.expect_kw("read")
+                self.accept_kw("local")
+                mode = "read"
+            items.append((tn, mode))
+            if not self.accept_op(","):
+                break
+        return ast.LockTablesStmt(items)
+
+    def _parse_unlock(self) -> ast.UnlockTablesStmt:
+        self.expect_kw("unlock")
+        self.accept_kw("tables", "table")
+        return ast.UnlockTablesStmt()
+
     def _parse_flush(self) -> ast.FlushStmt:
         self.expect_kw("flush")
         what = self.ident("flush target").lower()

@@ -129,22 +129,28 @@ class PlanBuilder:
             return LogicalDual(Schema([]), 1)
         if isinstance(node, ast.TableName):
             db = (node.db or self.current_db).lower()
-            if db in ("information_schema", "performance_schema"):
+            if db in ("information_schema", "performance_schema", "mysql"):
                 from ..infoschema_tables import MEMTABLES
                 from .logical import LogicalMemTable
 
-                spec = MEMTABLES.get(node.name.lower())
+                key = (f"mysql.{node.name.lower()}" if db == "mysql"
+                       else node.name.lower())
+                spec = MEMTABLES.get(key)
                 if spec is None:
-                    raise PlanError(
-                        f"unknown memtable {db}.{node.name}"
-                    )
-                cols, _provider = spec
-                alias = node.alias or node.name
-                sch = Schema([
-                    SchemaCol(next_uid(), n, ft, alias, n, i)
-                    for i, (n, ft) in enumerate(cols)
-                ])
-                return LogicalMemTable(node.name.lower(), sch)
+                    if db == "mysql":
+                        pass  # ordinary user tables may live in `mysql`
+                    else:
+                        raise PlanError(
+                            f"unknown memtable {db}.{node.name}"
+                        )
+                else:
+                    cols, _provider = spec
+                    alias = node.alias or node.name
+                    sch = Schema([
+                        SchemaCol(next_uid(), n, ft, alias, n, i)
+                        for i, (n, ft) in enumerate(cols)
+                    ])
+                    return LogicalMemTable(key, sch)
             t = self._table_info(node)
             if t.is_view:
                 sel = t.view_select

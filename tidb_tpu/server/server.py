@@ -148,6 +148,7 @@ class MySQLServer:
             pass
         finally:
             sess.rollback()
+            sess._release_table_locks()  # MySQL frees them on disconnect
             self.domain.sessions.pop(sess.conn_id, None)
             writer.close()
 

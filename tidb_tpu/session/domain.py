@@ -59,6 +59,10 @@ class Domain:
         self._conn_counter = 0
         self.sessions: Dict[int, object] = {}  # conn_id -> Session (weak-ish)
         self.digest_summary = {}  # digest -> per-statement-shape aggregates
+        # LOCK TABLES registry: (db, table) -> {"mode": read|write,
+        # "owners": {conn_id}} — read locks shard across sessions, write
+        # locks have one owner (reference: ddl/table_lock.go role)
+        self.table_locks: Dict[tuple, dict] = {}
         self.slow_threshold_ms = 300
         self.slow_queries = []
         if data_dir:

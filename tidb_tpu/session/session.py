@@ -213,6 +213,18 @@ class Session:
         from . import priv as _priv
 
         _priv.check_stmt(self, s)  # optimize.go:128-131 choke point
+        if isinstance(s, (ast.SelectStmt, ast.UnionStmt, ast.InsertStmt,
+                          ast.UpdateStmt, ast.DeleteStmt,
+                          ast.LoadDataStmt)):
+            self._check_table_locks(s)
+        elif isinstance(s, (ast.DropTableStmt, ast.TruncateTableStmt,
+                            ast.AlterTableStmt, ast.RenameTableStmt,
+                            ast.CreateIndexStmt, ast.DropIndexStmt)):
+            tns = (s.tables if isinstance(s, ast.DropTableStmt)
+                   else [s.old] if isinstance(s, ast.RenameTableStmt)
+                   else [s.table])
+            for tn in tns:
+                self._check_ddl_table_lock(tn.db, tn.name)
         from ..errors import DeadlockError
 
         try:
@@ -282,6 +294,11 @@ class Session:
             from . import priv
 
             return priv.handle(self, s)
+        if isinstance(s, ast.LockTablesStmt):
+            return self._run_lock_tables(s)
+        if isinstance(s, ast.UnlockTablesStmt):
+            self._release_table_locks()
+            return ResultSet()
         # ---- DDL ------------------------------------------------------
         return self._run_ddl(s)
 
@@ -829,6 +846,100 @@ class Session:
     # ------------------------------------------------------------------
     # DDL
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # LOCK TABLES (server-level table locks; MySQL semantics: a session
+    # holding any table locks may only touch locked tables, writes need a
+    # WRITE lock, foreign WRITE locks exclude everyone else)
+    # ------------------------------------------------------------------
+    _LOCK_EXEMPT_DBS = ("information_schema", "performance_schema",
+                        "mysql")  # MySQL exempts these from LOCK TABLES
+
+    def _run_lock_tables(self, s) -> ResultSet:
+        isc = self.domain.catalog.info_schema()
+        wanted = []
+        for tn, mode in s.items:
+            db = (tn.db or self.current_db).lower()
+            isc.table(db, tn.name)  # must exist
+            wanted.append(((db, tn.name.lower()), mode))
+        with self.domain._mu:
+            locks = self.domain.table_locks
+            for key, mode in wanted:
+                h = locks.get(key)
+                if h is None:
+                    continue
+                others = h["owners"] - {self.conn_id}
+                if others and (mode == "write" or h["mode"] == "write"):
+                    raise ExecutorError(
+                        f"Table '{key[1]}' is locked by another session")
+            # LOCK TABLES implicitly releases this session's prior locks
+            self._release_table_locks_locked()
+            for key, mode in wanted:
+                h = locks.get(key)
+                if h is None or not h["owners"]:
+                    locks[key] = {"mode": mode, "owners": {self.conn_id}}
+                else:  # shared read lock gains another owner
+                    h["owners"].add(self.conn_id)
+        return ResultSet()
+
+    def _release_table_locks(self):
+        with self.domain._mu:
+            self._release_table_locks_locked()
+
+    def _release_table_locks_locked(self):
+        locks = self.domain.table_locks
+        for key in list(locks):
+            locks[key]["owners"].discard(self.conn_id)
+            if not locks[key]["owners"]:
+                del locks[key]
+
+    def _check_table_locks(self, stmt):
+        """MySQL LOCK TABLES enforcement at dispatch time."""
+        if not self.domain.table_locks:
+            return
+        from .priv import _walk_tables
+
+        refs: list = []
+        _walk_tables(stmt, refs)
+        if not refs:
+            return
+        writing = isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
+                                    ast.DeleteStmt, ast.LoadDataStmt))
+        target = getattr(stmt, "table", None) if writing else None
+        with self.domain._mu:
+            locks = self.domain.table_locks
+            mine = any(self.conn_id in v["owners"] for v in locks.values())
+            for tn in refs:
+                db = (tn.db or self.current_db).lower()
+                if db in self._LOCK_EXEMPT_DBS:
+                    continue
+                key = (db, tn.name.lower())
+                h = locks.get(key)
+                if h is None:
+                    if mine:
+                        raise ExecutorError(
+                            f"Table '{tn.name}' was not locked with "
+                            f"LOCK TABLES")
+                    continue
+                if self.conn_id in h["owners"]:
+                    if writing and tn is target and h["mode"] != "write":
+                        raise ExecutorError(
+                            f"Table '{tn.name}' was locked with a READ "
+                            f"lock and can't be updated")
+                    continue
+                if h["mode"] == "write" or (writing and tn is target):
+                    raise ExecutorError(
+                        f"Table '{tn.name}' is locked by another session")
+
+    def _check_ddl_table_lock(self, db: str, name: str):
+        """DDL on a table another session holds locked is refused (MySQL:
+        even a foreign READ lock blocks DROP/ALTER)."""
+        key = ((db or self.current_db).lower(), name.lower())
+        with self.domain._mu:
+            h = self.domain.table_locks.get(key)
+            if h is not None and h["owners"] - {self.conn_id}:
+                raise ExecutorError(
+                    f"Table '{name}' is locked by another session")
+
     def _run_ddl(self, s: ast.Stmt) -> ResultSet:
         cat = self.domain.catalog
         if isinstance(s, ast.CreateDatabaseStmt):
