@@ -23,7 +23,7 @@ with zero output):
   slow tunnel yields a small-scale number instead of nothing;
 - warm-up (transfer+compile) is timed separately from steady state.
 
-Env knobs: BENCH_ROWS (max scale, default 16M), BENCH_ITERS (default 3),
+Env knobs: BENCH_ROWS (max scale, default 64M), BENCH_ITERS (default 3),
 BENCH_REGIONS (default 8), BENCH_WALL_LIMIT (s, default 1500),
 BENCH_FORCE_CPU=1 (pin jax to host cpu).
 """
@@ -41,7 +41,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-MAX_ROWS = int(os.environ.get("BENCH_ROWS", 16_000_000))
+MAX_ROWS = int(os.environ.get("BENCH_ROWS", 64_000_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 3))
 REGIONS = int(os.environ.get("BENCH_REGIONS", 8))
 WALL_LIMIT = float(os.environ.get("BENCH_WALL_LIMIT", 1500))

@@ -24,6 +24,11 @@ def main():
                     help="default coprocessor engine routing")
     args = ap.parse_args()
 
+    # multi-host bring-up MUST precede the first jax backend touch
+    # (jax.distributed contract); no-op without TIDB_TPU_COORDINATOR
+    from .copr.parallel import _maybe_init_multihost
+
+    _maybe_init_multihost()
     from .session import Domain
     from .server import StatusServer, serve_forever
 

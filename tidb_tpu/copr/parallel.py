@@ -54,11 +54,41 @@ from .jax_engine import _Analyzed, _fingerprint, _gather_tile, _to_state_dtype
 # ---------------------------------------------------------------------------
 
 _MESH: Optional[Mesh] = None
+_DIST_INIT = False
+
+
+def _maybe_init_multihost():
+    """Multi-host (DCN) bring-up seam: when TIDB_TPU_COORDINATOR is set,
+    join the jax.distributed cluster before building the mesh, so
+    jax.devices() spans every host's chips and the same shard_map program
+    runs dp over ICI within a host and DCN across hosts.  This replaces the
+    reference's NCCL/MPI store-client fabric with XLA's collective runtime;
+    single-host runs skip it entirely.
+
+    Env: TIDB_TPU_COORDINATOR=host:port, TIDB_TPU_NUM_PROCESSES,
+    TIDB_TPU_PROCESS_ID (jax.distributed.initialize contract)."""
+    global _DIST_INIT
+    if _DIST_INIT:
+        return
+    import os
+
+    coord = os.environ.get("TIDB_TPU_COORDINATOR")
+    if not coord:
+        _DIST_INIT = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ.get("TIDB_TPU_NUM_PROCESSES", "1")),
+        process_id=int(os.environ.get("TIDB_TPU_PROCESS_ID", "0")),
+    )
+    _DIST_INIT = True  # only latched on success (a raise retries next call)
 
 
 def get_mesh() -> Mesh:
-    """Process-wide 1-D device mesh over every visible device."""
+    """Process-wide 1-D device mesh over every visible device (all hosts'
+    devices once the multi-host seam has joined the cluster)."""
     global _MESH
+    _maybe_init_multihost()
     if _MESH is None or len(_MESH.devices.ravel()) != len(jax.devices()):
         _MESH = Mesh(np.array(jax.devices()), ("dp",))
     return _MESH

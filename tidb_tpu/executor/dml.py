@@ -407,8 +407,48 @@ class DeleteExec(_DMLBase):
 class LoadDataExec(_DMLBase):
     """LOAD DATA INFILE: bulk CSV ingest straight into base blocks — the
     columnar fast path (no per-row txn), matching how analytical tables are
-    loaded.  Reference: executor/load_data.go (row path there; block path is
-    the TPU-native design choice)."""
+    loaded.  Reference: executor/load_data.go (row path there; the native
+    one-pass block path is the TPU-native design choice)."""
+
+    def _load_native(self, t, fts) -> bool:
+        """C++ fast path (native/csvkit.cpp): one native pass over the file
+        -> columnar arrays, vectorized partition routing, direct bulk load.
+        False = ineligible (quoted fields, exotic types, no toolchain) and
+        the csv-module path runs instead."""
+        from ..native import csv_parse_columns
+
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        if self.ignore_lines:
+            pos = 0
+            for _ in range(self.ignore_lines):
+                nl = buf.find(b"\n", pos)
+                if nl < 0:
+                    pos = len(buf)
+                    break
+                pos = nl + 1
+            buf = buf[pos:]  # one slice, not one per skipped line
+        out = csv_parse_columns(buf, fts, self.fields_terminated)
+        if out is None:
+            return False
+        arrays, valids = out
+        n = len(arrays[0]) if arrays else 0
+        ts = self.ctx.storage.current_ts()
+        if n and t.is_partitioned:
+            pi = t.partition_info
+            off = t.find_column(pi.column).offset
+            ridx = _native_partition_route(pi, arrays[off], valids[off])
+            for k, pd in enumerate(pi.defs):
+                m = ridx == k
+                if not m.any():
+                    continue
+                self.ctx.storage.table(pd.id).bulk_load_arrays(
+                    [a[m] for a in arrays], [v[m] for v in valids], ts)
+        elif n:
+            self.ctx.storage.table(t.id).bulk_load_arrays(arrays, valids,
+                                                          ts)
+        self.ctx.affected_rows += n
+        return True
 
     def __init__(self, ctx, table: TableInfo, path: str,
                  fields_terminated: str = ",", ignore_lines: int = 0,
@@ -421,6 +461,8 @@ class LoadDataExec(_DMLBase):
     def _next(self) -> Optional[Chunk]:
         t = self.table
         fts = [c.ftype for c in t.columns]
+        if self._load_native(t, fts):
+            return None  # native path loaded everything
         cols: List[list] = [[] for _ in fts]
         with open(self.path, "r", newline="") as f:
             reader = csv.reader(f, delimiter=self.fields_terminated)
@@ -459,17 +501,43 @@ class LoadDataExec(_DMLBase):
         return None
 
 
+def _native_partition_route(pi, arr: np.ndarray, valid: np.ndarray):
+    """Vectorized locatePartition over a whole column: returns per-row
+    partition index into pi.defs (NULLs -> partition 0)."""
+    v = arr.astype(np.int64, copy=False)
+    if pi.kind == "hash":
+        idx = v % len(pi.defs)
+        return np.where(valid, idx, 0)
+    bounds = [p.less_than for p in pi.defs]
+    finite = [b for b in bounds if b is not None]
+    idx = np.searchsorted(np.asarray(finite, dtype=np.int64), v,
+                          side="right")
+    if bounds[-1] is not None:  # no MAXVALUE partition: out-of-range error
+        from ..errors import KVError
+
+        if (idx[valid] >= len(bounds)).any():
+            bad = int(v[valid][idx[valid] >= len(bounds)][0])
+            raise KVError(f"Table has no partition for value {bad}")
+    idx = np.minimum(idx, len(pi.defs) - 1)
+    return np.where(valid, idx, 0)
+
+
 def _parse_field(raw: Optional[str], ft: FieldType):
     if raw is None or raw == "\\N":
         return None
     k = ft.kind
     try:
         if k in (TypeKind.INT, TypeKind.UINT, TypeKind.BOOL):
-            return int(raw)
+            v = int(raw)
+            if abs(v) > (1 << 63) - 1:
+                return None  # out of int64: NULL (native path agrees)
+            return v
         if k == TypeKind.FLOAT:
             return float(raw)
         if k == TypeKind.DECIMAL:
-            return float(raw)  # Column.from_values scales decimals
+            from ..types.values import parse_decimal_exact
+
+            return parse_decimal_exact(raw, ft.scale)  # scaled-int repr
         if k == TypeKind.DATE:
             from ..types.values import parse_date
 
@@ -478,6 +546,14 @@ def _parse_field(raw: Optional[str], ft: FieldType):
             from ..types.values import parse_datetime
 
             return parse_datetime(raw)
+        if k == TypeKind.TIME:
+            from ..types.values import parse_time
+
+            return parse_time(raw)
+        if k in (TypeKind.ENUM, TypeKind.SET, TypeKind.BIT,
+                 TypeKind.JSON):
+            # reuse the cast machinery for member/bitmask/json coercion
+            return _coerce_value(raw, ft)
     except (ValueError, TypeError):
         return None
     return raw

@@ -24,6 +24,18 @@ _lib_mu = threading.Lock()
 _build_failed = False
 
 
+def _build_and_dlopen(src: str, so: str) -> ctypes.CDLL:
+    """mtime-keyed lazy g++ build + dlopen (shared by all native kernels;
+    callers hold _lib_mu and latch their own failure flag)."""
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
+            check=True, capture_output=True, timeout=120,
+        )
+    return ctypes.CDLL(so)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     if _lib is not None or _build_failed:
@@ -32,13 +44,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-                    check=True, capture_output=True, timeout=120,
-                )
-            lib = ctypes.CDLL(_SO)
+            lib = _build_and_dlopen(_SRC, _SO)
             lib.ht64_new.restype = ctypes.c_void_p
             lib.ht64_new.argtypes = [ctypes.c_int64]
             lib.ht64_free.argtypes = [ctypes.c_void_p]
@@ -159,3 +165,122 @@ def decode_i64_keys(data: bytes) -> np.ndarray:
         return out
     u = np.frombuffer(data, dtype=np.uint64).byteswap()
     return (u ^ np.uint64(1 << 63)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# native CSV -> columnar parser (csvkit.cpp); LOAD DATA's bulk fast path
+# ---------------------------------------------------------------------------
+
+_CSV_SRC = os.path.join(_HERE, "csvkit.cpp")
+_CSV_SO = os.path.join(_HERE, "_csvkit.so")
+_csv_lib = None
+_csv_failed = False
+
+
+def _load_csv() -> Optional[ctypes.CDLL]:
+    global _csv_lib, _csv_failed
+    if _csv_lib is not None or _csv_failed:
+        return _csv_lib
+    with _lib_mu:
+        if _csv_lib is not None or _csv_failed:
+            return _csv_lib
+        try:
+            lib = _build_and_dlopen(_CSV_SRC, _CSV_SO)
+            lib.csv_parse.restype = ctypes.c_int64
+            lib.csv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ]
+            _csv_lib = lib
+        except Exception:
+            _csv_failed = True
+    return _csv_lib
+
+
+# FieldType.kind -> csvkit kind code (None = unsupported, take Python path)
+_CSV_KINDS = {
+    "INT": 0, "UINT": 0, "BOOL": 0, "FLOAT": 1, "STRING": 2,
+    "DATE": 3, "DATETIME": 4, "DECIMAL": 5,
+}
+
+
+def csv_parse_columns(buf: bytes, ftypes, delim: str):
+    """One native pass over a CSV buffer -> (arrays, valids) in storage
+    representation, or None when ineligible (quotes present, unsupported
+    column kind, no toolchain) — the caller falls back to Python csv.
+
+    DATE columns land as int64 here; the caller downcasts to the storage
+    dtype.  Wide decimals are ineligible (int64-only parser)."""
+    lib = _load_csv()
+    if lib is None or b'"' in buf:
+        return None
+    if len(buf) >= (1 << 31):
+        # string slices travel as int32 offsets; past 2 GiB they would
+        # wrap — the Python path streams instead
+        return None
+    kinds = []
+    scales = []
+    for ft in ftypes:
+        code = _CSV_KINDS.get(ft.kind.name)
+        if code is None or (code == 5 and ft.is_wide_decimal):
+            return None
+        kinds.append(code)
+        scales.append(ft.scale)
+    n_rows = buf.count(b"\n") + (0 if buf.endswith(b"\n") or not buf else 1)
+    if n_rows == 0:
+        return [], []
+    ncols = len(ftypes)
+    n_str = sum(1 for k in kinds if k == 2)
+    cols = []
+    valids = []
+    ptrs = (ctypes.c_void_p * ncols)()
+    vptrs = (ctypes.c_void_p * ncols)()
+    for ci, k in enumerate(kinds):
+        if k == 1:
+            arr = np.zeros(n_rows, dtype=np.float64)
+        else:
+            arr = np.zeros(n_rows, dtype=np.int64)  # strings: unused slot
+        v = np.zeros(n_rows, dtype=np.uint8)
+        cols.append(arr)
+        valids.append(v)
+        ptrs[ci] = arr.ctypes.data
+        vptrs[ci] = v.ctypes.data
+    str_offs = np.zeros(max(n_rows * max(n_str, 1), 1), dtype=np.int32)
+    str_lens = np.zeros_like(str_offs)
+    kinds_arr = np.asarray(kinds, dtype=np.int32)
+    scales_arr = np.asarray(scales, dtype=np.int32)
+    got = lib.csv_parse(
+        buf, len(buf), delim.encode()[:1], ncols,
+        kinds_arr.ctypes.data, scales_arr.ctypes.data, n_rows,
+        ptrs, vptrs, str_offs.ctypes.data, str_lens.ctypes.data,
+        max(n_str, 1),
+    )
+    if got < 0:
+        return None
+    out_arrays = []
+    out_valids = []
+    str_slot = 0
+    for ci, k in enumerate(kinds):
+        valid = valids[ci][:got].astype(bool)
+        if k == 2:
+            offs = str_offs[: got * max(n_str, 1)].reshape(got, max(n_str, 1))
+            lens = str_lens[: got * max(n_str, 1)].reshape(got, max(n_str, 1))
+            data = np.empty(got, dtype=object)
+            o_col = offs[:, str_slot]
+            l_col = lens[:, str_slot]
+            for i in range(got):
+                data[i] = buf[o_col[i]: o_col[i] + l_col[i]].decode(
+                    "utf-8", "replace") if valid[i] else ""
+            str_slot += 1
+            out_arrays.append(data)
+        elif k == 1:
+            out_arrays.append(cols[ci][:got])
+        else:
+            arr = cols[ci][:got]
+            if ftypes[ci].np_dtype != np.int64:
+                arr = arr.astype(ftypes[ci].np_dtype)
+            out_arrays.append(arr)
+        out_valids.append(valid)
+    return out_arrays, out_valids

@@ -1,0 +1,286 @@
+// Native bulk CSV -> columnar parser for LOAD DATA.
+//
+// Reference role: executor/load_data.go's field splitting + kv encode hot
+// loop (Go, row-at-a-time).  Here one C++ pass over the raw buffer emits
+// columnar arrays directly — the shape bulk_load_arrays wants — so ingest
+// feeds the TPU-facing block store without a Python-per-field loop.
+//
+// Contract (see native/__init__.py csv_parse):
+//   kinds[c]: 0=int64  1=float64  2=string  3=date(YYYY-MM-DD -> days)
+//             4=datetime -> micros  5=decimal(scale) -> scaled int64
+//   numeric-ish cols write int64/f64 into caller-allocated [max_rows]
+//   arrays; string cols write (offset,len) int32 pairs into str_offs/
+//   str_lens at [row * n_str_cols + str_slot].
+//   Empty fields and \N parse as NULL (valid=0).
+//   Returns the number of rows parsed, or -1 on structural error.
+//   Quoted fields are NOT handled here: the caller routes buffers
+//   containing '"' through the Python csv path.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// Howard Hinnant's days_from_civil (public-domain algorithm)
+inline int64_t days_from_civil(int64_t y, unsigned m, unsigned d) {
+    y -= m <= 2;
+    const int64_t era = (y >= 0 ? y : y - 399) / 400;
+    const unsigned yoe = static_cast<unsigned>(y - era * 400);
+    const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + static_cast<int64_t>(doe) - 719468;
+}
+
+constexpr int64_t kMaxI64 = 9223372036854775807LL;
+
+inline bool acc_digit(int64_t* v, char c) {
+    // overflow-checked v = v*10 + d (signed overflow is UB; reject instead)
+    int64_t d = c - '0';
+    if (*v > (kMaxI64 - d) / 10) return false;
+    *v = *v * 10 + d;
+    return true;
+}
+
+inline bool parse_int(const char* p, const char* e, int64_t* out) {
+    if (p == e) return false;
+    bool neg = false;
+    if (*p == '-' || *p == '+') { neg = (*p == '-'); ++p; }
+    if (p == e) return false;
+    int64_t v = 0;
+    for (; p != e; ++p) {
+        if (*p < '0' || *p > '9') return false;
+        if (!acc_digit(&v, *p)) return false;  // out of int64: NULL
+    }
+    *out = neg ? -v : v;
+    return true;
+}
+
+// decimal text -> scaled int64 at `scale`, half-away-from-zero on excess
+// fractional digits (mydecimal.go FromString semantics, narrow range)
+inline bool parse_decimal(const char* p, const char* e, int scale,
+                          int64_t* out) {
+    if (p == e) return false;
+    bool neg = false;
+    if (*p == '-' || *p == '+') { neg = (*p == '-'); ++p; }
+    if (p == e) return false;
+    int64_t v = 0;
+    int frac_seen = -1;  // -1: before '.', else count of frac digits taken
+    int64_t round_add = 0;
+    for (; p != e; ++p) {
+        if (*p == '.') {
+            if (frac_seen >= 0) return false;
+            frac_seen = 0;
+            continue;
+        }
+        if (*p < '0' || *p > '9') return false;
+        if (frac_seen < 0) {
+            if (!acc_digit(&v, *p)) return false;
+        } else if (frac_seen < scale) {
+            if (!acc_digit(&v, *p)) return false;
+            ++frac_seen;
+        } else if (frac_seen == scale) {
+            round_add = (*p >= '5') ? 1 : 0;
+            ++frac_seen;  // swallow the rest
+        }
+    }
+    int pad = scale - (frac_seen < 0 ? 0 : (frac_seen > scale ? scale
+                                                              : frac_seen));
+    for (int i = 0; i < pad; ++i) {
+        if (v > kMaxI64 / 10) return false;
+        v *= 10;
+    }
+    if (v == kMaxI64 && round_add) return false;
+    v += round_add;
+    *out = neg ? -v : v;
+    return true;
+}
+
+inline bool parse_date_days(const char* p, const char* e, int64_t* out) {
+    // YYYY-MM-DD (lengths 8-10 tolerated for 1-digit month/day)
+    int64_t y = 0, m = 0, d = 0;
+    const char* q = p;
+    while (q != e && *q != '-') { if (*q < '0' || *q > '9') return false;
+        y = y * 10 + (*q - '0'); ++q; }
+    if (q == e) return false; ++q;
+    while (q != e && *q != '-') { if (*q < '0' || *q > '9') return false;
+        m = m * 10 + (*q - '0'); ++q; }
+    if (q == e) return false; ++q;
+    while (q != e) { if (*q < '0' || *q > '9') return false;
+        d = d * 10 + (*q - '0'); ++q; }
+    if (m < 1 || m > 12 || d < 1 || d > 31) return false;
+    *out = days_from_civil(y, static_cast<unsigned>(m),
+                           static_cast<unsigned>(d));
+    return true;
+}
+
+inline bool parse_datetime_us(const char* p, const char* e, int64_t* out) {
+    // "YYYY-MM-DD[ HH:MM:SS[.ffffff]]"
+    const char* sp = p;
+    while (sp != e && *sp != ' ' && *sp != 'T') ++sp;
+    int64_t days;
+    if (!parse_date_days(p, sp, &days)) return false;
+    int64_t us = days * 86400000000LL;
+    if (sp != e) {
+        ++sp;
+        int64_t h = 0, mi = 0, s = 0, frac = 0; int fdig = 0;
+        const char* q = sp;
+        while (q != e && *q != ':') { if (*q < '0' || *q > '9') return false;
+            h = h * 10 + (*q - '0'); ++q; }
+        if (q != e) { ++q;
+            while (q != e && *q != ':') { if (*q < '0' || *q > '9')
+                return false; mi = mi * 10 + (*q - '0'); ++q; }
+            if (q != e) { ++q;
+                while (q != e && *q != '.') { if (*q < '0' || *q > '9')
+                    return false; s = s * 10 + (*q - '0'); ++q; }
+                if (q != e) { ++q;
+                    while (q != e && fdig < 6) { if (*q < '0' || *q > '9')
+                        return false; frac = frac * 10 + (*q - '0');
+                        ++fdig; ++q; }
+                }
+            }
+        }
+        while (fdig < 6) { frac *= 10; ++fdig; }
+        us += (h * 3600 + mi * 60 + s) * 1000000LL + frac;
+    }
+    *out = us;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out_cols: ncols pointers; int64* for kinds 0/3/4/5, double* for kind 1,
+// ignored (may be null) for kind 2.  out_valid: ncols pointers to uint8
+// [max_rows].  str_offs/str_lens: int32 [max_rows * n_str_cols].
+int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t ncols,
+                  const int32_t* kinds, const int32_t* scales,
+                  int64_t max_rows, void** out_cols, uint8_t** out_valid,
+                  int32_t* str_offs, int32_t* str_lens,
+                  int32_t n_str_cols) {
+    int64_t row = 0;
+    int64_t i = 0;
+    while (i < len && row < max_rows) {
+        // one record
+        int32_t col = 0, str_slot = 0;
+        while (col < ncols) {
+            int64_t start = i;
+            while (i < len && buf[i] != delim && buf[i] != '\n')
+                ++i;
+            int64_t end = i;
+            // CRLF: the \r belongs to the terminator, not the field
+            if (end > start && i < len && buf[i] == '\n'
+                && buf[end - 1] == '\r')
+                --end;
+            const char* p = buf + start;
+            const char* e = buf + end;
+            bool is_null = (start == end) ||
+                (end - start == 2 && p[0] == '\\' && p[1] == 'N');
+            uint8_t ok = 0;
+            switch (kinds[col]) {
+                case 0: {  // int64
+                    int64_t v;
+                    if (!is_null && parse_int(p, e, &v)) {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = v;
+                        ok = 1;
+                    } else {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = 0;
+                    }
+                    break;
+                }
+                case 1: {  // float64
+                    if (!is_null) {
+                        char tmp[64];
+                        int64_t n = end - start;
+                        if (n > 0 && n < 63) {
+                            memcpy(tmp, p, n);
+                            tmp[n] = 0;
+                            char* endp = nullptr;
+                            double v = strtod(tmp, &endp);
+                            if (endp == tmp + n) {
+                                reinterpret_cast<double*>(
+                                    out_cols[col])[row] = v;
+                                ok = 1;
+                            }
+                        }
+                    }
+                    if (!ok)
+                        reinterpret_cast<double*>(out_cols[col])[row] = 0.0;
+                    break;
+                }
+                case 2: {  // string: record the slice.  Only \N is NULL —
+                    // an empty field is the empty string (LOAD DATA rule)
+                    bool null_str = (end - start == 2 && p[0] == '\\'
+                                     && p[1] == 'N');
+                    str_offs[row * n_str_cols + str_slot] =
+                        static_cast<int32_t>(null_str ? 0 : start);
+                    str_lens[row * n_str_cols + str_slot] =
+                        static_cast<int32_t>(null_str ? 0 : end - start);
+                    ok = null_str ? 0 : 1;
+                    ++str_slot;
+                    break;
+                }
+                case 3: {  // date -> days
+                    int64_t v;
+                    if (!is_null && parse_date_days(p, e, &v)) {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = v;
+                        ok = 1;
+                    } else {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = 0;
+                    }
+                    break;
+                }
+                case 4: {  // datetime -> micros
+                    int64_t v;
+                    if (!is_null && parse_datetime_us(p, e, &v)) {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = v;
+                        ok = 1;
+                    } else {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = 0;
+                    }
+                    break;
+                }
+                case 5: {  // decimal(scale) -> scaled int64
+                    int64_t v;
+                    if (!is_null && parse_decimal(p, e, scales[col], &v)) {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = v;
+                        ok = 1;
+                    } else {
+                        reinterpret_cast<int64_t*>(out_cols[col])[row] = 0;
+                    }
+                    break;
+                }
+                default:
+                    return -1;
+            }
+            out_valid[col][row] = ok;
+            ++col;
+            if (i < len && buf[i] == delim) {
+                ++i;
+                if (col == ncols) return -2;  // too many fields
+            } else {
+                break;  // end of record (or buffer)
+            }
+        }
+        // missing trailing fields -> NULL
+        for (; col < ncols; ++col) {
+            out_valid[col][row] = 0;
+            if (kinds[col] == 2) {
+                str_offs[row * n_str_cols + str_slot] = 0;
+                str_lens[row * n_str_cols + str_slot] = 0;
+                ++str_slot;
+            } else if (kinds[col] == 1) {
+                reinterpret_cast<double*>(out_cols[col])[row] = 0.0;
+            } else {
+                reinterpret_cast<int64_t*>(out_cols[col])[row] = 0;
+            }
+        }
+        // consume the record terminator (records end at \n only)
+        if (i < len && buf[i] == '\n') ++i;
+        ++row;
+    }
+    return row;
+}
+
+}  // extern "C"
