@@ -407,3 +407,49 @@ def test_cost_routing_small_scan_to_host():
     rows = s.execute("explain analyze select count(*) from cr")[0].rows
     readers = [r for r in rows if "TableReader" in r[0]]
     assert any("engine:mesh" in r[4] for r in readers), readers
+
+
+def test_admin_check_table_verifies_indexes():
+    """ADMIN CHECK TABLE verifies existing index artifacts against current
+    data and unique constraints over the full base+delta overlay
+    (executor/admin.go CheckTable role)."""
+    import pytest as _pytest
+
+    from tidb_tpu.errors import ExecutorError
+    from tidb_tpu.session import Domain
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table ac (a bigint primary key, b bigint)")
+    s.execute("insert into ac values (1, 10), (2, 20), (3, 30)")
+    t = d.catalog.info_schema().table("test", "ac")
+    store = d.storage.table(t.id)
+    store.compact(d.storage.current_ts())
+    s.execute("create index ib on ac (b)")
+    s.execute("admin check table ac")  # clean
+    offs = tuple(t.col_offsets(["b"]))
+    idx = store.indexes.get(store, offs)  # materialize the artifact
+    idx.cols[0][0] = 999  # poison one key
+    with _pytest.raises(ExecutorError):
+        s.execute("admin check table ac")
+    # unique violations hiding in the DELTA are caught too: sneak a
+    # duplicate past the executor via the raw txn API
+    s2 = d.new_session()
+    s2.execute("create table uq (a bigint primary key)")
+    s2.execute("insert into uq values (1)")
+    t2 = d.catalog.info_schema().table("test", "uq")
+    st2 = d.storage.table(t2.id)
+    txn = d.storage.begin()
+    txn.put(t2.id, st2.alloc_handle(), (1,))  # duplicate PK, no checks
+    txn.commit()
+    with _pytest.raises(ExecutorError):
+        s2.execute("admin check table uq")
+    # partitioned: per-store artifacts verified after compaction
+    s.execute("create table pc (k bigint primary key)"
+              " partition by hash (k) partitions 2")
+    s.execute("insert into pc values (1), (2), (3)")
+    tp = d.catalog.info_schema().table("test", "pc")
+    for pd in tp.partition_info.defs:
+        d.storage.table(pd.id).compact(d.storage.current_ts())
+    s.execute("admin check table pc")

@@ -85,3 +85,31 @@ def test_spill_produces_identical_results(sess):
     sess.execute("set tidb_use_tpu = 1")
     assert len(free) == 50_000
     assert spilled == free
+
+
+def test_q3_shaped_join_parity_at_1m(sess):
+    """Join + group + topN at 1M rows: the runtime-filter pushdown and
+    keep-order merge paths under real volume."""
+    sess.execute("set tidb_use_tpu = 1")
+    sess.execute("create table if not exists ords"
+                 " (o_orderkey bigint, o_date date)")
+    t = sess.domain.catalog.info_schema().table("test", "ords")
+    store = sess.domain.storage.table(t.id)
+    if store.base_rows == 0:
+        import numpy as np
+
+        from tidb_tpu.types.values import parse_date
+
+        rng = np.random.default_rng(4)
+        n = 100_000
+        base = parse_date("1992-01-01")
+        sess.domain.storage.table(t.id).bulk_load_arrays(
+            [rng.integers(0, 200_000, n),
+             (base + rng.integers(0, 2000, n)).astype(np.int32)],
+            ts=sess.domain.storage.current_ts())
+    _parity(sess, """
+        select o.o_orderkey, count(*), sum(l.l_quantity)
+        from lineitem l join ords o on l.l_orderkey % 200000 = o.o_orderkey
+        where o.o_date < '1995-01-01'
+        group by o.o_orderkey
+        order by sum(l.l_quantity) desc, o.o_orderkey limit 10""")

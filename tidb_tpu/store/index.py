@@ -86,6 +86,13 @@ class IndexManager:
             self._cache[key] = idx
         return idx
 
+    def peek(self, col_offsets) -> "SortedIndex | None":
+        """Cached index artifact or None — NEVER builds (ADMIN CHECK uses
+        this: verifying a freshly derived index against its own source
+        would be tautological)."""
+        with self._mu:
+            return self._cache.get(tuple(col_offsets))
+
     def put(self, col_offsets: tuple, idx: "SortedIndex"):
         """Register a prebuilt index (online add-index backfill artifact)."""
         with self._mu:
