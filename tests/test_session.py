@@ -453,3 +453,70 @@ def test_admin_check_table_verifies_indexes():
     for pd in tp.partition_info.defs:
         d.storage.table(pd.id).compact(d.storage.current_ts())
     s.execute("admin check table pc")
+
+
+def test_tidb_snapshot_historical_read():
+    """SET tidb_snapshot pins autocommit reads at a historical TSO
+    (session.go setSnapshotTS): reads see the old state, writes refuse,
+    clearing restores current reads."""
+    import time as _time
+
+    import pytest as _pytest
+
+    from tidb_tpu.errors import TiDBTPUError
+    from tidb_tpu.session import Domain
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table h (v bigint)")
+    s.execute("insert into h values (1)")
+    ts0 = d.storage.current_ts()
+    _time.sleep(0.005)
+    s.execute("insert into h values (2)")
+    s.execute("update h set v = 99 where v = 1")
+    s.execute(f"set tidb_snapshot = {ts0}")
+    assert s.query("select v from h order by v") == [(1,)]
+    with _pytest.raises(TiDBTPUError):
+        s.execute("insert into h values (3)")
+    s.execute("set tidb_snapshot = ''")
+    assert sorted(s.query("select v from h")) == [(2,), (99,)]
+
+
+def test_tidb_snapshot_schema_and_write_guards():
+    """Historical reads use the schema of that time; every write statement
+    (incl. EXPLAIN ANALYZE DML and DDL) refuses while pinned; bad values
+    and in-transaction SETs are typed errors."""
+    import time as _time
+
+    import pytest as _pytest
+
+    from tidb_tpu.errors import TiDBTPUError
+    from tidb_tpu.session import Domain
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table h (v bigint)")
+    s.execute("insert into h values (1)")
+    _time.sleep(0.005)
+    ts0 = d.storage.current_ts()
+    _time.sleep(0.005)
+    s.execute("create table later_t (x bigint)")
+    s.execute(f"set tidb_snapshot = {ts0}")
+    with _pytest.raises(TiDBTPUError):
+        s.query("select * from later_t")  # didn't exist yet
+    assert s.query("show tables") == [("h",)]
+    for q in ("explain analyze insert into h values (9)",
+              "drop table h", "analyze table h",
+              "create table zzz (a bigint)"):
+        with _pytest.raises(TiDBTPUError):
+            s.execute(q)
+    s.execute("explain select * from h")  # plain EXPLAIN is read-only
+    with _pytest.raises(TiDBTPUError):
+        s.execute("set tidb_snapshot = 'bogus'")
+    s.execute("set tidb_snapshot = ''")
+    s.execute("begin")
+    with _pytest.raises(TiDBTPUError):
+        s.execute(f"set tidb_snapshot = {ts0}")
+    s.execute("rollback")

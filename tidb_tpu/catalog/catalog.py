@@ -140,6 +140,10 @@ class Catalog:
         self.schema_version = 0
         self.jobs: List[DDLJob] = []
         self._snapshot: Optional[InfoSchema] = None
+        # (wall_ms, InfoSchema) ring for historical reads (tidb_snapshot):
+        # GetSnapshotInfoSchema role — old TableInfos are shared, not
+        # copied, so entries are cheap
+        self._history: List[tuple] = []
         # table id -> schema_version of its last DDL: the commit-time
         # schema checker (domain/schema_validator.go) compares a txn's
         # write set against these so a txn straddling a DDL on a table it
@@ -166,7 +170,17 @@ class Catalog:
             return self._next_id
 
     def _bump(self):
+        # DDL paths mutate DBInfo.tables in place before bumping, so the
+        # snapshot here reflects the POST-change schema as of now; per-DB
+        # table dicts are copied because future DDLs keep mutating them
+        # (TableInfo values themselves are replaced, never mutated)
+        frozen = {k: DBInfo(d.id, d.name, dict(d.tables))
+                  for k, d in self._dbs.items()}
         self.schema_version += 1
+        self._history.append((int(time.time() * 1000),
+                              InfoSchema(self.schema_version, frozen)))
+        if len(self._history) > 64:
+            self._history = self._history[-48:]
         self._snapshot = None
         if self.on_ddl is not None:
             self.on_ddl(self)
@@ -189,6 +203,25 @@ class Catalog:
                 # mutated, by DDL ops below
                 self._snapshot = InfoSchema(self.schema_version, dict(self._dbs))
             return self._snapshot
+
+    def info_schema_at(self, wall_ms: int) -> InfoSchema:
+        """Schema as of a historical wall-clock ms (domain.go:286
+        GetSnapshotInfoSchema).  Each history entry is the post-DDL schema
+        stamped at DDL time, so the schema AT `wall_ms` is the newest entry
+        not newer than it; older than all history = best effort (the
+        oldest recorded), no DDL since = current."""
+        with self._mu:
+            best = None
+            for t_ms, isc in self._history:
+                if t_ms <= wall_ms:
+                    best = isc
+                else:
+                    break
+            if best is not None:
+                return best
+            if self._history and self._history[0][0] > wall_ms:
+                return self._history[0][1]
+            return self.info_schema()
 
     def _persist(self):
         if getattr(self, "on_ddl", None) is not None:

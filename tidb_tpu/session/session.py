@@ -111,6 +111,7 @@ class Session:
         # authenticated identity; in-process sessions are trusted as root,
         # the wire server overwrites this after the auth handshake
         self.user = "root@%"
+        self._snapshot_ts = None  # SET tidb_snapshot historical-read TSO
         self._txn = None  # explicit txn (BEGIN..COMMIT)
         self._in_txn = False
         self._killed = False
@@ -213,6 +214,8 @@ class Session:
         from . import priv as _priv
 
         _priv.check_stmt(self, s)  # optimize.go:128-131 choke point
+        if self._snapshot_ts is not None:
+            self._snapshot_write_guard(s)
         if isinstance(s, (ast.SelectStmt, ast.UnionStmt, ast.InsertStmt,
                           ast.UpdateStmt, ast.DeleteStmt,
                           ast.LoadDataStmt)):
@@ -335,14 +338,29 @@ class Session:
             index_join_variant=variant,
         )
 
+    def _infoschema(self):
+        """Schema for planning/execution: historical when tidb_snapshot is
+        pinned (GetSnapshotInfoSchema), else current."""
+        if self._snapshot_ts is not None:
+            from ..store.oracle import extract_physical
+
+            return self.domain.catalog.info_schema_at(
+                extract_physical(self._snapshot_ts))
+        return self.domain.catalog.info_schema()
+
     def _exec_ctx(self, current_read: bool = False) -> ExecContext:
         txn = self._txn if self._in_txn or self._txn is not None else None
+        snap = self._snapshot_ts
+        if txn is None and snap is not None:
+            read_ts = snap  # historical read (tidb_snapshot)
+        else:
+            read_ts = self.domain.storage.current_ts() if txn is None else 0
         ctx = ExecContext(
             self.domain.storage,
-            infoschema=self.domain.catalog.info_schema(),
+            infoschema=self._infoschema(),
             sess_vars=self.vars,
             txn=txn,
-            read_ts=self.domain.storage.current_ts() if txn is None else 0,
+            read_ts=read_ts,
         )
         ctx.current_read = current_read
         ctx.killed = self._killed
@@ -373,7 +391,7 @@ class Session:
                 self._plan_cache.move_to_end(key)
                 return hit
         phys = plan_statement(
-            stmt, self.domain.catalog.info_schema(), self.current_db,
+            stmt, self._infoschema(), self.current_db,
             self._pctx(hints), exec_subplan=self._exec_subplan,
             param_values=params,
         )
@@ -392,8 +410,9 @@ class Session:
         vars) — DML against unrelated tables leaves cached plans valid.
         None disables caching: txn writes change pushdown eligibility, and
         parameterized plans bake constant ranges."""
-        if params is not None or self._txn is not None:
-            return None
+        if params is not None or self._txn is not None \
+                or self._snapshot_ts is not None:
+            return None  # historical reads: never cache
         if not isinstance(stmt, (ast.SelectStmt, ast.UnionStmt)):
             return None
         sql = getattr(stmt, "_sql_text", None)
@@ -615,6 +634,9 @@ class Session:
                 from ..planner.build import _eval_const
 
                 value = _eval_const(eb.build(vexpr))
+            if name.lower() == "tidb_snapshot":
+                self._set_snapshot(value)
+                continue
             if not is_global and not self.vars.known(name) \
                     and name.lower() not in SYSVAR_DEFAULTS:
                 # unknown non-global names are user variables (@x); the
@@ -626,11 +648,67 @@ class Session:
                 self.vars.set_session(name, value)
         return ResultSet()
 
+    def _snapshot_write_guard(self, s):
+        """TiDB rejects EVERY write statement under tidb_snapshot — DML,
+        DDL, and EXPLAIN ANALYZE of DML (which executes)."""
+        wr = (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
+              ast.LoadDataStmt, ast.CreateTableStmt, ast.DropTableStmt,
+              ast.TruncateTableStmt, ast.AlterTableStmt,
+              ast.RenameTableStmt, ast.CreateIndexStmt, ast.DropIndexStmt,
+              ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
+              ast.CreateViewStmt, ast.AnalyzeTableStmt)
+        target = s.target if isinstance(s, (ast.ExplainStmt,
+                                            ast.TraceStmt)) else s
+        analyze = getattr(s, "analyze", True)  # plain EXPLAIN is read-only
+        if isinstance(target, wr) and (target is s or analyze):
+            raise ExecutorError(
+                "can not execute write statement when 'tidb_snapshot' "
+                "is set")
+
+    def _set_snapshot(self, value):
+        """SET tidb_snapshot: pin autocommit reads to a historical TSO
+        (session.go setSnapshotTS / GetSnapshotInfoSchema role).  Accepts a
+        raw TSO, a unix-seconds number, or 'YYYY-MM-DD HH:MM:SS'; bounded
+        below by the GC safepoint.  Empty string clears it.
+
+        Bounds beyond GC: column-layout DDL (ADD/DROP/MODIFY COLUMN)
+        rebuilds the store eagerly (catalog._rebuild_storage), so data time
+        travel does not cross such a DDL — reads older than the rebuild see
+        an empty table, like a reader behind a TiFlash delta-merge horizon.
+        DML-only history time-travels exactly."""
+        from ..store.oracle import compose_ts
+
+        if value in ("", None, 0):
+            self._snapshot_ts = None
+            self.vars.set_session("tidb_snapshot", "")
+            return
+        if self._txn is not None or self._in_txn:
+            raise PlanError(
+                "can not set tidb_snapshot during a transaction")
+        try:
+            if isinstance(value, str):
+                from ..types.values import parse_datetime
+
+                ts = compose_ts(parse_datetime(value) // 1000, 0)
+            else:
+                v = int(value)
+                # heuristic matching TiDB: big values are TSOs, small
+                # ones unix seconds
+                ts = v if v > (1 << 40) else compose_ts(v * 1000, 0)
+        except (ValueError, TypeError) as e:
+            raise PlanError(f"invalid tidb_snapshot value {value!r}: {e}")
+        floor = self.domain.maintenance.last_safepoint
+        if floor and ts < floor:
+            raise PlanError(
+                "snapshot is older than GC safe point")
+        self._snapshot_ts = ts
+        self.vars.set_session("tidb_snapshot", str(ts))
+
     def _run_show(self, s: ast.ShowStmt) -> ResultSet:
         import fnmatch
 
         kind = s.kind
-        isc = self.domain.catalog.info_schema()
+        isc = self._infoschema()  # snapshot-aware (tidb_snapshot)
 
         def like_filter(names):
             if s.like:
