@@ -162,3 +162,23 @@ def test_hash_and_encoding_functions(d):
     assert s.query("select sha2('x', 3)") == [(None,)]  # bad bits -> NULL
     assert s.query("select uncompress(compress('roundtrip'))") == [
         ("roundtrip",)]
+
+
+def test_show_stats_healthy_and_analyze_status(d):
+    import time as _time
+
+    s = d.new_session()
+    s.execute("create table sh (a bigint)")
+    s.execute("insert into sh values (1), (2), (3), (4)")
+    s.execute("analyze table sh")
+    healthy = s.query("show stats_healthy")
+    assert ("test", "sh", "", 100) in healthy
+    status = s.query("show analyze status")
+    row = [r for r in status if r[1] == "sh"][0]
+    assert row[0] == "test" and row[4] == 4 and row[6] == "finished"
+    # deletes mutate delta chains in place: health must still degrade
+    # (modifications = versions newer than the stats build)
+    _time.sleep(0.01)
+    s.execute("delete from sh where a < 4")
+    h = [r for r in s.query("show stats_healthy") if r[1] == "sh"][0][3]
+    assert h <= 50, h

@@ -770,6 +770,61 @@ class Session:
                              is_query=True)
         if kind in ("stats_meta", "stats_histograms", "stats_buckets"):
             return self._show_stats(kind)
+        if kind == "stats_healthy":
+            # health = 100 * (1 - modified/count) (handle.go Healthy):
+            # modified counts MVCC versions committed AFTER the stats were
+            # built (deletes/updates mutate chains in place, so chain
+            # lengths alone can't tell old rows from new modifications)
+            from ..store.oracle import extract_physical
+
+            rows = []
+            for dbn in isc.schema_names():
+                for t in isc.tables(dbn):
+                    if t.is_view:
+                        continue
+                    st = self.domain.stats.get(t.id)
+                    if st is None:
+                        continue
+                    build_ms = int((st.build_time or 0) * 1000)
+                    modified = 0
+                    for pid in t.physical_ids():
+                        try:
+                            store = self.domain.storage.table(pid)
+                        except KVError:
+                            continue
+                        for chain in store.delta.values():
+                            for v in chain:
+                                if extract_physical(
+                                        v.commit_ts) > build_ms:
+                                    modified += 1
+                    health = max(0, 100 - int(
+                        100 * modified / max(st.row_count, 1)))
+                    rows.append((dbn, t.name, "", health))
+            return ResultSet(
+                ["Db_name", "Table_name", "Partition_name", "Healthy"],
+                rows, is_query=True)
+        if kind == "analyze_status":
+            db_of = {}
+            for dbn in isc.schema_names():
+                for t in isc.tables(dbn):
+                    db_of[t.id] = dbn
+            rows = []
+            for tid, st in sorted(
+                    self.domain.stats.cache_snapshot().items()):
+                owner = isc.table_by_id(tid)
+                if owner is None:
+                    continue
+                rows.append((
+                    db_of.get(owner.id, ""), owner.name,
+                    "" if tid == owner.id else f"pid {tid}",
+                    "analyze columns", st.row_count,
+                    time.strftime("%Y-%m-%d %H:%M:%S",
+                                  time.localtime(st.build_time or 0)),
+                    "finished"))
+            return ResultSet(
+                ["Table_schema", "Table_name", "Partition", "Job_info",
+                 "Processed_rows", "Start_time", "State"], rows,
+                is_query=True)
         if kind == "regions":
             db = s.db or self.current_db
             t = isc.table(db, s.target)

@@ -192,6 +192,13 @@ class StatsHandle:
         with self._mu:
             return self._cache.get(table_id)
 
+    def cache_snapshot(self):
+        """Point-in-time copy of the stats cache for introspection (SHOW
+        ANALYZE STATUS / mysql.stats_meta) — iteration outside the lock
+        would race concurrent ANALYZE inserts."""
+        with self._mu:
+            return dict(self._cache)
+
     # ------------------------------------------------------------------
     def need_auto_analyze(self, table_id: int) -> bool:
         """update.go:621-639 NeedAnalyzeTable: analyze when modified rows
