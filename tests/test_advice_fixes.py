@@ -146,3 +146,180 @@ def test_scan_fault_transient_retry(sess):
         assert sess.query("select sum(a) from t") == [(6,)]
     finally:
         FAILPOINTS.disable("distsql/task_error")
+
+
+# ---------------------------------------------------------------------------
+# round-4 advisor findings
+# ---------------------------------------------------------------------------
+
+def test_pinned_snapshot_survives_compaction(sess):
+    """ADVICE r4 #1: SET tidb_snapshot pins the compaction/GC floor, so a
+    historical read stays correct under write load + maintenance."""
+    d = sess.domain
+    sess.execute("create table hs (id bigint primary key, v bigint)")
+    sess.execute("insert into hs values (1, 10), (2, 20)")
+    ts0 = d.storage.current_ts()
+    sess.execute(f"set tidb_snapshot = {ts0}")
+    assert sess.query("select v from hs order by id") == [(10,), (20,)]
+    # concurrent write load + aggressive maintenance must NOT fold the
+    # base past the pinned TSO
+    w = d.new_session()
+    for i in range(20):
+        w.execute(f"update hs set v = {100 + i} where id = 1")
+    tid = d.catalog.info_schema().table("test", "hs").id
+    d.storage.maybe_compact(tid, threshold=0)  # deferred: pin held
+    d.maintenance.tick()
+    assert sess.query("select v from hs order by id") == [(10,), (20,)]
+    # releasing the pin lets compaction fold
+    sess.execute("set tidb_snapshot = ''")
+    d.storage.maybe_compact(tid, threshold=0)
+    store = d.storage.table(tid)
+    assert not store.delta  # folded now
+    assert sess.query("select v from hs where id = 1") == [(119,)]
+
+
+def test_read_below_compaction_horizon_errors(sess):
+    """A read whose TSO predates the base rebuild fails loudly instead of
+    returning an empty table."""
+    from tidb_tpu.errors import TiDBTPUError
+
+    d = sess.domain
+    sess.execute("set tidb_use_tpu = 0")
+    sess.execute("create table hz (id bigint primary key, v bigint)")
+    sess.execute("insert into hz values (1, 1)")
+    ts0 = d.storage.current_ts()
+    tid = d.catalog.info_schema().table("test", "hz").id
+    sess.execute("update hz set v = 2 where id = 1")
+    d.storage.maybe_compact(tid, threshold=0)  # no pin: folds, base_ts > ts0
+    assert d.storage.table(tid).base_ts > ts0
+    sess.execute(f"set tidb_snapshot = {ts0}")
+    with pytest.raises(TiDBTPUError, match="compaction horizon"):
+        sess.query("select v from hz")
+    sess.execute("set tidb_snapshot = ''")
+
+
+def test_granter_must_hold_granted_privs(sess):
+    """ADVICE r4 #2: CREATE USER or bare GRANT OPTION alone must not allow
+    privilege escalation via GRANT ALL."""
+    from tidb_tpu.errors import PrivilegeError
+
+    d = sess.domain
+    sess.execute("create user admin")
+    sess.execute("grant create user on *.* to admin")
+    sess.execute("create user mallory")
+    adm = d.new_session()
+    adm.user = "admin@%"
+    # user management still works with CREATE USER
+    adm.execute("create user bob")
+    # ...but granting requires GRANT OPTION + the privileges themselves
+    with pytest.raises(PrivilegeError):
+        adm.execute("grant all on *.* to admin")
+    sess.execute("grant grant option on *.* to mallory")
+    mal = d.new_session()
+    mal.user = "mallory@%"
+    with pytest.raises(PrivilegeError):
+        mal.execute("grant select on *.* to mallory")  # doesn't hold SELECT
+    # a granter holding the priv + grant option succeeds
+    sess.execute("grant select on *.* to mallory")
+    mal.execute("grant select on *.* to bob")
+    assert any("SELECT" in g for g in d.priv.show_grants("bob"))
+
+
+def test_global_binding_requires_super(sess):
+    """ADVICE r4 #3: GLOBAL bindings rewrite every session's plans —
+    SUPER required; binding DDL is also a write under tidb_snapshot."""
+    from tidb_tpu.errors import PrivilegeError, TiDBTPUError
+
+    d = sess.domain
+    sess.execute("create table bb (a bigint)")
+    sess.execute("create user lowpriv")
+    sess.execute("grant select on *.* to lowpriv")
+    lp = d.new_session()
+    lp.user = "lowpriv@%"
+    with pytest.raises(PrivilegeError):
+        lp.execute(
+            "create global binding for select * from bb using "
+            "select /*+ HASH_JOIN() */ * from bb")
+    # session-scope binding is fine for a normal user
+    lp.execute("create binding for select * from bb using "
+               "select /*+ HASH_JOIN() */ * from bb")
+    # writes under tidb_snapshot are rejected, including binding DDL
+    ts0 = d.storage.current_ts()
+    sess.execute(f"set tidb_snapshot = {ts0}")
+    with pytest.raises(TiDBTPUError, match="tidb_snapshot"):
+        sess.execute("create binding for select * from bb using "
+                     "select /*+ HASH_JOIN() */ * from bb")
+    sess.execute("set tidb_snapshot = ''")
+
+
+def test_hash_partition_negative_keys_match_reference(sess):
+    """ADVICE r4 #4: negative hash partition keys use abs(truncated rem),
+    matching TiDB locateHashPartition (-5 % 3 -> bucket 2, not 1)."""
+    sess.execute("create table hp (id bigint primary key, v bigint) "
+                 "partition by hash(id) partitions 3")
+    sess.execute("insert into hp values (-5, 1), (5, 2), (-3, 3), (4, 4)")
+    isc = sess.domain.catalog.info_schema()
+    t = isc.table("test", "hp")
+    pi = t.partition_info
+    assert pi.partition_for_value(-5) is pi.defs[2]
+    assert pi.partition_for_value(5) is pi.defs[2]
+    assert pi.partition_for_value(-3) is pi.defs[0]
+    # the three routing paths agree and reads see every row
+    assert sess.query("select v from hp where id = -5") == [(1,)]
+    assert sess.query("select count(*) from hp") == [(4,)]
+
+
+def test_point_get_below_horizon_errors_too(sess):
+    """The horizon guard covers the index/point-get fast paths, not just
+    the copr scan: stale snapshots must never see FUTURE data."""
+    from tidb_tpu.errors import TiDBTPUError
+
+    d = sess.domain
+    sess.execute("create table pz (id bigint primary key, v bigint)")
+    sess.execute("insert into pz values (1, 1), (2, 2)")
+    ts0 = d.storage.current_ts()
+    tid = d.catalog.info_schema().table("test", "pz").id
+    sess.execute("update pz set v = 9 where id = 1")
+    d.storage.maybe_compact(tid, threshold=0)
+    sess.execute(f"set tidb_snapshot = {ts0}")
+    for q in ("select v from pz where id = 1",          # PointGet
+              "select v from pz where id in (1, 2)"):    # BatchPointGet
+        with pytest.raises(TiDBTPUError, match="compaction horizon"):
+            sess.query(q)
+    sess.execute("set tidb_snapshot = ''")
+    assert sess.query("select v from pz where id = 1") == [(9,)]
+
+
+def test_emptied_table_below_horizon_errors(sess):
+    """A fully-deleted-then-compacted table (base_rows == 0) still errors
+    for a stale snapshot instead of silently returning []."""
+    from tidb_tpu.errors import TiDBTPUError
+
+    d = sess.domain
+    sess.execute("set tidb_use_tpu = 0")
+    sess.execute("create table ez (id bigint primary key, v bigint)")
+    sess.execute("insert into ez values (1, 1)")
+    ts0 = d.storage.current_ts()
+    tid = d.catalog.info_schema().table("test", "ez").id
+    sess.execute("delete from ez")
+    d.storage.maybe_compact(tid, threshold=0)
+    assert d.storage.table(tid).base_rows == 0
+    sess.execute(f"set tidb_snapshot = {ts0}")
+    with pytest.raises(TiDBTPUError, match="compaction horizon"):
+        sess.query("select v from ez")
+    sess.execute("set tidb_snapshot = ''")
+
+
+def test_db_scope_grant_all_needs_only_db_privs(sess):
+    """GRANT ALL at db scope expands to scope-applicable privileges only —
+    a db admin without SUPER/CREATE USER can still GRANT ALL ON db.*."""
+    d = sess.domain
+    sess.execute("create user dbadmin")
+    sess.execute("create user app")
+    for p in ("select", "insert", "update", "delete", "create", "drop",
+              "alter", "index", "create view", "grant option"):
+        sess.execute(f"grant {p} on test.* to dbadmin")
+    adm = d.new_session()
+    adm.user = "dbadmin@%"
+    adm.execute("grant all on test.* to app")
+    assert d.priv.check("app", "select", "test", "t")

@@ -128,19 +128,20 @@ def test_write_waits_for_for_update_lock(d):
 
 
 def test_lock_wait_timeout(d):
-    from tidb_tpu.store.txn import Transaction
-
+    """The per-session innodb_lock_wait_timeout bounds the row-lock wait
+    (plumbed into the transaction at _begin_txn; ADVICE r4 #5)."""
     a, b = d.new_session(), d.new_session()
     a.execute("begin")
     a.execute("select * from acc where id = 1 for update")
+    b.execute("set innodb_lock_wait_timeout = 1")  # MySQL minimum
     b.execute("begin")
-    old = Transaction.LOCK_WAIT_TIMEOUT_S
-    Transaction.LOCK_WAIT_TIMEOUT_S = 0.2
     try:
+        t0 = time.monotonic()
         with pytest.raises(LockWaitTimeoutError):
             b.execute("select * from acc where id = 1 for update")
+        elapsed = time.monotonic() - t0
+        assert 0.9 <= elapsed < 5  # honored 1s, not the 50s default
     finally:
-        Transaction.LOCK_WAIT_TIMEOUT_S = old
         a.execute("rollback")
         b.execute("rollback")
 

@@ -163,11 +163,15 @@ def test_create_view_priv_and_grant_option(d, root):
         vu.execute("create view v1 as select a from t")
     root.execute("grant create view on test.* to vu")
     vu.execute("create view v1 as select a from t")
-    # GRANT OPTION lets a non-admin grant
+    # GRANT OPTION lets a non-admin grant — but only privileges they
+    # themselves hold at that scope (MySQL executor/grant.go semantics)
     root.execute("create user go_user")
     root.execute("create user target_user")
     root.execute("grant grant option on *.* to go_user")
     gs = _as(d, "go_user")
+    with pytest.raises(PrivilegeError):
+        gs.execute("grant select on test.t to target_user")  # lacks SELECT
+    root.execute("grant select on *.* to go_user")
     gs.execute("grant select on test.t to target_user")
     assert d.priv.check("target_user", "select", "test", "t")
 

@@ -114,7 +114,10 @@ class PartitionInfo:
         if self.kind == "hash":
             if v is None:
                 return self.defs[0]
-            return self.defs[int(v) % len(self.defs)]
+            # MySQL/TiDB locateHashPartition: abs of the TRUNCATED
+            # remainder (Go %), equal to abs(v) % n — not Python's floored
+            # modulo; negative keys must land in the reference's bucket
+            return self.defs[abs(int(v)) % len(self.defs)]
         if v is None:
             return self.defs[0]
         v = int(v)

@@ -35,6 +35,7 @@ def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
 
     chunks: List[Chunk] = []
     base_end = min(clipped.end, table.base_rows)
+    table.check_read_horizon(ts)
     if table.base_ts <= ts and clipped.start < base_end:
         if req.engine == "tpu":
             try:

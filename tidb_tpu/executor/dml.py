@@ -506,7 +506,10 @@ def _native_partition_route(pi, arr: np.ndarray, valid: np.ndarray):
     partition index into pi.defs (NULLs -> partition 0)."""
     v = arr.astype(np.int64, copy=False)
     if pi.kind == "hash":
-        idx = v % len(pi.defs)
+        # abs(v) % n == abs of Go's truncated remainder (reference
+        # locateHashPartition); np.abs(int64.min) overflows but that value
+        # is rejected upstream as out of int64 range
+        idx = np.abs(v) % len(pi.defs)
         return np.where(valid, idx, 0)
     bounds = [p.less_than for p in pi.defs]
     finite = [b for b in bounds if b is not None]

@@ -111,6 +111,7 @@ def _overlay_sets(ctx, store, table_id: int):
     """(deleted, inserted, buffer, overlay_handle_set) at the statement's
     snapshot — the shared MVCC overlay all index-side readers apply."""
     ts = ctx.snapshot_ts()
+    store.check_read_horizon(ts)
     deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
     buffer = {}
     if ctx.txn is not None:

@@ -132,7 +132,7 @@ def prune_partitions(table: TableInfo, conds: List[Expression],
         return sorted(out, key=lambda p: pi.defs.index(p))
     if pi.kind == "hash":
         if lo is not None and lo == hi and not lo_open and not hi_open:
-            return [pi.defs[lo % len(pi.defs)]]
+            return [pi.defs[abs(lo) % len(pi.defs)]]  # Go truncated-rem abs
         return list(pi.defs)
     # RANGE: keep defs whose [prev_bound, less_than) intersects [lo, hi]
     out = []

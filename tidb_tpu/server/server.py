@@ -147,7 +147,7 @@ class MySQLServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            sess.rollback()
+            sess.close()  # unpin snapshots + rollback
             sess._release_table_locks()  # MySQL frees them on disconnect
             self.domain.sessions.pop(sess.conn_id, None)
             writer.close()

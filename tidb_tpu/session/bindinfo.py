@@ -114,6 +114,19 @@ def handle(session, sql: str):
     m = _BINDING_RE.match(sql)
     verb = m.group(1).lower()
     is_global = (m.group(2) or "session").lower() == "global"
+    # binding DDL short-circuits the normal statement path (execute()
+    # dispatches here before parsing), so the privilege and snapshot
+    # guards must run here (ADVICE r4 #3):
+    # - it is a write: reject under SET tidb_snapshot
+    # - GLOBAL bindings rewrite every session's plans: SUPER required
+    #   (TiDB gates global bind DDL the same way)
+    if session._snapshot_ts is not None:
+        from ..errors import ExecutorError
+
+        raise ExecutorError(
+            "can not execute write statement when 'tidb_snapshot' is set")
+    if is_global:
+        session.domain.priv.require(session.user, "super")
     tail = sql[m.end():].strip().rstrip(";")
     if verb == "create":
         orig, hinted = _split_for_using(tail)

@@ -81,6 +81,11 @@ class MaintenanceWorker:
         floor = storage.live_txn_floor()
         if floor is not None:
             safepoint = min(safepoint, floor - 1)
+        pinned = storage.pinned_read_floor()
+        if pinned is not None:
+            # sessions pinned via SET tidb_snapshot read at their pinned
+            # TSO outside any transaction — hold the safepoint for them too
+            safepoint = min(safepoint, pinned - 1)
         if safepoint <= self.last_safepoint:
             return
         self.last_safepoint = safepoint

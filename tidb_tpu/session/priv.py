@@ -358,11 +358,21 @@ def check_stmt(session, s) -> None:
         pm.require(user, "create user")
         return
     if isinstance(s, (ast.GrantStmt, ast.RevokeStmt)):
-        # MySQL: granting needs GRANT OPTION (plus the privileges held);
-        # the admin CREATE USER privilege also suffices here
-        if not (pm.check(user, "grant option")
-                or pm.check(user, "create user")):
-            pm.require(user, "grant option")
+        # MySQL (executor/grant.go): the granter must hold GRANT OPTION at
+        # the statement's scope AND every privilege being granted there.
+        # CREATE USER alone authorizes user management, not grants —
+        # otherwise a user-admin could GRANT ALL to themselves.
+        db, table = _parse_level(s.level)
+        pm.require(user, "grant option", db, table)
+        # ALL expands to the privileges that EXIST at the statement's
+        # scope: db/table-level ALL comprises only DML+DDL privileges
+        # (MySQL has no db-scoped SUPER/PROCESS/CREATE USER to demand)
+        scope_all = (KNOWN_PRIVS - {"grant option", "all"} if db is None
+                     else DML_PRIVS | DDL_PRIVS)
+        for p in s.privs:
+            needed = sorted(scope_all) if p.lower() == "all" else [p]
+            for q in needed:
+                pm.require(user, q, db, table)
         return
     if isinstance(s, (ast.KillStmt, ast.AdminStmt, ast.SplitRegionStmt)):
         pm.require(user, "super")

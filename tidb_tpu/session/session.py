@@ -112,6 +112,7 @@ class Session:
         # the wire server overwrites this after the auth handshake
         self.user = "root@%"
         self._snapshot_ts = None  # SET tidb_snapshot historical-read TSO
+        self._snapshot_pin = None  # storage pin token holding GC/compaction
         self._txn = None  # explicit txn (BEGIN..COMMIT)
         self._in_txn = False
         self._killed = False
@@ -176,6 +177,16 @@ class Session:
                     raise SchemaChangedError()
 
             txn.schema_check = schema_check
+            try:
+                # MySQL clients tune row-lock waits per session; clamp to
+                # MySQL's documented range [1, 1073741824] so a bogus SET
+                # (get_int -> 0) can't turn every wait into an instant
+                # timeout
+                txn.lock_wait_timeout_s = float(min(max(
+                    self.vars.get_int("innodb_lock_wait_timeout"), 1),
+                    1 << 30))
+            except Exception:
+                pass
             self._txn = txn
         return self._txn
 
@@ -673,13 +684,16 @@ class Session:
 
         Bounds beyond GC: column-layout DDL (ADD/DROP/MODIFY COLUMN)
         rebuilds the store eagerly (catalog._rebuild_storage), so data time
-        travel does not cross such a DDL — reads older than the rebuild see
-        an empty table, like a reader behind a TiFlash delta-merge horizon.
-        DML-only history time-travels exactly."""
+        travel does not cross such a DDL — reads older than the rebuild
+        raise 'snapshot is older than the compaction horizon'.  While a
+        snapshot is pinned, GC and background compaction hold their floor
+        at the pinned TSO (storage.pin_read), so DML-only history
+        time-travels exactly."""
         from ..store.oracle import compose_ts
 
         if value in ("", None, 0):
             self._snapshot_ts = None
+            self._unpin_snapshot()
             self.vars.set_session("tidb_snapshot", "")
             return
         if self._txn is not None or self._in_txn:
@@ -702,7 +716,27 @@ class Session:
             raise PlanError(
                 "snapshot is older than GC safe point")
         self._snapshot_ts = ts
+        # hold GC + compaction at this TSO for the life of the pin:
+        # without it background compaction advances base_ts and the
+        # historical read silently turns empty (ADVICE r4 #1)
+        self._unpin_snapshot()
+        self._snapshot_pin = self.domain.storage.pin_read(ts)
         self.vars.set_session("tidb_snapshot", str(ts))
+
+    def _unpin_snapshot(self):
+        if self._snapshot_pin is not None:
+            self.domain.storage.unpin_read(self._snapshot_pin)
+            self._snapshot_pin = None
+
+    def close(self):
+        """Connection teardown: release snapshot pins and roll back any
+        open transaction so GC/compaction are not held forever."""
+        self._unpin_snapshot()
+        try:
+            if self._txn is not None:
+                self.rollback()
+        except Exception:
+            pass
 
     def _run_show(self, s: ast.ShowStmt) -> ResultSet:
         import fnmatch

@@ -13,6 +13,8 @@ from typing import Dict, Optional
 # name -> (default, kind)  kind in {int, bool, str, float}
 SYSVAR_DEFAULTS = {
     "autocommit": ("1", "bool"),
+    # MySQL row-lock wait budget (seconds; MySQL default 50)
+    "innodb_lock_wait_timeout": ("50", "int"),
     "sql_mode": ("ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES", "str"),
     "max_execution_time": ("0", "int"),
     # GC retention (seconds; gc_worker.go gcDefaultLifeTime is 10m) and

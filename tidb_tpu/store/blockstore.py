@@ -344,10 +344,22 @@ class TableStore:
                 return v
         return None
 
+    def check_read_horizon(self, ts: int):
+        """Fail loudly when a read's TSO predates the base rebuild
+        (compaction / bulk load / DDL rebuild): the data the reader should
+        see no longer exists, and every read path — copr scan, point get,
+        index-side overlay — must surface that rather than returning
+        empty/future rows (TiDB's 'snapshot is older than GC safe point')."""
+        if 0 < ts < self.base_ts:
+            raise KVError(
+                "snapshot is older than the compaction horizon "
+                f"(read ts {ts} < base ts {self.base_ts})")
+
     def read_row(self, handle: int, ts: int,
                  resolve_locks: bool = True) -> Optional[tuple]:
         """Point read at snapshot ts (None = not found)."""
         with self._mu:
+            self.check_read_horizon(ts)
             lk = self.check_lock(handle, ts)
             if lk is not None:
                 raise LockedError((self.table_id, handle), lk.start_ts)

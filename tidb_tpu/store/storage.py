@@ -34,6 +34,11 @@ class BlockStorage(Storage):
         # waiters (the TTL path only covers txns this process no longer
         # tracks — crashed processes start with an empty registry)
         self._live_txns: set = set()
+        # pinned historical read TSOs (SET tidb_snapshot): compaction and
+        # GC must not advance past the oldest pin, or historical reads
+        # would silently lose their base blocks (ADVICE r4 #1)
+        self._pinned_reads: Dict[int, int] = {}
+        self._pin_seq = 0
         self._tables: Dict[int, TableStore] = {}
         self._mu = threading.RLock()
         self._client = CoprClient(self)
@@ -118,6 +123,24 @@ class BlockStorage(Storage):
         with self._mu:
             return min(self._live_txns) if self._live_txns else None
 
+    # ---- pinned historical reads (tidb_snapshot) ----------------------
+    def pin_read(self, ts: int) -> int:
+        """Register a long-lived historical read TSO; returns an unpin
+        token.  GC/compaction treat pinned TSOs like live-txn snapshots."""
+        with self._mu:
+            self._pin_seq += 1
+            self._pinned_reads[self._pin_seq] = ts
+            return self._pin_seq
+
+    def unpin_read(self, token: int):
+        with self._mu:
+            self._pinned_reads.pop(token, None)
+
+    def pinned_read_floor(self):
+        with self._mu:
+            return (min(self._pinned_reads.values())
+                    if self._pinned_reads else None)
+
     def data_version(self) -> int:
         """Monotonic counter bumped on bulk load, compaction, and committed
         DML (via TableStore.on_mutate) — O(1) plan-cache invalidation with
@@ -145,10 +168,12 @@ class BlockStorage(Storage):
         t = self._tables.get(table_id)
         if t is None or t.locks:
             return
-        if self.live_txn_floor() is not None:
+        if self.live_txn_floor() is not None \
+                or self.pinned_read_floor() is not None:
             # compaction advances base_ts and folds the delta: an open
-            # snapshot reader would see an empty table mid-transaction.
-            # Defer until no transaction is pinned (same rule as GC).
+            # snapshot reader (live txn OR pinned tidb_snapshot) would see
+            # an empty table mid-read.  Defer until no snapshot is pinned
+            # (same rule as GC).
             return
         if len(t.delta) > max(threshold, t.base_rows // 10):
             try:

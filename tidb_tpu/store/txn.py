@@ -64,9 +64,11 @@ class Transaction:
             return m.values if m.op == "put" else None
         return self.storage.table(table_id).read_row(handle, self.start_ts)
 
-    # pessimistic lock-wait knobs (innodb_lock_wait_timeout analog)
+    # pessimistic lock-wait knobs; the per-session innodb_lock_wait_timeout
+    # overrides the default via `lock_wait_timeout_s` (session._begin_txn)
     LOCK_WAIT_TIMEOUT_S = 5.0
     LOCK_WAIT_POLL_S = 0.005
+    lock_wait_timeout_s: float = LOCK_WAIT_TIMEOUT_S
 
     def lock_keys(self, *keys: RowKey, ttl_ms: int = 3000):
         """Pessimistic locks taken during execution (2pc.go:668).
@@ -100,7 +102,7 @@ class Transaction:
         import time as _time
 
         detector = self.storage.deadlock
-        deadline = _time.monotonic() + self.LOCK_WAIT_TIMEOUT_S
+        deadline = _time.monotonic() + self.lock_wait_timeout_s
         waiting_on = None
         try:
             while True:
