@@ -41,7 +41,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-MAX_ROWS = int(os.environ.get("BENCH_ROWS", 64_000_000))
+MAX_ROWS = int(os.environ.get("BENCH_ROWS", 128_000_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 3))
 REGIONS = int(os.environ.get("BENCH_REGIONS", 8))
 WALL_LIMIT = float(os.environ.get("BENCH_WALL_LIMIT", 1500))
@@ -190,7 +190,8 @@ def _run(state: dict):
 def _run_inner(state: dict):
     state.setdefault("phases", {})["worker_start"] = round(
         time.perf_counter() - T0, 1)
-    scales = [s for s in (262_144, 1_048_576, 4_000_000, MAX_ROWS)
+    scales = [s for s in (262_144, 1_048_576, 4_000_000, 64_000_000,
+                          MAX_ROWS)
               if s <= MAX_ROWS]
     if not scales:
         scales = [MAX_ROWS]

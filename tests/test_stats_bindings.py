@@ -127,9 +127,14 @@ def _ops(s, q):
     return [r[0] for r in s.execute("explain " + q)[0].rows]
 
 
+def _default_join_op(ops):
+    # agg-over-join now plans as the device broadcast join when eligible
+    return any("HashJoin" in op or "DeviceJoinReader" in op for op in ops)
+
+
 def test_binding_flips_join_algorithm(joined):
     s = joined
-    assert any("HashJoin" in op for op in _ops(s, _Q))
+    assert _default_join_op(_ops(s, _Q))
     s.execute(f"create session binding for {_Q} using "
               f"select /*+ MERGE_JOIN */ count(*) from big join small"
               f" on big.id = small.id where small.x < 10")
@@ -140,7 +145,7 @@ def test_binding_flips_join_algorithm(joined):
     # execution uses the bound plan and stays correct
     assert s.query(_Q) == [(400,)]
     s.execute(f"drop session binding for {_Q}")
-    assert any("HashJoin" in op for op in _ops(s, _Q))
+    assert _default_join_op(_ops(s, _Q))
 
 
 def test_global_binding_and_show(joined, d):

@@ -22,25 +22,40 @@ class ByteCapCache:
         self._bytes = 0
         self.capacity = capacity_bytes
         self._mu = threading.Lock()
+        # per-key in-flight latches: a background prefetch and a query
+        # racing on the same column must not BOTH push it over the link
+        # (transfers are the expensive part; see _MeshCache)
+        self._inflight: Dict[tuple, threading.Event] = {}
 
     def get_or_load(self, key: tuple, loader: Callable[[], Tuple]) -> tuple:
+        while True:
+            with self._mu:
+                hit = self._cache.get(key)
+                if hit is not None:
+                    return hit
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    break  # we are the loader
+            ev.wait()  # another thread is loading this key
+        try:
+            value = loader()  # outside the lock: loads transfer data
+        except BaseException:
+            with self._mu:
+                self._inflight.pop(key, None)
+            ev.set()
+            raise
+        nbytes = sum(v.nbytes for v in value if v is not None)
         with self._mu:
-            hit = self._cache.get(key)
-            if hit is not None:
-                return hit
-        value = loader()  # outside the lock: loads transfer data
-        nbytes = sum(v.nbytes for v in value)
-        with self._mu:
-            hit = self._cache.get(key)
-            if hit is not None:  # raced with another loader; keep first
-                return hit
             while self._bytes + nbytes > self.capacity and self._order:
                 old = self._order.pop(0)
                 ov = self._cache.pop(old)
-                self._bytes -= sum(v.nbytes for v in ov)
+                self._bytes -= sum(v.nbytes for v in ov if v is not None)
             self._cache[key] = value
             self._order.append(key)
             self._bytes += nbytes
+            self._inflight.pop(key, None)
+        ev.set()
         return value
 
     def clear(self):

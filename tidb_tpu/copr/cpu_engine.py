@@ -20,6 +20,7 @@ from . import aggstate
 from .ir import (
     DAG,
     AggregationIR,
+    JoinLookupIR,
     JoinProbeIR,
     LimitIR,
     ProjectionIR,
@@ -51,6 +52,34 @@ def run_dag_on_chunk(dag: DAG, chunk: Chunk, aux: Optional[dict] = None) -> Chun
                 if len(keys) else np.zeros(chunk.num_rows, dtype=np.bool_)
             )
             chunk = chunk.filter(member)
+        elif isinstance(ex, JoinLookupIR):
+            keys = (aux or {}).get(f"probe_keys_{ex.filter_id}")
+            payload = (aux or {}).get(f"payload_{ex.filter_id}")
+            pvalids = (aux or {}).get(f"payload_valid_{ex.filter_id}")
+            if keys is None or payload is None:
+                raise ExecutorError(
+                    f"missing join lookup aux {ex.filter_id}")
+            v = ex.key.eval(chunk)
+            bits = key_bits_int64(v.data)
+            if len(keys):
+                pos = np.searchsorted(keys, bits)
+                pos_c = np.clip(pos, 0, len(keys) - 1)
+                member = (keys[pos_c] == bits) & v.validity()
+            else:
+                pos_c = np.zeros(chunk.num_rows, dtype=np.int64)
+                member = np.zeros(chunk.num_rows, dtype=np.bool_)
+            chunk = chunk.filter(member)
+            hit_pos = pos_c[member]
+            cols = list(chunk.columns)
+            for j, ft in enumerate(ex.payload_ftypes):
+                data = payload[j][hit_pos] if len(keys) else \
+                    payload[j][:0]
+                pv = None
+                if pvalids is not None and pvalids[j] is not None:
+                    pv = pvalids[j][hit_pos] if len(keys) else \
+                        pvalids[j][:0]
+                cols.append(Column(ft, data, pv))
+            chunk = Chunk(cols)
         elif isinstance(ex, ProjectionIR):
             chunk = Chunk([e.eval(chunk).to_column() for e in ex.exprs])
         elif isinstance(ex, AggregationIR):

@@ -171,6 +171,40 @@ class JoinProbeIR:
         }
 
 
+@dataclass
+class JoinLookupIR:
+    """Device broadcast lookup join (the full-join successor of
+    JoinProbeIR): the build side's sorted UNIQUE int64 keys AND its payload
+    columns ship in CopRequest.aux (``probe_keys_{fid}``,
+    ``payload_{fid}`` = list of np arrays aligned to the sorted keys,
+    ``payload_valid_{fid}`` = list of bool arrays or None).  Each probe row
+    binary-searches its key; misses are dropped (inner join) and hits
+    extend the row with the matched payload row — downstream IR expressions
+    address payload column j as scan-output index len(scan.columns)+j
+    (+ previous lookups' widths).
+
+    The TPU redesign of the reference's root-side HashJoin worker pool
+    (executor/join.go:232-414): the hash table is broadcast to every mesh
+    shard and the probe runs INSIDE the same shard_map program as the scan
+    and the partial aggregation, so join-heavy shapes return aggregated
+    partials instead of shipping filtered probe streams to the host.
+    Build-key uniqueness is a plan-time guarantee (PK/unique-index
+    provenance, physical.py _build_key_unique)."""
+
+    key: Expression
+    filter_id: int = 0
+    payload_ftypes: List[FieldType] = field(default_factory=list)
+
+    def to_dict(self):
+        return {
+            "type": "join_lookup",
+            "key": serialize_expr(self.key),
+            "filter_id": self.filter_id,
+            "payload_ftypes": [serialize_ftype(f) for f in
+                               self.payload_ftypes],
+        }
+
+
 def key_bits_int64(data, validity=None):
     """Canonical int64 representation of join/group key values (host side):
     float64 by bit pattern with -0.0 normalized, everything else widened to
@@ -257,6 +291,11 @@ class DAG:
                 out.append(
                     JoinProbeIR(deserialize_expr(ed["key"]), ed["filter_id"])
                 )
+            elif t == "join_lookup":
+                out.append(JoinLookupIR(
+                    deserialize_expr(ed["key"]), ed["filter_id"],
+                    [deserialize_ftype(f) for f in ed["payload_ftypes"]],
+                ))
             elif t == "topn":
                 out.append(
                     TopNIR(
@@ -280,6 +319,8 @@ class DAG:
         for ex in self.executors[1:]:
             if isinstance(ex, ProjectionIR):
                 fts = [e.ftype for e in ex.exprs]
+            elif isinstance(ex, JoinLookupIR):
+                fts = fts + list(ex.payload_ftypes)
             elif isinstance(ex, AggregationIR):
                 out = [g.ftype for g in ex.group_by]
                 if ex.mode == "partial":

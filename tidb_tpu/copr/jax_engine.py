@@ -37,6 +37,7 @@ from ..types import FieldType, TypeKind, ty_int
 from .ir import (
     DAG,
     AggregationIR,
+    JoinLookupIR,
     JoinProbeIR,
     LimitIR,
     ProjectionIR,
@@ -200,6 +201,7 @@ class _Analyzed:
         self.scan: TableScanIR = dag.scan
         self.selections: List[SelectionIR] = []
         self.probes: List[JoinProbeIR] = []
+        self.lookups: List[JoinLookupIR] = []
         self.projection: Optional[ProjectionIR] = None
         self.agg: Optional[AggregationIR] = None
         self.topn: Optional[TopNIR] = None
@@ -213,6 +215,10 @@ class _Analyzed:
                 if self.agg or self.topn or self.projection:
                     raise JaxUnsupported("join probe after agg/topn on device")
                 self.probes.append(ex)
+            elif isinstance(ex, JoinLookupIR):
+                if self.agg or self.topn or self.projection:
+                    raise JaxUnsupported("join lookup after agg/topn")
+                self.lookups.append(ex)
             elif isinstance(ex, ProjectionIR):
                 if self.agg or self.topn:
                     raise JaxUnsupported("projection after agg/topn on device")
@@ -242,7 +248,13 @@ class _Analyzed:
         }
         all_exprs: List[Expression] = [
             c for s in self.selections for c in s.conditions
-        ] + [p.key for p in self.probes]
+        ] + [p.key for p in self.probes] + [lk.key for lk in self.lookups]
+        if self.lookups and self.agg is None:
+            # the mesh filter/topn readback paths gather rows from the
+            # TABLE, which has no payload columns — lookups are only
+            # device-run under a partial aggregation (the planner only
+            # emits that shape; fan-out CPU regions handle the rest)
+            raise JaxUnsupported("join lookup without device aggregation")
         if self.projection is not None:
             all_exprs += self.projection.exprs
         if self.topn is not None:
@@ -275,12 +287,21 @@ class _Analyzed:
         #         float/NULLable keys) — mesh path only
         self.agg_mode = "dense"
         if self.agg is not None:
+            width = len(self.scan.columns)
             for a in self.agg.aggs:
                 if a.distinct:
                     raise JaxUnsupported("distinct agg on device")
                 if a.name not in ("count", "sum", "avg", "min", "max",
                                   "first_row"):
                     raise JaxUnsupported(f"device agg {a.name}")
+                if a.name == "first_row" and self.lookups:
+                    refs: set = set()
+                    for x in a.args:
+                        x.collect_columns(refs)
+                    if any(i >= width for i in refs):
+                        # first_row partials resolve via a TABLE gather,
+                        # which has no payload columns
+                        raise JaxUnsupported("first_row over join payload")
             try:
                 self._analyze_dense_keys(table)
             except JaxUnsupported:
@@ -317,6 +338,9 @@ class _Analyzed:
             if k.ftype.kind == TypeKind.FLOAT:
                 # dense int codes would truncate: 1.2 and 1.4 collapse
                 raise JaxUnsupported("float group key on device")
+            if k.index >= len(self.scan.columns):
+                # payload column (join lookup): no base stats — sort mode
+                raise JaxUnsupported("payload group key needs sort agg")
             store_ci = self.scan.columns[k.index]
             lo, hi, has_null = table.column_stats(store_ci)
             if has_null:
@@ -338,12 +362,15 @@ class _Analyzed:
         self.num_groups = max(g, 1)
 
     def needed_cols(self) -> List[int]:
-        """Scan-output col indices the device actually needs."""
+        """Scan-output col indices the device actually needs (payload
+        indices from join lookups are aux-fed, not scanned — dropped)."""
         need: set = set()
         for c in self.conds:
             c.collect_columns(need)
         for p in self.probes:
             p.key.collect_columns(need)
+        for lk in self.lookups:
+            lk.key.collect_columns(need)
         if self.agg is not None:
             need.update(self.group_cols)
             for k in self.agg.group_by:
@@ -356,7 +383,8 @@ class _Analyzed:
                 p.collect_columns(need)
         if self.topn is not None:
             self.topn.order_by[0][0].collect_columns(need)
-        return sorted(need)
+        width = len(self.scan.columns)
+        return sorted(i for i in need if i < width)
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +399,11 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
         "kind": kind,
         "conds": [serialize_expr(c) for c in an.conds],
         "probes": [[serialize_expr(p.key), p.filter_id] for p in an.probes],
+        "lookups": [
+            [serialize_expr(lk.key), lk.filter_id,
+             [int(f.kind) for f in lk.payload_ftypes]]
+            for lk in an.lookups
+        ],
         "proj": [serialize_expr(p) for p in an.proj_exprs]
         if an.proj_exprs is not None
         else None,
@@ -405,6 +438,10 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
     are cached device arrays (keyed on base_version), and only G-sized
     partials come back.
     """
+    if an.lookups:
+        # the broadcast lookup join runs in the mesh engine only; the
+        # per-tile fallback hands these regions to the CPU interpreter
+        raise JaxUnsupported("join lookup needs the mesh engine")
     n = TILE
 
     def cols_env(datas, valids):

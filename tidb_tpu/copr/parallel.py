@@ -102,10 +102,46 @@ def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
     return n_tiles, n_pad, n_pad // n_shards
 
 
+def _full_dtype(kind) -> np.dtype:
+    """The dtype compile_expr expects for a column of this kind (what the
+    device program casts the wire array to before any arithmetic)."""
+    if kind in (TypeKind.DATE, TypeKind.STRING):
+        return np.dtype(np.int32)
+    if kind == TypeKind.FLOAT:
+        return np.dtype(np.float64)
+    return np.dtype(np.int64)
+
+
+def _wire_dtype(table, store_ci: int) -> np.dtype:
+    """Narrowest integer dtype that exactly holds the column's base values
+    (and 0, the pad value).  The tunnel's h2d bandwidth (~75MB/s measured)
+    and HBM read bandwidth both scale with wire width, so an int64 column
+    whose values fit int8 transfers AND scans 8x cheaper; the device
+    program widens in-register (XLA fuses the convert into consumers).
+    Floats stay f64: value-preserving narrowing is not generally exact."""
+    full = _full_dtype(table.cols[store_ci].ftype.kind)
+    if full == np.float64:
+        return full
+    lo, hi, _ = table.column_stats(store_ci)
+    if hi < lo:  # empty: stats sentinel
+        return np.dtype(np.int8)
+    for cand in (np.int8, np.int16, np.int32):
+        info = np.iinfo(cand)
+        if info.min <= min(lo, 0) and max(hi, 0) <= info.max:
+            return np.dtype(cand) if np.dtype(cand).itemsize \
+                < full.itemsize else full
+    return full
+
+
 class _MeshCache:
     """(store_uid, base_version, store_ci, device_ids, TILE) -> sharded
     [n_pad, TILE] arrays; device ids in the key so a rebuilt same-size mesh
-    never serves arrays placed on a dead device set."""
+    never serves arrays placed on a dead device set.
+
+    The cached data array keeps the NARROW wire dtype (see _wire_dtype) and
+    the valid slot is None for columns with no NULLs — consumers cast on
+    device / substitute a constant mask, so both the link transfer and the
+    steady-state HBM traffic shrink to the narrow width."""
 
     def __init__(self, capacity_bytes: int = 8 << 30):
         from .cache import ByteCapCache
@@ -126,20 +162,30 @@ class _MeshCache:
         def load():
             tile = je.TILE
             n_tiles, n_pad, _ = _layout(table.base_rows, S)
-            first, fvalid = _gather_tile(
-                table, store_ci, 0, min(tile, table.base_rows)
-            )
-            data = np.zeros((n_pad, tile), dtype=first.dtype)
-            valid = np.zeros((n_pad, tile), dtype=np.bool_)
-            data[0], valid[0] = first, fvalid
-            for t in range(1, n_tiles):
-                d, v = _gather_tile(
-                    table, store_ci, t * tile,
-                    min((t + 1) * tile, table.base_rows),
-                )
-                data[t], valid[t] = d, v
+            wire = _wire_dtype(table, store_ci)
+            _, _, has_null = table.column_stats(store_ci)
+            # vectorized build: ONE flat buffer filled block-by-block
+            # (memcpy + cast per 64k block — no per-tile Python loop), so
+            # host prep is bandwidth-bound, not interpreter-bound
+            flat = np.zeros(n_pad * tile, dtype=wire)
+            off = 0
+            vflat = None
+            if has_null:
+                vflat = np.zeros(n_pad * tile, dtype=np.bool_)
+            for _s, arrs, vals in table.iter_base_blocks(
+                    [store_ci], 0, table.base_rows):
+                blk, v = arrs[0], vals[0]
+                n = len(blk)
+                flat[off:off + n] = blk  # casts to wire dtype
+                if vflat is not None:
+                    vflat[off:off + n] = True if v is None else v
+                off += n
             sh = NamedSharding(mesh, P("dp"))
-            return jax.device_put(data, sh), jax.device_put(valid, sh)
+            data = jax.device_put(flat.reshape(n_pad, tile), sh)
+            valid = None
+            if vflat is not None:
+                valid = jax.device_put(vflat.reshape(n_pad, tile), sh)
+            return data, valid
 
         return self._c.get_or_load(key, load)
 
@@ -148,6 +194,84 @@ class _MeshCache:
 
 
 MESH_CACHE = _MeshCache()
+
+# h2d transfers over the tunnel are synchronous (~113MB/s single-stream,
+# ~170MB/s with 4 streams measured) — a small shared pool overlaps the
+# host tile build of one column with the link transfer of another, for
+# both foreground queries and the background prefetcher
+_XFER_POOL = None
+_SHUTDOWN = False
+
+
+def _xfer_pool():
+    global _XFER_POOL
+    if _XFER_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _XFER_POOL = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="tidb-tpu-xfer")
+    return _XFER_POOL
+
+
+def _note_shutdown():
+    global _SHUTDOWN
+    _SHUTDOWN = True
+
+
+# threading._register_atexit runs BEFORE Py_Finalize joins non-daemon
+# threads (plain atexit runs after the join — too late to stop them);
+# this caps the interpreter-exit delay at one in-flight column transfer
+import threading as _threading  # noqa: E402
+
+try:
+    _threading._register_atexit(_note_shutdown)
+except AttributeError:  # pragma: no cover - very old CPython
+    import atexit as _atexit
+
+    _atexit.register(_note_shutdown)
+
+
+def load_columns(mesh: Mesh, table, store_cis):
+    """Load several columns into the mesh cache concurrently; returns the
+    (data, valid) pairs in order."""
+    cis = list(store_cis)
+    if len(cis) <= 1:
+        return [MESH_CACHE.get_column(mesh, table, ci) for ci in cis]
+    futs = [_xfer_pool().submit(MESH_CACHE.get_column, mesh, table, ci)
+            for ci in cis]
+    return [f.result() for f in futs]
+
+
+def prefetch_table(storage, table_id: int, min_rows: int = 1 << 20):
+    """Warm the mesh column cache for a table in the background (device
+    cache warming after bulk load — the TiFlash eager-replica analog).
+    Concurrent queries never double-transfer: ByteCapCache latches
+    in-flight loads per key.  No-op for small tables."""
+    import threading
+
+    try:
+        table = storage.table(table_id)
+    except Exception:
+        return
+    if table.base_rows < min_rows:
+        return
+
+    def run():
+        try:
+            mesh = get_mesh()
+            version = table.base_version
+            for ci in range(len(table.cols)):
+                if _SHUTDOWN or table.base_version != version:
+                    return  # interpreter exiting / data changed under us
+                MESH_CACHE.get_column(mesh, table, ci)
+        except Exception:
+            pass  # prefetch is advisory; queries load on demand
+
+    # NON-daemon: a daemon thread mid-device_put at interpreter exit
+    # crashes the tunnel client ("FATAL: exception not rethrown"); the
+    # _SHUTDOWN latch bounds the exit delay to one column transfer
+    threading.Thread(
+        target=run, daemon=False, name="tidb-tpu-prefetch").start()
 
 # all-true deletion masks, byte-capped like the data cache (they are
 # device-resident [n_pad, TILE] bools); keyed on the mesh's device ids so a
@@ -177,6 +301,26 @@ def _all_true(mesh: Mesh, n_pad: int):
 # sharded programs
 # ---------------------------------------------------------------------------
 
+def _cols_env(an: _Analyzed, col_order: List[int], datas, valids,
+              n_local: int):
+    """Per-shard column environment for compile_expr: widen the narrow
+    wire arrays to the canonical dtype in-register (XLA fuses the convert
+    into every consumer — HBM reads stay narrow), and substitute a traced
+    constant mask for columns cached without a validity array (no NULLs:
+    zero transfer, zero HBM)."""
+    env = {}
+    for j, ci in enumerate(col_order):
+        d = datas[j].reshape(n_local)
+        target = _full_dtype(an.scan.ftypes[ci].kind)
+        if d.dtype != target:
+            d = d.astype(target)
+        v = valids[j]
+        v = (jnp.ones(n_local, dtype=jnp.bool_) if v is None
+             else v.reshape(n_local))
+        env[ci] = (d, v)
+    return env
+
+
 _COMPILED: Dict[str, object] = {}
 
 # max selected rows gathered host-side per streamed chunk (kv.Request
@@ -201,7 +345,11 @@ def _key_device(d):
 
 def _apply_probes(an: _Analyzed, cols, m, pargs, n_local: int):
     """AND the runtime join-filter membership tests into the row mask:
-    sorted build keys broadcast to every shard, searchsorted probe."""
+    sorted build keys broadcast to every shard, searchsorted probe.
+    Then run the broadcast lookup JOINS: drop misses and extend the
+    column env with gathered payload rows (JoinLookupIR) — the join
+    completes ON DEVICE, inside the same shard program as the scan and
+    the partial aggregation."""
     for i, p in enumerate(an.probes):
         keys, kn = pargs[2 * i], pargs[2 * i + 1]
         d, v = compile_expr(p.key, cols, n_local)
@@ -210,11 +358,32 @@ def _apply_probes(an: _Analyzed, cols, m, pargs, n_local: int):
         pos_c = jnp.clip(pos, 0, keys.shape[0] - 1)
         hit = (pos < kn) & (keys[pos_c] == k)
         m = m & v & hit
+    off = 2 * len(an.probes)
+    out_idx = len(an.scan.columns)
+    for lk in an.lookups:
+        keys, kn = pargs[off], pargs[off + 1]
+        off += 2
+        d, v = compile_expr(lk.key, cols, n_local)
+        k = d.astype(jnp.int64)
+        pos = jnp.searchsorted(keys, k)
+        pos_c = jnp.clip(pos, 0, keys.shape[0] - 1)
+        hit = (pos < kn) & (keys[pos_c] == k) & v
+        m = m & hit
+        for _ft in lk.payload_ftypes:
+            pl, pv = pargs[off], pargs[off + 1]
+            off += 2
+            # broadcast gather: matched build row per probe row; misses
+            # are dead rows under m, their payload validity is False
+            cols[out_idx] = (pl[pos_c], hit & pv[pos_c])
+            out_idx += 1
     return m
 
 
 def _probe_specs(an: _Analyzed):
-    return (P(), P()) * len(an.probes)
+    specs = [P(), P()] * len(an.probes)
+    for lk in an.lookups:
+        specs += [P(), P()] + [P(), P()] * len(lk.payload_ftypes)
+    return tuple(specs)
 
 
 def _packed_jit(fn):
@@ -292,10 +461,7 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     n_global = S * n_local
 
     def cols_env(datas, valids):
-        return {
-            ci: (datas[j].reshape(n_local), valids[j].reshape(n_local))
-            for j, ci in enumerate(col_order)
-        }
+        return _cols_env(an, col_order, datas, valids, n_local)
 
     def masks(del_mask, start, end):
         shard = jax.lax.axis_index("dp").astype(jnp.int64)
@@ -343,7 +509,7 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
                 ops.masked_segment_count(gidx, m, G), "dp"
             )
             results = []
-            for a in agg_ir.aggs:
+            for ai, a in enumerate(agg_ir.aggs):
                 if a.name == "count":
                     if a.args:
                         d, v = compile_expr(a.args[0], cols, n_local)
@@ -357,6 +523,9 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
                 mv = m & v
                 if a.name in ("sum", "avg"):
                     st = a.partial_types()[0]
+                    # NOTE: int64 accumulation measured FASTER than f64 on
+                    # v5e (192ms vs 244ms Q1@64M in-process A/B) — keep
+                    # the carry-chain emulation, it beats convert+f64 adds
                     dd = _to_state_dtype(d, a.args[0].ftype, st)
                     results.append((
                         jax.lax.psum(ops.masked_segment_sum(dd, gidx, mv, G), "dp"),
@@ -516,10 +685,7 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
             tags.append("argfirst")
 
     def cols_env(datas, valids):
-        return {
-            ci: (datas[j].reshape(n_local), valids[j].reshape(n_local))
-            for j, ci in enumerate(col_order)
-        }
+        return _cols_env(an, col_order, datas, valids, n_local)
 
     def shard_fn(datas, valids, del_mask, start, end, *pargs):
         cols = cols_env(datas, valids)
@@ -557,7 +723,7 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
         pos = jnp.nonzero(boundary, size=OUT, fill_value=n_local - 1)[0]
         out_keys = tuple(k[pos] for k in skeys)
         results = []
-        for a in agg_ir.aggs:
+        for ai, a in enumerate(agg_ir.aggs):
             if a.name == "count":
                 if a.args:
                     d, v = compile_expr(a.args[0], cols, n_local)
@@ -786,8 +952,49 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
         pargs.append(jnp.int64(k))
         kpads.append(kpad)
 
+    for lk in an.lookups:
+        arr = (req.aux or {}).get(f"probe_keys_{lk.filter_id}")
+        payload = (req.aux or {}).get(f"payload_{lk.filter_id}")
+        pvalids = (req.aux or {}).get(f"payload_valid_{lk.filter_id}")
+        if arr is None or payload is None:
+            from ..errors import ExecutorError
+
+            raise ExecutorError(f"missing join lookup aux {lk.filter_id}")
+        if lk.key.ftype.kind == TypeKind.FLOAT:
+            req.mesh_reject_reason = "float lookup key"
+            return None
+        k = len(arr)
+        kpad = 16
+        while kpad < k:
+            kpad <<= 1
+        padded = np.full(kpad, np.iinfo(np.int64).max, dtype=np.int64)
+        padded[:k] = arr
+        pargs.append(jnp.asarray(padded))
+        pargs.append(jnp.int64(k))
+        for j, ft in enumerate(lk.payload_ftypes):
+            pl = np.zeros(kpad, dtype=_full_dtype(ft.kind))
+            pl[:k] = payload[j]
+            pv = np.zeros(kpad, dtype=np.bool_)
+            src_v = pvalids[j] if pvalids is not None else None
+            pv[:k] = True if src_v is None else src_v
+            pargs.append(jnp.asarray(pl))
+            pargs.append(jnp.asarray(pv))
+        kpads.append(kpad)
+
+    # column arrays load BEFORE the program lookup: the compiled program
+    # is specialized on each column's wire dtype and null pattern.
+    # Loads run on the transfer pool so host tile builds overlap link
+    # transfers (the tunnel's device_put is synchronous).
+    datas, valids = [], []
+    for d, v in load_columns(
+            mesh, table, [an.scan.columns[ci] for ci in col_order]):
+        datas.append(d)
+        valids.append(v)
+    wire_sig = [(str(d.dtype), v is None) for d, v in zip(datas, valids)]
+
     fp = (_fingerprint(an, kind)
-          + f"|mesh S={S} Tl={Tl} cols={col_order} kpads={kpads}")
+          + f"|mesh S={S} Tl={Tl} cols={col_order} kpads={kpads} "
+          + f"wire={wire_sig}")
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mesh_fn(an, kind, col_order, mesh, Tl)
@@ -803,13 +1010,6 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
         del_mask = jax.device_put(dm, NamedSharding(mesh, P("dp")))
     else:
         del_mask = _all_true(mesh, n_pad)
-
-    datas, valids = [], []
-    for ci in col_order:
-        store_ci = an.scan.columns[ci]
-        d, v = MESH_CACHE.get_column(mesh, table, store_ci)
-        datas.append(d)
-        valids.append(v)
 
     from ..metrics import REGISTRY
 

@@ -448,7 +448,24 @@ class LoadDataExec(_DMLBase):
             self.ctx.storage.table(t.id).bulk_load_arrays(arrays, valids,
                                                           ts)
         self.ctx.affected_rows += n
+        self._prefetch(t)
         return True
+
+    def _prefetch(self, t):
+        """Warm the device mesh cache in the background right after a bulk
+        load, so the first analytic query finds columns resident (TiFlash
+        eager replica analog; gated by tidb_tpu_prefetch)."""
+        try:
+            if not self.ctx.sess_vars.get_bool("tidb_tpu_prefetch"):
+                return
+        except Exception:
+            pass
+        from ..copr.parallel import prefetch_table
+
+        ids = ([pd.id for pd in t.partition_info.defs]
+               if t.is_partitioned else [t.id])
+        for tid in ids:
+            prefetch_table(self.ctx.storage, tid)
 
     def __init__(self, ctx, table: TableInfo, path: str,
                  fields_terminated: str = ",", ignore_lines: int = 0,
@@ -498,6 +515,7 @@ class LoadDataExec(_DMLBase):
                 valids.append(col.validity())
             self.ctx.storage.table(t.id).bulk_load_arrays(arrays, valids, ts)
         self.ctx.affected_rows += n
+        self._prefetch(t)
         return None
 
 

@@ -242,3 +242,85 @@ class UnionScanExec(Executor):
         c = self._batches[self._pos]
         self._pos += 1
         return c
+
+
+class DeviceJoinReaderExec(Executor):
+    """Broadcast lookup join completed inside the cop task: drain the
+    (small, unique-key) build side, ship its sorted keys + payload columns
+    to the probe reader's device DAG (JoinLookupIR), then stream the
+    reader's joined/aggregated chunks.
+
+    The role of the reference's HashJoinExec build phase + probe worker
+    pool (executor/join.go:232-414), but the probe+join+partial-agg all
+    execute in the device shard program; only aggregated partials return.
+    Build-key uniqueness is guaranteed at plan time
+    (planner/physical.py _build_key_unique)."""
+
+    def __init__(self, ctx: ExecContext, reader: Executor, build: Executor,
+                 build_key_pos: int, payload_pos: List[int],
+                 filter_id: int = 0, plan_id: int = -1):
+        super().__init__(ctx, reader.ftypes, [build, reader], plan_id)
+        self.reader = reader
+        self.build = build
+        self.build_key_pos = build_key_pos
+        self.payload_pos = payload_pos
+        self.filter_id = filter_id
+
+    def open(self):
+        from ..copr.ir import key_bits_int64
+        from ..chunk import concat_chunks
+        from ..errors import ExecutorError
+
+        self.build.open()
+        chunks = []
+        while True:
+            c = self.build.next()
+            if c is None:
+                break
+            if c.num_rows:
+                chunks.append(c)
+        self.build.close()
+        if chunks:
+            built = concat_chunks(chunks)
+            kcol = built.col(self.build_key_pos)
+            valid = kcol.validity()
+            if not valid.all():
+                built = built.filter(valid)  # NULL keys never match (inner)
+                kcol = built.col(self.build_key_pos)
+            bits = key_bits_int64(kcol.data)
+            order = np.argsort(bits, kind="stable")
+            keys = bits[order]
+            if len(keys) > 1 and (keys[1:] == keys[:-1]).any():
+                raise ExecutorError(
+                    "device join: build keys not unique (planner "
+                    "uniqueness inference violated)")
+            payload, pvalid = [], []
+            for pos in self.payload_pos:
+                col = built.col(pos)
+                payload.append(col.data[order])
+                v = col.validity()
+                pvalid.append(None if v.all() else v[order])
+        else:
+            keys = np.zeros(0, dtype=np.int64)
+            payload = [np.zeros(0, dtype=np.int64)
+                       for _ in self.payload_pos]
+            pvalid = [None for _ in self.payload_pos]
+        fid = self.filter_id
+        self.reader.set_runtime_aux({
+            f"probe_keys_{fid}": np.ascontiguousarray(keys, dtype=np.int64),
+            f"payload_{fid}": payload,
+            f"payload_valid_{fid}": pvalid,
+        })
+        self.reader.open()
+        self._opened = True
+
+    def _next(self):
+        return self.reader.next()
+
+    def close(self):
+        try:
+            self.build.close()  # no-op when already closed after the drain
+        except Exception:
+            pass
+        self.reader.close()
+        self._opened = False

@@ -174,11 +174,57 @@ class PhysTableReader(PhysicalPlan):
             elif isinstance(ex, LimitIR):
                 info = f"limit:{ex.limit}"
             else:
-                from ..copr.ir import JoinProbeIR
+                from ..copr.ir import JoinLookupIR, JoinProbeIR
 
                 if isinstance(ex, JoinProbeIR):
                     info = f"runtime filter: {ex.key} in build keys"
+                elif isinstance(ex, JoinLookupIR):
+                    info = (f"inner join on {ex.key}, "
+                            f"{len(ex.payload_ftypes)} payload cols "
+                            "(broadcast build)")
             lines.append((f"{pad2}{nm}", "", "cop[tpu]", info))
+        return lines
+
+
+class PhysDeviceJoinReader(PhysicalPlan):
+    """Broadcast lookup join pushed into the cop task: the build subplan
+    runs root-side first, its sorted unique keys + payload columns ship to
+    every mesh shard, and the probe table's device DAG completes
+    scan -> filter -> JOIN -> partial aggregation on chip
+    (copr/ir.py JoinLookupIR; the reference's executor/join.go HashJoin
+    role, relocated into the coprocessor)."""
+
+    def __init__(self, schema: Schema, reader: PhysTableReader,
+                 build: PhysicalPlan, build_key_pos: int,
+                 payload_pos: List[int], filter_id: int = 0):
+        super().__init__(schema, [build])
+        self.reader = reader
+        self.build_plan = build
+        self.build_key_pos = build_key_pos
+        self.payload_pos = payload_pos
+        self.filter_id = filter_id
+
+    def task(self) -> str:
+        return "root"
+
+    def info(self) -> str:
+        return (f"build key @{self.build_key_pos}, "
+                f"payload cols {self.payload_pos} -> cop join")
+
+    def build(self, ctx):
+        from ..executor.readers import DeviceJoinReaderExec
+
+        return DeviceJoinReaderExec(
+            ctx, self.reader.build(ctx), self.build_plan.build(ctx),
+            self.build_key_pos, self.payload_pos, self.filter_id, self.id)
+
+    def explain_tree(self, indent: int = 0, lines=None):
+        lines = lines if lines is not None else []
+        pad = ("  " * indent + "└─") if indent else ""
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(), "root",
+                      self.info()))
+        self.reader.explain_tree(indent + 1, lines)
+        self.build_plan.explain_tree(indent + 1, lines)
         return lines
 
 
@@ -1149,10 +1195,187 @@ def _physical_agg(plan: LogicalAggregation,
                 ]
                 return PhysHashAgg(reader, fin_gb, plan.aggs, True,
                                    plan.schema)
+    # agg over an eligible inner join: push scan+filter+JOIN+partial agg
+    # into one device program (the Q3/SSB star-aggregate shape)
+    if isinstance(child_l, LogicalJoin) and pctx.enable_pushdown:
+        dj = _try_device_join_agg(plan, child_l, pctx)
+        if dj is not None:
+            return dj
     child = to_physical(child_l, pctx)
     gb = _remap(plan.group_by, child.schema)
     aggs = [a.remap_columns(child.schema.position_map()) for a in plan.aggs]
     return PhysHashAgg(child, gb, aggs, False, plan.schema)
+
+
+# device-join gates: the build side is broadcast to every shard, so it must
+# be decisively the small side; the key must be int-domain and plan-time
+# unique (lookup join semantics: <= 1 match per probe row)
+DEVICE_JOIN_BUILD_MAX = 2_000_000
+_DJ_KEY_KINDS = (TypeKind.INT, TypeKind.UINT, TypeKind.DECIMAL,
+                 TypeKind.DATE)
+_DJ_PAYLOAD_KINDS = _DJ_KEY_KINDS + (TypeKind.FLOAT, TypeKind.BOOL)
+
+
+def _build_key_unique(plan, uid: int) -> bool:
+    """Conservative plan-time uniqueness: does each output row of `plan`
+    carry a distinct value of column `uid`?  (util/ranger + schema key
+    inference role — TiDB's schema.Keys/maxOneRow propagation.)"""
+    from .logical import (LogicalAggregation, LogicalDataSource, LogicalJoin,
+                          LogicalProjection, LogicalSelection)
+
+    if isinstance(plan, LogicalDataSource):
+        sc = next((c for c in plan.schema.cols if c.uid == uid), None)
+        if sc is None:
+            return False
+        t = plan.table
+        if 0 <= t.pk_is_handle < len(t.columns) \
+                and t.columns[t.pk_is_handle].name == sc.name:
+            return True
+        return any((ix.unique or ix.primary) and len(ix.columns) == 1
+                   and ix.columns[0] == sc.name for ix in t.indexes)
+    if isinstance(plan, LogicalSelection):
+        return _build_key_unique(plan.children[0], uid)
+    if isinstance(plan, LogicalProjection):
+        if not any(c.uid == uid for c in plan.schema.cols):
+            return False
+        return _build_key_unique(plan.children[0], uid)
+    if isinstance(plan, LogicalAggregation):
+        # the SOLE group-by key is unique per output row by construction;
+        # with multiple keys the same value of one key can repeat
+        return (len(plan.group_by) == 1
+                and isinstance(plan.group_by[0], ColumnExpr)
+                and plan.group_by[0].unique_id == uid)
+    if isinstance(plan, LogicalJoin):
+        left, right = plan.children
+        in_left = any(c.uid == uid for c in left.schema.cols)
+        side, other = (left, right) if in_left else (right, left)
+        if not _build_key_unique(side, uid):
+            return False
+        if plan.kind in ("semi", "anti_semi") and in_left:
+            return True  # semi joins only filter left rows
+        if plan.kind == "inner" and len(plan.eq_conds) == 1:
+            # each side row matches <= 1 other row iff the other side's
+            # eq key is unique there
+            le, re_ = plan.eq_conds[0]
+            oe = re_ if in_left else le
+            if isinstance(oe, ColumnExpr) and oe.unique_id >= 0:
+                return _build_key_unique(other, oe.unique_id)
+        return False
+    return False
+
+
+def _try_device_join_agg(plan: LogicalAggregation, join: LogicalJoin,
+                         pctx: PhysicalContext):
+    """Agg(InnerJoin(probe datasource, small unique-key build)) ->
+    final agg over a DeviceJoinReader whose cop DAG is
+    scan -> selection -> JoinLookupIR -> partial AggregationIR.
+    Returns None whenever any gate fails (the generic paths take over)."""
+    from ..copr.ir import JoinLookupIR
+
+    if join.kind != "inner" or len(join.eq_conds) != 1 or join.other_conds:
+        return None
+    if not plan.aggs:
+        return None
+    if pctx.prefer_merge_join:
+        return None  # MERGE_JOIN hint/binding pins the root algorithm
+    left, right = join.children
+    le, re_ = join.eq_conds[0]
+    for probe_l, build_l, pk_e, bk_e in (
+            (left, right, le, re_), (right, left, re_, le)):
+        if not isinstance(probe_l, LogicalDataSource):
+            continue
+        if not isinstance(bk_e, ColumnExpr) or bk_e.unique_id < 0:
+            continue
+        if pk_e.ftype.kind not in _DJ_KEY_KINDS:
+            continue
+        # both key sides must share the scaled-int comparison domain
+        if bk_e.ftype.kind != pk_e.ftype.kind:
+            continue
+        if pk_e.ftype.kind == TypeKind.DECIMAL \
+                and bk_e.ftype.scale != pk_e.ftype.scale:
+            continue
+        if not _build_key_unique(build_l, bk_e.unique_id):
+            continue
+        task, residual = _start_cop(probe_l, pctx)
+        if task is None or residual:
+            continue
+        if task.ranges == []:
+            continue  # fully pruned: the Dual path handles it
+        if any(not isinstance(ex, SelectionIR) for ex in task.dag_execs):
+            continue
+        dict_uids = _dict_uids(probe_l, pctx)
+        from ..expr.pushdown import can_push_agg, can_push_expr
+
+        if not can_push_expr(pk_e, pctx.pushdown_blacklist, dict_uids):
+            continue
+        probe_uids = {c.uid for c in probe_l.schema.cols}
+        build_pos = {c.uid: i for i, c in enumerate(build_l.schema.cols)}
+        # split agg expr refs between probe scan cols and build payload
+        refs: set = set()
+        for g in plan.group_by:
+            g.collect_columns(refs)
+        for a in plan.aggs:
+            for x in a.args:
+                x.collect_columns(refs)
+        payload_uids = sorted(u for u in refs if u not in probe_uids)
+        if any(u not in build_pos for u in payload_uids):
+            continue  # references something outside the join
+        payload_cols = [build_l.schema.cols[build_pos[u]]
+                        for u in payload_uids]
+        if any(c.ftype.kind not in _DJ_PAYLOAD_KINDS for c in payload_cols):
+            continue
+        if any(a.name == "first_row" and any(
+                u not in probe_uids
+                for u in _collect(a)) for a in plan.aggs):
+            continue  # first_row partials gather from the table
+        # size gate (after the cheap structural gates)
+        build_phys = to_physical(build_l, pctx)
+        build_est = _est_rows(build_phys, pctx)
+        probe_est = _est_rows(
+            PhysTableReader(Schema(task.scan_cols), task, False,
+                            probe_l.ranges), pctx)
+        if build_est > DEVICE_JOIN_BUILD_MAX \
+                or build_est > 0.5 * max(probe_est, 1):
+            continue
+        # remap: probe uids -> scan positions; build uids -> payload slots
+        scan_w = len(task.scan_cols)
+        mapping = dict(task.scan_pos_map())
+        for j, u in enumerate(payload_uids):
+            mapping[u] = scan_w + j
+        gb = [g.remap_columns(mapping) for g in plan.group_by]
+        aggs = [a.remap_columns(mapping) for a in plan.aggs]
+        if not all(can_push_expr(g, pctx.pushdown_blacklist, dict_uids)
+                   or _is_plain_col(g) for g in gb):
+            continue
+        if not all(can_push_agg(a, pctx.pushdown_blacklist, dict_uids)
+                   for a in aggs):
+            continue
+        pk_pos = pk_e.remap_columns(task.scan_pos_map())
+        task.dag_execs.append(JoinLookupIR(
+            pk_pos, 0, [c.ftype for c in payload_cols]))
+        task.dag_execs.append(AggregationIR(gb, aggs, mode="partial"))
+        # first_row partials are position-sensitive: region chunks must
+        # merge in handle order (same invariant as the direct agg
+        # pushdown path) or "first" depends on task completion order
+        has_first = any(a.name == "first_row" for a in aggs)
+        reader = PhysTableReader(_partial_schema(plan), task,
+                                 keep_order=has_first,
+                                 ranges=probe_l.ranges)
+        djr = PhysDeviceJoinReader(
+            reader.schema, reader, build_phys,
+            build_pos[bk_e.unique_id],
+            [build_pos[u] for u in payload_uids])
+        fin_gb = [ColumnExpr(i, g.ftype, str(g), -1)
+                  for i, g in enumerate(plan.group_by)]
+        return PhysHashAgg(djr, fin_gb, plan.aggs, True, plan.schema)
+    return None
+
+
+def _collect(a) -> set:
+    refs: set = set()
+    for x in a.args:
+        x.collect_columns(refs)
+    return refs
 
 
 def _partial_schema(plan: LogicalAggregation) -> Schema:

@@ -67,6 +67,9 @@ SYSVAR_DEFAULTS = {
     "tidb_opt_distinct_agg_push_down": ("0", "bool"),
     # --- TPU-native knobs ---------------------------------------------
     "tidb_use_tpu": ("1", "bool"),  # per-session engine routing (cpu|tpu)
+    # background device-cache warming after bulk loads (LOAD DATA):
+    # the first analytic query finds columns resident on the mesh
+    "tidb_tpu_prefetch": ("1", "bool"),
     "tidb_tpu_block_rows": (str(1 << 20), "int"),
     "tidb_allow_batch_cop": ("1", "bool"),
     "tidb_enable_pushdown": ("1", "bool"),

@@ -8,10 +8,15 @@ from __future__ import annotations
 
 import numpy as np
 
+# authentic TPC-H column types (the reference's lineitem DDL uses
+# DECIMAL(15,2) for quantity/extendedprice/discount/tax): decimals store
+# as scaled int64, so every money column narrows on the wire
+# (store/blockstore.py scaled-int decimal + parallel._wire_dtype)
 LINEITEM_DDL = (
     "create table lineitem ("
     " l_orderkey bigint, l_quantity decimal(15,2),"
-    " l_extendedprice double, l_discount double, l_tax double,"
+    " l_extendedprice decimal(15,2), l_discount decimal(15,2),"
+    " l_tax decimal(15,2),"
     " l_returnflag varchar(1), l_linestatus varchar(1),"
     " l_shipdate date)"
 )
@@ -39,13 +44,16 @@ def build_lineitem(n: int, regions: int = 8, seed: int = 7):
         arrays = [
             rng.integers(1, n // 4 + 2, m, dtype=np.int64),     # orderkey
             rng.integers(100, 5100, m, dtype=np.int64),          # qty (scaled .2)
-            rng.uniform(900.0, 105000.0, m),                     # extendedprice
-            np.round(rng.uniform(0.0, 0.1, m), 2),               # discount
-            np.round(rng.uniform(0.0, 0.08, m), 2),              # tax
+            rng.integers(90_000, 10_500_001, m, dtype=np.int64),  # price (.2)
+            rng.integers(0, 11, m, dtype=np.int64),              # discount (.2)
+            rng.integers(0, 9, m, dtype=np.int64),               # tax (.2)
             flags[rng.integers(0, 3, m)],                        # returnflag
             status[rng.integers(0, 2, m)],                       # linestatus
             (base + rng.integers(0, span, m)).astype(np.int32),  # shipdate
         ]
         store.bulk_load_arrays(arrays, ts=domain.storage.current_ts())
     domain.storage.regions.split_even(t.id, regions, store.base_rows)
+    from .copr.parallel import prefetch_table
+
+    prefetch_table(domain.storage, t.id)
     return s
