@@ -1,0 +1,96 @@
+"""Multi-host worker: one process of a 2-process jax.distributed cluster.
+
+Launched by tests/test_multihost.py (and __graft_entry__.dryrun_multihost)
+with argv = [process_id, num_processes, coordinator_port].  Each process
+contributes 4 virtual CPU devices; the mesh spans all 8 across both
+processes, so the shard_map scan's psum merges ride the cross-process
+collective fabric — the role of the reference's multi-node NCCL/MPI store
+fabric (store/tikv/client_batch.go:38-387), carried by XLA collectives
+over DCN in the real deployment.
+
+Every process runs the SAME deterministic script: identical data build,
+identical query sequence (multi-controller SPMD contract).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["TIDB_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["TIDB_TPU_NUM_PROCESSES"] = str(nproc)
+    os.environ["TIDB_TPU_PROCESS_ID"] = str(pid)
+    os.environ["TIDB_TPU_TILE"] = "1024"
+    os.environ["TIDB_TPU_COMPILE_CACHE"] = "0"  # per-process compiles
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # join the cluster on the MAIN thread before any worker thread races
+    # into backend init (get_mesh -> _maybe_init_multihost)
+    from tidb_tpu.copr.parallel import MESH_CACHE, get_mesh
+
+    mesh = get_mesh()
+    devs = mesh.devices.ravel()
+    assert len(devs) == 4 * nproc, f"mesh spans {len(devs)} devices"
+    assert len(jax.devices()) == 4 * nproc
+
+    from tidb_tpu.tpch_data import build_lineitem
+
+    sess = build_lineitem(16384, regions=4)  # deterministic in every proc
+
+    q1 = ("select l_returnflag, l_linestatus, sum(l_quantity),"
+          " sum(l_extendedprice), sum(l_extendedprice * (1 - l_discount)),"
+          " avg(l_discount), count(*) from lineitem"
+          " where l_shipdate <= '1998-09-02'"
+          " group by l_returnflag, l_linestatus"
+          " order by l_returnflag, l_linestatus")
+    q6 = ("select sum(l_extendedprice * l_discount) from lineitem"
+          " where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'"
+          " and l_discount between 0.05 and 0.07 and l_quantity < 24")
+
+    from tidb_tpu.metrics import REGISTRY
+
+    before = REGISTRY.snapshot().get("mesh_scans_total", 0)
+    results = {}
+    for name, q in (("q1", q1), ("q6", q6)):
+        sess.execute("set tidb_use_tpu = 1")
+        tpu = sess.query(q)
+        sess.execute("set tidb_use_tpu = 0")
+        cpu = sess.query(q)
+        assert len(tpu) == len(cpu) and tpu, (name, tpu, cpu)
+        for ra, rb in zip(tpu, cpu):
+            for x, y in zip(ra, rb):
+                if isinstance(x, float) or isinstance(y, float):
+                    assert abs(x - y) <= 1e-9 * max(1.0, abs(y)), (name, ra, rb)
+                else:
+                    assert x == y, (name, ra, rb)
+        results[name] = tpu
+    assert REGISTRY.snapshot().get("mesh_scans_total", 0) > before, \
+        "queries did not run on the distributed mesh"
+
+    # the cached column arrays must span BOTH processes' devices: this
+    # process only addresses its local shards, and the sharding's device
+    # set covers every process index
+    data, _ = next(iter(MESH_CACHE._cache.values()))
+    all_procs = {d.process_index for d in data.sharding.device_set}
+    local_procs = {s.device.process_index for s in data.addressable_shards}
+    assert all_procs == set(range(nproc)), all_procs
+    assert local_procs == {pid}, (local_procs, pid)
+
+    print(f"MULTIHOST_OK pid={pid} devices={len(devs)} "
+          f"q1_rows={len(results['q1'])} q6={results['q6'][0][0]:.4f}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Multi-host distributed execution proof: TWO OS processes join a
+jax.distributed cluster over localhost, build one 8-device mesh (4 virtual
+CPU devices per process), and run Q1/Q6 through the full SQL stack with
+the scan sharded across BOTH processes' devices.
+
+This is the working proof of SURVEY §5's "distributed communication
+backend" row: the reference scales with a NCCL/MPI + gRPC batch fabric
+(store/tikv/client_batch.go:38-387); here the same role is XLA's
+collective runtime reached through jax.distributed — identical code path
+on real multi-host TPU pods (ICI in-host, DCN across hosts)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_query_parity():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=560)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers timed out; partial: {outs}")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK pid={pid} devices=8" in out, out[-2000:]
+    # both processes computed the same answers (SPMD determinism)
+    tail0 = outs[0].splitlines()[-1].split("q1_rows=")[1]
+    tail1 = outs[1].splitlines()[-1].split("q1_rows=")[1]
+    assert tail0 == tail1

@@ -206,10 +206,12 @@ _SHUTDOWN = False
 def _xfer_pool():
     global _XFER_POOL
     if _XFER_POOL is None:
+        import os
         from concurrent.futures import ThreadPoolExecutor
 
         _XFER_POOL = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="tidb-tpu-xfer")
+            max_workers=int(os.environ.get("TIDB_TPU_XFER_THREADS", "4")),
+            thread_name_prefix="tidb-tpu-xfer")
     return _XFER_POOL
 
 
@@ -233,9 +235,14 @@ except AttributeError:  # pragma: no cover - very old CPython
 
 def load_columns(mesh: Mesh, table, store_cis):
     """Load several columns into the mesh cache concurrently; returns the
-    (data, valid) pairs in order."""
+    (data, valid) pairs in order.
+
+    Multi-process meshes load SEQUENTIALLY: every process must issue
+    device_puts against the shared mesh in the same deterministic order,
+    or the collective fabric sees mismatched ops (observed as gloo
+    'received data size doesn't match expected size' aborts)."""
     cis = list(store_cis)
-    if len(cis) <= 1:
+    if len(cis) <= 1 or jax.process_count() > 1:
         return [MESH_CACHE.get_column(mesh, table, ci) for ci in cis]
     futs = [_xfer_pool().submit(MESH_CACHE.get_column, mesh, table, ci)
             for ci in cis]
@@ -255,10 +262,21 @@ def prefetch_table(storage, table_id: int, min_rows: int = 1 << 20):
         return
     if table.base_rows < min_rows:
         return
+    try:
+        # backend init happens HERE, on the caller thread: first-touch
+        # from a background thread can hang the tunnel client, and the
+        # process_count gate needs an initialized backend anyway
+        mesh = get_mesh()
+        if jax.process_count() > 1:
+            # multi-controller SPMD: background transfers would desync the
+            # per-process device_put order (see load_columns); queries
+            # load deterministically on demand instead
+            return
+    except Exception:
+        return
 
     def run():
         try:
-            mesh = get_mesh()
             version = table.base_version
             for ci in range(len(table.cols)):
                 if _SHUTDOWN or table.base_version != version:

@@ -81,9 +81,75 @@ class TableReaderExec(Executor):
         return engine
 
     def _next(self) -> Optional[Chunk]:
-        return self._result.next_chunk()
+        chunk = self._result.next_chunk()
+        if chunk is None:
+            self._exhausted = True
+        else:
+            self._out_rows += chunk.num_rows
+        return chunk
+
+    _exhausted = False
+    _out_rows = 0
+
+    def _record_feedback(self):
+        """Feed the observed whole-scan selectivity back into the stats
+        (statistics/feedback.go role).  Only for fully-drained plain
+        scan[+selection] DAGs over the whole table — partial drains
+        (LIMIT/kill) and aggregated outputs would poison the signal."""
+        from ..copr.ir import SelectionIR
+
+        if not self._exhausted:
+            return
+        if getattr(self.ctx, "historical", False):
+            return  # tidb_snapshot reads observe the PAST, not the present
+        execs = self.dag.executors
+        conds = []
+        for ex in execs[1:]:
+            if not isinstance(ex, SelectionIR):
+                return  # agg/topn/limit/lookup outputs aren't row counts
+            conds.extend(ex.conditions)
+        if not conds:
+            return
+        stats = getattr(self.ctx, "domain", None)
+        stats = stats.stats if stats is not None else None
+        if stats is None:
+            return
+        tid = self.dag.scan.table_id
+        if any(kr.table_id != tid or kr.start > 0 for kr in self.ranges):
+            return  # partitioned / clipped scan: rows aren't the table's
+        try:
+            store = self.ctx.storage.table(tid)
+        except Exception:
+            return
+        # denominator = rows VISIBLE AT THE SCAN'S SNAPSHOT, not the
+        # current store size: a historical read (tidb_snapshot / old txn)
+        # over a since-mutated table must not learn a wrongly-scaled
+        # selectivity that poisons future plans
+        ts = self.ctx.snapshot_ts()
+        deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+        visible_base = store.base_rows if store.base_ts <= ts else 0
+        total = visible_base - len(deleted) + len(inserted)
+        if total <= 0:
+            return
+        # digest over STORE offsets (same key the planner computes)
+        scan = self.dag.scan
+        pos_to_store = {i: ci for i, ci in enumerate(scan.columns)}
+        from ..copr.ir import deserialize_expr, serialize_expr
+
+        # strip planner uids first (remap keys on uid when present; these
+        # in-memory IR exprs still carry them) so the scan-position ->
+        # store-offset remap actually applies
+        remapped = [
+            deserialize_expr(serialize_expr(c)).remap_columns(pos_to_store)
+            for c in conds
+        ]
+        stats.record_feedback(tid, remapped, self._out_rows / total)
 
     def _close(self):
+        try:
+            self._record_feedback()
+        except Exception:
+            pass  # advisory: never fail a query on stats upkeep
         if self._result is not None:
             if self.plan_id >= 0:
                 r = self._result

@@ -1648,6 +1648,29 @@ def _attach_runtime_filter(kind, left, right, lkeys, rkeys, build_right,
     return None
 
 
+def _key_ndv(child: PhysicalPlan, key, child_rows: float,
+             pctx: PhysicalContext):
+    """ANALYZEd NDV of a plain-column join key, capped by the child's
+    estimated output rows (filters cannot increase distinct count); None
+    when no stats reach the key."""
+    if not isinstance(key, ColumnExpr) or key.unique_id < 0:
+        return None
+    node = child
+    while isinstance(node, (PhysSelection, PhysSort)):
+        node = node.children[0]
+    if not isinstance(node, PhysTableReader) or pctx.stats is None:
+        return None
+    sc = next((c for c in node.cop.scan_cols if c.uid == key.unique_id),
+              None)
+    st = pctx.stats.get(node.cop.table.id)
+    if sc is None or st is None:
+        return None
+    cs = st.columns.get(sc.store_offset)
+    if cs is None or cs.ndv <= 0:
+        return None
+    return max(min(float(cs.ndv), child_rows), 1.0)
+
+
 def _cop_selectivity(p: "PhysTableReader", conds, pctx) -> float:
     """Histogram-backed selectivity for pushed conds; conds' ColumnExprs are
     remapped (by uid) onto STORE column offsets for the stats lookup."""
@@ -1692,7 +1715,19 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
         r = _est_rows(p.children[1], pctx)
         if p.kind in ("semi", "anti_semi", "left_outer_semi"):
             return l
-        return max(l, r)  # FK-join heuristic
+        # equi-join output from key NDVs: |L ⋈ R| = |L|·|R| / max(ndv_l,
+        # ndv_r) (the classic System-R containment assumption, the
+        # reference's statistics join estimation) — fixed-fraction
+        # heuristics only when no ANALYZEd NDV reaches the key
+        if p.left_keys and p.right_keys:
+            nl = _key_ndv(p.children[0], p.left_keys[0], l, pctx)
+            nr = _key_ndv(p.children[1], p.right_keys[0], r, pctx)
+            if nl is not None and nr is not None:
+                est = l * r / max(nl, nr, 1.0)
+                if p.kind == "left_outer":
+                    est = max(est, l)
+                return max(est, 1.0)
+        return max(l, r)  # FK-join heuristic (no usable key stats)
     if isinstance(p, PhysIndexJoin):
         o = _est_rows(p.children[0], pctx)
         if p.kind in ("semi", "anti_semi"):

@@ -374,6 +374,7 @@ class Session:
             read_ts=read_ts,
         )
         ctx.current_read = current_read
+        ctx.historical = snap is not None  # stats feedback skips stale reads
         ctx.killed = self._killed
         ctx.domain = self.domain  # memtable providers read live state
         self.last_exec_ctx = ctx
@@ -469,6 +470,9 @@ class Session:
             sql, self.current_db,
             self.domain.catalog.schema_version,
             tuple(vers),
+            # learned-selectivity generation: feedback that materially
+            # moved an estimate must re-plan cached statements
+            self.domain.stats.feedback.epoch,
             getattr(self.domain, "bindings_version", 0),
             getattr(self, "_bindings_version", 0),
             self.vars.get_bool("tidb_enable_pushdown"),

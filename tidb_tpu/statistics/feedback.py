@@ -1,0 +1,91 @@
+"""Query feedback: learn real selectivities from executed scans.
+
+Reference: statistics/feedback.go:51 (QueryFeedback collected per scan
+range) applied back into stats in statistics/handle/update.go:411-489.
+TPU-native simplification: the coprocessor DAG evaluates whole conjunction
+sets per scan, so feedback is keyed on the (table, normalized-conds)
+digest and learned as an EWMA of observed selectivity.  The planner
+consults learned entries BEFORE histogram math, so estimates converge to
+actuals after a few executions even when histograms are stale or the
+conjunction is correlated (the two classic drift sources)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional, Tuple
+
+
+def conds_digest(conds) -> Optional[str]:
+    """Stable digest of a conjunction (exprs remapped to STORE offsets).
+    None when any conjunct fails to serialize (no learning for it)."""
+    from ..copr.ir import serialize_expr
+
+    try:
+        parts = sorted(
+            json.dumps(serialize_expr(c), sort_keys=True, default=str)
+            for c in conds
+        )
+    except Exception:
+        return None
+    return "&".join(parts)
+
+
+class QueryFeedback:
+    """(table_id, conds digest) -> EWMA of observed selectivity."""
+
+    ALPHA = 0.5  # fast convergence; observations are whole-scan truths
+    MAX_ENTRIES = 4096
+
+    def __init__(self):
+        self._fb: Dict[Tuple[int, str], Tuple[float, int]] = {}
+        self._mu = threading.Lock()
+        # bumped only when a learned value MATERIALLY moves (new entry or
+        # >1.5x shift): cached plans consult this generation, so stable
+        # entries keep the plan cache hot while fresh learning re-plans
+        self.epoch = 0
+
+    def record(self, table_id: int, digest: str, actual_sel: float,
+               baseline_sel: float = None):
+        """Update the learned EWMA.  The plan-cache generation bumps only
+        when learning MATERIALLY disagrees with what the planner would
+        estimate anyway (baseline = histogram math) or with the previous
+        learned value — accurate histograms keep the plan cache hot."""
+        actual_sel = min(max(actual_sel, 0.0), 1.0)
+
+        def far(a, b):
+            lo, hi = sorted((max(a, 1e-9), max(b, 1e-9)))
+            return hi / lo > 1.5
+
+        with self._mu:
+            cur = self._fb.get((table_id, digest))
+            if cur is None:
+                if len(self._fb) >= self.MAX_ENTRIES:
+                    # bounded memory: drop the least-observed entry
+                    victim = min(self._fb, key=lambda k: self._fb[k][1])
+                    del self._fb[victim]
+                self._fb[(table_id, digest)] = (actual_sel, 1)
+                if baseline_sel is None or far(actual_sel, baseline_sel):
+                    self.epoch += 1
+            else:
+                sel, n = cur
+                new = sel * (1 - self.ALPHA) + actual_sel * self.ALPHA
+                self._fb[(table_id, digest)] = (new, n + 1)
+                if far(sel, new):
+                    self.epoch += 1
+
+    def lookup(self, table_id: int, digest: str) -> Optional[float]:
+        with self._mu:
+            cur = self._fb.get((table_id, digest))
+        return cur[0] if cur is not None else None
+
+    def invalidate_table(self, table_id: int):
+        """ANALYZE rebuilt the histograms: fresh stats supersede learned
+        corrections (update.go resets feedback the same way)."""
+        with self._mu:
+            for k in [k for k in self._fb if k[0] == table_id]:
+                del self._fb[k]
+
+    def snapshot(self):
+        with self._mu:
+            return dict(self._fb)

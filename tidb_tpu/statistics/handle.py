@@ -46,16 +46,22 @@ class TableStats:
 
 class StatsHandle:
     def __init__(self, storage):
+        from .feedback import QueryFeedback
+
         self.storage = storage
         self._cache: Dict[int, TableStats] = {}
         self._mu = threading.RLock()
         self.auto_analyze_ratio = 0.5
+        # learned whole-conjunction selectivities (statistics/feedback.go
+        # role): consulted before histogram math in estimate_selectivity
+        self.feedback = QueryFeedback()
 
     # ------------------------------------------------------------------
     epoch = 0  # bumped per analyze: plan-cache invalidation
 
     def analyze_table(self, table_id: int, n_buckets: int = 64) -> TableStats:
         self.epoch += 1
+        self.feedback.invalidate_table(table_id)
         return self._analyze_table(table_id, n_buckets)
 
     def analyze(self, table_info, n_buckets: int = 64) -> TableStats:
@@ -70,9 +76,12 @@ class StatsHandle:
         ]
         if table_info.partition_info is None:
             self.epoch += 1
+            self.feedback.invalidate_table(table_info.id)
             return self._analyze_table(table_info.id, n_buckets,
                                        index_offsets)
         self.epoch += 1
+        for pid in table_info.physical_ids():
+            self.feedback.invalidate_table(pid)
         total, version = 0, 0
         for pd in table_info.partition_info.defs:
             st = self._analyze_table(pd.id, n_buckets, index_offsets)
@@ -218,7 +227,20 @@ class StatsHandle:
     # selectivity (statistics/selectivity.go, simplified to per-conjunct
     # independence like the reference's fallback path)
     # ------------------------------------------------------------------
-    def estimate_selectivity(self, table_id: int, conds) -> float:
+    def record_feedback(self, table_id: int, conds, actual_sel: float):
+        """Executor-side entry: learn the observed selectivity of a fully
+        drained scan's conjunction (statistics/feedback.go role)."""
+        from .feedback import conds_digest
+
+        dg = conds_digest(conds)
+        if dg is None:
+            return
+        baseline = self.estimate_selectivity(table_id, conds,
+                                             use_feedback=False)
+        self.feedback.record(table_id, dg, actual_sel, baseline)
+
+    def estimate_selectivity(self, table_id: int, conds,
+                             use_feedback: bool = True) -> float:
         """Per-conjunct selectivity with two sharpenings over naive
         independence (statistics/selectivity.go):
 
@@ -232,6 +254,15 @@ class StatsHandle:
         st = self.get(table_id)
         if st is None or st.row_count == 0:
             return 0.25 ** min(len(conds), 2) if conds else 1.0
+        if conds and use_feedback:
+            # learned truth from prior executions beats histogram math
+            from .feedback import conds_digest
+
+            dg = conds_digest(conds)
+            if dg is not None:
+                learned = self.feedback.lookup(table_id, dg)
+                if learned is not None:
+                    return max(min(learned, 1.0), 1e-6)
         try:
             store = self.storage.table(table_id)
         except Exception:
