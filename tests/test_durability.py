@@ -121,15 +121,29 @@ def test_injected_storage_with_data_dir_rejected(tmp_path):
         Domain(storage=BlockStorage(), data_dir=str(tmp_path))
 
 
-def test_drop_table_removes_files(data_dir):
+def test_drop_table_keeps_files_until_gc(data_dir):
+    """DROP TABLE detaches into the recycle bin (RECOVER TABLE flashback
+    source); the GC worker destroys the files after gc_life — the
+    reference's delete-range task timing."""
     import os
+    import time
 
     s = _fresh(data_dir)
+    d = s.domain
+    d.maintenance.stop()
     s.execute("create table t (a bigint)")
     s.execute("insert into t values (1)")
     tdir = os.path.join(data_dir, "tables")
     assert os.listdir(tdir)
     s.execute("drop table t")
+    # data survives the drop (flashback window)...
+    s.execute("recover table t")
+    assert s.query("select * from t") == [(1,)]
+    s.execute("drop table t")
+    # ...until GC passes the retention window
+    d.global_vars["tidb_gc_life_time"] = "0"
+    time.sleep(0.01)
+    d.maintenance.tick()
     assert not any(f.endswith((".npz", ".log")) for f in os.listdir(tdir))
 
     s2 = _fresh(data_dir)

@@ -156,6 +156,9 @@ class Catalog:
         # DDL — the supported seam for persistence (ddl callbacks analog,
         # domain/domain.go:584-589)
         self.on_ddl = None
+        # dropped tables awaiting GC: RECOVER TABLE flashback source
+        # (ddl_api.go:1457; purged by the maintenance GC past gc_life)
+        self.recycle_bin: List[dict] = []
 
     def _notify_drop(self, table_id: int):
         if self.on_table_dropped is not None:
@@ -307,12 +310,68 @@ class Catalog:
                 raise UnknownTableError(f"{db}.{name}")
             del d.tables[name.lower()]
             if not t.is_view:
+                # detach into the recycle bin instead of destroying: data
+                # survives until the GC horizon so RECOVER TABLE can
+                # flashback (ddl_api.go:1457; TiKV keeps dropped ranges
+                # until the delete-range GC task passes the drop TSO)
+                stores = {}
                 for pid in t.physical_ids():
-                    self.storage.drop_table(pid)
+                    st = self.storage.detach_table(pid)
+                    if st is not None:
+                        stores[pid] = st
                     self._notify_drop(pid)
+                self.recycle_bin.append(
+                    {"t": t, "db": db.lower(), "stores": stores,
+                     "drop_wall": time.time()})
             self._bump()
             self._touch_info(t)
             self._record(DDLJob(self.gen_id(), "drop_table", db, name))
+
+    def recover_table(self, db: str, name: str) -> TableInfo:
+        """RECOVER TABLE: restore the newest recycle-bin entry for
+        `db.name` (flashback before the GC horizon purges it)."""
+        with self._mu:
+            d = self._dbs.get(db.lower())
+            if d is None:
+                raise UnknownDatabaseError(db)
+            if name.lower() in d.tables:
+                raise TableExistsError(
+                    f"{db}.{name} exists; rename or drop it first")
+            for i in range(len(self.recycle_bin) - 1, -1, -1):
+                e = self.recycle_bin[i]
+                if e["db"] == db.lower() and e["t"].name.lower() == \
+                        name.lower():
+                    del self.recycle_bin[i]
+                    t = e["t"]
+                    for pid, st in e["stores"].items():
+                        self.storage.attach_table(pid, st)
+                        self._touch(pid)
+                    d.tables[name.lower()] = t
+                    self._bump()
+                    self._touch_info(t)
+                    self._persist()
+                    self._record(DDLJob(self.gen_id(), "recover_table",
+                                        db, name))
+                    return t
+            raise KVError(
+                f"no recoverable table {db}.{name} (GC may have purged it)")
+
+    def purge_recycle_bin(self, older_than_s: float):
+        """GC: destroy recycle-bin entries past the retention window
+        (the delete-range task the reference's gc_worker drives)."""
+        cutoff = time.time() - older_than_s
+        with self._mu:
+            keep = []
+            for e in self.recycle_bin:
+                if e["drop_wall"] <= cutoff:
+                    for st in e["stores"].values():
+                        if st.persister is not None:
+                            st.persister.remove()
+                else:
+                    keep.append(e)
+            purged = len(self.recycle_bin) - len(keep)
+            self.recycle_bin = keep
+            return purged
 
     def truncate_table(self, db: str, name: str):
         """Drop + recreate with a fresh table id (ddl_api.go TruncateTable)."""
@@ -790,11 +849,207 @@ class Catalog:
             overrides.get("columns", t.columns),
             overrides.get("indexes", t.indexes),
             t.pk_is_handle, t.auto_inc_id, t.comment, t.is_view, t.view_select,
-            t.partition_info,
+            overrides.get("partition_info", t.partition_info),
         )
         d.tables[table.lower()] = new
         self._bump()
         self._touch_info(new)
+
+    # ------------------------------------------------------------------
+    # partition management DDL (ddl_api.go:2187-2316 Add/Drop/Truncate/
+    # CoalescePartition).  RANGE add/drop/truncate are metadata + store
+    # create/drop (no data movement); HASH add/coalesce re-buckets every
+    # row (MySQL rebuilds the same way).
+    # ------------------------------------------------------------------
+    def add_partition(self, db: str, table: str, defs=None,
+                      add_buckets: int = 0):
+        from .schema import PartitionDef, PartitionInfo
+
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            pi = t.partition_info
+            if pi is None:
+                raise KVError(f"table {table} is not partitioned")
+            if pi.kind == "hash":
+                if add_buckets <= 0:
+                    raise KVError(
+                        "ADD PARTITION on a HASH table takes PARTITIONS n")
+                self._rehash_partitions(db, t, len(pi.defs) + add_buckets)
+                return
+            if not defs:
+                raise KVError("ADD PARTITION requires partition definitions")
+            cur = list(pi.defs)
+            if cur and cur[-1].less_than is None:
+                raise KVError(
+                    "cannot ADD PARTITION after the MAXVALUE partition")
+            # validate EVERY def before creating any store (no orphan
+            # stores on a failed statement); MAXVALUE may only close the
+            # list — a def after it would hide rows from ordered pruning
+            names = {p.name.lower() for p in cur}
+            last = cur[-1].less_than if cur else None
+            maxvalue_seen = False
+            for name, less_than in defs:
+                if maxvalue_seen:
+                    raise KVError(
+                        "no partition may follow the MAXVALUE partition")
+                if name.lower() in names:
+                    raise KVError(f"duplicate partition name {name!r}")
+                if less_than is None:
+                    maxvalue_seen = True
+                elif last is not None and less_than <= last:
+                    raise KVError(
+                        f"partition {name!r} bound {less_than} must exceed "
+                        f"the previous bound {last}")
+                names.add(name.lower())
+                last = less_than if less_than is not None else last
+            for name, less_than in defs:
+                pd = PartitionDef(self.gen_id(), name, less_than)
+                self.storage.create_table(pd.id, t.storage_columns())
+                self._touch(pd.id)
+                cur.append(pd)
+            new_pi = PartitionInfo(pi.kind, pi.column, cur)
+            self._replace_table(db, table, t, partition_info=new_pi)
+            self._persist()
+            self._record(DDLJob(self.gen_id(), "add_partition", db, table))
+
+    def drop_partition(self, db: str, table: str, names):
+        from .schema import PartitionInfo
+
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            pi = t.partition_info
+            if pi is None:
+                raise KVError(f"table {table} is not partitioned")
+            if pi.kind != "range":
+                raise KVError("DROP PARTITION applies to RANGE tables"
+                               " (use COALESCE PARTITION for HASH)")
+            want = {n.lower() for n in names}
+            have = {p.name.lower() for p in pi.defs}
+            missing = want - have
+            if missing:
+                raise KVError(f"no partition named {sorted(missing)}")
+            keep = [p for p in pi.defs if p.name.lower() not in want]
+            if not keep:
+                raise KVError("cannot drop every partition "
+                               "(use DROP TABLE instead)")
+            dropped = [p for p in pi.defs if p.name.lower() in want]
+            for pd in dropped:
+                self.storage.drop_table(pd.id)
+                self._notify_drop(pd.id)
+            new_pi = PartitionInfo(pi.kind, pi.column, keep)
+            self._replace_table(db, table, t, partition_info=new_pi)
+            self._persist()
+            self._record(DDLJob(self.gen_id(), "drop_partition", db, table))
+
+    def truncate_partition(self, db: str, table: str, names):
+        from .schema import PartitionDef, PartitionInfo
+
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            pi = t.partition_info
+            if pi is None:
+                raise KVError(f"table {table} is not partitioned")
+            want = {n.lower() for n in names}
+            have = {p.name.lower() for p in pi.defs}
+            missing = want - have
+            if missing:
+                raise KVError(f"no partition named {sorted(missing)}")
+            out = []
+            for pd in pi.defs:
+                if pd.name.lower() in want:
+                    # fresh physical id, fresh store (TruncateTable rule:
+                    # readers holding the old snapshot keep the old id)
+                    self.storage.drop_table(pd.id)
+                    self._notify_drop(pd.id)
+                    new_pd = PartitionDef(self.gen_id(), pd.name,
+                                          pd.less_than)
+                    self.storage.create_table(new_pd.id, t.storage_columns())
+                    self._touch(new_pd.id)
+                    out.append(new_pd)
+                else:
+                    out.append(pd)
+            new_pi = PartitionInfo(pi.kind, pi.column, out)
+            self._replace_table(db, table, t, partition_info=new_pi)
+            self._persist()
+            self._record(DDLJob(self.gen_id(), "truncate_partition", db,
+                                table))
+
+    def coalesce_partition(self, db: str, table: str, n: int):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            pi = t.partition_info
+            if pi is None:
+                raise KVError(f"table {table} is not partitioned")
+            if pi.kind != "hash":
+                raise KVError("COALESCE PARTITION applies to HASH tables")
+            if n <= 0 or n >= len(pi.defs):
+                raise KVError(
+                    f"cannot coalesce {n} of {len(pi.defs)} partitions")
+            self._rehash_partitions(db, t, len(pi.defs) - n)
+
+    def _rehash_partitions(self, db: str, t: TableInfo, new_num: int):
+        """Re-bucket a HASH table to `new_num` partitions: fold committed
+        deltas, read every row, route by abs(key) %% new_num into fresh
+        stores (MySQL's hash reorganization copies rows the same way).
+
+        Concurrency: the old stores are DETACHED before any row is read,
+        so a commit racing the rebuild fails with 'no storage for table'
+        (the DDL-aborts-concurrent-writer rule) instead of silently
+        landing in a store that is about to be destroyed.  A store with
+        live prewrite locks aborts the DDL and everything reattaches."""
+        from .schema import PartitionDef, PartitionInfo
+
+        pi = t.partition_info
+        ts = self.storage.current_ts()
+        off = t.find_column(pi.column).offset
+        n_cols = len(t.storage_columns())
+        old = {pd.id: self.storage.detach_table(pd.id) for pd in pi.defs}
+        parts_data = []
+        try:
+            for pd in pi.defs:
+                store = old[pd.id]
+                store.compact(ts)  # raises on live locks: DDL loses
+                parts_data.append(store.base_chunk(
+                    range(n_cols), 0, store.base_rows,
+                    decode_strings=True))
+        except Exception:
+            for pid, st in old.items():
+                if st is not None:
+                    self.storage.attach_table(pid, st)
+            raise
+        new_defs = [PartitionDef(self.gen_id(), f"p{i}", None)
+                    for i in range(new_num)]
+        for pd in pi.defs:
+            st = old.get(pd.id)
+            if st is not None and st.persister is not None:
+                st.persister.remove()
+            self._notify_drop(pd.id)
+        stores = {}
+        for pd in new_defs:
+            stores[pd.id] = self.storage.create_table(
+                pd.id, t.storage_columns())
+            self._touch(pd.id)
+        for chunk in parts_data:
+            n = chunk.num_rows
+            if not n:
+                continue
+            key = chunk.col(off)
+            ridx = np.abs(key.data.astype(np.int64)) % new_num
+            ridx = np.where(key.validity(), ridx, 0)
+            for b, pd in enumerate(new_defs):
+                m = ridx == b
+                if not m.any():
+                    continue
+                arrays, valids = [], []
+                for ci in range(n_cols):
+                    col = chunk.col(ci)
+                    arrays.append(col.data[m])
+                    valids.append(col.validity()[m])
+                stores[pd.id].bulk_load_arrays(arrays, valids, ts)
+        new_pi = PartitionInfo(pi.kind, pi.column, new_defs)
+        self._replace_table(db, t.name, t, partition_info=new_pi)
+        self._persist()
+        self._record(DDLJob(self.gen_id(), "rehash_partition", db, t.name))
 
     def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
                          add_default=None, drop: str = None, retype=None):

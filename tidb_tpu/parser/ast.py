@@ -293,10 +293,15 @@ class DropIndexStmt(Stmt):
 @dataclass
 class AlterTableStmt(Stmt):
     table: TableName
-    action: str  # add_column, drop_column, add_index, drop_index, rename, modify_column
+    action: str  # add_column, drop_column, add_index, drop_index, rename,
+    # modify_column, add_partition, drop_partition, truncate_partition,
+    # coalesce_partition
     column: Optional[ColumnDef] = None
     index: Optional[IndexDef] = None
     name: str = ""  # drop target / rename target
+    part_defs: List["PartitionDefAst"] = field(default_factory=list)
+    names: List[str] = field(default_factory=list)  # partition names
+    number: int = 0  # COALESCE PARTITION n / ADD PARTITION PARTITIONS n
 
 
 @dataclass
@@ -439,8 +444,14 @@ class KillStmt(Stmt):
 
 @dataclass
 class AdminStmt(Stmt):
-    kind: str  # check_table, show_ddl, show_ddl_jobs, ...
+    kind: str  # check_table, show_ddl, show_ddl_jobs, recover_index, ...
     tables: List[TableName] = field(default_factory=list)
+    index: str = ""  # RECOVER/CLEANUP INDEX target
+
+
+@dataclass
+class RecoverTableStmt(Stmt):
+    table: TableName = None
 
 
 @dataclass

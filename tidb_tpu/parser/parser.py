@@ -767,6 +767,13 @@ class Parser:
         col = self.ident("column")
         self.expect_op(")")
         self.expect_op("(")
+        defs = self._parse_partition_defs()
+        self.expect_op(")")
+        return ast.PartitionByAst("range", col, defs)
+
+    def _parse_partition_defs(self) -> List["ast.PartitionDefAst"]:
+        """PARTITION p VALUES LESS THAN (n)|MAXVALUE [, ...] — shared by
+        CREATE TABLE ... PARTITION BY RANGE and ALTER ... ADD PARTITION."""
         defs: List[ast.PartitionDefAst] = []
         while True:
             self.expect_kw("partition")
@@ -784,8 +791,7 @@ class Parser:
                 defs.append(ast.PartitionDefAst(name, -v if neg else v))
             if not self.accept_op(","):
                 break
-        self.expect_op(")")
-        return ast.PartitionByAst("range", col, defs)
+        return defs
 
     def _skip_balanced_until_comma(self):
         depth = 0
@@ -955,6 +961,19 @@ class Parser:
         self.expect_kw("table")
         table = self._parse_table_name()
         if self.accept_kw("add"):
+            if self.accept_kw("partition"):
+                # ALTER TABLE t ADD PARTITION PARTITIONS n        (HASH)
+                # ALTER TABLE t ADD PARTITION (PARTITION p VALUES
+                #   LESS THAN (v)|MAXVALUE, ...)                  (RANGE)
+                if self.accept_kw("partitions"):
+                    n = int(self.next().value)
+                    return ast.AlterTableStmt(table, "add_partition",
+                                              number=n)
+                self.expect_op("(")
+                defs = self._parse_partition_defs()
+                self.expect_op(")")
+                return ast.AlterTableStmt(table, "add_partition",
+                                          part_defs=defs)
             if self.accept_kw("index", "key"):
                 idx_name = ""
                 if not self.at_op("("):
@@ -986,10 +1005,27 @@ class Parser:
             return ast.AlterTableStmt(table, "add_column",
                                       column=self._parse_column_def())
         if self.accept_kw("drop"):
+            if self.accept_kw("partition"):
+                names = [self.ident("partition")]
+                while self.accept_op(","):
+                    names.append(self.ident("partition"))
+                return ast.AlterTableStmt(table, "drop_partition",
+                                          names=names)
             if self.accept_kw("index", "key"):
                 return ast.AlterTableStmt(table, "drop_index", name=self.ident())
             self.accept_kw("column")
             return ast.AlterTableStmt(table, "drop_column", name=self.ident())
+        if self.accept_kw("truncate"):
+            self.expect_kw("partition")
+            names = [self.ident("partition")]
+            while self.accept_op(","):
+                names.append(self.ident("partition"))
+            return ast.AlterTableStmt(table, "truncate_partition",
+                                      names=names)
+        if self.accept_kw("coalesce"):
+            self.expect_kw("partition")
+            n = int(self.next().value)
+            return ast.AlterTableStmt(table, "coalesce_partition", number=n)
         if self.accept_kw("modify"):
             self.accept_kw("column")
             return ast.AlterTableStmt(table, "modify_column",
@@ -1338,10 +1374,22 @@ class Parser:
         if self.accept_kw("recover"):
             self.expect_kw("index")
             tables = [self._parse_table_name()]
-            self.ident("index name")
-            return ast.AdminStmt("recover_index", tables)
+            name = self.ident("index name")
+            return ast.AdminStmt("recover_index", tables, index=name)
+        if self.accept_kw("cleanup"):
+            self.expect_kw("index")
+            tables = [self._parse_table_name()]
+            name = self.ident("index name")
+            return ast.AdminStmt("cleanup_index", tables, index=name)
         t = self.peek()
         raise ParseError(f"unsupported ADMIN {t.value!r}", t.line, t.col)
+
+    def _parse_recover(self) -> "ast.RecoverTableStmt":
+        """RECOVER TABLE t — flashback the most recently dropped `t` from
+        the catalog's recycle bin (ddl_api.go:1457 RecoverTable role)."""
+        self.expect_kw("recover")
+        self.expect_kw("table")
+        return ast.RecoverTableStmt(self._parse_table_name())
 
     def _parse_split(self) -> ast.SplitRegionStmt:
         self.expect_kw("split")

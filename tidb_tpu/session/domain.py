@@ -100,6 +100,31 @@ class Domain:
             # finish DDL jobs a dead process left mid-ladder (owner resume,
             # ddl_worker.go:362): backfills continue from their checkpoint
             self.catalog.resume_pending_jobs()
+        self._purge_orphan_files(data_dir)
+
+    def _purge_orphan_files(self, data_dir: str):
+        """Remove table files no catalog entry references: the recycle
+        bin (RECOVER TABLE flashback) is process-lifetime, so a restart
+        within the GC window would otherwise leak dropped tables' files
+        on disk forever."""
+        import os
+        import re
+
+        tdir = os.path.join(data_dir, "tables")
+        if not os.path.isdir(tdir):
+            return
+        live: set = set()
+        isc = self.catalog.info_schema()
+        for db in isc.schema_names():
+            for t in isc.tables(db):
+                live.update(t.physical_ids())
+        for fn in os.listdir(tdir):
+            m = re.match(r"t(\d+)\.(base\.npz|delta\.log)$", fn)
+            if m and int(m.group(1)) not in live:
+                try:
+                    os.remove(os.path.join(tdir, fn))
+                except OSError:
+                    pass
 
     def _bootstrap(self):
         """Create system schemas (session/bootstrap.go analog)."""

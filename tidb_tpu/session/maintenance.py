@@ -78,6 +78,12 @@ class MaintenanceWorker:
         storage = self.domain.storage
         now_ms = int(time.time() * 1000)
         safepoint = compose_ts(now_ms - int(self._gc_life_s() * 1000), 0)
+        # recycle-bin purge runs on EVERY tick, independent of the MVCC
+        # safepoint: a pinned snapshot must not let dropped-table stores
+        # accumulate in RAM/disk forever
+        purged = self.domain.catalog.purge_recycle_bin(self._gc_life_s())
+        if purged:
+            REGISTRY.inc("gc_recycle_bin_purged_total", purged)
         floor = storage.live_txn_floor()
         if floor is not None:
             safepoint = min(safepoint, floor - 1)

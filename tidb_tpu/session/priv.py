@@ -347,6 +347,9 @@ def check_stmt(session, s) -> None:
     if isinstance(s, (ast.CreateIndexStmt, ast.DropIndexStmt)):
         pm.require(user, "index", db_of(s.table))
         return
+    if isinstance(s, ast.RecoverTableStmt):
+        pm.require(user, "create", db_of(s.table))
+        return
     if isinstance(s, ast.CreateDatabaseStmt):
         pm.require(user, "create", s.name.lower())
         return

@@ -671,7 +671,8 @@ class Session:
               ast.TruncateTableStmt, ast.AlterTableStmt,
               ast.RenameTableStmt, ast.CreateIndexStmt, ast.DropIndexStmt,
               ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
-              ast.CreateViewStmt, ast.AnalyzeTableStmt)
+              ast.CreateViewStmt, ast.AnalyzeTableStmt,
+              ast.RecoverTableStmt)
         target = s.target if isinstance(s, (ast.ExplainStmt,
                                             ast.TraceStmt)) else s
         analyze = getattr(s, "analyze", True)  # plain EXPLAIN is read-only
@@ -1011,7 +1012,41 @@ class Session:
                 )
                 self._admin_check_table(t)
             return ResultSet()
+        if s.kind in ("recover_index", "cleanup_index"):
+            tn = s.tables[0]
+            t = self.domain.catalog.info_schema().table(
+                tn.db or self.current_db, tn.name)
+            return self._admin_repair_index(t, s.index, s.kind)
         raise PlanError(f"ADMIN {s.kind} not supported")
+
+    def _admin_repair_index(self, t: TableInfo, index_name: str,
+                            kind: str) -> ResultSet:
+        """ADMIN RECOVER INDEX / CLEANUP INDEX (util/admin.go:281-312):
+        indexes here are DERIVED sorted artifacts, so both repairs
+        re-derive the artifact from the base rows — RECOVER reports how
+        many entries the rebuilt index carries (ADDED_COUNT/SCAN_COUNT),
+        CLEANUP how many bogus entries the rebuild discarded."""
+        ix = next((x for x in t.indexes
+                   if x.name.lower() == index_name.lower()), None)
+        if ix is None:
+            raise PlanError(f"index {index_name!r} does not exist on "
+                            f"{t.name}")
+        added = scanned = removed = 0
+        for pid in t.physical_ids():
+            store = self.domain.storage.table(pid)
+            offs = tuple(t.col_offsets(ix.columns))
+            old = store.indexes.peek(offs)
+            old_n = len(old.handles) if old is not None else None
+            store.indexes.invalidate(offs)
+            rebuilt = store.indexes.get(store, offs)  # re-derive from rows
+            added += len(rebuilt.handles)
+            scanned += store.base_rows
+            if old_n is not None and old_n > len(rebuilt.handles):
+                removed += old_n - len(rebuilt.handles)
+        if kind == "recover_index":
+            return ResultSet(["ADDED_COUNT", "SCAN_COUNT"],
+                             [(added, scanned)], is_query=True)
+        return ResultSet(["REMOVED_COUNT"], [(removed,)], is_query=True)
 
     def _admin_check_table(self, t: TableInfo):
         """ADMIN CHECK TABLE (executor/admin.go CheckTable role), adapted
@@ -1203,6 +1238,9 @@ class Session:
         if isinstance(s, ast.TruncateTableStmt):
             cat.truncate_table(s.table.db or self.current_db, s.table.name)
             return ResultSet()
+        if isinstance(s, ast.RecoverTableStmt):
+            cat.recover_table(s.table.db or self.current_db, s.table.name)
+            return ResultSet()
         if isinstance(s, ast.RenameTableStmt):
             cat.rename_table(s.old.db or self.current_db, s.old.name,
                              s.new.name)
@@ -1250,7 +1288,37 @@ class Session:
         if s.action == "rename":
             cat.rename_table(db, s.table.name, s.name)
             return ResultSet()
+        if s.action in ("add_partition", "drop_partition",
+                        "truncate_partition", "coalesce_partition"):
+            return self._run_partition_ddl(cat, db, s)
         raise PlanError(f"ALTER {s.action} not supported")
+
+    def _run_partition_ddl(self, cat, db: str, s: ast.AlterTableStmt):
+        """ALTER TABLE ... ADD/DROP/TRUNCATE/COALESCE PARTITION with
+        per-partition stats invalidation (ddl_api.go:2187-2316 analog)."""
+        name = s.table.name
+        before = {pd.id for pd in
+                  (cat.info_schema().table(db, name).partition_info.defs
+                   if cat.info_schema().table(db, name).partition_info
+                   else [])}
+        if s.action == "add_partition":
+            cat.add_partition(db, name,
+                              [(d.name, d.less_than) for d in s.part_defs],
+                              add_buckets=s.number)
+        elif s.action == "drop_partition":
+            cat.drop_partition(db, name, s.names)
+        elif s.action == "truncate_partition":
+            cat.truncate_partition(db, name, s.names)
+        else:
+            cat.coalesce_partition(db, name, s.number)
+        # stats: removed/replaced partitions invalidate via the catalog's
+        # drop hook; the logical merged row count is stale either way, so
+        # drop it and let auto-analyze / the next ANALYZE rebuild
+        t = cat.info_schema().table(db, name)
+        after = {pd.id for pd in t.partition_info.defs}
+        if after != before:
+            self.domain.stats.drop(t.id)
+        return ResultSet()
 
     def _column_info(self, cd: ast.ColumnDef) -> ColumnInfo:
         tn = cd.type_name.lower()

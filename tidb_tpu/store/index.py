@@ -98,6 +98,13 @@ class IndexManager:
         with self._mu:
             self._cache[tuple(col_offsets)] = idx
 
+    def invalidate(self, col_offsets) -> bool:
+        """Drop a cached artifact so the next get() rebuilds from base
+        rows — ADMIN RECOVER/CLEANUP INDEX (util/admin.go:281-312 role:
+        re-derive the index from the row data)."""
+        with self._mu:
+            return self._cache.pop(tuple(col_offsets), None) is not None
+
     def _build(self, store, col_offsets: tuple) -> SortedIndex:
         n = store.base_rows
         cols: List[np.ndarray] = []

@@ -77,6 +77,32 @@ class BlockStorage(Storage):
             # the TSO must move past every persisted commit
             self.oracle.advance_to(max_ts + 1)
 
+    def detach_table(self, table_id: int):
+        """Remove a table from the live catalog WITHOUT destroying its
+        data or files — the store object moves to the caller (catalog
+        recycle bin for RECOVER TABLE).  The reference's analog: dropped
+        data stays in TiKV until the GC worker passes the drop TSO."""
+        with self._mu:
+            t = self._tables.pop(table_id, None)
+            if t is not None and t.persister is not None:
+                t.persister._close_delta()
+            self.regions.drop_table(table_id)
+            return t
+
+    def attach_table(self, table_id: int, store: TableStore):
+        """Re-register a detached store (RECOVER TABLE flashback)."""
+        with self._mu:
+            if table_id in self._tables:
+                raise KVError(f"table {table_id} exists in storage")
+            self._tables[table_id] = store
+            store.on_mutate = self._bump_data_version
+            if self.data_dir is not None and store.persister is None:
+                from .persist import TablePersister
+
+                store.persister = TablePersister(self.data_dir, table_id)
+            self.regions.bootstrap_table(table_id)
+            self._bump_data_version()
+
     def drop_table(self, table_id: int, keep_files: bool = False):
         with self._mu:
             t = self._tables.pop(table_id, None)
