@@ -129,3 +129,59 @@ def test_system_schemas_exempt_from_lock_tables(d):
     assert a.query("select * from information_schema.tables")  # exempt
     assert a.query("select user from mysql.user where user = 'root'")
     a.execute("unlock tables")
+
+
+# ---------------------------------------------------------------------------
+# cluster/ops deep introspection + profiling (cluster_reader.go:42,
+# util/profile roles)
+# ---------------------------------------------------------------------------
+
+def test_cluster_introspection_tables(d):
+    s = d.new_session()
+    cfg = s.query("select name, value from information_schema.cluster_config"
+                  " where type = 'tidb-tpu'")
+    assert any(n == "tidb_gc_life_time" for n, _ in cfg)
+    hw = s.query("select * from information_schema.cluster_hardware")
+    assert any(r[2] == "cpu" for r in hw)
+    si = s.query("select name, value from"
+                 " information_schema.cluster_systeminfo")
+    names = {n for n, _ in si}
+    assert "os" in names and "pid" in names
+
+
+def test_engine_state_table_shows_cache(d):
+    s = d.new_session()
+    s.execute("create table eng (a bigint)")
+    s.execute("insert into eng values " + ", ".join(
+        f"({i})" for i in range(3000)))
+    t = d.catalog.info_schema().table("test", "eng")
+    d.storage.maybe_compact(t.id, threshold=0)  # rows -> base blocks
+    s.query("select sum(a) from eng")  # warms the mesh column cache
+    rows = s.query("select component, name, value from"
+                   " information_schema.tidb_tpu_engine")
+    comp = {r[0] for r in rows}
+    assert "mesh" in comp and "column_cache" in comp and "programs" in comp
+    entries = [r for r in rows
+               if r[0] == "column_cache" and r[1] == "entries"]
+    assert entries and int(entries[0][2]) >= 1
+    # the per-entry rows expose the narrow wire dtype used for HBM/scan
+    detail = [r for r in rows if r[0] == "column_cache"
+              and r[1].startswith("store=")]
+    assert detail and "dtype=" in detail[0][2]
+
+
+def test_profiling_table(d):
+    s = d.new_session()
+    s.execute("create table pr (a bigint)")
+    s.execute("insert into pr values (1), (2), (3)")
+    assert s.query("select * from information_schema.tidb_profile") == []
+    s.execute("set tidb_profiling = 1")
+    for _ in range(3):
+        s.query("select sum(a) from pr")
+    prof = s.query("select function, calls, cum_time_ms from"
+                   " information_schema.tidb_profile")
+    assert prof, "profiler collected nothing"
+    assert any("session.py" in r[0] or "execute" in r[0] for r in prof)
+    assert all(r[1] >= 1 for r in prof)
+    s.execute("set tidb_profiling = 0")
+    assert s.query("select * from information_schema.tidb_profile") == []

@@ -2156,3 +2156,8 @@ def _uncompress(func, args, n):
             out[i] = ""
             valid[i] = False
     return Vec(func.ftype, out, valid)
+
+
+# breadth tail: the long-tail builtin surface registers itself into this
+# module's REGISTRY (expression/builtin_string_vec.go etc. roles)
+from . import builtins_ext  # noqa: E402,F401

@@ -365,3 +365,148 @@ def _mysql_stats_meta(domain, isc):
 ])
 def _mysql_global_variables(domain, isc):
     return sorted(domain.global_vars.items())
+
+
+# ---------------------------------------------------------------------------
+# cluster/ops deep introspection (executor/cluster_reader.go:42 role, over
+# the single in-process node) + profiling (util/profile role)
+# ---------------------------------------------------------------------------
+
+
+@_register("cluster_config", [
+    ("type", ty_string()), ("instance", ty_string()),
+    ("name", ty_string()), ("value", ty_string()),
+])
+def _cluster_config(domain, isc):
+    import os
+
+    from .session.vars import SYSVAR_DEFAULTS
+
+    rows = []
+    merged = {k: v[0] for k, v in SYSVAR_DEFAULTS.items()}
+    merged.update(domain.global_vars)
+    for name in sorted(merged):
+        rows.append(("tidb-tpu", "127.0.0.1", name, str(merged[name])))
+    for env in sorted(k for k in os.environ if k.startswith("TIDB_TPU_")):
+        rows.append(("env", "127.0.0.1", env, os.environ[env]))
+    return rows
+
+
+@_register("cluster_hardware", [
+    ("type", ty_string()), ("instance", ty_string()),
+    ("device_type", ty_string()), ("device_name", ty_string()),
+    ("name", ty_string()), ("value", ty_string()),
+])
+def _cluster_hardware(domain, isc):
+    import os
+
+    rows = [("tidb-tpu", "127.0.0.1", "cpu", "host", "logical_cores",
+             str(os.cpu_count() or 1))]
+    try:
+        import jax
+
+        for d in jax.devices():
+            rows.append(("tidb-tpu", "127.0.0.1", d.platform,
+                         getattr(d, "device_kind", "device"),
+                         "id", str(d.id)))
+    except Exception:
+        pass  # device backend not initialized: host info only
+    return rows
+
+
+@_register("cluster_systeminfo", [
+    ("type", ty_string()), ("instance", ty_string()),
+    ("name", ty_string()), ("value", ty_string()),
+])
+def _cluster_systeminfo(domain, isc):
+    import os
+    import platform
+
+    rows = [
+        ("tidb-tpu", "127.0.0.1", "os", platform.platform()),
+        ("tidb-tpu", "127.0.0.1", "python", platform.python_version()),
+        ("tidb-tpu", "127.0.0.1", "pid", str(os.getpid())),
+    ]
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith(("MemTotal", "MemAvailable")):
+                    k, v = line.split(":", 1)
+                    rows.append(("tidb-tpu", "127.0.0.1", k.lower(),
+                                 v.strip()))
+    except OSError:
+        pass
+    return rows
+
+
+@_register("tidb_tpu_engine", [
+    ("component", ty_string()), ("name", ty_string()),
+    ("value", ty_string()),
+])
+def _tidb_tpu_engine(domain, isc):
+    """Live device-engine state: the mesh, the sharded column cache, and
+    the compiled-program registry — the introspection that drives perf
+    debugging (what is resident, at which wire dtype, over which devices)."""
+    rows = []
+    try:
+        from .copr import jax_engine as je
+        from .copr import parallel as pl
+
+        mesh = pl._MESH
+        if mesh is not None:
+            devs = mesh.devices.ravel()
+            rows.append(("mesh", "devices", str(len(devs))))
+            rows.append(("mesh", "platform", devs[0].platform))
+        rows.append(("mesh", "tile_rows", str(je.TILE)))
+        cache = pl.MESH_CACHE._c
+        rows.append(("column_cache", "entries", str(len(cache))))
+        rows.append(("column_cache", "bytes", str(cache._bytes)))
+        rows.append(("column_cache", "capacity_bytes", str(cache.capacity)))
+        for key, val in list(cache.items_view.items())[:64]:
+            data = val[0]
+            rows.append((
+                "column_cache",
+                f"store={key[0]} ver={key[1]} col={key[2]}",
+                f"dtype={data.dtype} shape={list(data.shape)} "
+                f"bytes={data.nbytes} "
+                f"nulls={'none' if val[1] is None else 'bitmap'}",
+            ))
+        rows.append(("programs", "mesh_compiled", str(len(pl._COMPILED))))
+        rows.append(("programs", "tile_compiled",
+                     str(len(je._COMPILED))))
+        tile_cache = je.DEVICE_CACHE._c
+        rows.append(("tile_cache", "entries", str(len(tile_cache))))
+        rows.append(("tile_cache", "bytes", str(tile_cache._bytes)))
+    except Exception as e:  # pragma: no cover - defensive surface
+        rows.append(("engine", "error", repr(e)))
+    return rows
+
+
+@_register("tidb_profile", [
+    ("function", ty_string()), ("calls", ty_int()),
+    ("total_time_ms", ty_float()), ("cum_time_ms", ty_float()),
+])
+def _tidb_profile(domain, isc):
+    """cProfile aggregate since `SET tidb_profiling = 1` (util/profile's
+    flamegraph table role, rendered flat: hottest cumulative first)."""
+    prof = getattr(domain, "profiler", None)
+    if prof is None:
+        return []
+    # cProfile's enable/disable hooks are PER-THREAD: toggling them from
+    # this reader thread would leak a live profiling hook onto the server
+    # pool thread serving this query.  getstats() on a running collector
+    # is safe (it snapshots timer state without touching hooks).
+    try:
+        stats = prof.getstats()
+    except Exception:
+        return []
+    rows = []
+    for entry in stats:
+        code = entry.code
+        name = (code if isinstance(code, str)
+                else f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                     f"{code.co_firstlineno}:{code.co_name}")
+        rows.append((name, int(entry.callcount),
+                     entry.inlinetime * 1000.0, entry.totaltime * 1000.0))
+    rows.sort(key=lambda r: -r[3])
+    return rows[:200]

@@ -300,6 +300,24 @@ class ExprBuilder:
             return self._make_func(
                 "extract", [self._build(e.args[1])], {"unit": unit}
             )
+        if name in ("timestampadd", "timestampdiff"):
+            # first arg is a bare unit keyword (SECOND, DAY, MONTH, ...) —
+            # depending on the word it parses as a column ref or a
+            # zero-arg function call (MONTH, DATE are also functions);
+            # MySQL also accepts the ODBC SQL_TSI_* spellings
+            unit = _bare_word(e.args[0], "day")
+            if unit.startswith("sql_tsi_"):
+                unit = unit[len("sql_tsi_"):]
+            if unit not in ("microsecond", "second", "minute", "hour",
+                            "day", "week", "month", "quarter", "year"):
+                raise PlanError(f"invalid {name.upper()} unit {unit!r}")
+            rest = [self._build(a) for a in e.args[1:]]
+            return self._make_func(name, rest, {"unit": unit})
+        if name == "get_format":
+            # GET_FORMAT(DATE|DATETIME|TIME, 'locale'): the first arg is a
+            # bare keyword, not an expression
+            kindc = Constant(_bare_word(e.args[0], "date"), ty_string(False))
+            return self._make_func(name, [kindc, self._build(e.args[1])])
         args = [self._build(a) for a in e.args]
         return self._make_func(name, args)
 
@@ -390,3 +408,16 @@ def fold_constant(e: Expression) -> Expression:
             # matching Column.constant / the cop IR wire format.
             return Constant(x, e.ftype)
     return e
+
+
+def _bare_word(node, default: str) -> str:
+    """The identifier a bare keyword argument parsed into (column ref or
+    zero-arg function call), lowercased."""
+    import tidb_tpu.parser.ast as _ast
+
+    if isinstance(node, _ast.ColumnRef):
+        return node.name.lower()
+    if isinstance(node, _ast.FuncCall):
+        return node.name.lower()
+    v = getattr(node, "value", None)
+    return str(v).lower() if v is not None else default

@@ -652,6 +652,9 @@ class Session:
             if name.lower() == "tidb_snapshot":
                 self._set_snapshot(value)
                 continue
+            if name.lower() == "tidb_profiling":
+                self._set_profiling(value)
+                continue
             if not is_global and not self.vars.known(name) \
                     and name.lower() not in SYSVAR_DEFAULTS:
                 # unknown non-global names are user variables (@x); the
@@ -727,6 +730,26 @@ class Session:
         self._unpin_snapshot()
         self._snapshot_pin = self.domain.storage.pin_read(ts)
         self.vars.set_session("tidb_snapshot", str(ts))
+
+    def _set_profiling(self, value):
+        """SET tidb_profiling = 1|0: toggle the domain cProfile collector
+        surfaced through information_schema.tidb_profile (util/profile's
+        pprof table role; covers the session thread's planner/executor
+        work — distsql worker threads run outside the collector)."""
+        on = str(value).strip().lower() in ("1", "true", "on")
+        dom = self.domain
+        if on and getattr(dom, "profiler", None) is None:
+            import cProfile
+
+            dom.profiler = cProfile.Profile()
+            dom.profiler.enable()
+        elif not on and getattr(dom, "profiler", None) is not None:
+            dom.profiler.disable()
+            dom.profiler = None
+        # the collector is domain-wide: mirror its ACTUAL state where
+        # operators look (SHOW VARIABLES / cluster_config)
+        dom.global_vars["tidb_profiling"] = "1" if on else "0"
+        self.vars.set_session("tidb_profiling", "1" if on else "0")
 
     def _unpin_snapshot(self):
         if self._snapshot_pin is not None:

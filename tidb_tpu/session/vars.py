@@ -63,6 +63,8 @@ SYSVAR_DEFAULTS = {
     "tidb_retry_limit": ("10", "int"),
     "tidb_disable_txn_auto_retry": ("0", "bool"),
     "tidb_snapshot": ("", "str"),
+    # domain-wide cProfile collector -> information_schema.tidb_profile
+    "tidb_profiling": ("0", "bool"),
     "tidb_opt_agg_push_down": ("1", "bool"),
     "tidb_opt_distinct_agg_push_down": ("0", "bool"),
     # --- TPU-native knobs ---------------------------------------------
