@@ -270,3 +270,45 @@ def test_wire_auth_and_denied_select():
         await srv.stop()
 
     _run(body())
+
+
+def test_user_host_pattern_matching(d, root):
+    """user@host accounts resolve by MySQL specificity: exact host beats
+    pattern beats % (privilege/privileges/cache.go role)."""
+    pm = d.priv
+    root.execute("create user 'app'@'10.0.0.5' identified by 'exact'")
+    root.execute("create user 'app'@'10.0.%' identified by 'subnet'")
+    root.execute("create user 'app'@'%' identified by 'anywhere'")
+    assert pm.match_account("app", "10.0.0.5") == "app@10.0.0.5"
+    assert pm.match_account("app", "10.0.3.7") == "app@10.0.%"
+    assert pm.match_account("app", "192.168.1.1") == "app@%"
+    assert pm.match_account("app", "127.0.0.1") == "app@%"
+    assert pm.match_account("nobody", "10.0.0.5") is None
+    # localhost account matches loopback clients
+    root.execute("create user 'op'@'localhost'")
+    assert pm.match_account("op", "127.0.0.1") == "op@localhost"
+    # per-host grants are distinct identities
+    root.execute("grant select on test.* to 'app'@'10.0.%'")
+    assert pm.check("app@10.0.%", "select", "test", "t")
+    assert not pm.check("app@%", "select", "test", "t")
+
+
+def test_auth_resolves_most_specific_account(d, root):
+    import hashlib
+
+    pm = d.priv
+    root.execute("create user 'svc'@'10.1.%' identified by 'subnetpw'")
+    root.execute("create user 'svc'@'%' identified by 'globalpw'")
+    salt = b"12345678901234567890"
+
+    def token(pw):
+        stage1 = hashlib.sha1(pw.encode()).digest()
+        stage2 = hashlib.sha1(stage1).digest()
+        mix = hashlib.sha1(salt + stage2).digest()
+        return bytes(a ^ b for a, b in zip(stage1, mix))
+
+    # the subnet client must authenticate with the SUBNET account's pw
+    assert pm.auth("svc", token("subnetpw"), salt, host="10.1.2.3") == \
+        "svc@10.1.%"
+    assert pm.auth("svc", token("globalpw"), salt, host="10.1.2.3") is None
+    assert pm.auth("svc", token("globalpw"), salt, host="8.8.8.8") == "svc@%"

@@ -223,3 +223,73 @@ def test_index_ndv_survives_auto_analyze_and_string_deltas(d):
               ", ".join("('x','y')" for _ in range(10)))
     st = d.stats.get(tid)
     assert st.index_ndv and list(st.index_ndv.values()) == [2]
+
+
+def test_baseline_capture_on_second_execution(joined, d):
+    """tidb_capture_plan_baselines: the second sighting of a digest
+    captures a GLOBAL binding pinning the current join plan
+    (bindinfo/handle.go:545) — and a LITERAL VARIANT of the statement
+    still executes ITS OWN literals (bindings carry hints, not text)."""
+    s = joined
+    s.execute("set tidb_capture_plan_baselines = 1")
+    q = ("select count(*) from big join small on big.id = small.id"
+         " where small.x < 10")
+    try:
+        s.query(q)
+        assert s.query("show global bindings") == []
+        assert s.query(q) == [(400,)]  # second sighting -> captured
+        rows = s.query("show global bindings")
+        assert rows and rows[0][2] == "global"
+        assert "/*+" in rows[0][1]
+        # literal variants share the digest; each returns its OWN answer
+        truth3 = s.query("select count(*) from big join small"
+                         " on big.id = small.id where small.x < 3"
+                         " and 1 = 1")  # different digest: no binding
+        got3 = s.query(q.replace("< 10", "< 3"))
+        assert got3 == truth3 and got3 != [(400,)], (got3, truth3)
+        # capture requires SUPER: a plain user's repeats don't publish
+        s.execute("drop global binding for " + q)
+        d.priv.create_user("lowpriv", "", False)
+        lp = d.new_session()
+        lp.user = "lowpriv@%"
+        lp.execute("set tidb_capture_plan_baselines = 1")
+        d.priv.grant("lowpriv", ["select"], "*.*")
+        lp.query(q)
+        lp.query(q)
+        assert s.query("show global bindings") == []
+    finally:
+        s.execute("set tidb_capture_plan_baselines = 0")
+
+
+def test_explicit_binding_rejects_mismatched_statement(joined):
+    """CREATE BINDING validates the hinted text normalizes to the same
+    digest as the original (handle.go CreateBindRecord)."""
+    import pytest as _pytest
+
+    from tidb_tpu.errors import TiDBTPUError
+
+    s = joined
+    with _pytest.raises(TiDBTPUError):
+        s.execute(
+            "create session binding for select count(*) from big using "
+            "select /*+ MERGE_JOIN */ count(*) from small")
+
+
+def test_json_conjunct_split_keeps_device_scan(d):
+    """A JSON conjunct stays root-side while the numeric conjuncts of the
+    same WHERE still run on the device mesh (round-4 weak #7 pinned)."""
+    import numpy as np
+
+    s = d.new_session()
+    s.execute("create table js (a bigint, doc json)")
+    t = d.catalog.info_schema().table("test", "js")
+    docs = np.array(['{"k": %d}' % (i % 5) for i in range(5000)],
+                    dtype=object)
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(5000), docs], ts=d.storage.current_ts())
+    q = ("select count(*) from js"
+         " where a < 2500 and json_extract(doc, '$.k') = 2")
+    rows = s.execute("explain analyze " + q)[0].rows
+    reader = next(r for r in rows if "TableReader" in r[0])
+    assert "engine:mesh" in reader[-1], reader
+    assert s.query(q) == [(500,)]

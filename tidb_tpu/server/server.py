@@ -69,14 +69,20 @@ class MySQLServer:
             hs = P.parse_handshake_response(resp)
             pw.seq = pr.seq
             # mysql_native_password verification against the grant tables
-            # (server/conn.go openSessionAndDoAuth analog)
-            if not self.domain.priv.auth(hs["user"], hs["auth"], salt):
+            # (server/conn.go openSessionAndDoAuth analog); the client's
+            # address picks the most specific user@host account
+            peer = writer.get_extra_info("peername")
+            client_host = peer[0] if peer else "localhost"
+            account = self.domain.priv.auth(hs["user"], hs["auth"], salt,
+                                            host=client_host)
+            if account is None:
                 await pw.send(P.err_packet(
                     1045,
-                    f"Access denied for user '{hs['user']}'",
+                    f"Access denied for user '{hs['user']}'"
+                    f"@'{client_host}'",
                     "28000"))
                 return
-            sess.user = f"{hs['user']}@%"
+            sess.user = account
             if hs["db"]:
                 try:
                     sess.execute(f"use {hs['db']}")

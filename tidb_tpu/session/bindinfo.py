@@ -130,9 +130,17 @@ def handle(session, sql: str):
     tail = sql[m.end():].strip().rstrip(";")
     if verb == "create":
         orig, hinted = _split_for_using(tail)
-        # both sides must parse; the hinted side is what gets planned
+        # both sides must parse, and they must normalize to the SAME
+        # digest (bindinfo/handle.go CreateBindRecord validation): the
+        # binding carries HINTS for the user's statement — it never
+        # substitutes the stored literals for the incoming ones
         parse(orig)
-        parse(re.sub(r"/\*.*?\*/", " ", hinted, flags=re.S))
+        clean = re.sub(r"/\*.*?\*/", " ", hinted, flags=re.S)
+        parse(clean)
+        if sql_digest(orig) != sql_digest(clean):
+            raise PlanError(
+                "CREATE BINDING: the hinted statement must match the "
+                "original (same normalized digest)")
         store = _store(session, is_global)
         store[sql_digest(orig)] = {
             "original": orig,
@@ -150,8 +158,11 @@ def handle(session, sql: str):
 
 
 def apply_binding(session, stmt) -> Tuple[object, Optional[frozenset]]:
-    """Swap a statement for its bound hinted form (handle.go:122 — the
-    match runs on the normalized digest before planning)."""
+    """Attach a matched binding's HINTS to the user's statement
+    (handle.go:122 — the match runs on the normalized digest before
+    planning).  The incoming statement is NEVER swapped for the stored
+    text: literals differ between digest-equal statements, and executing
+    the stored literals would return another query's answer."""
     sql = getattr(stmt, "_sql_text", None)
     if sql is None:
         return stmt, None
@@ -166,12 +177,90 @@ def apply_binding(session, stmt) -> Tuple[object, Optional[frozenset]]:
     from ..metrics import REGISTRY
 
     REGISTRY.inc("binding_hits_total")
-    clean = re.sub(r"/\*.*?\*/", " ", b["hinted"], flags=re.S)
-    bound = parse(clean)[0]
-    bound._sql_text = sql  # cache key stays on the original text
-    # EXPLAIN/TRACE plan the target, not the wrapper
-    target = getattr(stmt, "target", None)
-    if target is not None and not isinstance(bound, type(stmt)):
-        stmt.target = bound
-        return stmt, b["hints"]
-    return bound, b["hints"]
+    return stmt, b["hints"]
+
+
+# ---------------------------------------------------------------------------
+# baseline capture (bindinfo/handle.go:545 CaptureBaselines role)
+# ---------------------------------------------------------------------------
+
+
+def _plan_hints(phys) -> frozenset:
+    """Derive optimizer hints that pin the CURRENT plan's join choices
+    (what the reference encodes as bind SQL hint comments)."""
+    hints = set()
+
+    def walk(p):
+        nm = type(p).__name__
+        if nm == "PhysMergeJoin":
+            hints.add("merge_join")
+        elif nm == "PhysIndexJoin":
+            hints.add("inl_join")
+        elif nm in ("PhysHashJoin", "PhysDeviceJoinReader"):
+            # the device broadcast join IS the hash join relocated into
+            # the cop task; HASH_JOIN re-plans to the same family
+            hints.add("hash_join")
+        for c in getattr(p, "children", []):
+            walk(c)
+        for attr in ("reader", "build_plan"):
+            r = getattr(p, attr, None)
+            if r is not None:
+                walk(r)
+
+    walk(phys)
+    return frozenset(hints)
+
+
+def maybe_capture(session, sql: str, stmt, phys) -> None:
+    """When tidb_capture_plan_baselines is on, a SELECT digest seen for
+    the SECOND time captures a GLOBAL binding that pins its current plan
+    (handle.go:545 — capture runs off stmt-summary frequency >= 2).
+
+    Guards mirror explicit CREATE GLOBAL BINDING (handle()): capture
+    publishes into every session's plans, so only SUPER sessions
+    capture, never under tidb_snapshot, and never from a plan that a
+    SESSION binding shaped (a private experiment must not go global)."""
+    try:
+        if not session.vars.get_bool("tidb_capture_plan_baselines"):
+            return
+    except Exception:
+        return
+    from ..parser import ast
+
+    if not isinstance(stmt, (ast.SelectStmt,)):
+        return
+    if session._snapshot_ts is not None:
+        return
+    if not session.domain.priv.check(session.user, "super"):
+        return
+    digest = sql_digest(sql)
+    if digest in _store(session, False):
+        return  # session-binding-shaped plan: don't promote it globally
+    dom = session.domain
+    seen = getattr(dom, "_capture_seen", None)
+    if seen is None:
+        seen = dom._capture_seen = {}
+    if len(seen) >= 4096 and digest not in seen:
+        seen.clear()  # bounded, like the stmt-summary cap
+    n = seen.get(digest, 0) + 1
+    seen[digest] = n
+    if n != 2:  # capture exactly on the second sighting
+        return
+    store = _store(session, True)
+    if digest in store:
+        return  # explicit binding wins
+    hints = _plan_hints(phys)
+    if not hints:
+        return  # nothing plan-shaping to pin: a binding would be noise
+    hint_txt = "/*+ " + ", ".join(sorted(h.upper() for h in hints)) + " */ "
+    m = re.match(r"\s*select\b", sql, re.I)
+    if m is None:
+        return
+    hinted = sql[:m.end() - 6] + "select " + hint_txt + sql[m.end():]
+    store[digest] = {
+        "original": sql,
+        "hinted": hinted,
+        "hints": hints,
+        "captured": True,
+    }
+    _bump(session, True)

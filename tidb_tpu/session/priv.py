@@ -37,6 +37,30 @@ def _norm_user(u: str) -> str:
     return u if "@" in u else f"{u}@%"
 
 
+def _host_matches(pattern: str, host: str) -> bool:
+    """MySQL host matching: % and _ are LIKE wildcards, case-insensitive;
+    'localhost' and loopback addresses are interchangeable."""
+    import fnmatch
+
+    pattern = pattern.lower()
+    host = (host or "localhost").lower()
+    if host in ("127.0.0.1", "::1"):
+        if pattern == "localhost":
+            return True
+    if pattern == host:
+        return True
+    glob = pattern.replace("*", "[*]").replace("?", "[?]")
+    glob = glob.replace("%", "*").replace("_", "?")
+    return fnmatch.fnmatchcase(host, glob)
+
+
+def _host_specificity(pattern: str) -> tuple:
+    """Sort key: literal hosts first, then fewer wildcards, then longer
+    literal text (privilege/privileges/cache.go sortFromIdx rule)."""
+    wild = pattern.count("%") + pattern.count("_")
+    return (wild, -len(pattern.replace("%", "").replace("_", "")))
+
+
 def _stage2(password: str) -> str:
     """mysql_native_password stored hash: SHA1(SHA1(password)), hex."""
     if not password:
@@ -172,21 +196,45 @@ class PrivManager:
             self._save()
 
     # ---- checks --------------------------------------------------------
-    def auth(self, user: str, token: bytes, salt: bytes) -> bool:
+    def match_account(self, name: str, host: str):
+        """Resolve (login name, client host) to the most specific account
+        key, MySQL-style: exact hosts beat patterns, fewer wildcards beat
+        more (privilege/privileges/cache.go connectionVerification)."""
+        with self._mu:
+            cands = []
+            for key in self.users:
+                uname, _, pat = key.rpartition("@")
+                if uname == name and _host_matches(pat, host):
+                    cands.append((key, pat))
+        if not cands:
+            return None
+        host_l = (host or "localhost").lower()
+        # an exact pattern==host match outranks aliases ('127.0.0.1'
+        # account beats 'localhost' for a 127.0.0.1 client) — determinism
+        # does not depend on dict order
+        cands.sort(key=lambda kp: (kp[1].lower() != host_l,)
+                   + _host_specificity(kp[1]))
+        return cands[0][0]
+
+    def auth(self, user: str, token: bytes, salt: bytes,
+             host: str = "%"):
         """mysql_native_password: token = SHA1(pw) XOR
-        SHA1(salt + SHA1(SHA1(pw))); verify against the stored stage2."""
-        u = self.users.get(_norm_user(user))
+        SHA1(salt + SHA1(SHA1(pw))); verify against the stored stage2 of
+        the MOST SPECIFIC account whose host pattern matches the client.
+        Returns the matched account key ('name@pattern') or None."""
+        key = self.match_account(user, host)
+        u = self.users.get(key) if key is not None else None
         if u is None:
-            return False
+            return None
         stored = u["password"]
         if not stored:
-            return len(token) == 0
+            return key if len(token) == 0 else None
         if len(token) != 20:
-            return False
+            return None
         stage2 = bytes.fromhex(stored)
         mix = hashlib.sha1(salt + stage2).digest()
         stage1 = bytes(a ^ b for a, b in zip(token, mix))
-        return hashlib.sha1(stage1).digest() == stage2
+        return key if hashlib.sha1(stage1).digest() == stage2 else None
 
     def check(self, user: str, priv: str, db: Optional[str] = None,
               table: Optional[str] = None) -> bool:

@@ -487,6 +487,11 @@ class Session:
             self._select_for_update_lock(stmt, params)
         phys = self._plan(stmt, params)
         self.last_plan = phys
+        sql = getattr(stmt, "_sql_text", None)
+        if sql is not None:
+            from . import bindinfo
+
+            bindinfo.maybe_capture(self, sql, stmt, phys)
         ctx = self._exec_ctx(current_read=for_update)
         exe = phys.build(ctx)
         chunks = collect_all(exe)

@@ -65,6 +65,9 @@ SYSVAR_DEFAULTS = {
     "tidb_snapshot": ("", "str"),
     # domain-wide cProfile collector -> information_schema.tidb_profile
     "tidb_profiling": ("0", "bool"),
+    # auto-capture plan baselines for repeated statements
+    # (bindinfo/handle.go:545 CaptureBaselines)
+    "tidb_capture_plan_baselines": ("0", "bool"),
     "tidb_opt_agg_push_down": ("1", "bool"),
     "tidb_opt_distinct_agg_push_down": ("0", "bool"),
     # --- TPU-native knobs ---------------------------------------------
