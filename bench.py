@@ -77,6 +77,15 @@ where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
   and l_discount between 0.05 and 0.07 and l_quantity < 24
 """
 
+Q3 = (
+    "select l_orderkey, o_orderdate, o_shippriority,"
+    " sum(l_extendedprice * (1 - l_discount)) as rev"
+    " from lineitem, orders where l_orderkey = o_orderkey"
+    " and o_orderdate < '1995-03-15' and l_shipdate > '1995-03-15'"
+    " group by l_orderkey, o_orderdate, o_shippriority"
+    " order by rev desc, l_orderkey limit 10"
+)
+
 
 def preflight(state: dict) -> bool:
     """Touch the device, retrying until half the wall budget is gone: a
@@ -231,6 +240,33 @@ def _run_inner(state: dict):
             time.perf_counter() - T0, 1)
         persist_partial(state)
 
+    # Q3-shaped device join: scan+filter+JOIN+partial agg in ONE device
+    # program (JoinLookupIR) vs the CPU oracle's root-side hash join
+    if state.get("q1") and remaining() > 180:
+        from tidb_tpu.tpch_data import build_q3_tables
+
+        n_li = min(state.get("loaded_rows", 4_000_000), 16_000_000)
+        n_ord = max(n_li // 8, 1000)
+        log(f"Q3 join bench: {n_li} lineitem x {n_ord} orders...")
+        sess3 = build_q3_tables(n_li, n_ord)
+        plan = [r[0] for r in sess3.execute("explain " + Q3)[0].rows]
+        in_cop = any("DeviceJoinReader" in op for op in plan)
+        sess3.execute("set tidb_use_tpu = 1")
+        q3_warm, q3_best = time_query(sess3, Q3, ITERS)
+        sess3.execute("set tidb_use_tpu = 0")
+        _, q3_cpu = time_query(sess3, Q3, 1)
+        state["q3"] = {
+            "rows": n_li, "warm_s": round(q3_warm, 4),
+            "steady_s": round(q3_best, 5),
+            "cpu_s": round(q3_cpu, 4),
+            "speedup": round(q3_cpu / q3_best, 2),
+            "join_in_cop_task": in_cop,
+        }
+        log(f"Q3 tpu: steady={q3_best:.4f}s cpu={q3_cpu:.3f}s "
+            f"speedup={q3_cpu / q3_best:.1f}x cop-join={in_cop}")
+        state["phases"]["q3_done"] = round(time.perf_counter() - T0, 1)
+        persist_partial(state)
+
     # CPU oracle baseline on a bounded subsample, scaled linearly
     n = state.get("loaded_rows", 0)
     if n and remaining() > 60:
@@ -297,6 +333,12 @@ def emit(state: dict):
                     if cpu.get("q6_s_scaled") and q6.get("steady_s") else None
                 ),
                 "load_s": state.get("load_s"),
+                "load_rows_per_sec": (
+                    round(state["loaded_rows"] / state["load_s"], 1)
+                    if state.get("load_s") and state.get("loaded_rows")
+                    else None
+                ),
+                "q3": state.get("q3"),
                 "devices": state.get("devices"),
                 "complete": bool(state.get("done")),
                 "worker_error": state.get("worker_error"),

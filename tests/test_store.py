@@ -206,3 +206,63 @@ def test_2pc_failpoint_prewrite_conflict(storage):
     # locks must have been cleaned up
     assert t.locks == {}
     FAILPOINTS.clear()
+
+
+def test_dict_encode_fast_path_type_safety():
+    """Cross-type-equal objects (5 vs 5.0) must encode via str() like the
+    slow path — never collapse into one dictionary entry."""
+    import numpy as np
+
+    from tidb_tpu.store.blockstore import TableStore
+    from tidb_tpu.types import ty_string
+
+    st = TableStore(1, [("s", ty_string())])
+    arr = np.empty(4, dtype=object)
+    arr[:] = [5, 5.0, "5", "5.0"]
+    st.bulk_load_arrays([arr], ts=1)
+    chunk = st.base_chunk([0], 0, 4)
+    assert list(chunk.col(0).data) == ["5", "5.0", "5", "5.0"]
+    assert st.cols[0].dictionary == ["5", "5.0"]
+
+
+def test_dict_encode_high_cardinality_falls_back():
+    import numpy as np
+
+    from tidb_tpu.store.blockstore import TableStore
+    from tidb_tpu.types import ty_string
+
+    st = TableStore(1, [("s", ty_string())])
+    arr = np.array([f"v{i:05d}" for i in range(5000)], dtype=object)
+    st.bulk_load_arrays([arr], ts=1)
+    assert len(st.cols[0].dictionary) == 5000
+    assert list(st.base_chunk([0], 0, 3).col(0).data) == \
+        ["v00000", "v00001", "v00002"]
+
+
+def test_coded_ingest_validates_before_append():
+    """A bad dictionary for a LATER column must not leave earlier columns
+    with phantom blocks (torn store)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from tidb_tpu.errors import KVError
+    from tidb_tpu.store.blockstore import TableStore
+    from tidb_tpu.types import ty_int, ty_string
+
+    st = TableStore(1, [("a", ty_int()), ("s", ty_string())])
+    with _pytest.raises(KVError):
+        st.bulk_load_arrays(
+            [np.arange(4), np.array([0, 1, 2, 3], dtype=np.int32)],
+            ts=1, dictionaries={1: ["b", "a"]})  # unsorted dict
+    assert st.base_rows == 0
+    assert all(not blocks for blocks in st._blocks)
+    # valid coded ingest round-trips, merging with a later object load
+    st.bulk_load_arrays(
+        [np.arange(3), np.array([2, 0, 1], dtype=np.int32)],
+        ts=1, dictionaries={1: ["a", "b", "c"]})
+    arr = np.empty(2, dtype=object)
+    arr[:] = ["b", "z"]
+    st.bulk_load_arrays([np.arange(2), arr], ts=2)
+    assert list(st.base_chunk([1], 0, 5).col(0).data) == \
+        ["c", "a", "b", "b", "z"]
+    assert st.cols[1].dictionary == ["a", "b", "c", "z"]

@@ -36,8 +36,9 @@ def build_lineitem(n: int, regions: int = 8, seed: int = 7):
     rng = np.random.default_rng(seed)
     base = parse_date("1992-01-01")
     span = parse_date("1998-12-01") - base
-    flags = np.array(["A", "N", "R"], dtype=object)
-    status = np.array(["F", "O"], dtype=object)
+    # string columns ship as Arrow-style dictionary codes: the generator
+    # KNOWS its categories, so no per-row encode on the load path
+    dicts = {5: ["A", "N", "R"], 6: ["F", "O"]}
     CHUNK = 1 << 21
     for s0 in range(0, n, CHUNK):
         m = min(CHUNK, n - s0)
@@ -47,13 +48,54 @@ def build_lineitem(n: int, regions: int = 8, seed: int = 7):
             rng.integers(90_000, 10_500_001, m, dtype=np.int64),  # price (.2)
             rng.integers(0, 11, m, dtype=np.int64),              # discount (.2)
             rng.integers(0, 9, m, dtype=np.int64),               # tax (.2)
-            flags[rng.integers(0, 3, m)],                        # returnflag
-            status[rng.integers(0, 2, m)],                       # linestatus
+            rng.integers(0, 3, m, dtype=np.int32),               # returnflag
+            rng.integers(0, 2, m, dtype=np.int32),               # linestatus
             (base + rng.integers(0, span, m)).astype(np.int32),  # shipdate
         ]
-        store.bulk_load_arrays(arrays, ts=domain.storage.current_ts())
+        store.bulk_load_arrays(arrays, ts=domain.storage.current_ts(),
+                               dictionaries=dicts)
     domain.storage.regions.split_even(t.id, regions, store.base_rows)
     from .copr.parallel import prefetch_table
 
     prefetch_table(domain.storage, t.id)
+    return s
+
+
+def build_q3_tables(n_li: int, n_orders: int, regions: int = 8,
+                    seed: int = 11):
+    """Q3-shaped pair: orders (PK o_orderkey, the broadcast build side)
+    joined by a lineitem fact table — the device lookup-join benchmark
+    shape (reference executor/join.go role under TPC-H Q3)."""
+    from .session import Domain
+    from .types.values import parse_date
+
+    domain = Domain()
+    s = domain.new_session()
+    s.execute("create table orders (o_orderkey bigint primary key,"
+              " o_orderdate date, o_shippriority bigint)")
+    s.execute("create table lineitem (l_orderkey bigint,"
+              " l_extendedprice decimal(15,2), l_discount decimal(15,2),"
+              " l_shipdate date)")
+    rng = np.random.default_rng(seed)
+    base = parse_date("1995-01-01")
+    t_o = domain.catalog.info_schema().table("test", "orders")
+    t_l = domain.catalog.info_schema().table("test", "lineitem")
+    domain.storage.table(t_o.id).bulk_load_arrays([
+        np.arange(n_orders, dtype=np.int64),
+        (base + rng.integers(-400, 400, n_orders)).astype(np.int64),
+        rng.integers(0, 5, n_orders),
+    ], ts=domain.storage.current_ts())
+    CHUNK = 1 << 21
+    store = domain.storage.table(t_l.id)
+    for s0 in range(0, n_li, CHUNK):
+        m = min(CHUNK, n_li - s0)
+        store.bulk_load_arrays([
+            rng.integers(0, n_orders, m),
+            rng.integers(90_000, 10_500_001, m),
+            rng.integers(0, 11, m),
+            (base + rng.integers(-300, 300, m)).astype(np.int64),
+        ], ts=domain.storage.current_ts())
+    domain.storage.regions.split_even(t_l.id, regions, store.base_rows)
+    s.execute("analyze table orders")
+    s.execute("analyze table lineitem")
     return s
