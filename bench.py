@@ -77,14 +77,13 @@ where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
   and l_discount between 0.05 and 0.07 and l_quantity < 24
 """
 
-Q3 = (
-    "select l_orderkey, o_orderdate, o_shippriority,"
-    " sum(l_extendedprice * (1 - l_discount)) as rev"
-    " from lineitem, orders where l_orderkey = o_orderkey"
-    " and o_orderdate < '1995-03-15' and l_shipdate > '1995-03-15'"
-    " group by l_orderkey, o_orderdate, o_shippriority"
-    " order by rev desc, l_orderkey limit 10"
-)
+# canonical Q3 text lives beside its data builder (one plan shape across
+# bench/dryruns/tests); imported lazily because jax must not load before
+# preflight pins the platform
+def _q3_sql():
+    from tidb_tpu.tpch_data import Q3_SQL
+
+    return Q3_SQL
 
 
 def preflight(state: dict) -> bool:
@@ -249,6 +248,7 @@ def _run_inner(state: dict):
         n_ord = max(n_li // 8, 1000)
         log(f"Q3 join bench: {n_li} lineitem x {n_ord} orders...")
         sess3 = build_q3_tables(n_li, n_ord)
+        Q3 = _q3_sql()
         plan = [r[0] for r in sess3.execute("explain " + Q3)[0].rows]
         in_cop = any("DeviceJoinReader" in op for op in plan)
         sess3.execute("set tidb_use_tpu = 1")

@@ -57,15 +57,26 @@ def main() -> int:
           " where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'"
           " and l_discount between 0.05 and 0.07 and l_quantity < 24")
 
+    # device broadcast join across BOTH processes' shards: the payload
+    # broadcast and the joined partial-agg psum ride the same collective
+    # fabric (deterministic per-process build order is the contract)
+    from tidb_tpu.tpch_data import Q3_SQL as q3, build_q3_tables
+
+    s3 = build_q3_tables(16384, 512, regions=4)
+    # the broadcast join must actually BE in the cop task here
+    plan_ops = [r[0] for r in s3.execute("explain " + q3)[0].rows]
+    assert any("DeviceJoinReader" in op for op in plan_ops), plan_ops
+
     from tidb_tpu.metrics import REGISTRY
 
     before = REGISTRY.snapshot().get("mesh_scans_total", 0)
     results = {}
-    for name, q in (("q1", q1), ("q6", q6)):
-        sess.execute("set tidb_use_tpu = 1")
-        tpu = sess.query(q)
-        sess.execute("set tidb_use_tpu = 0")
-        cpu = sess.query(q)
+    for name, sess_q, q in (("q1", sess, q1), ("q6", sess, q6),
+                            ("q3", s3, q3)):
+        sess_q.execute("set tidb_use_tpu = 1")
+        tpu = sess_q.query(q)
+        sess_q.execute("set tidb_use_tpu = 0")
+        cpu = sess_q.query(q)
         assert len(tpu) == len(cpu) and tpu, (name, tpu, cpu)
         for ra, rb in zip(tpu, cpu):
             for x, y in zip(ra, rb):

@@ -61,6 +61,19 @@ def build_lineitem(n: int, regions: int = 8, seed: int = 7):
     return s
 
 
+# the canonical Q3-shaped query over build_q3_tables' pair (shared by the
+# bench, the driver dryruns, and the multihost worker so they always
+# exercise the same plan shape)
+Q3_SQL = (
+    "select l_orderkey, o_orderdate, o_shippriority,"
+    " sum(l_extendedprice * (1 - l_discount)) as rev"
+    " from lineitem, orders where l_orderkey = o_orderkey"
+    " and o_orderdate < '1995-03-15' and l_shipdate > '1995-03-15'"
+    " group by l_orderkey, o_orderdate, o_shippriority"
+    " order by rev desc, l_orderkey limit 10"
+)
+
+
 def build_q3_tables(n_li: int, n_orders: int, regions: int = 8,
                     seed: int = 11):
     """Q3-shaped pair: orders (PK o_orderkey, the broadcast build side)
