@@ -382,7 +382,8 @@ class Catalog:
                 self.storage.drop_table(pid)
                 self._notify_drop(pid)
             new = TableInfo(
-                self.gen_id(), t.name, t.columns, t.indexes, t.pk_is_handle, 1
+                self.gen_id(), t.name, t.columns, t.indexes, t.pk_is_handle,
+                1, t.comment, foreign_keys=list(t.foreign_keys),
             )
             d.tables[name.lower()] = new
             if t.partition_info is not None:
@@ -417,6 +418,7 @@ class Catalog:
                            t.auto_inc_id, t.comment, t.is_view,
                            t.view_select, t.partition_info)
             d.tables[new.lower()] = t2
+            self._rewrite_referencing_fks(db, old, new_table=new)
             self._bump()
             self._touch_info(t)
             self._record(DDLJob(self.gen_id(), "rename_table", db, new))
@@ -458,8 +460,12 @@ class Catalog:
                 c.offset = i
             new_idx = [ix for ix in t.indexes
                        if col.name.lower() not in [c.lower() for c in ix.columns]]
+            new_fks = [fk for fk in t.foreign_keys
+                       if col.name.lower() not in
+                       [c.lower() for c in fk["columns"]]]
             self._rebuild_storage(t, new_cols, drop=col.name)
-            self._replace_table(db, table, t, columns=new_cols, indexes=new_idx)
+            self._replace_table(db, table, t, columns=new_cols,
+                                indexes=new_idx, foreign_keys=new_fks)
             self._record(job)
 
     def modify_column(self, db: str, table: str, col: ColumnInfo):
@@ -475,6 +481,68 @@ class Catalog:
             self._rebuild_storage(t, new_cols, retype=(old.offset, col.ftype))
             self._replace_table(db, table, t, columns=new_cols)
             self._record(DDLJob(self.gen_id(), "modify_column", db, table))
+
+    def change_column(self, db: str, table: str, old_name: str,
+                      col: ColumnInfo):
+        """CHANGE COLUMN: rename + retype in one op (ddl_api.go:2785)."""
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            old = t.find_column(old_name)
+            if old is None:
+                raise KVError(f"no column {old_name!r}")
+            if col.name.lower() != old_name.lower() and \
+                    t.find_column(col.name) is not None:
+                raise KVError(f"column {col.name!r} exists")
+            col.offset = old.offset
+            new_cols = list(t.columns)
+            new_cols[old.offset] = col
+
+            def ren(n):
+                return col.name if n.lower() == old.name.lower() else n
+
+            new_ixs = [IndexInfo(x.id, x.name, [ren(c) for c in x.columns],
+                                 x.unique, x.primary, x.state)
+                       for x in t.indexes]
+            new_fks = [{**fk, "columns": [ren(c) for c in fk["columns"]]}
+                       for fk in t.foreign_keys]
+            self._rebuild_storage(t, new_cols,
+                                  retype=(old.offset, col.ftype),
+                                  rename=(old.name, col.name))
+            self._replace_table(db, table, t, columns=new_cols,
+                                indexes=new_ixs, foreign_keys=new_fks)
+            # other tables referencing THIS column track the new name
+            self._rewrite_referencing_fks(
+                db, table, ref_col_rename=(old.name, col.name))
+            self._record(DDLJob(self.gen_id(), "change_column", db, table))
+
+    def _rewrite_referencing_fks(self, ref_db: str, ref_table: str,
+                                 ref_col_rename=None, new_table=None):
+        """Keep FK metadata in OTHER tables pointing at (ref_db,
+        ref_table) consistent across renames (SHOW CREATE TABLE must emit
+        replayable DDL)."""
+        for dname, dinfo in self._dbs.items():
+            for tname, ti in list(dinfo.tables.items()):
+                changed = False
+                fks = []
+                for fk in ti.foreign_keys:
+                    if fk["ref_db"] == ref_db.lower() and                             fk["ref_table"] == ref_table.lower():
+                        fk = dict(fk)
+                        if new_table is not None:
+                            fk["ref_table"] = new_table.lower()
+                            changed = True
+                        if ref_col_rename is not None:
+                            old_c, new_c = ref_col_rename
+                            cols = [new_c if c.lower() == old_c.lower()
+                                    else c for c in fk["ref_columns"]]
+                            if cols != fk["ref_columns"]:
+                                fk["ref_columns"] = cols
+                                changed = True
+                    fks.append(fk)
+                if changed:
+                    dinfo.tables[tname] = TableInfo(
+                        ti.id, ti.name, ti.columns, ti.indexes,
+                        ti.pk_is_handle, ti.auto_inc_id, ti.comment,
+                        ti.is_view, ti.view_select, ti.partition_info, fks)
 
     # ------------------------------------------------------------------
     # indexes.  write-reorg backfill (ddl/index.go) collapses to metadata:
@@ -850,10 +918,101 @@ class Catalog:
             overrides.get("indexes", t.indexes),
             t.pk_is_handle, t.auto_inc_id, t.comment, t.is_view, t.view_select,
             overrides.get("partition_info", t.partition_info),
+            overrides.get("foreign_keys", list(t.foreign_keys)),
         )
         d.tables[table.lower()] = new
         self._bump()
         self._touch_info(new)
+
+    # ------------------------------------------------------------------
+    # light ALTERs: metadata-only changes (ddl_api.go RebaseAutoID :1999,
+    # AlterTableComment :2902, RenameIndex :3105, FK :3509/:3541)
+    # ------------------------------------------------------------------
+    def rebase_auto_increment(self, db: str, table: str, n: int):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            # MySQL: rebase never goes backwards
+            new = TableInfo(t.id, t.name, t.columns, t.indexes,
+                            t.pk_is_handle, max(int(n), t.auto_inc_id),
+                            t.comment, t.is_view, t.view_select,
+                            t.partition_info, list(t.foreign_keys))
+            self._dbs[db.lower()].tables[table.lower()] = new
+            self._bump()
+            self._touch_info(new)
+            self._record(DDLJob(self.gen_id(), "rebase_auto_id", db, table))
+
+    def set_table_comment(self, db: str, table: str, comment: str):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            new = TableInfo(t.id, t.name, t.columns, t.indexes,
+                            t.pk_is_handle, t.auto_inc_id, comment,
+                            t.is_view, t.view_select, t.partition_info,
+                            list(t.foreign_keys))
+            self._dbs[db.lower()].tables[table.lower()] = new
+            self._bump()
+            self._touch_info(new)
+            self._record(DDLJob(self.gen_id(), "modify_comment", db, table))
+
+    def rename_index(self, db: str, table: str, old: str, new_name: str):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            ix = next((x for x in t.indexes
+                       if x.name.lower() == old.lower()), None)
+            if ix is None:
+                raise KVError(f"index {old!r} does not exist")
+            if any(x.name.lower() == new_name.lower() for x in t.indexes):
+                raise KVError(f"index {new_name!r} exists")
+            new_ixs = [IndexInfo(x.id, new_name if x is ix else x.name,
+                                 x.columns, x.unique, x.primary, x.state)
+                       for x in t.indexes]
+            self._replace_table(db, table, t, indexes=new_ixs)
+            self._record(DDLJob(self.gen_id(), "rename_index", db, table))
+
+    def add_foreign_key(self, db: str, table: str, name: str, columns,
+                        ref_db: str, ref_table: str, ref_columns):
+        with self._mu:
+            isc = self.info_schema()
+            t = isc.table(db, table)
+            rt = isc.table(ref_db, ref_table)  # referenced table must exist
+            for c in columns:
+                if t.find_column(c) is None:
+                    raise KVError(f"no column {c!r} in {table}")
+            for c in ref_columns:
+                if rt.find_column(c) is None:
+                    raise KVError(f"no column {c!r} in {ref_table}")
+            if len(columns) != len(ref_columns):
+                raise KVError("FK column count mismatch")
+            if any(fk["name"].lower() == name.lower()
+                   for fk in t.foreign_keys):
+                raise KVError(f"foreign key {name!r} exists")
+            fks = list(t.foreign_keys) + [{
+                "name": name, "columns": list(columns),
+                "ref_db": ref_db.lower(), "ref_table": ref_table.lower(),
+                "ref_columns": list(ref_columns),
+            }]
+            new = TableInfo(t.id, t.name, t.columns, t.indexes,
+                            t.pk_is_handle, t.auto_inc_id, t.comment,
+                            t.is_view, t.view_select, t.partition_info, fks)
+            self._dbs[db.lower()].tables[table.lower()] = new
+            self._bump()
+            self._touch_info(new)
+            self._record(DDLJob(self.gen_id(), "add_foreign_key", db, table))
+
+    def drop_foreign_key(self, db: str, table: str, name: str):
+        with self._mu:
+            t = self.info_schema().table(db, table)
+            fks = [fk for fk in t.foreign_keys
+                   if fk["name"].lower() != name.lower()]
+            if len(fks) == len(t.foreign_keys):
+                raise KVError(f"foreign key {name!r} does not exist")
+            new = TableInfo(t.id, t.name, t.columns, t.indexes,
+                            t.pk_is_handle, t.auto_inc_id, t.comment,
+                            t.is_view, t.view_select, t.partition_info, fks)
+            self._dbs[db.lower()].tables[table.lower()] = new
+            self._bump()
+            self._touch_info(new)
+            self._record(DDLJob(self.gen_id(), "drop_foreign_key", db,
+                                table))
 
     # ------------------------------------------------------------------
     # partition management DDL (ddl_api.go:2187-2316 Add/Drop/Truncate/
@@ -1052,17 +1211,19 @@ class Catalog:
         self._record(DDLJob(self.gen_id(), "rehash_partition", db, t.name))
 
     def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
-                         add_default=None, drop: str = None, retype=None):
+                         add_default=None, drop: str = None, retype=None,
+                         rename=None):
         """Rewrite the TableStore for a column-layout change.  Committed
         delta folds in (compact), so the new store is base-only.  For a
         partitioned table every partition store is rebuilt."""
         for pid in t.physical_ids():
             self._rebuild_one_store(pid, t, new_cols, add_default, drop,
-                                    retype)
+                                    retype, rename)
 
     def _rebuild_one_store(self, store_id: int, t: TableInfo,
                            new_cols: List[ColumnInfo],
-                           add_default=None, drop: str = None, retype=None):
+                           add_default=None, drop: str = None, retype=None,
+                           rename=None):
         store = self.storage.table(store_id)
         ts = self.storage.current_ts()
         store.compact(ts)
@@ -1082,7 +1243,10 @@ class Catalog:
                                   dtype=ft.np_dtype)
                 valid = np.full(n, default is not None, dtype=np.bool_)
             else:
-                oi = old_names.index(c.name)
+                src_name = c.name
+                if rename is not None and c.name == rename[1]:
+                    src_name = rename[0]  # CHANGE COLUMN: data moves over
+                oi = old_names.index(src_name)
                 col = chunk.col(oi)
                 arr, valid = col.data, col.validity()
                 if retype is not None and oi == retype[0]:

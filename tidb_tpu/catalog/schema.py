@@ -175,6 +175,10 @@ class TableInfo:
     is_view: bool = False
     view_select: str = ""  # original SELECT text for views
     partition_info: Optional[PartitionInfo] = None
+    # FOREIGN KEY metadata (stored + displayed, unenforced — the
+    # reference's support level, ddl_api.go:3509): list of dicts
+    # {name, columns, ref_db, ref_table, ref_columns}
+    foreign_keys: List[dict] = field(default_factory=list)
 
     @property
     def is_partitioned(self) -> bool:
@@ -233,6 +237,8 @@ class TableInfo:
             "view_select": self.view_select,
             "partition_info": (self.partition_info.to_dict()
                                if self.partition_info else None),
+            "foreign_keys": [dict(fk) for fk in self.foreign_keys],
+            "comment": self.comment,
         }
 
     @staticmethod
@@ -243,9 +249,11 @@ class TableInfo:
             [ColumnInfo.from_dict(c) for c in d["columns"]],
             [IndexInfo.from_dict(i) for i in d["indexes"]],
             d.get("pk_is_handle", -1), d.get("auto_inc_id", 1),
+            comment=d.get("comment", ""),
             is_view=d.get("is_view", False),
             view_select=d.get("view_select", ""),
             partition_info=PartitionInfo.from_dict(pi) if pi else None,
+            foreign_keys=[dict(fk) for fk in d.get("foreign_keys", [])],
         )
 
 

@@ -256,12 +256,24 @@ class PartitionByAst(Node):
 
 
 @dataclass
+class FkDef(Node):
+    """FOREIGN KEY metadata (stored, displayed, not enforced — matching
+    the reference's FK support level, ddl_api.go:3509)."""
+
+    name: str = ""
+    columns: List[str] = field(default_factory=list)
+    ref_table: "TableName" = None
+    ref_columns: List[str] = field(default_factory=list)
+
+
+@dataclass
 class CreateTableStmt(Stmt):
     table: TableName
     columns: List[ColumnDef]
     indexes: List[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
     partition_by: Optional[PartitionByAst] = None
+    foreign_keys: List[FkDef] = field(default_factory=list)
 
 
 @dataclass
@@ -301,7 +313,19 @@ class AlterTableStmt(Stmt):
     name: str = ""  # drop target / rename target
     part_defs: List["PartitionDefAst"] = field(default_factory=list)
     names: List[str] = field(default_factory=list)  # partition names
-    number: int = 0  # COALESCE PARTITION n / ADD PARTITION PARTITIONS n
+    number: int = 0  # COALESCE PARTITION n / ADD PARTITION PARTITIONS n /
+    # AUTO_INCREMENT rebase value
+    fk: Optional["FkDef"] = None  # ADD FOREIGN KEY
+
+
+@dataclass
+class DropStatsStmt(Stmt):
+    table: TableName = None
+
+
+@dataclass
+class RepairTableStmt(Stmt):
+    table: TableName = None
 
 
 @dataclass

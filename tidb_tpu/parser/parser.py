@@ -696,6 +696,7 @@ class Parser:
         self.expect_op("(")
         cols: List[ast.ColumnDef] = []
         indexes: List[ast.IndexDef] = []
+        fks: List[ast.FkDef] = []
         while True:
             if self.at_kw("primary"):
                 self.next()
@@ -729,8 +730,17 @@ class Parser:
                     names.append(self.ident())
                 self.expect_op(")")
                 indexes.append(ast.IndexDef(idx_name or f"idx_{names[0]}", names))
-            elif self.at_kw("foreign", "constraint", "check"):
-                # skip constraint definitions to matching depth
+            elif self.at_kw("foreign", "constraint"):
+                cname = ""
+                if self.accept_kw("constraint"):
+                    if not self.at_kw("foreign"):
+                        cname = self.ident("constraint")
+                if self.at_kw("foreign"):
+                    fks.append(self._parse_fk_tail(cname))
+                else:
+                    # CHECK / other constraint kinds: skipped (unenforced)
+                    self._skip_balanced_until_comma()
+            elif self.at_kw("check"):
                 self._skip_balanced_until_comma()
             else:
                 cols.append(self._parse_column_def())
@@ -747,7 +757,37 @@ class Parser:
         if self.accept_kw("partition"):
             self.expect_kw("by")
             part = self._parse_partition_by()
-        return ast.CreateTableStmt(table, cols, indexes, ine, part)
+        return ast.CreateTableStmt(table, cols, indexes, ine, part, fks)
+
+    def _parse_fk_tail(self, cname: str = "") -> "ast.FkDef":
+        """FOREIGN KEY [name] (cols) REFERENCES tbl (cols) [ON ...]."""
+        self.expect_kw("foreign")
+        self.expect_kw("key")
+        name = cname
+        if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+            name = self.ident("fk name")
+        self.expect_op("(")
+        cols = [self.ident()]
+        while self.accept_op(","):
+            cols.append(self.ident())
+        self.expect_op(")")
+        self.expect_kw("references")
+        ref = self._parse_table_name()
+        self.expect_op("(")
+        rcols = [self.ident()]
+        while self.accept_op(","):
+            rcols.append(self.ident())
+        self.expect_op(")")
+        # referential actions parse and are recorded as unenforced
+        while self.accept_kw("on"):
+            self.next()  # delete | update
+            if self.accept_kw("set"):
+                self.next()  # null | default
+            elif self.accept_kw("no"):
+                self.next()  # action
+            else:
+                self.next()  # cascade | restrict
+        return ast.FkDef(name or f"fk_{cols[0]}", cols, ref, rcols)
 
     def _parse_partition_by(self) -> "ast.PartitionByAst":
         """PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n)|
@@ -932,6 +972,8 @@ class Parser:
             name = self.ident("index name")
             self.expect_kw("on")
             return ast.DropIndexStmt(name, self._parse_table_name())
+        if self.accept_kw("stats"):
+            return ast.DropStatsStmt(self._parse_table_name())
         if self.accept_kw("user"):
             ie = self._if_exists()
             return ast.DropUserStmt(self._parse_user_name(), ie)
@@ -1001,6 +1043,13 @@ class Parser:
                     table, "add_index",
                     index=ast.IndexDef(idx_name or f"uk_{cols[0]}", cols, True),
                 )
+            if self.at_kw("foreign", "constraint"):
+                cname = ""
+                if self.accept_kw("constraint"):
+                    if not self.at_kw("foreign"):
+                        cname = self.ident("constraint")
+                return ast.AlterTableStmt(table, "add_fk",
+                                          fk=self._parse_fk_tail(cname))
             self.accept_kw("column")
             return ast.AlterTableStmt(table, "add_column",
                                       column=self._parse_column_def())
@@ -1011,6 +1060,10 @@ class Parser:
                     names.append(self.ident("partition"))
                 return ast.AlterTableStmt(table, "drop_partition",
                                           names=names)
+            if self.accept_kw("foreign"):
+                self.expect_kw("key")
+                return ast.AlterTableStmt(table, "drop_fk",
+                                          name=self.ident("fk name"))
             if self.accept_kw("index", "key"):
                 return ast.AlterTableStmt(table, "drop_index", name=self.ident())
             self.accept_kw("column")
@@ -1030,10 +1083,29 @@ class Parser:
             self.accept_kw("column")
             return ast.AlterTableStmt(table, "modify_column",
                                       column=self._parse_column_def())
+        if self.accept_kw("change"):
+            # CHANGE [COLUMN] old_name new_def (rename + retype)
+            self.accept_kw("column")
+            old = self.ident("column")
+            return ast.AlterTableStmt(table, "change_column", name=old,
+                                      column=self._parse_column_def())
         if self.accept_kw("rename"):
+            if self.accept_kw("index", "key"):
+                old = self.ident("index")
+                self.expect_kw("to")
+                return ast.AlterTableStmt(table, "rename_index",
+                                          names=[old, self.ident("index")])
             self.accept_kw("to") or self.accept_kw("as")
             return ast.AlterTableStmt(table, "rename",
                                       name=self._parse_table_name().name)
+        if self.accept_kw("auto_increment"):
+            self.accept_op("=")
+            return ast.AlterTableStmt(table, "auto_increment",
+                                      number=int(self.next().value))
+        if self.accept_kw("comment"):
+            self.accept_op("=")
+            return ast.AlterTableStmt(table, "comment",
+                                      name=str(self.next().value))
         t = self.peek()
         raise ParseError(f"unsupported ALTER TABLE action {t.value!r}", t.line, t.col)
 
@@ -1365,6 +1437,10 @@ class Parser:
                 while self.peek().kind != T.EOF and not self.at_op(";"):
                     self.next()
                 return ast.AdminStmt("show_slow")
+            # ADMIN SHOW t NEXT_ROW_ID
+            tbl = self._parse_table_name()
+            self.expect_kw("next_row_id")
+            return ast.AdminStmt("show_next_row_id", [tbl])
         if self.accept_kw("checksum"):
             self.expect_kw("table")
             tables = [self._parse_table_name()]
@@ -1383,6 +1459,13 @@ class Parser:
             return ast.AdminStmt("cleanup_index", tables, index=name)
         t = self.peek()
         raise ParseError(f"unsupported ADMIN {t.value!r}", t.line, t.col)
+
+    def _parse_repair(self) -> "ast.RepairTableStmt":
+        """REPAIR TABLE t — re-derive every index artifact and verify
+        (util/admin.go RepairTable role for derived indexes)."""
+        self.expect_kw("repair")
+        self.expect_kw("table")
+        return ast.RepairTableStmt(self._parse_table_name())
 
     def _parse_recover(self) -> "ast.RecoverTableStmt":
         """RECOVER TABLE t — flashback the most recently dropped `t` from

@@ -425,7 +425,8 @@ def check_stmt(session, s) -> None:
             for q in needed:
                 pm.require(user, q, db, table)
         return
-    if isinstance(s, (ast.KillStmt, ast.AdminStmt, ast.SplitRegionStmt)):
+    if isinstance(s, (ast.KillStmt, ast.AdminStmt, ast.SplitRegionStmt,
+                      ast.DropStatsStmt, ast.RepairTableStmt)):
         pm.require(user, "super")
         return
     if isinstance(s, ast.ShowStmt) and s.kind == "grants" and s.target:

@@ -680,7 +680,8 @@ class Session:
               ast.RenameTableStmt, ast.CreateIndexStmt, ast.DropIndexStmt,
               ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
               ast.CreateViewStmt, ast.AnalyzeTableStmt,
-              ast.RecoverTableStmt)
+              ast.RecoverTableStmt, ast.DropStatsStmt,
+              ast.RepairTableStmt)
         target = s.target if isinstance(s, (ast.ExplainStmt,
                                             ast.TraceStmt)) else s
         analyze = getattr(s, "analyze", True)  # plain EXPLAIN is read-only
@@ -1045,7 +1046,59 @@ class Session:
             t = self.domain.catalog.info_schema().table(
                 tn.db or self.current_db, tn.name)
             return self._admin_repair_index(t, s.index, s.kind)
+        if s.kind == "checksum_table":
+            rows = []
+            for tn in s.tables:
+                db = tn.db or self.current_db
+                t = self.domain.catalog.info_schema().table(db, tn.name)
+                rows.append((db, tn.name) + self._checksum_table(t))
+            return ResultSet(
+                ["Db_name", "Table_name", "Checksum_crc64_xor",
+                 "Total_kvs", "Total_bytes"], rows, is_query=True)
+        if s.kind == "show_next_row_id":
+            tn = s.tables[0]
+            db = tn.db or self.current_db
+            t = self.domain.catalog.info_schema().table(db, tn.name)
+            nid = max(self.domain.storage.table(pid).next_handle
+                      for pid in t.physical_ids())
+            return ResultSet(
+                ["DB_NAME", "TABLE_NAME", "COLUMN_NAME", "NEXT_GLOBAL_ROW_ID"],
+                [(db, tn.name, "_tidb_rowid", max(nid, t.auto_inc_id))],
+                is_query=True)
         raise PlanError(f"ADMIN {s.kind} not supported")
+
+    def _checksum_table(self, t: TableInfo):
+        """(crc64_xor, total_kvs, total_bytes) over the VISIBLE rows of
+        every physical store (the reference's checksum cop request,
+        kv/kv.go:206-211, computed in-process)."""
+        import zlib
+
+        ts = self.domain.storage.current_ts()
+        crc = 0
+        kvs = 0
+        nbytes = 0
+        for pid in t.physical_ids():
+            store = self.domain.storage.table(pid)
+            deleted, inserted = store.delta_overlay(ts, 0, 1 << 62)
+            dele = set(deleted)
+            n = store.base_rows
+            step = 1 << 16
+            for lo in range(0, n, step):
+                chunk = store.base_chunk(range(store.n_cols), lo,
+                                         min(lo + step, n))
+                for off, row in enumerate(chunk.to_pylist()):
+                    if lo + off in dele:
+                        continue
+                    raw = repr(row).encode()
+                    crc ^= zlib.crc32(raw)
+                    kvs += 1
+                    nbytes += len(raw)
+            for h in sorted(inserted):
+                raw = repr(tuple(inserted[h])).encode()
+                crc ^= zlib.crc32(raw)
+                kvs += 1
+                nbytes += len(raw)
+        return crc, kvs, nbytes
 
     def _admin_repair_index(self, t: TableInfo, index_name: str,
                             kind: str) -> ResultSet:
@@ -1269,6 +1322,22 @@ class Session:
         if isinstance(s, ast.RecoverTableStmt):
             cat.recover_table(s.table.db or self.current_db, s.table.name)
             return ResultSet()
+        if isinstance(s, ast.DropStatsStmt):
+            t = cat.info_schema().table(s.table.db or self.current_db,
+                                        s.table.name)
+            for pid in t.physical_ids() + [t.id]:
+                self.domain.stats.drop(pid)
+            return ResultSet()
+        if isinstance(s, ast.RepairTableStmt):
+            # re-derive every index artifact from the row data, then run
+            # the full integrity check (util/admin.go RepairTable role
+            # over derived indexes)
+            t = cat.info_schema().table(s.table.db or self.current_db,
+                                        s.table.name)
+            for ix in t.indexes:
+                self._admin_repair_index(t, ix.name, "recover_index")
+            self._admin_check_table(t)
+            return ResultSet()
         if isinstance(s, ast.RenameTableStmt):
             cat.rename_table(s.old.db or self.current_db, s.old.name,
                              s.new.name)
@@ -1319,6 +1388,28 @@ class Session:
         if s.action in ("add_partition", "drop_partition",
                         "truncate_partition", "coalesce_partition"):
             return self._run_partition_ddl(cat, db, s)
+        if s.action == "change_column":
+            cat.change_column(db, s.table.name, s.name,
+                              self._column_info(s.column))
+            return ResultSet()
+        if s.action == "rename_index":
+            cat.rename_index(db, s.table.name, s.names[0], s.names[1])
+            return ResultSet()
+        if s.action == "auto_increment":
+            cat.rebase_auto_increment(db, s.table.name, s.number)
+            return ResultSet()
+        if s.action == "comment":
+            cat.set_table_comment(db, s.table.name, s.name)
+            return ResultSet()
+        if s.action == "add_fk":
+            fk = s.fk
+            cat.add_foreign_key(
+                db, s.table.name, fk.name, fk.columns,
+                fk.ref_table.db or db, fk.ref_table.name, fk.ref_columns)
+            return ResultSet()
+        if s.action == "drop_fk":
+            cat.drop_foreign_key(db, s.table.name, s.name)
+            return ResultSet()
         raise PlanError(f"ALTER {s.action} not supported")
 
     def _run_partition_ddl(self, cat, db: str, s: ast.AlterTableStmt):
@@ -1410,6 +1501,33 @@ class Session:
             idx_id += 1
         if s.partition_by is not None:
             info.partition_info = self._partition_info(s.partition_by, info)
+        seen_fk = set()
+        for fk in s.foreign_keys:
+            # same validation as ALTER ... ADD FOREIGN KEY
+            # (catalog.add_foreign_key): referenced table + columns must
+            # exist, names unique, column counts equal
+            ref_db = (fk.ref_table.db or self.current_db).lower()
+            for c in fk.columns:
+                if info.find_column(c) is None:
+                    raise PlanError(f"FK column {c!r} does not exist")
+            rt = self.domain.catalog.info_schema().table(
+                ref_db, fk.ref_table.name)
+            for c in fk.ref_columns:
+                if rt.find_column(c) is None:
+                    raise PlanError(
+                        f"FK referenced column {c!r} does not exist in "
+                        f"{fk.ref_table.name}")
+            if len(fk.columns) != len(fk.ref_columns):
+                raise PlanError("FK column count mismatch")
+            if fk.name.lower() in seen_fk:
+                raise PlanError(f"duplicate foreign key name {fk.name!r}")
+            seen_fk.add(fk.name.lower())
+            info.foreign_keys.append({
+                "name": fk.name, "columns": list(fk.columns),
+                "ref_db": ref_db,
+                "ref_table": fk.ref_table.name.lower(),
+                "ref_columns": list(fk.ref_columns),
+            })
         return info
 
     def _partition_info(self, pb, info: TableInfo):
@@ -1521,6 +1639,11 @@ def _show_create(t: TableInfo) -> str:
             )
         else:
             lines.append(f"  KEY `{ix.name}` (`{'`,`'.join(ix.columns)}`)")
+    for fk in t.foreign_keys:
+        lines.append(
+            f"  CONSTRAINT `{fk['name']}` FOREIGN KEY "
+            f"(`{'`,`'.join(fk['columns'])}`) REFERENCES "
+            f"`{fk['ref_table']}` (`{'`,`'.join(fk['ref_columns'])}`)")
     body = ",\n".join(lines)
     out = f"CREATE TABLE `{t.name}` (\n{body}\n)"
     pi = t.partition_info
