@@ -312,3 +312,92 @@ def test_auth_resolves_most_specific_account(d, root):
         "svc@10.1.%"
     assert pm.auth("svc", token("globalpw"), salt, host="10.1.2.3") is None
     assert pm.auth("svc", token("globalpw"), salt, host="8.8.8.8") == "svc@%"
+
+
+# ---------------------------------------------------------------------------
+# MySQL roles (executor/simple.go SET ROLE family, privilege merge with
+# activeRoles in privileges/cache.go)
+# ---------------------------------------------------------------------------
+
+
+def test_roles_grant_activate_and_merge(d, root):
+    root.execute("create role 'r_read', 'r_write'")
+    root.execute("grant select on test.* to r_read")
+    root.execute("grant insert, update on test.* to r_write")
+    root.execute("create user rolf identified by 'x'")
+    root.execute("grant r_read, r_write to rolf")
+    rolf = _as(d, "rolf")
+    # granted but NOT active: no access yet
+    with pytest.raises(PrivilegeError):
+        rolf.query("select * from t")
+    rolf.execute("set role 'r_read'")
+    assert rolf.query("select * from t") == [(1,)]
+    with pytest.raises(PrivilegeError):
+        rolf.execute("insert into t values (5)")
+    rolf.execute("set role all")
+    rolf.execute("insert into t values (5)")
+    rolf.execute("set role none")
+    with pytest.raises(PrivilegeError):
+        rolf.query("select * from t")
+    # activating a role you don't have fails
+    with pytest.raises(KVError):
+        rolf.execute("set role 'r_admin'")
+
+
+def test_default_roles_and_drop_role(d, root):
+    root.execute("create role r1")
+    root.execute("grant select on test.* to r1")
+    root.execute("create user du")
+    root.execute("grant r1 to du")
+    root.execute("set default role all to du")
+    assert d.priv.default_roles("du") == {"r1@%"}
+    du = _as(d, "du")
+    du.execute("set role default")
+    assert du.query("select * from t")
+    # dropping the role revokes it everywhere
+    root.execute("drop role r1")
+    assert d.priv.granted_roles("du") == set()
+    du2 = _as(d, "du")
+    du2.active_roles = ["r1@%"]  # stale activation no longer grants
+    with pytest.raises(PrivilegeError):
+        du2.query("select * from t")
+
+
+def test_role_management_requires_admin(d, root):
+    root.execute("create user pleb")
+    pleb = _as(d, "pleb")
+    for q in ("create role nope", "drop role nope",
+              "grant nope to pleb", "set default role none to root"):
+        with pytest.raises(PrivilegeError):
+            pleb.execute(q)
+    # SET DEFAULT ROLE for yourself is allowed (with granted roles)
+    root.execute("create role rx")
+    root.execute("grant rx to pleb")
+    pleb.execute("set default role all to pleb")
+    assert d.priv.default_roles("pleb") == {"rx@%"}
+
+
+def test_roles_cannot_login_and_mixed_case(d, root):
+    pm = d.priv
+    root.execute("create role 'Admin'")
+    root.execute("grant super on *.* to 'Admin'")
+    # a role never authenticates, even with an empty token
+    assert pm.auth("Admin", b"", bytes(20)) is None
+    assert pm.match_account("Admin", "127.0.0.1") is None
+    # case-preserving grant of a quoted/mixed-case role
+    root.execute("create user mc")
+    root.execute("grant 'Admin' to mc")
+    assert pm.granted_roles("mc") == {"Admin@%"}
+    mc = _as(d, "mc")
+    mc.execute("set role 'Admin'")
+    mc.execute("kill 99")  # SUPER via the active role
+    root.execute("revoke 'Admin' from mc")
+    assert pm.granted_roles("mc") == set()
+
+
+def test_drop_user_cleans_role_references(d, root):
+    root.execute("create role rr")
+    root.execute("create user uu")
+    root.execute("grant rr to uu")
+    root.execute("drop user rr")  # dropped via DROP USER, not DROP ROLE
+    assert d.priv.granted_roles("uu") == set()

@@ -185,3 +185,14 @@ def test_profiling_table(d):
     assert all(r[1] >= 1 for r in prof)
     s.execute("set tidb_profiling = 0")
     assert s.query("select * from information_schema.tidb_profile") == []
+
+
+def test_cluster_log_ring(d):
+    import logging
+
+    s = d.new_session()
+    logging.getLogger("tidb_tpu.test").warning("hello ring %d", 42)
+    rows = s.query("select level, message from"
+                   " information_schema.cluster_log")
+    assert any("hello ring 42" in m and lvl == "WARNING"
+               for lvl, m in rows)

@@ -510,3 +510,22 @@ def _tidb_profile(domain, isc):
                      entry.inlinetime * 1000.0, entry.totaltime * 1000.0))
     rows.sort(key=lambda r: -r[3])
     return rows[:200]
+
+
+@_register("cluster_log", [
+    ("time", ty_string()), ("type", ty_string()),
+    ("instance", ty_string()), ("level", ty_string()),
+    ("message", ty_string()),
+])
+def _cluster_log(domain, isc):
+    """Recent in-process log records (executor/cluster_reader.go's
+    CLUSTER_LOG memtable over the single node)."""
+    import datetime as _dt
+
+    rows = []
+    for created, level, name, msg in list(getattr(domain, "log_ring", ())):
+        ts = _dt.datetime.fromtimestamp(created).strftime(
+            "%Y-%m-%d %H:%M:%S")
+        rows.append((ts, "tidb-tpu", "127.0.0.1", level,
+                     f"[{name}] {msg}"))
+    return rows

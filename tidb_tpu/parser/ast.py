@@ -319,6 +319,43 @@ class AlterTableStmt(Stmt):
 
 
 @dataclass
+class CreateRoleStmt(Stmt):
+    roles: List[str] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRoleStmt(Stmt):
+    roles: List[str] = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRoleStmt(Stmt):
+    roles: List[str] = field(default_factory=list)
+    users: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RevokeRoleStmt(Stmt):
+    roles: List[str] = field(default_factory=list)
+    users: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SetRoleStmt(Stmt):
+    mode: str = "list"  # list | all | none | default
+    roles: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SetDefaultRoleStmt(Stmt):
+    mode: str = "list"  # list | all | none
+    roles: List[str] = field(default_factory=list)
+    users: List[str] = field(default_factory=list)
+
+
+@dataclass
 class DropStatsStmt(Stmt):
     table: TableName = None
 

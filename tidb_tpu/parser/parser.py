@@ -686,6 +686,12 @@ class Parser:
                 self.expect_kw("by")
                 password = self.next().value
             return ast.CreateUserStmt(user, password, ine)
+        if self.accept_kw("role"):
+            ine = self._if_not_exists()
+            roles = [self._parse_user_name()]
+            while self.accept_op(","):
+                roles.append(self._parse_user_name())
+            return ast.CreateRoleStmt(roles, ine)
         self.expect_kw("table")
         ine = self._if_not_exists()
         table = self._parse_table_name()
@@ -977,6 +983,12 @@ class Parser:
         if self.accept_kw("user"):
             ie = self._if_exists()
             return ast.DropUserStmt(self._parse_user_name(), ie)
+        if self.accept_kw("role"):
+            ie = self._if_exists()
+            roles = [self._parse_user_name()]
+            while self.accept_op(","):
+                roles.append(self._parse_user_name())
+            return ast.DropRoleStmt(roles, ie)
         is_view = bool(self.accept_kw("view"))
         if not is_view:
             self.expect_kw("table")
@@ -1236,6 +1248,33 @@ class Parser:
 
     def _parse_set(self) -> ast.Stmt:
         self.expect_kw("set")
+        if self.accept_kw("role"):
+            if self.accept_kw("none"):
+                return ast.SetRoleStmt("none")
+            if self.accept_kw("all"):
+                return ast.SetRoleStmt("all")
+            if self.accept_kw("default"):
+                return ast.SetRoleStmt("default")
+            roles = [self._parse_user_name()]
+            while self.accept_op(","):
+                roles.append(self._parse_user_name())
+            return ast.SetRoleStmt("list", roles)
+        if self.accept_kw("default"):
+            self.expect_kw("role")
+            mode, roles = "list", []
+            if self.accept_kw("none"):
+                mode = "none"
+            elif self.accept_kw("all"):
+                mode = "all"
+            else:
+                roles = [self._parse_user_name()]
+                while self.accept_op(","):
+                    roles.append(self._parse_user_name())
+            self.expect_kw("to")
+            users = [self._parse_user_name()]
+            while self.accept_op(","):
+                users.append(self._parse_user_name())
+            return ast.SetDefaultRoleStmt(mode, roles, users)
         if self.accept_kw("password"):
             user = ""
             if self.accept_kw("for"):
@@ -1502,8 +1541,34 @@ class Parser:
             return "grant option"
         return p
 
-    def _parse_grant(self) -> ast.GrantStmt:
+    def _role_form_ahead(self) -> bool:
+        """After GRANT/REVOKE: the role form has TO/FROM before any ON —
+        decided by lookahead so role names keep their case and quoting
+        (privilege names lowercase; role names are identifiers)."""
+        for k in range(self.pos, len(self.toks)):
+            t = self.toks[k]
+            if t.kind == T.IDENT:
+                v = t.value.lower()
+                if v == "on":
+                    return False
+                if v in ("to", "from"):
+                    return True
+            if t.kind == T.EOF:
+                return False
+        return False
+
+    def _parse_grant(self) -> "ast.Stmt":
         self.expect_kw("grant")
+        if self._role_form_ahead():
+            # GRANT role[, role]... TO user[, user]... (no ON clause)
+            roles = [self._parse_user_name()]
+            while self.accept_op(","):
+                roles.append(self._parse_user_name())
+            self.expect_kw("to")
+            users = [self._parse_user_name()]
+            while self.accept_op(","):
+                users.append(self._parse_user_name())
+            return ast.GrantRoleStmt(roles, users)
         privs = [self._parse_priv_name()]
         while self.accept_op(","):
             privs.append(self._parse_priv_name())
@@ -1514,8 +1579,17 @@ class Parser:
         self.expect_kw("to")
         return ast.GrantStmt(privs, level, self._parse_user_name())
 
-    def _parse_revoke(self) -> ast.RevokeStmt:
+    def _parse_revoke(self) -> "ast.Stmt":
         self.expect_kw("revoke")
+        if self._role_form_ahead():
+            roles = [self._parse_user_name()]
+            while self.accept_op(","):
+                roles.append(self._parse_user_name())
+            self.expect_kw("from")
+            users = [self._parse_user_name()]
+            while self.accept_op(","):
+                users.append(self._parse_user_name())
+            return ast.RevokeRoleStmt(roles, users)
         privs = [self._parse_priv_name()]
         while self.accept_op(","):
             privs.append(self._parse_priv_name())

@@ -83,6 +83,10 @@ class MySQLServer:
                     "28000"))
                 return
             sess.user = account
+            # default roles activate at login (MySQL activate_all_roles
+            # off: only the DEFAULT set)
+            sess.active_roles = sorted(
+                self.domain.priv.default_roles(account))
             if hs["db"]:
                 try:
                     sess.execute(f"use {hs['db']}")

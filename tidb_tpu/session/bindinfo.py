@@ -126,7 +126,9 @@ def handle(session, sql: str):
         raise ExecutorError(
             "can not execute write statement when 'tidb_snapshot' is set")
     if is_global:
-        session.domain.priv.require(session.user, "super")
+        session.domain.priv.require(
+            session.user, "super",
+            roles=tuple(getattr(session, "active_roles", ())))
     tail = sql[m.end():].strip().rstrip(";")
     if verb == "create":
         orig, hinted = _split_for_using(tail)
@@ -231,7 +233,9 @@ def maybe_capture(session, sql: str, stmt, phys) -> None:
         return
     if session._snapshot_ts is not None:
         return
-    if not session.domain.priv.check(session.user, "super"):
+    if not session.domain.priv.check(
+            session.user, "super",
+            roles=tuple(getattr(session, "active_roles", ()))):
         return
     digest = sql_digest(sql)
     if digest in _store(session, False):

@@ -7,6 +7,7 @@ IS the schema authority (no lease/reload loop needed), globals are a dict.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, Optional
 
@@ -56,6 +57,14 @@ class Domain:
         self.catalog.on_table_dropped = self.stats.drop
         self.global_vars: Dict[str, str] = {}
         self._mu = threading.RLock()
+        # ring buffer of recent log records -> information_schema.
+        # cluster_log (executor/cluster_reader.go memtable role); ONE
+        # process-wide handler — re-pointed at the newest Domain's ring so
+        # discarded domains don't accumulate handlers or leak deques
+        import collections
+
+        self.log_ring = collections.deque(maxlen=512)
+        _attach_log_ring(self.log_ring)
         self._conn_counter = 0
         self.sessions: Dict[int, object] = {}  # conn_id -> Session (weak-ish)
         self.digest_summary = {}  # digest -> per-statement-shape aggregates
@@ -193,3 +202,31 @@ class Domain:
                 self.slow_queries.append((sql, dur_s))
                 if len(self.slow_queries) > 100:
                     self.slow_queries = self.slow_queries[-50:]
+
+
+class _RingLogHandler(logging.Handler):
+    """Process-wide singleton handler feeding the newest Domain's ring."""
+
+    def __init__(self):
+        super().__init__()
+        self.ring = None
+
+    def emit(self, record):
+        ring = self.ring
+        if ring is None:
+            return
+        try:
+            ring.append((record.created, record.levelname,
+                         record.name, record.getMessage()[:400]))
+        except Exception:  # noqa: BLE001 - logging must never raise
+            pass
+
+
+_RING_HANDLER = _RingLogHandler()
+
+
+def _attach_log_ring(ring):
+    logger = logging.getLogger("tidb_tpu")
+    if _RING_HANDLER not in logger.handlers:
+        logger.addHandler(_RING_HANDLER)
+    _RING_HANDLER.ring = ring

@@ -81,9 +81,10 @@ class PrivManager:
             self.users["root@%"]["global"].add("all")
 
     @staticmethod
-    def _new_user(password: str) -> dict:
+    def _new_user(password: str, is_role: bool = False) -> dict:
         return {"password": _stage2(password), "global": set(),
-                "dbs": {}, "tables": {}}
+                "dbs": {}, "tables": {}, "roles": set(),
+                "default_roles": set(), "is_role": is_role}
 
     # ---- persistence (mysql.* system tables analog) -------------------
     def _path(self) -> Optional[str]:
@@ -103,6 +104,9 @@ class PrivManager:
                 "dbs": {d: sorted(v) for d, v in u["dbs"].items()},
                 "tables": {f"{d} {t}": sorted(v)
                            for (d, t), v in u["tables"].items()},
+                "roles": sorted(u.get("roles", ())),
+                "default_roles": sorted(u.get("default_roles", ())),
+                "is_role": bool(u.get("is_role")),
             }
         tmp = p + ".tmp"
         with open(tmp, "w") as f:
@@ -122,6 +126,9 @@ class PrivManager:
                 "dbs": {d: set(v) for d, v in u["dbs"].items()},
                 "tables": {tuple(key.split(" ", 1)): set(v)
                            for key, v in u["tables"].items()},
+                "roles": set(u.get("roles", ())),
+                "default_roles": set(u.get("default_roles", ())),
+                "is_role": bool(u.get("is_role")),
             }
 
     # ---- user management ----------------------------------------------
@@ -145,6 +152,12 @@ class PrivManager:
             if key not in self.users and not if_exists:
                 raise KVError(f"user {user!r} does not exist")
             self.users.pop(key, None)
+            # a dropped account (user OR role) must not linger in other
+            # accounts' role lists: a later CREATE ROLE under the same
+            # name would silently re-attach
+            for other in self.users.values():
+                other.get("roles", set()).discard(key)
+                other.get("default_roles", set()).discard(key)
             self._save()
 
     def set_password(self, user: str, password: str):
@@ -155,6 +168,83 @@ class PrivManager:
                 raise KVError(f"user {user!r} does not exist")
             u["password"] = _stage2(password)
             self._save()
+
+    # ---- roles (MySQL 8 roles; executor/simple.go SET ROLE family) -----
+    def create_role(self, role: str, if_not_exists: bool):
+        key = _norm_user(role)
+        with self._mu:
+            if key in self.users:
+                if if_not_exists:
+                    return
+                raise KVError(f"role {role!r} exists")
+            self.users[key] = self._new_user("", is_role=True)
+            self._save()
+
+    def drop_role(self, role: str, if_exists: bool):
+        key = _norm_user(role)
+        with self._mu:
+            u = self.users.get(key)
+            if u is None or not u.get("is_role"):
+                if if_exists:
+                    return
+                raise KVError(f"role {role!r} does not exist")
+            del self.users[key]
+            for other in self.users.values():
+                other.get("roles", set()).discard(key)
+                other.get("default_roles", set()).discard(key)
+            self._save()
+
+    def grant_role(self, roles: List[str], user: str):
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            if u is None:
+                raise KVError(f"user {user!r} does not exist")
+            for r in roles:
+                rk = _norm_user(r)
+                ru = self.users.get(rk)
+                if ru is None or not ru.get("is_role"):
+                    raise KVError(f"role {r!r} does not exist")
+                u.setdefault("roles", set()).add(rk)
+            self._save()
+
+    def revoke_role(self, roles: List[str], user: str):
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            if u is None:
+                raise KVError(f"user {user!r} does not exist")
+            for r in roles:
+                u.get("roles", set()).discard(_norm_user(r))
+                u.get("default_roles", set()).discard(_norm_user(r))
+            self._save()
+
+    def set_default_roles(self, user: str, roles) -> None:
+        """roles: iterable of names, or the strings 'all'/'none'."""
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            if u is None:
+                raise KVError(f"user {user!r} does not exist")
+            if roles == "all":
+                u["default_roles"] = set(u.get("roles", ()))
+            elif roles == "none":
+                u["default_roles"] = set()
+            else:
+                want = {_norm_user(r) for r in roles}
+                missing = want - u.get("roles", set())
+                if missing:
+                    raise KVError(
+                        f"role(s) {sorted(missing)} not granted to {user}")
+                u["default_roles"] = want
+            self._save()
+
+    def granted_roles(self, user: str) -> Set[str]:
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            return set(u.get("roles", ())) if u else set()
+
+    def default_roles(self, user: str) -> Set[str]:
+        with self._mu:
+            u = self.users.get(_norm_user(user))
+            return set(u.get("default_roles", ())) if u else set()
 
     def grant(self, user: str, privs: List[str], level: str):
         key = _norm_user(user)
@@ -202,7 +292,9 @@ class PrivManager:
         more (privilege/privileges/cache.go connectionVerification)."""
         with self._mu:
             cands = []
-            for key in self.users:
+            for key, u in self.users.items():
+                if u.get("is_role"):
+                    continue  # MySQL roles are created LOCKED: no login
                 uname, _, pat = key.rpartition("@")
                 if uname == name and _host_matches(pat, host):
                     cands.append((key, pat))
@@ -237,8 +329,17 @@ class PrivManager:
         return key if hashlib.sha1(stage1).digest() == stage2 else None
 
     def check(self, user: str, priv: str, db: Optional[str] = None,
-              table: Optional[str] = None) -> bool:
-        u = self.users.get(_norm_user(user))
+              table: Optional[str] = None, roles=()) -> bool:
+        """True when the user holds `priv` directly OR through any of the
+        session's ACTIVE roles (privilege merge,
+        privileges/cache.go RequestVerification with activeRoles)."""
+        if self._check_one(_norm_user(user), priv, db, table):
+            return True
+        return any(self._check_one(_norm_user(r), priv, db, table)
+                   for r in roles)
+
+    def _check_one(self, key: str, priv: str, db, table) -> bool:
+        u = self.users.get(key)
         if u is None:
             return False
         priv = priv.lower()
@@ -257,8 +358,8 @@ class PrivManager:
         return False
 
     def require(self, user: str, priv: str, db: Optional[str] = None,
-                table: Optional[str] = None):
-        if not self.check(user, priv, db, table):
+                table: Optional[str] = None, roles=()):
+        if not self.check(user, priv, db, table, roles=roles):
             target = f"{db}.{table}" if table else (db or "*")
             raise PrivilegeError(priv.upper(), user, target)
 
@@ -344,11 +445,13 @@ def _walk_tables(node, out: List[ast.TableName]):
 
 
 def check_stmt(session, s) -> None:
-    """Raise PrivilegeError unless session.user may run statement `s`.
-    root (ALL at global scope) short-circuits — the common in-process
-    path costs one dict lookup."""
+    """Raise PrivilegeError unless session.user may run statement `s`
+    (directly or through the session's ACTIVE roles).  root (ALL at
+    global scope) short-circuits — the common in-process path costs one
+    dict lookup."""
     pm = session.domain.priv
     user = session.user
+    roles = tuple(getattr(session, "active_roles", ()))
     u = pm.users.get(_norm_user(user))
     if u is not None and "all" in u["global"]:
         return
@@ -363,7 +466,8 @@ def check_stmt(session, s) -> None:
     if isinstance(s, (ast.SelectStmt, ast.UnionStmt, ast.ExplainStmt,
                       ast.TraceStmt)):
         for tn in tables_of(s):
-            pm.require(user, "select", db_of(tn), tn.name.lower())
+            pm.require(user, "select", db_of(tn), tn.name.lower(),
+                       roles=roles)
         return
     if isinstance(s, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
                       ast.LoadDataStmt)):
@@ -371,42 +475,52 @@ def check_stmt(session, s) -> None:
                 ast.DeleteStmt: "delete", ast.LoadDataStmt: "insert"}[
                     type(s)]
         target = s.table
-        pm.require(user, need, db_of(target), target.name.lower())
+        pm.require(user, need, db_of(target), target.name.lower(),
+                   roles=roles)
         for tn in tables_of(s):
             if tn is target:
                 continue
-            pm.require(user, "select", db_of(tn), tn.name.lower())
+            pm.require(user, "select", db_of(tn), tn.name.lower(),
+                       roles=roles)
         return
     if isinstance(s, ast.CreateTableStmt):
-        pm.require(user, "create", db_of(s.table))
+        pm.require(user, "create", db_of(s.table), roles=roles)
         return
     if isinstance(s, ast.CreateViewStmt):
-        pm.require(user, "create view", db_of(s.name))
+        pm.require(user, "create view", db_of(s.name), roles=roles)
         return
     if isinstance(s, (ast.DropTableStmt, ast.TruncateTableStmt)):
         tns = s.tables if isinstance(s, ast.DropTableStmt) else [s.table]
         for tn in tns:
-            pm.require(user, "drop", db_of(tn))
+            pm.require(user, "drop", db_of(tn), roles=roles)
         return
     if isinstance(s, (ast.AlterTableStmt, ast.RenameTableStmt)):
         tn = s.table if isinstance(s, ast.AlterTableStmt) else s.old
-        pm.require(user, "alter", db_of(tn))
+        pm.require(user, "alter", db_of(tn), roles=roles)
         return
     if isinstance(s, (ast.CreateIndexStmt, ast.DropIndexStmt)):
-        pm.require(user, "index", db_of(s.table))
+        pm.require(user, "index", db_of(s.table), roles=roles)
         return
     if isinstance(s, ast.RecoverTableStmt):
-        pm.require(user, "create", db_of(s.table))
+        pm.require(user, "create", db_of(s.table), roles=roles)
         return
     if isinstance(s, ast.CreateDatabaseStmt):
-        pm.require(user, "create", s.name.lower())
+        pm.require(user, "create", s.name.lower(), roles=roles)
         return
     if isinstance(s, ast.DropDatabaseStmt):
-        pm.require(user, "drop", s.name.lower())
+        pm.require(user, "drop", s.name.lower(), roles=roles)
         return
     if isinstance(s, (ast.CreateUserStmt, ast.DropUserStmt,
-                      ast.SetPasswordStmt)):
-        pm.require(user, "create user")
+                      ast.SetPasswordStmt, ast.CreateRoleStmt,
+                      ast.DropRoleStmt, ast.GrantRoleStmt,
+                      ast.RevokeRoleStmt)):
+        pm.require(user, "create user", roles=roles)
+        return
+    if isinstance(s, ast.SetRoleStmt):
+        return  # activating roles granted to yourself
+    if isinstance(s, ast.SetDefaultRoleStmt):
+        if any(_norm_user(u2) != _norm_user(user) for u2 in s.users):
+            pm.require(user, "create user", roles=roles)
         return
     if isinstance(s, (ast.GrantStmt, ast.RevokeStmt)):
         # MySQL (executor/grant.go): the granter must hold GRANT OPTION at
@@ -414,7 +528,7 @@ def check_stmt(session, s) -> None:
         # CREATE USER alone authorizes user management, not grants —
         # otherwise a user-admin could GRANT ALL to themselves.
         db, table = _parse_level(s.level)
-        pm.require(user, "grant option", db, table)
+        pm.require(user, "grant option", db, table, roles=roles)
         # ALL expands to the privileges that EXIST at the statement's
         # scope: db/table-level ALL comprises only DML+DDL privileges
         # (MySQL has no db-scoped SUPER/PROCESS/CREATE USER to demand)
@@ -423,17 +537,17 @@ def check_stmt(session, s) -> None:
         for p in s.privs:
             needed = sorted(scope_all) if p.lower() == "all" else [p]
             for q in needed:
-                pm.require(user, q, db, table)
+                pm.require(user, q, db, table, roles=roles)
         return
     if isinstance(s, (ast.KillStmt, ast.AdminStmt, ast.SplitRegionStmt,
                       ast.DropStatsStmt, ast.RepairTableStmt)):
-        pm.require(user, "super")
+        pm.require(user, "super", roles=roles)
         return
     if isinstance(s, ast.ShowStmt) and s.kind == "grants" and s.target:
         from .session import Session  # typing only; avoid cycle at import
 
         if _norm_user(s.target) != _norm_user(user):
-            pm.require(user, "create user")  # enumerate others: admin-only
+            pm.require(user, "create user", roles=roles)  # enumerate others: admin-only
         return
     # SET / SHOW / USE / txn control / PREPARE-EXECUTE: unrestricted
     # (EXECUTE re-enters check_stmt with the underlying statement)
@@ -452,6 +566,37 @@ def handle(session, s):
         pm.grant(s.user, s.privs, s.level)
     elif isinstance(s, ast.RevokeStmt):
         pm.revoke(s.user, s.privs, s.level)
+    elif isinstance(s, ast.CreateRoleStmt):
+        for r in s.roles:
+            pm.create_role(r, s.if_not_exists)
+    elif isinstance(s, ast.DropRoleStmt):
+        for r in s.roles:
+            pm.drop_role(r, s.if_exists)
+    elif isinstance(s, ast.GrantRoleStmt):
+        for u in s.users:
+            pm.grant_role(s.roles, u)
+    elif isinstance(s, ast.RevokeRoleStmt):
+        for u in s.users:
+            pm.revoke_role(s.roles, u)
+    elif isinstance(s, ast.SetRoleStmt):
+        granted = pm.granted_roles(session.user)
+        if s.mode == "none":
+            session.active_roles = []
+        elif s.mode == "all":
+            session.active_roles = sorted(granted)
+        elif s.mode == "default":
+            session.active_roles = sorted(pm.default_roles(session.user))
+        else:
+            want = [_norm_user(r) for r in s.roles]
+            missing = [r for r in want if r not in granted]
+            if missing:
+                raise KVError(f"role(s) {missing} not granted to "
+                              f"{session.user}")
+            session.active_roles = sorted(want)
+    elif isinstance(s, ast.SetDefaultRoleStmt):
+        target = (s.mode if s.mode in ("all", "none") else s.roles)
+        for u in s.users:
+            pm.set_default_roles(u, target)
     elif isinstance(s, ast.FlushStmt):
         pass
     from .session import ResultSet

@@ -111,6 +111,7 @@ class Session:
         # authenticated identity; in-process sessions are trusted as root,
         # the wire server overwrites this after the auth handshake
         self.user = "root@%"
+        self.active_roles: List[str] = []  # SET ROLE state (MySQL roles)
         self._snapshot_ts = None  # SET tidb_snapshot historical-read TSO
         self._snapshot_pin = None  # storage pin token holding GC/compaction
         self._txn = None  # explicit txn (BEGIN..COMMIT)
@@ -304,7 +305,10 @@ class Session:
             return self._run_admin(s)
         if isinstance(s, (ast.GrantStmt, ast.RevokeStmt, ast.CreateUserStmt,
                           ast.DropUserStmt, ast.SetPasswordStmt,
-                          ast.FlushStmt)):
+                          ast.FlushStmt, ast.CreateRoleStmt,
+                          ast.DropRoleStmt, ast.GrantRoleStmt,
+                          ast.RevokeRoleStmt, ast.SetRoleStmt,
+                          ast.SetDefaultRoleStmt)):
             from . import priv
 
             return priv.handle(self, s)
