@@ -389,6 +389,11 @@ class PrivManager:
             if privs:
                 out.append(f"GRANT {_fmt(privs)} ON `{db}`.`{t}` "
                            f"TO {ident}")
+        roles = sorted(u.get("roles", ()))
+        if roles:
+            rid = ", ".join(
+                "`{}`@`{}`".format(*r.rsplit("@", 1)) for r in roles)
+            out.append(f"GRANT {rid} TO {ident}")
         return out
 
 
