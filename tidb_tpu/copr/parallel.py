@@ -670,6 +670,31 @@ class MeshAggOverflow(Exception):
     the caller falls back to the host hash aggregation."""
 
 
+def _fd_sort_lookup(an: _Analyzed):
+    """True when the single unique-key lookup FUNCTIONALLY DETERMINES
+    every group key (the TPC-H Q3 shape: GROUP BY join_key, payload...):
+    the matched build-row index then serves as the one sort key, so the
+    per-shard sort is a single int argsort instead of a lexsort over
+    every key column + null flag."""
+    import json as _json
+
+    if len(an.lookups) != 1 or an.probes or an.agg is None:
+        return False
+    lk = an.lookups[0]
+    key_ser = _json.dumps(serialize_expr(lk.key), sort_keys=True)
+    width = len(an.scan.columns)
+    lo, hi = width, width + len(lk.payload_ftypes)
+    from ..expr.expression import ColumnExpr
+
+    for g in an.agg.group_by:
+        if isinstance(g, ColumnExpr) and lo <= g.index < hi:
+            continue  # payload column: fixed per matched build row
+        if _json.dumps(serialize_expr(g), sort_keys=True) == key_ser:
+            continue  # the join key itself (unique per build row)
+        return False
+    return True
+
+
 def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
                        tiles_per_shard: int):
     """Sort-based per-shard partial aggregation for arbitrary group keys
@@ -690,6 +715,7 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
     n_global = S * n_local
     OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
     agg_ir = an.agg
+    fd_lookup = _fd_sort_lookup(an)
 
     tags = []
     for a in agg_ir.aggs:
@@ -724,17 +750,35 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
             zero = jnp.float64(0.0) if k.dtype == jnp.float64 else jnp.int64(0)
             key_bits.append(jnp.where(v, k, zero))
             key_flags.append(v.astype(jnp.int64))
-        # lexsort: LAST key is primary -> selected rows first, grouped by key
-        order = jnp.lexsort(
-            tuple(key_bits + key_flags + [(~m).astype(jnp.int64)])
-        )
-        sm = m[order]
-        sgofs = gofs[order]
-        skeys = [k[order] for k in key_bits + key_flags]
         ar = jnp.arange(n_local, dtype=jnp.int64)
-        diff = ar == 0
-        for k in skeys:
-            diff = diff | (k != jnp.roll(k, 1))
+        if fd_lookup:
+            # every group key is determined by the matched build row: one
+            # int argsort on the build-row index replaces the full lexsort
+            # (XLA CSE folds this searchsorted into _apply_probes' one)
+            lk = an.lookups[0]
+            bkeys = pargs[2 * len(an.probes)]
+            dk, _vk = compile_expr(lk.key, cols, n_local)
+            posk = jnp.clip(jnp.searchsorted(bkeys, dk.astype(jnp.int64)),
+                            0, bkeys.shape[0] - 1)
+            sortk = jnp.where(m, posk, bkeys.shape[0])  # unselected last
+            order = jnp.argsort(sortk)
+            ssort = sortk[order]
+            diff = (ar == 0) | (ssort != jnp.roll(ssort, 1))
+            sm = m[order]
+            sgofs = gofs[order]
+            skeys = [k[order] for k in key_bits + key_flags]
+        else:
+            # lexsort: LAST key is primary -> selected rows first, grouped
+            # by key
+            order = jnp.lexsort(
+                tuple(key_bits + key_flags + [(~m).astype(jnp.int64)])
+            )
+            sm = m[order]
+            sgofs = gofs[order]
+            skeys = [k[order] for k in key_bits + key_flags]
+            diff = ar == 0
+            for k in skeys:
+                diff = diff | (k != jnp.roll(k, 1))
         boundary = sm & diff
         n_uniq = boundary.sum().astype(jnp.int64)
         seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0, OUT - 1)
