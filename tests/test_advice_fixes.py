@@ -323,3 +323,152 @@ def test_db_scope_grant_all_needs_only_db_privs(sess):
     adm.user = "dbadmin@%"
     adm.execute("grant all on test.* to app")
     assert d.priv.check("app", "select", "test", "t")
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor findings (shipped with the tidb_tpu.lint PR)
+# ---------------------------------------------------------------------------
+
+
+def test_rename_table_keeps_own_foreign_keys(sess):
+    """ADVICE r5 medium: rename_table rebuilt TableInfo without
+    foreign_keys, silently dropping the renamed table's OWN FK metadata
+    (only OTHER tables' references were rewritten)."""
+    sess.execute("create table parent (id bigint primary key)")
+    sess.execute("create table child (id bigint primary key, pid bigint,"
+                 " constraint fk_p foreign key (pid)"
+                 " references parent (id))")
+    sess.execute("rename table child to child2")
+    t = sess.domain.catalog.info_schema().table("test", "child2")
+    assert [fk["name"] for fk in t.foreign_keys] == ["fk_p"]
+    sc = sess.query("show create table child2")[0][1]
+    assert "FOREIGN KEY" in sc and "fk_p" in sc
+
+
+def test_rehash_partitions_racing_commit_survives(sess, monkeypatch):
+    """ADVICE r5 medium: _rehash_partitions took the fold TSO BEFORE
+    detaching the old stores; a commit landing in that window got
+    commit_ts > ts and compact(ts) silently discarded the row.  The TSO
+    is now taken after all stores are detached, so a commit that beat
+    the detach is folded in (and one that didn't aborts loudly)."""
+    d = sess.domain
+    sess.execute("create table hp (k bigint, v bigint)"
+                 " partition by hash(k) partitions 4")
+    sess.execute("insert into hp values "
+                 + ", ".join(f"({i}, {i})" for i in range(40)))
+    s2 = d.new_session()
+    orig = d.storage.detach_table
+    fired = []
+
+    def detach_hook(pid):
+        if not fired:
+            fired.append(pid)
+            # the racing commit: lands after any fold-TSO taken before
+            # detach, but before any store is actually detached
+            s2.execute("insert into hp values (777, 777)")
+        return orig(pid)
+
+    monkeypatch.setattr(d.storage, "detach_table", detach_hook)
+    sess.execute("alter table hp coalesce partition 1")
+    assert fired, "detach hook never fired — rehash path changed?"
+    assert sess.query("select k, v from hp where k = 777") == [(777, 777)]
+    assert sess.query("select count(*) from hp") == [(41,)]
+
+
+def test_binding_recapture_after_drop(sess):
+    """ADVICE r5 low: the domain-wide _capture_seen counter captured only
+    on EXACTLY the second sighting, so a dropped captured binding could
+    never be recaptured (the count kept growing past 2)."""
+    s = sess
+    s.execute("create table cb1 (id bigint)")
+    s.execute("create table cb2 (id bigint)")
+    s.execute("insert into cb1 values (1), (2), (3)")
+    s.execute("insert into cb2 values (1), (2)")
+    s.execute("set tidb_capture_plan_baselines = 1")
+    q = "select count(*) from cb1 join cb2 on cb1.id = cb2.id"
+    try:
+        s.query(q)
+        assert s.query("show global bindings") == []
+        s.query(q)  # second sighting -> captured
+        assert len(s.query("show global bindings")) == 1
+        s.execute("drop global binding for " + q)
+        assert s.query("show global bindings") == []
+        s.query(q)
+        s.query(q)  # two fresh sightings -> recaptured
+        assert len(s.query("show global bindings")) == 1
+    finally:
+        s.execute("set tidb_capture_plan_baselines = 0")
+        s.execute("drop global binding for " + q)
+
+
+def test_checksum_delete_and_overlay_aware(sess):
+    """The vectorized ADMIN CHECKSUM must still see the delta overlay:
+    deletes shrink kvs, uncompacted inserts count, content changes the
+    crc (the old per-row repr() loop is now tests/test_lint.py's
+    canonical row-loop lint specimen)."""
+    d = sess.domain
+    sess.execute("create table ckv (a bigint, b varchar(8), c double)")
+    sess.execute("insert into ckv values (1, 'x', 1.5), (2, 'y', 2.5),"
+                 " (3, null, 3.5)")
+    d.storage.maybe_compact(
+        d.catalog.info_schema().table("test", "ckv").id, threshold=0)
+    _, _, crc0, kvs0, _ = sess.execute("admin checksum table ckv")[0].rows[0]
+    assert kvs0 == 3
+    sess.execute("delete from ckv where a = 2")       # delta delete
+    sess.execute("insert into ckv values (4, 'z', 4.5)")  # delta insert
+    _, _, crc1, kvs1, nb1 = sess.execute("admin checksum table ckv")[0].rows[0]
+    assert kvs1 == 3 and crc1 != crc0 and nb1 > 0
+    # NULL flip changes the checksum even when the fill bytes match
+    sess.execute("update ckv set b = '' where a = 3")
+    crc2 = sess.execute("admin checksum table ckv")[0].rows[0][2]
+    assert crc2 != crc1
+
+
+def test_checksum_invariant_to_compaction_state(sess):
+    """Identical VISIBLE content must checksum identically whether the
+    deletes are a delta overlay over base rows or already physically
+    compacted away — a replica mid-compaction must not report a false
+    mismatch.  In particular an all-rows-deleted store contributes 0."""
+    d = sess.domain
+    sess.execute("create table ckc (a bigint, b varchar(8))")
+    tid = d.catalog.info_schema().table("test", "ckc").id
+    sess.execute("insert into ckc values (1, 'x'), (2, 'y')")
+    d.storage.maybe_compact(tid, threshold=0)
+    sess.execute("delete from ckc")
+    deleted_overlay = sess.execute("admin checksum table ckc")[0].rows[0][2:]
+    d.storage.maybe_compact(tid, threshold=0)   # deletes fold into base
+    deleted_folded = sess.execute("admin checksum table ckc")[0].rows[0][2:]
+    assert deleted_overlay == deleted_folded == (0, 0, 0)
+    # same with surviving rows: overlay-deleted vs compacted must agree
+    sess.execute("insert into ckc values (1, 'x'), (2, 'y'), (3, 'z')")
+    d.storage.maybe_compact(tid, threshold=0)
+    sess.execute("delete from ckc where a = 2")
+    overlay = sess.execute("admin checksum table ckc")[0].rows[0][2:]
+    d.storage.maybe_compact(tid, threshold=0)
+    folded = sess.execute("admin checksum table ckc")[0].rows[0][2:]
+    assert overlay == folded and overlay[1] == 2
+
+
+def test_pushed_cond_uids_survive_projection_elimination(sess):
+    """Planner bug found BY the new plan checker: eliminate_projections
+    relabeled a datasource's schema uids but left pushed_conds pointing
+    at the old ones, so the cop Selection read column #-1 (Python
+    negative indexing -> the LAST scan column) — wrong rows on any
+    multi-column scan under an eliminated identity projection."""
+    sess.execute("create table pe (a bigint, b bigint)")
+    sess.execute("insert into pe values (1, 5), (9, 1), (2, 5)")
+    sess.domain.storage.maybe_compact(
+        sess.domain.catalog.info_schema().table("test", "pe").id,
+        threshold=0)
+    assert sorted(sess.query(
+        "select a from pe where a = 1"
+        " union all select b from pe where b = 5")) == [(1,), (5,), (5,)]
+    assert sess.query(
+        "select a from (select a, b from pe) x where a = 1") == [(1,)]
+    # and with the build-time checker off, results are still right
+    sess.execute("set tidb_check_plan = 0")
+    try:
+        assert sess.query(
+            "select a from (select a, b from pe) x where a = 1") == [(1,)]
+    finally:
+        sess.execute("set tidb_check_plan = 1")

@@ -16,6 +16,7 @@ Reference mapping:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 import time
@@ -414,9 +415,11 @@ class Catalog:
             if new.lower() in d.tables:
                 raise TableExistsError(f"{db}.{new}")
             del d.tables[old.lower()]
-            t2 = TableInfo(t.id, new, t.columns, t.indexes, t.pk_is_handle,
-                           t.auto_inc_id, t.comment, t.is_view,
-                           t.view_select, t.partition_info)
+            # dataclasses.replace copies EVERY field: a positional
+            # constructor copy here silently reset foreign_keys (round-5
+            # ADVICE) and would reset any field added to TableInfo later
+            t2 = dataclasses.replace(t, name=new,
+                                     foreign_keys=list(t.foreign_keys))
             d.tables[new.lower()] = t2
             self._rewrite_referencing_fks(db, old, new_table=new)
             self._bump()
@@ -1159,10 +1162,16 @@ class Catalog:
         from .schema import PartitionDef, PartitionInfo
 
         pi = t.partition_info
-        ts = self.storage.current_ts()
         off = t.find_column(pi.column).offset
         n_cols = len(t.storage_columns())
         old = {pd.id: self.storage.detach_table(pd.id) for pd in pi.defs}
+        # The fold TSO is taken AFTER every store is detached: a commit
+        # racing the rebuild either finished before its store detached
+        # (commit_ts < ts — folded below) or hits a detached store and
+        # aborts.  Taken earlier, a commit landing between the ts capture
+        # and detach would get commit_ts > ts and compact(ts) would
+        # silently discard it (round-5 ADVICE).
+        ts = self.storage.current_ts()
         parts_data = []
         try:
             for pd in pi.defs:

@@ -38,6 +38,13 @@ class Expression:
     def remap_columns(self, mapping: dict) -> "Expression":
         raise NotImplementedError
 
+    def remap_uids(self, uid_map: dict) -> "Expression":
+        """Rewrite ColumnExpr unique_ids through uid_map (identity-
+        projection elimination relabels a schema to new uids; expressions
+        that referenced the old ones must follow).  Base raises so a new
+        Expression subclass cannot silently keep stale uids."""
+        raise NotImplementedError
+
     def is_constant(self) -> bool:
         return all(c.is_constant() for c in self.children()) and bool(self.children())
 
@@ -61,6 +68,12 @@ class ColumnExpr(Expression):
             return ColumnExpr(mapping[key], self.ftype, self.name, self.unique_id)
         return self
 
+    def remap_uids(self, uid_map: dict) -> "Expression":
+        if self.unique_id in uid_map:
+            return ColumnExpr(self.index, self.ftype, self.name,
+                              uid_map[self.unique_id])
+        return self
+
     def is_constant(self) -> bool:
         return False
 
@@ -78,6 +91,9 @@ class Constant(Expression):
         return Vec.from_column(Column.constant(self.ftype, self.value, n))
 
     def remap_columns(self, mapping: dict) -> "Expression":
+        return self
+
+    def remap_uids(self, uid_map: dict) -> "Expression":
         return self
 
     def is_constant(self) -> bool:
@@ -113,6 +129,11 @@ class ScalarFunc(Expression):
             self.ftype,
             self.meta,
         )
+
+    def remap_uids(self, uid_map: dict) -> "Expression":
+        return ScalarFunc(self.name,
+                          [a.remap_uids(uid_map) for a in self.args],
+                          self.ftype, self.meta)
 
     def __str__(self):
         if self.name in ("+", "-", "*", "/", "=", "!=", "<", "<=", ">", ">=",

@@ -1,0 +1,260 @@
+"""Kernel-contract checker: abstract-trace every registered copr kernel.
+
+DrJAX's observation (PAPERS.md) applies directly: shape/dtype/sharding
+contracts of jitted programs are verifiable by abstract tracing, no TPU
+required.  For every canonical device-DAG shape this repro registers
+(dense agg / scalar agg / filter+projection / topn — the per-tile kernels
+`jax_engine._build_tile_fn` compiles), this pass:
+
+1. traces the kernel with `jax.make_jaxpr` on canonical TILE-shaped
+   inputs (the exact dtypes `_gather_tile` feeds it) — any shape or
+   dtype inconsistency fails the trace and fails the lint;
+2. counts jaxpr equations whose outputs are int64 — growth vs the
+   checked-in baseline means an int64-emulation chain crept back into a
+   kernel (VERDICT.md names the int64-emulated VPU sum chain as the Q1
+   bottleneck: TPUs have no native int64, XLA emulates it pairwise);
+3. runs the canonical query corpus end-to-end twice through the real
+   engines and fails on distinct-jit-signature growth between the runs —
+   the recompile-bomb guard (a query re-run must never compile anything
+   new), plus a cap on the corpus' total signature count vs baseline.
+
+Everything runs under JAX_PLATFORMS=cpu; CI keeps this signal through
+device-tunnel outages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import Finding
+
+#: queries whose cop DAGs define the registered kernel corpus; keep shapes
+#: covering every `_build_tile_fn` kind plus the mesh lookup-join program.
+CANONICAL_KERNEL_QUERIES = [
+    ("q1-dense-agg",
+     "select l_returnflag, l_linestatus, sum(l_quantity),"
+     " sum(l_extendedprice * (1 - l_discount)), avg(l_discount), count(*)"
+     " from lineitem where l_shipdate <= '1998-09-02'"
+     " group by l_returnflag, l_linestatus"),
+    ("q6-scalar-agg",
+     "select sum(l_extendedprice * l_discount) from lineitem"
+     " where l_discount between 0.05 and 0.07 and l_quantity < 24"),
+    ("filter-project",
+     "select l_orderkey, l_extendedprice * (1 - l_discount) from lineitem"
+     " where l_quantity < 10"),
+    ("topn",
+     "select l_orderkey from lineitem order by l_extendedprice desc"
+     " limit 5"),
+    ("minmax-agg",
+     "select l_returnflag, min(l_quantity), max(l_extendedprice)"
+     " from lineitem group by l_returnflag"),
+]
+
+
+def _iter_eqns(jaxpr):
+    """All equations including nested call/pjit sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                yield from _iter_eqns(sub)
+
+
+def _jaxpr_stats(closed) -> Dict[str, int]:
+    eqns = list(_iter_eqns(closed.jaxpr))
+    i64 = 0
+    for e in eqns:
+        for ov in e.outvars:
+            if getattr(getattr(ov, "aval", None), "dtype", None) is not None \
+                    and str(ov.aval.dtype) == "int64":
+                i64 += 1
+                break
+    return {"eqns": len(eqns), "i64_eqns": i64}
+
+
+def _reader_dags(phys):
+    """Every cop DAG reachable from a physical plan (readers may hide
+    under DeviceJoinReader/DML wrappers)."""
+    out = []
+    seen = set()
+
+    def walk(p):
+        if id(p) in seen or p is None:
+            return
+        seen.add(id(p))
+        dag = getattr(p, "dag", None)
+        if dag is not None:
+            out.append((p, dag))
+        for c in getattr(p, "children", ()) or ():
+            walk(c)
+        for attr in ("reader", "build_plan", "select_phys"):
+            walk(getattr(p, attr, None))
+
+    walk(phys)
+    return out
+
+
+def canonical_inputs(table, an, col_order):
+    """TILE-shaped inputs with the exact dtypes `_gather_tile` feeds the
+    kernel (DATE/STRING as int32 codes, FLOAT as f64, else i64)."""
+    from ..copr.jax_engine import TILE
+    from ..types import TypeKind
+
+    datas, valids = [], []
+    for ci in col_order:
+        meta = table.cols[an.scan.columns[ci]]
+        k = meta.ftype.kind
+        dt = np.int32 if k in (TypeKind.DATE, TypeKind.STRING) else (
+            np.float64 if k == TypeKind.FLOAT else np.int64)
+        datas.append(np.zeros(TILE, dtype=dt))
+        valids.append(np.ones(TILE, dtype=np.bool_))
+    del_mask = np.ones(TILE, dtype=np.bool_)
+    return datas, valids, np.int64(0), np.int64(TILE), del_mask
+
+
+def trace_kernel(table, dag) -> Dict[str, int]:
+    """Abstract-trace one registered kernel; raises on contract breaks
+    (bad shapes/dtypes, out-of-range refs, non-compilable exprs)."""
+    import jax
+
+    from ..copr.ir import DAG
+    from ..copr.jax_engine import _Analyzed, _build_tile_fn
+
+    # trace the WIRE format: the engine only ever sees DAGs that crossed
+    # the distsql codec (which strips planner unique_ids); tracing the
+    # in-memory plan object would check a shape production never runs
+    dag = DAG.from_dict(dag.to_dict())
+    an = _Analyzed(dag, table)
+    kind = "agg" if an.agg is not None else (
+        "topn" if an.topn is not None else "filter")
+    col_order = an.needed_cols()
+    fn = _build_tile_fn(an, kind, col_order)
+    args = canonical_inputs(table, an, col_order)
+    if kind == "agg":
+        # the agg wrapper pairs each result with a static string tag for
+        # the host merge; strip tags so the output pytree is all-array
+        def traced(*a):
+            gcount, results = fn(*a)
+            return gcount, [v for _t, v in results]
+
+        closed = jax.make_jaxpr(traced)(*args)
+    else:
+        closed = jax.make_jaxpr(fn)(*args)
+    return _jaxpr_stats(closed)
+
+
+def _signature_census() -> Tuple[set, set]:
+    from ..copr import jax_engine as je
+    from ..copr import parallel as par
+
+    return set(je._COMPILED), set(par._COMPILED)
+
+
+def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
+                 collect_stats: Optional[Dict[str, dict]] = None
+                 ) -> List[Finding]:
+    """Trace the kernel corpus; returns findings for contract breaks,
+    int64-chain growth vs baseline, and jit-signature growth.
+
+    baseline_kernels: {kernel: {"i64_eqns": n}, "__signatures__": {...}}
+    (defaults to the checked-in baseline.json).  collect_stats, when a
+    dict, receives measured per-kernel stats (the --update-baseline path).
+    """
+    from ..parser import parse_one
+    from .baseline import load_baseline
+    from .plancheck import _canonical_session
+
+    if baseline_kernels is None:
+        baseline_kernels = load_baseline().get("kernels", {})
+    findings: List[Finding] = []
+
+    def emit(kernel: str, msg: str):
+        findings.append(Finding(
+            rule="kernel-contract", path="tidb_tpu/copr", line=0,
+            scope=kernel, token="trace", message=msg))
+
+    s = _canonical_session()
+    dom = s.domain
+    table = dom.storage.table(
+        dom.catalog.info_schema().table("test", "lineitem").id)
+
+    # -- per-kernel abstract traces -------------------------------------
+    from ..copr.jax_eval import JaxUnsupported
+
+    for name, sql in CANONICAL_KERNEL_QUERIES:
+        dags = []
+        try:
+            phys = s._plan(parse_one(sql))
+            dags = [d for _p, d in _reader_dags(phys)]
+            if not dags:
+                emit(name, "canonical query produced no cop DAG — the "
+                           "pushdown rewrite regressed")
+                continue
+            stats = None
+            for dag in dags:
+                try:
+                    stats = trace_kernel(table, dag)
+                    break
+                except JaxUnsupported:
+                    continue  # e.g. mesh-only shapes; try the next DAG
+            if stats is None:
+                emit(name, "no device-eligible kernel for canonical query "
+                           "(JaxUnsupported on every cop DAG) — device "
+                           "coverage regressed")
+                continue
+        except Exception as e:  # noqa: BLE001 — contract break
+            emit(name, f"kernel trace failed: {type(e).__name__}: {e}")
+            continue
+        if collect_stats is not None:
+            # collect mode refreshes the baseline, so comparing against
+            # the one being replaced is meaningless — contract breaks
+            # (trace failures, lost DAGs) are still emitted above
+            collect_stats[name] = stats
+            continue
+        base = baseline_kernels.get(name)
+        if base is None:
+            emit(name, f"kernel not in baseline (measured {stats}); run "
+                       "python -m tidb_tpu.lint --update-baseline")
+        elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+            emit(name,
+                 f"int64 equation count grew {base.get('i64_eqns')} -> "
+                 f"{stats['i64_eqns']}: an int64-emulation chain was "
+                 "reintroduced (TPUs emulate i64 pairwise; VERDICT.md "
+                 "names this the Q1 VPU bottleneck)")
+
+    # -- recompile-bomb guard -------------------------------------------
+    # count only signatures the corpus itself compiles: the engine caches
+    # are process-global, and other passes (or the bootstrap INSERT/
+    # ANALYZE statements) legitimately add their own entries
+    queries = [sql for _n, sql in CANONICAL_KERNEL_QUERIES]
+    je0, par0 = _signature_census()
+    for q in queries:
+        s.query(q)
+    je1, par1 = _signature_census()
+    for q in queries:
+        s.query(q)
+    je2, par2 = _signature_census()
+    grew = (je2 - je1) | (par2 - par1)
+    if grew:
+        emit("signature-growth",
+             f"re-running the canonical corpus compiled {len(grew)} NEW "
+             "jit signature(s) — a recompile bomb (fingerprint must be "
+             "stable across identical queries)")
+    n_sigs = len((je2 - je0)) + len((par2 - par0))
+    base_sigs = baseline_kernels.get("__signatures__", {}).get("max")
+    if collect_stats is not None:
+        # refreshing: the cap comparison targets the new stats
+        collect_stats["__signatures__"] = {"max": n_sigs}
+    elif base_sigs is not None and n_sigs > int(base_sigs):
+        emit("signature-growth",
+             f"canonical corpus now compiles {n_sigs} distinct jit "
+             f"signatures (baseline {base_sigs}) — new recompiles on the "
+             "hot path; justify and refresh the baseline if intended")
+    elif base_sigs is None and collect_stats is None:
+        emit("signature-growth",
+             "no __signatures__ entry in baseline; run "
+             "python -m tidb_tpu.lint --update-baseline")
+    return findings

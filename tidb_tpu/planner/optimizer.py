@@ -39,11 +39,22 @@ def finish_plan(logical, pctx: PhysicalContext) -> PhysicalPlan:
     if isinstance(logical, InsertPlan):
         if logical.select_plan is not None:
             logical.select_plan = optimize_logical(logical.select_plan, pctx)
-        return physical_for_stmt(logical, pctx)
+        return _verified(physical_for_stmt(logical, pctx), pctx)
     if isinstance(logical, (UpdatePlan, DeletePlan, LoadDataPlan)):
-        return physical_for_stmt(logical, pctx)
+        return _verified(physical_for_stmt(logical, pctx), pctx)
     assert isinstance(logical, LogicalPlan)
     logical = optimize_logical(logical, pctx)
     phys = physical_for_stmt(logical, pctx)
     annotate_estimates(phys, pctx)
+    return _verified(phys, pctx)
+
+
+def _verified(phys: PhysicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
+    """Schema/dtype-verify the finished plan (lint.plancheck) when the
+    session asks for it — the vet-for-plans pass over the OUTPUT of every
+    planner rewrite, gated on `tidb_check_plan`."""
+    if pctx.check_plan:
+        from ..lint.plancheck import assert_plan
+
+        assert_plan(phys)
     return phys

@@ -815,6 +815,9 @@ class PhysicalContext:
     prefer_merge_join: bool = False  # tidb_opt_prefer_merge_join
     enable_index_join: bool = True  # tidb_opt_enable_index_join
     index_join_variant: str = "lookup"  # tidb_index_join_variant
+    # tidb_check_plan: run the lint.plancheck schema/dtype verifier over
+    # every finished physical plan (vet-for-plans; cheap host-side walk)
+    check_plan: bool = False
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:

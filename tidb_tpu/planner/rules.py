@@ -361,12 +361,23 @@ def eliminate_projections(plan: LogicalPlan, top: bool = False) -> LogicalPlan:
             # with the projection's uids/names so parent references survive
             from dataclasses import replace
 
+            uid_map = {ccol.uid: pcol.uid for ccol, pcol in
+                       zip(child.schema.cols, plan.schema.cols)}
             child.schema = Schema([
                 replace(ccol, uid=pcol.uid, name=pcol.name,
                         display=pcol.display or ccol.display,
                         table=pcol.table or ccol.table)
                 for ccol, pcol in zip(child.schema.cols, plan.schema.cols)
             ])
+            if isinstance(child, LogicalDataSource):
+                # a datasource's pushed_conds reference its pre-relabel
+                # uids; left stale, _start_cop's scan remap misses them
+                # and the cop Selection reads col #-1 (the LAST scan
+                # column via Python negative indexing) — wrong rows on
+                # any multi-column scan.  Caught by lint.plancheck.
+                child.pushed_conds = [
+                    c.remap_uids(uid_map) for c in child.pushed_conds
+                ]
             return child
     return plan
 

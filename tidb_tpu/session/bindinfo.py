@@ -155,6 +155,10 @@ def handle(session, sql: str):
     digest = sql_digest(tail)
     store = _store(session, is_global)
     if store.pop(digest, None) is not None:
+        if is_global:
+            # a dropped captured binding must be RE-capturable: forget the
+            # sighting count so two fresh sightings trigger capture again
+            getattr(session.domain, "_capture_seen", {}).pop(digest, None)
         _bump(session, is_global)
     return ResultSet()
 
@@ -248,7 +252,11 @@ def maybe_capture(session, sql: str, stmt, phys) -> None:
         seen.clear()  # bounded, like the stmt-summary cap
     n = seen.get(digest, 0) + 1
     seen[digest] = n
-    if n != 2:  # capture exactly on the second sighting
+    # capture exactly on the second sighting; DROP BINDING resets the
+    # counter (handle() pops _capture_seen), so a dropped captured
+    # binding is recapturable by two fresh sightings without paying the
+    # hint walk on every later execution
+    if n != 2:
         return
     store = _store(session, True)
     if digest in store:

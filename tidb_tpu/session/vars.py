@@ -78,6 +78,10 @@ SYSVAR_DEFAULTS = {
     "tidb_tpu_block_rows": (str(1 << 20), "int"),
     "tidb_allow_batch_cop": ("1", "bool"),
     "tidb_enable_pushdown": ("1", "bool"),
+    # schema/dtype-verify every finished physical plan (lint.plancheck) —
+    # the vet-for-plans gate over planner rewrites; cheap host-side walk,
+    # runs only on plan-cache misses, so it stays on by default
+    "tidb_check_plan": ("1", "bool"),
 }
 
 
