@@ -35,3 +35,18 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_failpoints():
+    """A failpoint left armed by one test silently injects faults into
+    every later test — fail the LEAKING test, not its victims.  Use the
+    scoped `with failpoint(name, action):` manager (store/fault.py) to
+    make disarm structural."""
+    from tidb_tpu.store.fault import FAILPOINTS
+
+    yield
+    leaked = FAILPOINTS.armed()
+    if leaked:
+        FAILPOINTS.clear()
+        pytest.fail(f"test leaked armed failpoints: {leaked}")

@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 from tidb_tpu.session import Domain
-from tidb_tpu.store.fault import FAILPOINTS, once
+from tidb_tpu.store.fault import failpoint, once
 
 
 @pytest.fixture()
@@ -128,12 +128,9 @@ def test_scan_fault_device_fallback(sess):
         ts=sess.domain.storage.current_ts(),
     )
     sess.domain.storage.regions.split_even(t.id, 4, store.base_rows)
-    FAILPOINTS.enable("distsql/task_error", once(RuntimeError("chip died")))
-    try:
+    with failpoint("distsql/task_error", once(RuntimeError("chip died"))):
         rows = sess.query("select sum(a) from t")
         assert rows == [(sum(range(1000)),)]
-    finally:
-        FAILPOINTS.disable("distsql/task_error")
 
 
 def test_scan_fault_transient_retry(sess):
@@ -141,11 +138,8 @@ def test_scan_fault_transient_retry(sess):
     sess.execute("set tidb_use_tpu = 0")
     sess.execute("create table t (a bigint)")
     sess.execute("insert into t values (1), (2), (3)")
-    FAILPOINTS.enable("distsql/task_error", once(OSError("net blip")))
-    try:
+    with failpoint("distsql/task_error", once(OSError("net blip"))):
         assert sess.query("select sum(a) from t") == [(6,)]
-    finally:
-        FAILPOINTS.disable("distsql/task_error")
 
 
 # ---------------------------------------------------------------------------

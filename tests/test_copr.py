@@ -229,10 +229,9 @@ def test_limit(storage):
 
 def test_region_error_retry(storage):
     from tidb_tpu.errors import RegionError
-    from tidb_tpu.store.fault import FAILPOINTS, once
+    from tidb_tpu.store.fault import failpoint, once
 
-    FAILPOINTS.enable("copr/region_error", once(RegionError("injected")))
-    try:
+    with failpoint("copr/region_error", once(RegionError("injected"))):
         dag = DAG([scan_ir(), LimitIR(5)])
         req = CopRequest(dag=dag.to_dict(), ranges=[KeyRange(1, 0, 100)],
                          ts=storage.current_ts(), engine="cpu")
@@ -240,8 +239,6 @@ def test_region_error_retry(storage):
         for resp in storage.get_client().send(req):
             chunks.extend(resp.chunks)
         assert concat_chunks(chunks).num_rows == 5
-    finally:
-        FAILPOINTS.clear()
 
 
 def test_delta_overlay_included(storage):

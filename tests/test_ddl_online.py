@@ -10,7 +10,7 @@ import pytest
 
 from tidb_tpu.catalog.schema import STATE_PUBLIC
 from tidb_tpu.session import Domain
-from tidb_tpu.store.fault import FAILPOINTS
+from tidb_tpu.store.fault import failpoint
 
 
 @pytest.fixture()
@@ -61,12 +61,9 @@ def test_nonpublic_index_not_planned(data_dir):
     def crash(job, upto):
         raise Die()
 
-    FAILPOINTS.enable("ddl/backfill_batch", crash)
-    try:
+    with failpoint("ddl/backfill_batch", crash):
         with pytest.raises(Die):
             s.execute("create index ib on t (b)")
-    finally:
-        FAILPOINTS.disable("ddl/backfill_batch")
     ix = d.catalog.info_schema().table("test", "t").find_index("ib")
     assert ix is not None and ix.state != STATE_PUBLIC
     plan = s.execute("explain select a from t where b = 7")[0].rows
@@ -82,12 +79,9 @@ def test_error_mid_ladder_rolls_back(data_dir):
     def boom(job, upto):
         raise RuntimeError("disk full")
 
-    FAILPOINTS.enable("ddl/backfill_batch", boom)
-    try:
+    with failpoint("ddl/backfill_batch", boom):
         with pytest.raises(RuntimeError):
             s.execute("create index ib on t (b)")
-    finally:
-        FAILPOINTS.disable("ddl/backfill_batch")
     assert d.catalog.info_schema().table("test", "t").find_index("ib") is None
     job = [j for j in d.catalog.jobs if j.typ == "add_index"][-1]
     assert job.state == "rollback" and "disk full" in job.error
@@ -134,12 +128,9 @@ def test_delete_only_window_insert_dup_fails_ddl(data_dir):
             # a=5 already exists in base (a = arange over 100 rows)
             s2.execute("insert into t values (5, 999999)")
 
-    FAILPOINTS.enable("ddl/set_state", sneak)
-    try:
+    with failpoint("ddl/set_state", sneak):
         with pytest.raises(Exception, match="duplicate"):
             s.execute("create unique index ua on t (a)")
-    finally:
-        FAILPOINTS.disable("ddl/set_state")
     assert d.catalog.info_schema().table("test", "t").find_index("ua") is None
     job = [j for j in d.catalog.jobs if j.typ == "add_index"][-1]
     assert job.state == "rollback"
@@ -183,12 +174,9 @@ def test_crash_mid_backfill_resumes_on_reopen(data_dir):
         if upto >= 2 * d.catalog.BACKFILL_BATCH:
             raise Die()
 
-    FAILPOINTS.enable("ddl/backfill_batch", crash)
-    try:
+    with failpoint("ddl/backfill_batch", crash):
         with pytest.raises(Die):
             s.execute("create index ib on t (b)")
-    finally:
-        FAILPOINTS.disable("ddl/backfill_batch")
     job = [j for j in d.catalog.jobs if j.typ == "add_index"][-1]
     assert job.state == "running"
     assert job.reorg_progress >= 2 * d.catalog.BACKFILL_BATCH

@@ -175,3 +175,92 @@ def test_committed_txn_survives_hard_kill(data_dir):
     shutil.copytree(frozen, data_dir)
     s2 = _fresh(data_dir)
     assert s2.query("select a from t") == [(7,)]
+
+
+def _delta_path(data_dir, d, name="t"):
+    import os
+
+    tid = d.catalog.info_schema().table("test", name).id
+    return os.path.join(data_dir, "tables", f"t{tid}.delta.log")
+
+
+def test_torn_delta_tail_recovers_at_random_kill_offsets(data_dir, tmp_path):
+    """Crash-hardened recovery: the writer dies mid-append at an arbitrary
+    byte offset — recovery drops the torn final record with a warning +
+    metric instead of crashing in json.loads, and keeps every fully
+    synced record (leveldb WAL torn-tail semantics)."""
+    import os
+    import shutil
+
+    from tidb_tpu.metrics import REGISTRY
+
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint, s varchar(8))")
+    for i in range(6):
+        s.execute(f"insert into t values ({i}, 'r{i}')")
+    path = _delta_path(data_dir, d)
+    raw = open(path, "rb").read()
+    line_ends = [i + 1 for i, b in enumerate(raw) if b == 0x0A]
+    assert len(line_ends) == 6
+    del d, s  # no clean shutdown
+
+    rng = np.random.default_rng(11)
+    offsets = sorted({int(o) for o in rng.integers(line_ends[0], len(raw), 8)})
+    for cut in offsets:
+        work = str(tmp_path / f"cut{cut}")
+        shutil.copytree(data_dir, work)
+        wpath = os.path.join(work, "tables", os.path.basename(path))
+        with open(wpath, "r+b") as f:
+            f.truncate(cut)
+        # oracle: a record survives iff its JSON line is complete in the
+        # truncated file (a cut that only eats the trailing newline keeps
+        # the record — the payload itself is intact)
+        import json
+
+        complete, torn = 0, 0
+        for ln in raw[:cut].decode().splitlines():
+            if not ln.strip():
+                continue
+            try:
+                json.loads(ln)
+                complete += 1
+            except ValueError:
+                torn = 1
+                break
+        before = REGISTRY.snapshot().get("delta_log_torn_tail_total", 0)
+        s2 = Domain(data_dir=work).new_session()
+        assert s2.query("select count(*) from t") == [(complete,)], cut
+        after = REGISTRY.snapshot().get("delta_log_torn_tail_total", 0)
+        assert after - before == torn, cut
+        # recovered store keeps accepting writes, and recovery REPAIRED
+        # the log (truncated the torn bytes): a post-recovery commit must
+        # not concatenate onto the torn fragment and vanish (or corrupt
+        # the log) on the NEXT reopen
+        s2.execute("insert into t values (99, 'post')")
+        assert s2.query("select count(*) from t") == [(complete + 1,)]
+        del s2
+        s3 = Domain(data_dir=work).new_session()
+        assert s3.query("select count(*) from t") == [(complete + 1,)], cut
+        assert s3.query("select s from t where a = 99") == [("post",)]
+
+
+def test_corrupt_delta_mid_file_is_not_silently_dropped(data_dir):
+    """Only the FINAL record may be torn (crash truncation clips the end);
+    garbage in the middle is real corruption and must surface loudly
+    instead of silently losing committed rows."""
+    from tidb_tpu.store.persist import CorruptDeltaLogError
+
+    d = Domain(data_dir=data_dir)
+    s = d.new_session()
+    s.execute("create table t (a bigint)")
+    for i in range(3):
+        s.execute(f"insert into t values ({i})")
+    path = _delta_path(data_dir, d)
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    lines[1] = b"{garbage!!\n"
+    with open(path, "wb") as f:
+        f.writelines(lines)
+    del d, s
+    with pytest.raises(CorruptDeltaLogError):
+        Domain(data_dir=data_dir)

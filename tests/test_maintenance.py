@@ -160,3 +160,36 @@ def test_conflict_aborted_txn_does_not_pin_safepoint(d):
     with pytest.raises(TiDBTPUError):
         b.execute("commit")
     assert not d.storage._live_txns
+
+
+def test_orphan_lock_sweep_resolves_dead_sessions_locks(d):
+    """Proactive orphan-lock resolution (PR: degraded-mesh failover):
+    TTL-expired locks from txns this process no longer tracks are rolled
+    back on the maintenance tick instead of blocking the next writer to
+    touch the row (gc_worker.go resolveLocks analog)."""
+    s = d.new_session()
+    s.execute("create table ol (a bigint primary key, b bigint)")
+    s.execute("insert into ol values (1, 10)")
+    tid = d.catalog.info_schema().table("test", "ol").id
+    store = d.storage.table(tid)
+
+    # a live txn's lock is NEVER swept, even with an expired TTL
+    live = d.storage.begin()
+    live.lock_keys((tid, 1), ttl_ms=1)
+    time.sleep(0.005)
+    assert d.maintenance.sweep_orphan_locks() == 0
+    assert 1 in store.locks
+    live.rollback()
+
+    # crash analog: the lock's owner vanished from the live-txn registry
+    dead = d.storage.begin()
+    dead.lock_keys((tid, 1), ttl_ms=1)
+    d.storage.txn_finished(dead.start_ts)  # process forgot the txn
+    time.sleep(0.005)
+    before = REGISTRY.snapshot().get("orphan_locks_resolved_total", 0)
+    assert d.maintenance.sweep_orphan_locks() == 1
+    assert store.locks == {}
+    assert REGISTRY.snapshot()["orphan_locks_resolved_total"] == before + 1
+    # the row is immediately writable again, no lock-wait needed
+    s.execute("update ol set b = 11 where a = 1")
+    assert s.query("select b from ol") == [(11,)]

@@ -8,7 +8,7 @@ import pytest
 
 from tidb_tpu.errors import LockedError, RegionError, TxnConflictError
 from tidb_tpu.store import BlockStorage, KeyRange
-from tidb_tpu.store.fault import FAILPOINTS, once
+from tidb_tpu.store.fault import FAILPOINTS, failpoint, once
 from tidb_tpu.store.txn import resolve_lock
 from tidb_tpu.types import ty_float, ty_int, ty_string
 
@@ -200,12 +200,11 @@ def test_2pc_failpoint_prewrite_conflict(storage):
     t = make_table(storage)
     txn = storage.begin()
     txn.put(1, 3, (3, 0.0, "x"))
-    FAILPOINTS.enable("2pc/prewrite", once(TxnConflictError((1, 3))))
-    with pytest.raises(TxnConflictError):
-        txn.commit()
-    # locks must have been cleaned up
-    assert t.locks == {}
-    FAILPOINTS.clear()
+    with failpoint("2pc/prewrite", once(TxnConflictError((1, 3)))):
+        with pytest.raises(TxnConflictError):
+            txn.commit()
+        # locks must have been cleaned up
+        assert t.locks == {}
 
 
 def test_dict_encode_fast_path_type_safety():

@@ -10,7 +10,21 @@ invalidates without explicit eviction.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class _InFlight:
+    """One pending load: waiters block on `ev` and read the outcome off
+    the record, so a doomed (evicted-mid-load) value still reaches every
+    current waiter WITHOUT any of them restarting the load against a
+    condemned device set."""
+
+    __slots__ = ("ev", "value", "failed")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.value: Optional[tuple] = None
+        self.failed = False
 
 
 class ByteCapCache:
@@ -22,10 +36,13 @@ class ByteCapCache:
         self._bytes = 0
         self.capacity = capacity_bytes
         self._mu = threading.Lock()
-        # per-key in-flight latches: a background prefetch and a query
+        # per-key in-flight records: a background prefetch and a query
         # racing on the same column must not BOTH push it over the link
         # (transfers are the expensive part; see _MeshCache)
-        self._inflight: Dict[tuple, threading.Event] = {}
+        self._inflight: Dict[tuple, _InFlight] = {}
+        # keys evicted WHILE their load was in flight: the finished value
+        # must not be cached (it may be placed on a dead device)
+        self._doomed: set = set()
 
     def get_or_load(self, key: tuple, loader: Callable[[], Tuple]) -> tuple:
         while True:
@@ -33,36 +50,66 @@ class ByteCapCache:
                 hit = self._cache.get(key)
                 if hit is not None:
                     return hit
-                ev = self._inflight.get(key)
-                if ev is None:
-                    ev = self._inflight[key] = threading.Event()
+                rec = self._inflight.get(key)
+                if rec is None:
+                    rec = self._inflight[key] = _InFlight()
                     break  # we are the loader
-            ev.wait()  # another thread is loading this key
+            rec.ev.wait()  # another thread is loading this key
+            if not rec.failed:
+                return rec.value  # loaded (cached, or doomed-uncached)
+            # the loader failed: loop and possibly become the new loader
         try:
             value = loader()  # outside the lock: loads transfer data
         except BaseException:
             with self._mu:
+                rec.failed = True
                 self._inflight.pop(key, None)
-            ev.set()
+                self._doomed.discard(key)
+            rec.ev.set()
             raise
         nbytes = sum(v.nbytes for v in value if v is not None)
         with self._mu:
-            while self._bytes + nbytes > self.capacity and self._order:
-                old = self._order.pop(0)
-                ov = self._cache.pop(old)
-                self._bytes -= sum(v.nbytes for v in ov if v is not None)
-            self._cache[key] = value
-            self._order.append(key)
-            self._bytes += nbytes
+            rec.value = value
+            doomed = key in self._doomed
+            self._doomed.discard(key)
             self._inflight.pop(key, None)
-        ev.set()
+            if not doomed:
+                while self._bytes + nbytes > self.capacity and self._order:
+                    old = self._order.pop(0)
+                    ov = self._cache.pop(old)
+                    self._bytes -= sum(v.nbytes for v in ov if v is not None)
+                self._cache[key] = value
+                self._order.append(key)
+                self._bytes += nbytes
+            # doomed: hand the value to this caller and every waiter
+            # (their mesh is already condemned and will retry) but never
+            # cache it for a future, possibly-restored mesh
+        rec.ev.set()
         return value
+
+    def evict_if(self, pred: Callable[[tuple], bool]) -> int:
+        """Drop every entry whose key satisfies pred (device-failover
+        eviction: keys carrying a dead device's id must never serve a
+        rebuilt mesh).  In-flight loads matching pred are doomed: their
+        results are handed to the loading caller but never cached.
+        Returns the number of resident entries evicted."""
+        with self._mu:
+            victims = [k for k in self._cache if pred(k)]
+            for k in victims:
+                v = self._cache.pop(k)
+                self._order.remove(k)
+                self._bytes -= sum(x.nbytes for x in v if x is not None)
+            for k in self._inflight:
+                if pred(k):
+                    self._doomed.add(k)
+        return len(victims)
 
     def clear(self):
         with self._mu:
             self._cache.clear()
             self._order.clear()
             self._bytes = 0
+            self._doomed.update(self._inflight)  # don't cache mid-flight loads
 
     def __len__(self):
         return len(self._cache)

@@ -1,0 +1,225 @@
+"""Device health tracking + circuit breakers for the TPU mesh.
+
+Reference: the store-failover machinery of the reference — region_cache.go
+marks a sick store needCheck and routes around it, region_request.go's
+onSendFail backs off and retries another peer, and the health worker's
+liveness probes slowly re-admit a recovered store.  Our "store" is a
+*device* (one chip in the mesh): a runtime failure attributed to device k
+trips a per-device circuit breaker, the mesh rebuilds over the surviving
+device set, and a later half-open probe re-admits the chip once its
+cooldown passes — the same failover ladder a training stack needs when a
+chip drops out of the ring.
+
+The registry is process-global (devices are process-global); every
+transition is surfaced through metrics.REGISTRY and the
+information_schema.TIDB_TPU_DEVICE_HEALTH memtable.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import REGISTRY
+
+HEALTHY = "healthy"
+TRIPPED = "tripped"
+PROBING = "probing"  # half-open: one in-flight trial over the full mesh
+
+
+class DeviceFailure(RuntimeError):
+    """A runtime failure attributable to specific mesh devices.
+
+    Raised by fault injection (the mesh/device_error failpoint) and usable
+    by backends that can name the failing chip; `device_ids` drives the
+    per-device breakers."""
+
+    def __init__(self, msg: str, device_ids: Tuple[int, ...] = ()):
+        super().__init__(msg)
+        self.device_ids = tuple(device_ids)
+
+
+class HbmOomError(DeviceFailure):
+    """Device memory exhaustion: recoverable by evicting the tile caches
+    (HBM is a cache over host blocks) and re-running the program."""
+
+
+_OOM_RE = re.compile(
+    r"resource[_ ]exhausted|out of memory|hbm.*(?:exceed|exhaust|alloc)"
+    r"|allocation failure", re.I)
+_DEVICE_RE = re.compile(
+    r"xla\w*error|data[_ ]loss|unavailable|device.*(?:fail|lost|halt)"
+    r"|internal error", re.I)
+_DEVICE_ID_RE = re.compile(r"(?:device|tpu|chip)[ :_#]{0,2}(\d+)", re.I)
+
+
+def classify_failure(exc: BaseException) -> Optional[str]:
+    """"oom" | "device" | None for an exception raised while running a
+    mesh program.  None means "not a device-health event" — semantic
+    errors and unknown failures keep their existing fallback semantics."""
+    if isinstance(exc, HbmOomError):
+        return "oom"
+    if isinstance(exc, DeviceFailure):
+        return "device"
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return None
+    from ..errors import TiDBTPUError
+
+    if isinstance(exc, TiDBTPUError):
+        return None  # semantic (lock/kill/quota): never a device event
+    msg = f"{type(exc).__name__}: {exc}"
+    if _OOM_RE.search(msg):
+        return "oom"
+    if _DEVICE_RE.search(msg):
+        return "device"
+    return None
+
+
+def attribute_devices(exc: BaseException) -> Tuple[int, ...]:
+    """Device ids implicated by the failure: an explicit DeviceFailure
+    payload first, else a best-effort parse of the runtime's message
+    ("... on device 3 ..." / "TPU:3").  Empty when unattributable — the
+    caller then retries without tripping any breaker."""
+    ids = getattr(exc, "device_ids", ())
+    if ids:
+        return tuple(ids)
+    m = _DEVICE_ID_RE.search(str(exc))
+    if m:
+        return (int(m.group(1)),)
+    return ()
+
+
+@dataclass
+class DeviceState:
+    device_id: int
+    state: str = HEALTHY
+    error_count: int = 0
+    consecutive_errors: int = 0
+    trip_count: int = 0
+    last_error: str = ""
+    retry_at: float = 0.0  # monotonic deadline for the half-open probe
+    tripped_at: float = field(default=0.0)
+
+
+class DeviceHealthRegistry:
+    """Per-device error counters + circuit breakers with half-open probes.
+
+    trip_threshold consecutive attributed errors open the breaker (default
+    1: a hard device fault quarantines the chip immediately; the half-open
+    probe re-admits transient victims quickly).  After probe_after_s the
+    breaker goes half-open: the device rejoins the mesh for one trial run,
+    and the run's outcome either closes the breaker or re-trips it with a
+    doubled cooldown (capped)."""
+
+    def __init__(self, trip_threshold: int = 1, probe_after_s: float = 30.0,
+                 max_cooldown_s: float = 600.0, clock=time.monotonic):
+        self.trip_threshold = trip_threshold
+        self.probe_after_s = probe_after_s
+        self.max_cooldown_s = max_cooldown_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._devices: Dict[int, DeviceState] = {}
+
+    # ---- state transitions ---------------------------------------------
+    def record_error(self, device_id: int, exc: BaseException):
+        with self._mu:
+            st = self._devices.setdefault(device_id, DeviceState(device_id))
+            st.error_count += 1
+            st.consecutive_errors += 1
+            st.last_error = f"{type(exc).__name__}: {exc}"[:200]
+            REGISTRY.inc("device_errors_total")
+            if (st.state == PROBING
+                    or st.consecutive_errors >= self.trip_threshold):
+                self._trip(st)
+            self._publish()
+
+    def _trip(self, st: DeviceState):
+        st.state = TRIPPED
+        st.trip_count += 1
+        st.tripped_at = self._clock()
+        cooldown = min(self.probe_after_s * (2 ** (st.trip_count - 1)),
+                       self.max_cooldown_s)
+        st.retry_at = st.tripped_at + cooldown
+        REGISTRY.inc("device_breaker_trips_total")
+
+    def record_success(self, device_ids):
+        """A mesh program completed over these devices: close half-open
+        breakers and reset consecutive-error counters."""
+        with self._mu:
+            for did in device_ids:
+                st = self._devices.get(did)
+                if st is None:
+                    continue
+                st.consecutive_errors = 0
+                if st.state == PROBING:
+                    st.state = HEALTHY
+                    REGISTRY.inc("device_breaker_recoveries_total")
+            self._publish()
+
+    def select_devices(self, devices: List) -> List:
+        """Filter a device list down to mesh-eligible devices: healthy ones
+        plus tripped ones whose cooldown elapsed (admitted as half-open
+        probes).  Order is preserved (shard placement stays deterministic)."""
+        now = self._clock()
+        out = []
+        with self._mu:
+            for d in devices:
+                st = self._devices.get(d.id)
+                if st is None or st.state == HEALTHY:
+                    out.append(d)
+                elif st.state == PROBING:
+                    out.append(d)  # probe already in flight this round
+                elif now >= st.retry_at:
+                    st.state = PROBING
+                    REGISTRY.inc("device_breaker_probes_total")
+                    out.append(d)
+            self._publish()
+        return out
+
+    def expire_cooldowns(self):
+        """Make every open breaker immediately probe-eligible (operator
+        'retry now' action; tests drive the half-open transition without
+        waiting out real cooldowns)."""
+        with self._mu:
+            now = self._clock()
+            for st in self._devices.values():
+                if st.state == TRIPPED:
+                    st.retry_at = now
+
+    # ---- introspection --------------------------------------------------
+    def state_of(self, device_id: int) -> str:
+        with self._mu:
+            st = self._devices.get(device_id)
+            return st.state if st is not None else HEALTHY
+
+    def tripped_ids(self) -> Tuple[int, ...]:
+        with self._mu:
+            return tuple(sorted(d for d, st in self._devices.items()
+                                if st.state == TRIPPED))
+
+    def snapshot(self) -> List[DeviceState]:
+        """Copy of every tracked device state (infoschema provider)."""
+        import copy
+
+        with self._mu:
+            return [copy.copy(st)
+                    for _, st in sorted(self._devices.items())]
+
+    def reset(self):
+        """Forget all history (tests; operator ADMIN-style reset)."""
+        with self._mu:
+            self._devices.clear()
+            self._publish()
+
+    def _publish(self):
+        # gauge, not counter: reflects the CURRENT quarantine set
+        REGISTRY.set("device_health_tripped_devices",
+                     sum(1 for st in self._devices.values()
+                         if st.state == TRIPPED))
+
+
+# process-global: devices are process-global resources
+DEVICE_HEALTH = DeviceHealthRegistry()

@@ -151,6 +151,10 @@ class _DeviceCache:
 
         return self._c.get_or_load(key, load)
 
+    def clear(self):
+        """Drop every resident tile (HBM-OOM recovery path)."""
+        self._c.clear()
+
 
 def _gather_tile(table, store_ci: int, start: int, end: int):
     """Host-side: concatenate block slices for [start,end) and pad to TILE."""

@@ -26,6 +26,7 @@ Tests run this on 8 virtual CPU devices (tests/conftest.py); the driver's
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,8 +42,14 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..chunk import Chunk, Column
+from ..store.fault import FAILPOINTS
 from ..store.kv import CopRequest
 from ..types import TypeKind
+from .device_health import (
+    DEVICE_HEALTH,
+    attribute_devices,
+    classify_failure,
+)
 from .ir import DAG
 from .jax_eval import JaxUnsupported, compile_expr
 from . import jax_engine as je
@@ -54,6 +61,7 @@ from .jax_engine import _Analyzed, _fingerprint, _gather_tile, _to_state_dtype
 # ---------------------------------------------------------------------------
 
 _MESH: Optional[Mesh] = None
+_MESH_LOCK = threading.Lock()
 _DIST_INIT = False
 
 
@@ -84,14 +92,49 @@ def _maybe_init_multihost():
     _DIST_INIT = True  # only latched on success (a raise retries next call)
 
 
+def _eligible_devices():
+    """Mesh-eligible devices: the full visible set minus tripped breakers
+    (plus half-open probe admissions).  Multi-process meshes never filter —
+    every process must build the identical mesh or the collective fabric
+    desyncs; cross-host failover is the coordinator's job there."""
+    devs = list(jax.devices())
+    if jax.process_count() > 1:
+        return devs
+    healthy = DEVICE_HEALTH.select_devices(devs)
+    return healthy if healthy else devs  # all tripped: callers gate
+
+
+def _no_eligible_devices() -> bool:
+    """True when every breaker is open with no probe due — the mesh path
+    must step down to the per-region rung (checked on entry AND after
+    each consumed failure, since a retry may have tripped the last one)."""
+    return (jax.process_count() == 1
+            and not DEVICE_HEALTH.select_devices(list(jax.devices())))
+
+
 def get_mesh() -> Mesh:
-    """Process-wide 1-D device mesh over every visible device (all hosts'
-    devices once the multi-host seam has joined the cluster)."""
+    """Process-wide 1-D device mesh over every mesh-eligible device (all
+    hosts' devices once the multi-host seam has joined the cluster).  The
+    mesh REBUILDS whenever the eligible set changes — a tripped breaker
+    shrinks it to the survivors, a successful half-open probe restores it
+    (region_cache.go invalidateStore -> reload, on devices)."""
     global _MESH
     _maybe_init_multihost()
-    if _MESH is None or len(_MESH.devices.ravel()) != len(jax.devices()):
-        _MESH = Mesh(np.array(jax.devices()), ("dp",))
-    return _MESH
+    # serialize check-and-rebuild AND snapshot eligibility under the
+    # lock: with breakers changing the eligible set at runtime, a racing
+    # producer thread holding a pre-trip snapshot could otherwise
+    # reinstate a mesh containing the just-quarantined device
+    with _MESH_LOCK:
+        devs = _eligible_devices()
+        ids = tuple(d.id for d in devs)
+        if _MESH is None or tuple(d.id for d in _MESH.devices.ravel()) != ids:
+            if _MESH is not None:
+                from ..metrics import REGISTRY
+
+                REGISTRY.inc("mesh_rebuilds_total")
+            FAILPOINTS.hit("mesh/rebuild", device_ids=ids)
+            _MESH = Mesh(np.array(devs), ("dp",))
+        return _MESH
 
 
 def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
@@ -191,6 +234,13 @@ class _MeshCache:
 
     def clear(self):
         self._c.clear()
+
+    def evict_device(self, device_id: int) -> int:
+        """Drop every cached column placed on a mesh containing this
+        device: arrays sharded onto a dead chip are unreadable and must
+        never serve a rebuilt mesh (the key's device-id tuple exists for
+        exactly this)."""
+        return self._c.evict_if(lambda k: device_id in k[3])
 
 
 MESH_CACHE = _MeshCache()
@@ -868,7 +918,8 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
             flags = out["keys"][nk + i][lo: lo + k_s].astype(np.bool_)
             ft = g.ftype
             if ft.kind == TK.FLOAT:
-                data = np.asarray(bits, dtype=np.float64)  # value-domain keys
+                # value-domain keys; already host numpy (packed readback)
+                data = bits.astype(np.float64, copy=False)
             elif ft.kind == TK.STRING:
                 from ..store.blockstore import _decode_dict
 
@@ -944,22 +995,146 @@ def _mesh_over_partitions(storage, req: CopRequest, tids):
     return itertools.chain.from_iterable(outs)
 
 
+# initial run + up to two failover retries per request: the first retry
+# covers the common one-dead-chip case, the second a cascading failure;
+# beyond that the request leaves the mesh path (per-region fan-out rung)
+MAX_MESH_ATTEMPTS = 3
+
+
+def _handle_mesh_failure(req: CopRequest, exc: BaseException,
+                         attempts: int) -> bool:
+    """Consume one mesh runtime failure; True when the request may retry
+    on a (possibly rebuilt) mesh.
+
+    Device-attributed errors trip the chip's breaker and evict every
+    cached array placed on a mesh containing it; HBM OOM additionally
+    evicts the tile caches wholesale (device memory is a cache over host
+    blocks).  Unclassifiable errors are NOT consumed — the caller keeps
+    the existing whole-query fallback semantics."""
+    from ..metrics import REGISTRY
+
+    kind = classify_failure(exc)
+    if kind is None:
+        return False
+    # trip/evict side effects run EVEN on the final attempt: a device
+    # implicated in the last failure must still be quarantined (and its
+    # poisoned sharded arrays dropped) for the NEXT query, which would
+    # otherwise re-run over the dead chip before its breaker ever trips
+    dead = attribute_devices(exc)
+    for did in dead:
+        DEVICE_HEALTH.record_error(did, exc)
+        MESH_CACHE.evict_device(did)
+        if _ONES_CACHE is not None:
+            _ONES_CACHE.evict_if(lambda k, d=did: d in k[0])
+    if kind == "oom":
+        REGISTRY.inc("mesh_hbm_oom_total")
+        MESH_CACHE.clear()
+        je.DEVICE_CACHE.clear()
+        if _ONES_CACHE is not None:
+            _ONES_CACHE.clear()
+    if attempts + 1 >= MAX_MESH_ATTEMPTS:
+        return False
+    REGISTRY.inc("mesh_failover_retries_total")
+    import logging
+
+    logging.getLogger("tidb_tpu.copr").warning(
+        "mesh %s failure (devices %s): retrying over surviving device "
+        "set: %s", kind, list(dead) or "unattributed", exc)
+    return True
+
+
 def try_run_mesh(storage, req: CopRequest, table_id=None):
-    """Run the whole request across the device mesh; None if ineligible
-    (the caller falls back to the per-region thread fan-out).
+    """Run the whole request across the device mesh with device failover;
+    None if ineligible (the caller falls back to the per-region fan-out).
+
+    Failover ladder (README "Fault-tolerance model"): a runtime device
+    failure trips the chip's circuit breaker, evicts sharded arrays keyed
+    to the dead device set, REBUILDS the mesh over the survivors and
+    retries the same shard_map program — one sick chip degrades the mesh,
+    it does not demote the whole query to the per-region path.
 
     Returns an ITERABLE of chunks: a list for agg/topn, a ONE-SHOT lazy
-    generator for filters (streamed gathers — iterate exactly once; device
-    errors can surface during iteration)."""
+    generator for filters (streamed gathers — iterate exactly once; a
+    device error before the first chunk retries on the rebuilt mesh,
+    after rows were emitted it surfaces to the consumer)."""
     dag = DAG.from_dict(req.dag)
     tid = table_id if table_id is not None else dag.scan.table_id
     range_tids = sorted({kr.table_id for kr in req.ranges})
     if range_tids and (len(range_tids) > 1 or range_tids[0] != tid):
         # partitioned table: ranges address partition stores, not the
         # logical id in the DAG — run one mesh program per partition and
-        # chain results (partials/topn re-merge root-side, same as the
-        # per-region fan-out contract)
+        # chain results (each sub-request re-enters this wrapper, so
+        # failover applies per partition)
         return _mesh_over_partitions(storage, req, range_tids)
+    if _no_eligible_devices():
+        # every breaker open and no probe due: step down the ladder
+        req.mesh_reject_reason = "all device breakers open"
+        return None
+    attempts = 0
+    while True:
+        try:
+            out = _run_mesh_once(storage, req, tid)
+        except BaseException as e:
+            if not _handle_mesh_failure(req, e, attempts):
+                raise
+            if _no_eligible_devices():
+                # the failure just tripped the LAST breaker: don't burn
+                # the remaining attempts rebuilding over known-dead chips
+                # (_eligible_devices' all-tripped fallback) — step down
+                req.mesh_reject_reason = "all device breakers open"
+                return None
+            attempts += 1
+            continue
+        if out is not None and not isinstance(out, list):
+            # lazy filter stream: iteration gets the same failover loop
+            return _guarded_stream(storage, req, tid, out, attempts)
+        return out
+
+
+def _guarded_stream(storage, req: CopRequest, tid: int, gen, attempts: int):
+    """Wrap a one-shot filter stream in the failover loop: a device
+    failure BEFORE the first chunk rebuilds the mesh and restarts the
+    stream from scratch; after rows were emitted a retry would duplicate
+    them, so the error surfaces (distsql applies the same pre-first-chunk
+    rule to its own fallback)."""
+    while True:
+        emitted = False
+        try:
+            if gen is None:
+                # retry setup runs INSIDE the failover loop: a failure
+                # while rebuilding (e.g. OOM re-sharding onto fewer
+                # chips) gets the same classify/trip/retry treatment
+                gen = _run_mesh_once(storage, req, tid)
+                if gen is None or isinstance(gen, list):
+                    # re-analysis on the rebuilt mesh declined the
+                    # request (data changed under us): surface as a
+                    # pre-first-chunk error so distsql falls back
+                    raise RuntimeError(
+                        "mesh retry declined: "
+                        f"{getattr(req, 'mesh_reject_reason', 'ineligible')}")
+            for c in gen:
+                emitted = True
+                yield c
+            return
+        except BaseException as e:
+            # trip/evict side effects run even when the error must
+            # surface (mid-stream failures after emitted rows): the NEXT
+            # query needs the dead chip quarantined either way
+            handled = _handle_mesh_failure(req, e, attempts)
+            if emitted or not handled:
+                raise
+            if _no_eligible_devices():
+                # last breaker just tripped: surface pre-first-chunk so
+                # distsql steps down to the per-region rung
+                raise
+            attempts += 1
+            gen = None
+
+
+def _run_mesh_once(storage, req: CopRequest, tid: int):
+    """One attempt at running the request over the current mesh; None if
+    ineligible.  Raises on runtime failures — try_run_mesh owns failover."""
+    dag = DAG.from_dict(req.dag)
     table = storage.table(tid)
     if table.base_rows == 0 or table.base_ts > req.ts:
         req.mesh_reject_reason = "empty table or stale snapshot"
@@ -1054,9 +1229,13 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
         valids.append(v)
     wire_sig = [(str(d.dtype), v is None) for d, v in zip(datas, valids)]
 
+    # device ids in the key: a rebuilt mesh (even same-size, after a
+    # breaker trip + probe-restore cycle) must never reuse a program whose
+    # closure captured the dead mesh object
+    mesh_ids = tuple(d.id for d in mesh.devices.ravel())
     fp = (_fingerprint(an, kind)
-          + f"|mesh S={S} Tl={Tl} cols={col_order} kpads={kpads} "
-          + f"wire={wire_sig}")
+          + f"|mesh S={S} Tl={Tl} devs={mesh_ids} cols={col_order} "
+          + f"kpads={kpads} wire={wire_sig}")
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mesh_fn(an, kind, col_order, mesh, Tl)
@@ -1068,7 +1247,8 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
     if deleted:
         dm = np.ones((n_pad, je.TILE), dtype=np.bool_)
         flat = dm.reshape(-1)
-        flat[np.asarray(sorted(deleted), dtype=np.int64)] = False
+        flat[np.fromiter(sorted(deleted), dtype=np.int64,
+                         count=len(deleted))] = False
         del_mask = jax.device_put(dm, NamedSharding(mesh, P("dp")))
     else:
         del_mask = _all_true(mesh, n_pad)
@@ -1082,7 +1262,7 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
         # in STREAM_ROWS slices as the consumer drains the bounded queue,
         # so peak host memory no longer scales with the selected row count
         return _stream_filter(req, table, an, fn, datas, valids, del_mask,
-                              inserted, pargs)
+                              inserted, pargs, mesh_ids=mesh_ids)
 
     chunks: List[Chunk] = []
     agg_accum = None
@@ -1093,6 +1273,11 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
         end = min(kr.end, table.base_rows)
         if start >= end:
             continue
+        # deterministic mid-scan fault injection: the chaos harness kills
+        # virtual device k / exhausts HBM exactly here, between ranges
+        FAILPOINTS.hit("mesh/device_error", kind=kind,
+                       device_ids=mesh_ids, start=start, end=end)
+        FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=start, end=end)
         if kind == "agg" and an.agg_mode == "sort":
             try:
                 chunks.extend(_sort_agg_chunks(
@@ -1107,7 +1292,7 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
             gcount, results = fn(datas, valids, del_mask, start, end, pargs)
             # wrapped() already unpacked to numpy and merged shard partials
             agg_accum = _merge_mesh_agg(
-                agg_accum, np.asarray(gcount), results, table, an,
+                agg_accum, gcount, results, table, an,
             )
         elif kind == "topn":
             gidx, cnts, k = fn(datas, valids, del_mask, start, end, pargs)
@@ -1144,11 +1329,14 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
 
     from .engine import _merge_tail
 
+    # every shard program over every range completed: reset error streaks
+    # and close any half-open breaker that just survived its probe
+    DEVICE_HEALTH.record_success(mesh_ids)
     return [c for c in _merge_tail(dag, chunks) if c.num_rows > 0]
 
 
 def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
-                   pargs=()):
+                   pargs=(), mesh_ids=()):
     """Generator over a mesh filter's result chunks: one bit-packed mask
     readback per range, then STREAM_ROWS-sized host gathers on demand
     (distsql/stream.go:33-124; kv.Request.Streaming kv/kv.go:270)."""
@@ -1160,6 +1348,9 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
         end = min(kr.end, table.base_rows)
         if start >= end:
             continue
+        FAILPOINTS.hit("mesh/device_error", kind="filter",
+                       device_ids=mesh_ids, start=start, end=end)
+        FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=start, end=end)
         mask = fn(datas, valids, del_mask, start, end, pargs)
         handles = np.flatnonzero(mask)
         if remaining is not None:
@@ -1178,7 +1369,9 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
             REGISTRY.inc("mesh_stream_chunks_total")
             yield chunk
         if remaining is not None and remaining <= 0:
+            DEVICE_HEALTH.record_success(mesh_ids)
             return
+    DEVICE_HEALTH.record_success(mesh_ids)
     res = _delta_chunk(req, None, an, inserted)
     if res is not None:
         yield res

@@ -7,10 +7,12 @@ and exceeding the budget surfaces the last error instead of retrying forever.
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Dict
 
 from ..errors import KVError
+from ..store.kv import DEFAULT_BACKOFF_BUDGET_MS as DEFAULT_BUDGET_MS
 
 # (base_ms, cap_ms) per backoff type — mirrors backoff.go's NewBackoffFn
 # schedules (equal-jitter growth, capped).
@@ -26,13 +28,18 @@ class BackoffBudgetExceeded(KVError):
 
 
 class Backoffer:
-    """Sleep with exponential growth per type, bounded by a total budget."""
+    """Sleep with equal-jitter exponential growth per type, bounded by a
+    total budget (backoff.go NewBackoffFn EqualJitter: half the expo value
+    deterministic, half uniform-random — retries from concurrent tasks
+    de-synchronize instead of stampeding the same sick store/device)."""
 
-    def __init__(self, budget_ms: int = 10_000, *, sleep=time.sleep):
+    def __init__(self, budget_ms: int = DEFAULT_BUDGET_MS, *,
+                 sleep=time.sleep, rng: random.Random | None = None):
         self.budget_ms = budget_ms
         self.slept_ms = 0.0
         self._attempts: Dict[str, int] = {}
         self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
         self.errors: list = []
 
     def backoff(self, typ: str, err: BaseException | None = None):
@@ -41,7 +48,8 @@ class Backoffer:
         base, cap = BACKOFF_TYPES.get(typ, (5, 1000))
         n = self._attempts.get(typ, 0)
         self._attempts[typ] = n + 1
-        ms = min(base * (2 ** n), cap)
+        expo = min(base * (2 ** n), cap)
+        ms = expo / 2 + self._rng.uniform(0, expo / 2)  # equal jitter
         if self.slept_ms + ms > self.budget_ms:
             raise BackoffBudgetExceeded(
                 f"backoff budget exhausted after {self.slept_ms:.0f}ms "

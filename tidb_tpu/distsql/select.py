@@ -31,7 +31,7 @@ from ..copr.ir import DAG
 from ..errors import TiDBTPUError
 from ..store.fault import FAILPOINTS
 from ..store.kv import CopRequest, KeyRange
-from .backoff import Backoffer
+from .backoff import DEFAULT_BUDGET_MS, Backoffer
 
 
 @dataclass
@@ -45,6 +45,7 @@ class RequestBuilder:
     keep_order: bool = False
     streaming: bool = False
     engine: str = "tpu"
+    backoff_budget_ms: int = DEFAULT_BUDGET_MS
 
     def set_dag(self, dag: DAG) -> "RequestBuilder":
         self.dag = dag.to_dict()
@@ -70,12 +71,17 @@ class RequestBuilder:
         self.engine = engine
         return self
 
+    def set_backoff_budget(self, budget_ms: int) -> "RequestBuilder":
+        self.backoff_budget_ms = max(0, budget_ms)
+        return self
+
     def build(self) -> CopRequest:
         assert self.dag is not None and self.ranges, "incomplete request"
         return CopRequest(
             dag=self.dag, ranges=self.ranges, ts=self.ts,
             concurrency=self.concurrency, keep_order=self.keep_order,
             streaming=self.streaming, engine=self.engine,
+            backoff_budget_ms=self.backoff_budget_ms,
         )
 
 
@@ -105,7 +111,9 @@ class SelectResult:
         # EXPLAIN ANALYZE attribution: which engine actually served the scan
         self.scan_engine: str = "pending"
         self.total_tasks = 0
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # named so leak checks (tests/chaos harness) can find stragglers
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tidb-tpu-select")
         self._thread.start()
 
     # ---- producer side -------------------------------------------------
@@ -128,7 +136,7 @@ class SelectResult:
         from ..metrics import REGISTRY
 
         client = self.storage.get_client()
-        bo = Backoffer()
+        bo = Backoffer(budget_ms=self.req.backoff_budget_ms)
         engine = self.req.engine
         while True:
             if self._stop.is_set():
@@ -252,13 +260,13 @@ class SelectResult:
                     # task submission order == handle order (locate is
                     # sorted); yield in that order
                     for f in futures:
-                        for c in f.result():
+                        for c in self._task_result(f):
                             self._put(c)
                 else:
                     from concurrent.futures import as_completed
 
                     for f in as_completed(futures):
-                        for c in f.result():
+                        for c in self._task_result(f):
                             self._put(c)
                 self._put(_DONE)
             finally:
@@ -268,11 +276,40 @@ class SelectResult:
         except _Closed:
             pass
         except BaseException as e:  # surfaced on the consumer side
-            self._err = e
-            try:
-                self._put(_DONE)
-            except _Closed:
-                pass
+            self._finish_error(e)
+
+    def _task_result(self, f) -> List[Chunk]:
+        """Consume one task future; on its error, FAIL FAST: flag the stop
+        event so queued sibling tasks exit at entry and running ones
+        abandon their retry loops instead of finishing work (and burning
+        backoff budget) for a query that already failed."""
+        try:
+            return f.result()
+        except _Closed:
+            raise
+        except BaseException:
+            if not self._stop.is_set():
+                from ..metrics import REGISTRY
+
+                REGISTRY.inc("cop_fanout_failfast_total")
+                self._stop.set()
+            raise
+
+    def _finish_error(self, e: BaseException):
+        """Surface a producer error: a plain _put(_DONE) would raise
+        _Closed once the stop flag is set (fail-fast path) and strand the
+        consumer on get() — drain and deliver _DONE directly instead."""
+        self._err = e
+        self._stop.set()
+        try:
+            while True:
+                self._chunks.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            self._chunks.put_nowait(_DONE)
+        except queue.Full:  # pragma: no cover - queue just drained
+            pass
 
     # ---- consumer side -------------------------------------------------
     def next_chunk(self) -> Optional[Chunk]:
@@ -309,7 +346,8 @@ class SelectResult:
 
 def select_dag(storage, dag: DAG, ranges: List[KeyRange], ts: int,
                concurrency: int = 8, keep_order: bool = False,
-               engine: str = "tpu", aux: Optional[dict] = None) -> SelectResult:
+               engine: str = "tpu", aux: Optional[dict] = None,
+               backoff_budget_ms: int = DEFAULT_BUDGET_MS) -> SelectResult:
     req = (
         RequestBuilder()
         .set_dag(dag)
@@ -318,6 +356,7 @@ def select_dag(storage, dag: DAG, ranges: List[KeyRange], ts: int,
         .set_concurrency(concurrency)
         .set_keep_order(keep_order)
         .set_engine(engine)
+        .set_backoff_budget(backoff_budget_ms)
         .build()
     )
     if aux:

@@ -44,11 +44,16 @@ class TableReaderExec(Executor):
         self._cost_routed = False
         if engine == "tpu":
             engine = self._route(engine)
+        from ..distsql.backoff import DEFAULT_BUDGET_MS
+
+        budget = (self.ctx.vars.get_int("tidb_backoff_budget_ms",
+                                        DEFAULT_BUDGET_MS)
+                  if self.ctx.vars else DEFAULT_BUDGET_MS)
         self._result = select_dag(
             self.ctx.storage, self.dag, self.ranges, self.ctx.snapshot_ts(),
             concurrency=self.ctx.distsql_concurrency,
             keep_order=self.keep_order, engine=engine,
-            aux=self._aux,
+            aux=self._aux, backoff_budget_ms=budget,
         )
 
     def _route(self, engine: str) -> str:

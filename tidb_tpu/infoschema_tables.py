@@ -482,6 +482,49 @@ def _tidb_tpu_engine(domain, isc):
     return rows
 
 
+@_register("tidb_tpu_device_health", [
+    ("device_id", ty_int()), ("platform", ty_string()),
+    ("state", ty_string()), ("error_count", ty_int()),
+    ("consecutive_errors", ty_int()), ("trip_count", ty_int()),
+    ("in_current_mesh", ty_int()), ("last_error", ty_string()),
+])
+def _tidb_tpu_device_health(domain, isc):
+    """Per-device circuit-breaker state (the degraded-mesh failover
+    subsystem, copr/device_health.py): which chips are quarantined, why,
+    and whether the live mesh currently includes them — the operator view
+    the reference exposes for sick stores via pd/store state."""
+    from .copr.device_health import DEVICE_HEALTH
+
+    states = {st.device_id: st for st in DEVICE_HEALTH.snapshot()}
+    rows = []
+    try:
+        import jax
+
+        from .copr import parallel as pl
+
+        mesh_ids = set()
+        if pl._MESH is not None:
+            mesh_ids = {d.id for d in pl._MESH.devices.ravel()}
+        for d in jax.devices():
+            st = states.pop(d.id, None)
+            rows.append((
+                d.id, d.platform,
+                st.state if st is not None else "healthy",
+                st.error_count if st is not None else 0,
+                st.consecutive_errors if st is not None else 0,
+                st.trip_count if st is not None else 0,
+                1 if d.id in mesh_ids else 0,
+                st.last_error if st is not None else "",
+            ))
+    except Exception:
+        pass  # device backend not initialized: tracked-state rows only
+    for did in sorted(states):
+        st = states[did]
+        rows.append((did, "unknown", st.state, st.error_count,
+                     st.consecutive_errors, st.trip_count, 0, st.last_error))
+    return rows
+
+
 @_register("tidb_profile", [
     ("function", ty_string()), ("calls", ty_int()),
     ("total_time_ms", ty_float()), ("cum_time_ms", ty_float()),

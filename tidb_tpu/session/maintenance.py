@@ -60,6 +60,7 @@ class MaintenanceWorker:
     def tick(self):
         self.run_gc()
         self.run_compaction()
+        self.sweep_orphan_locks()
         self.watch_expensive()
         REGISTRY.inc("maintenance_ticks_total")
 
@@ -114,6 +115,37 @@ class MaintenanceWorker:
                 storage.maybe_compact(tid)
             except Exception:
                 pass  # raced a drop/lock; next tick retries
+
+    def sweep_orphan_locks(self) -> int:
+        """Proactively resolve TTL-expired locks whose owner txn this
+        process no longer tracks (crashed sessions).  Without the sweep,
+        resolution is on-access only: an orphan lock on a cold row blocks
+        the first writer to touch it for a full lock-wait — the reference
+        runs the same proactive pass in the GC worker
+        (gc_worker.go resolveLocks over the scanned range)."""
+        from ..store.txn import resolve_lock
+
+        storage = self.domain.storage
+        resolved = 0
+        for tid in list(storage.table_ids()):
+            try:
+                store = storage.table(tid)
+            except Exception:
+                continue  # dropped concurrently
+            for h, lk in list(store.locks.items()):
+                if storage.txn_alive(lk.start_ts):
+                    continue  # live owner: never steal its locks
+                if not storage.oracle.is_expired(lk.start_ts, lk.ttl_ms):
+                    continue
+                try:
+                    resolve_lock(storage, tid, h)
+                    resolved += 1
+                except Exception:
+                    continue  # raced a concurrent access-path resolution
+        if resolved:
+            REGISTRY.inc("orphan_locks_resolved_total", resolved)
+            log.info("resolved %d orphan lock(s)", resolved)
+        return resolved
 
     def watch_expensive(self):
         """Flag statements running past tidb_expensive_query_time_threshold

@@ -61,6 +61,9 @@ SYSVAR_DEFAULTS = {
     "tidb_mem_quota_query": (str(32 << 30), "int"),
     "tidb_oom_action": ("cancel", "str"),
     "tidb_retry_limit": ("10", "int"),
+    # total per-cop-task retry sleep budget (ms) — backoff.go's maxSleep,
+    # configurable instead of the old hard-coded 10s (distsql/backoff.py)
+    "tidb_backoff_budget_ms": ("10000", "int"),
     "tidb_disable_txn_auto_retry": ("0", "bool"),
     "tidb_snapshot": ("", "str"),
     # domain-wide cProfile collector -> information_schema.tidb_profile

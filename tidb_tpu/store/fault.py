@@ -9,7 +9,8 @@ registry the fake backend consults; tests arm/disarm named failpoints.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
 
 
 class FailpointRegistry:
@@ -31,6 +32,12 @@ class FailpointRegistry:
         with self._mu:
             self._points.clear()
 
+    def armed(self) -> List[str]:
+        """Names currently armed (leak detection: the autouse conftest
+        fixture fails any test that leaves a failpoint enabled)."""
+        with self._mu:
+            return sorted(self._points)
+
     def hit(self, name: str, **ctx):
         with self._mu:
             action = self._points.get(name)
@@ -40,6 +47,18 @@ class FailpointRegistry:
 
 # process-global registry (tests reset via clear())
 FAILPOINTS = FailpointRegistry()
+
+
+@contextmanager
+def failpoint(name: str, action: Callable):
+    """Scoped arming: `with failpoint("2pc/prewrite", once(exc)): ...`
+    guarantees disarm on every exit path — replaces the hand-rolled
+    try/finally enable/disable pairs tests used to carry."""
+    FAILPOINTS.enable(name, action)
+    try:
+        yield FAILPOINTS
+    finally:
+        FAILPOINTS.disable(name)
 
 
 def once(exc: Exception) -> Callable:

@@ -16,6 +16,10 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 # (table_id, index_id, encoded_value, handle).
 RowKey = Tuple[int, int]
 
+# canonical per-task retry sleep budget (backoff.go maxSleep default);
+# distsql.Backoffer and the tidb_backoff_budget_ms sysvar both anchor here
+DEFAULT_BACKOFF_BUDGET_MS = 10_000
+
 
 @dataclass(frozen=True)
 class KeyRange:
@@ -51,6 +55,9 @@ class CopRequest:
     # "tpu" | "cpu" — per-request engine routing, the analog of
     # kv.StoreType TiKV/TiFlash (kv/kv.go:222-232)
     engine: str = "tpu"
+    # total per-task retry sleep budget (backoff.go maxSleep analog);
+    # sessions override via the tidb_backoff_budget_ms sysvar
+    backoff_budget_ms: int = DEFAULT_BACKOFF_BUDGET_MS
     # runtime payloads resolved at execution time (numpy arrays), e.g.
     # probe_keys_{n} for JoinProbeIR — the analog of IndexLookUpJoin
     # building inner requests from outer rows

@@ -28,6 +28,12 @@ from ..types import TypeKind
 from .blockstore import TableStore, Version
 
 
+class CorruptDeltaLogError(RuntimeError):
+    """A delta-log record BEFORE the final line failed to parse: not a
+    torn tail (crash-truncation only ever clips the end) but real
+    corruption — surfaced instead of silently dropping committed data."""
+
+
 class TablePersister:
     def __init__(self, data_dir: str, table_id: int):
         self.dir = os.path.join(data_dir, "tables")
@@ -146,17 +152,69 @@ class TablePersister:
             self._load_base(store)
         if os.path.exists(self.delta_path):
             found = True
-            with open(self.delta_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
+            # two STREAMED passes (a post-write-burst log can be large;
+            # never materialize it): first find the final record's line
+            # index — the only one torn-tail tolerance may drop
+            last_payload = None
+            with open(self.delta_path, "rb") as f:
+                for i, bline in enumerate(f):
+                    if bline.strip():
+                        last_payload = i
+            torn_offset = None
+            unterminated = False
+            with open(self.delta_path, "rb") as f:
+                offset = 0
+                for i, bline in enumerate(f):
+                    line_start = offset
+                    offset += len(bline)
+                    payload = bline.decode("utf-8", "replace").strip()
+                    if not payload:
                         continue
-                    h, cts, sts, op, values = json.loads(line)
+                    if i == last_payload and not bline.endswith(b"\n"):
+                        unterminated = True
+                    try:
+                        h, cts, sts, op, values = json.loads(payload)
+                    except (ValueError, TypeError) as e:
+                        if i == last_payload:
+                            # torn tail: the writer died mid-append — the
+                            # record never committed (commit() returns only
+                            # after fsync of the FULL line), so dropping it
+                            # IS the correct recovery (leveldb WAL
+                            # semantics: a truncated final record drops)
+                            import logging
+
+                            from ..metrics import REGISTRY
+
+                            REGISTRY.inc("delta_log_torn_tail_total")
+                            logging.getLogger("tidb_tpu.store").warning(
+                                "dropping torn final delta-log record in "
+                                "%s (%d bytes): %s",
+                                self.delta_path, len(payload), e)
+                            torn_offset = line_start
+                            break
+                        raise CorruptDeltaLogError(
+                            f"{self.delta_path}: corrupt record at line "
+                            f"{i + 1} (not the final line): {e}") from e
                     store.delta.setdefault(h, []).append(
                         Version(cts, sts, op,
                                 tuple(values) if values is not None else None)
                     )
                     store.next_handle = max(store.next_handle, h + 1)
+            if torn_offset is not None or unterminated:
+                # REPAIR the log before accepting new appends: the next
+                # append_delta opens in 'a' mode, and a record written
+                # after torn bytes (or after a complete-but-unterminated
+                # final line) would merge into one unparseable line —
+                # silently losing committed rows on the following reopen
+                with open(self.delta_path, "r+b") as f:
+                    if torn_offset is not None:
+                        f.truncate(torn_offset)
+                    else:
+                        f.seek(0, os.SEEK_END)
+                        f.write(b"\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._fsync_dir()
         return found
 
     def _load_base(self, store: TableStore):
