@@ -381,6 +381,50 @@ def _run_inner(state: dict):
         state["phases"]["q3_done"] = round(time.perf_counter() - T0, 1)
         persist_partial(state)
 
+    # MPP shuffle join: both sides too big to broadcast — the exchange
+    # engine (tidb_tpu/mpp) hash-partitions both scans across the mesh
+    # with all_to_all and joins co-partitioned shards on device, vs the
+    # same query on the root-side host hash join
+    if state.get("q1") and remaining() > 150:
+        from tidb_tpu.metrics import REGISTRY
+        from tidb_tpu.tpch_data import build_q3_tables
+
+        n_li = min(state.get("loaded_rows", 2_000_000), 8_000_000)
+        n_ord = max(n_li // 4, 20_000)  # big build side: shuffle territory
+        log(f"MPP join bench: {n_li} lineitem x {n_ord} orders...")
+        sess_m = build_q3_tables(n_li, n_ord)
+        MPPQ = ("select count(*), sum(l_extendedprice), max(o_shippriority)"
+                " from lineitem join orders on l_orderkey = o_orderkey"
+                " where l_shipdate > '1995-03-15'")
+        sess_m.execute("set tidb_enforce_mpp = 1")
+        plan = [r[0] for r in sess_m.execute("explain " + MPPQ)[0].rows]
+        in_mpp = any("ExchangeSender" in op for op in plan)
+        m0 = REGISTRY.snapshot()
+        mpp_warm, mpp_best = time_query(sess_m, MPPQ, ITERS)
+        m1 = REGISTRY.snapshot()
+        served = (m1.get("mpp_joins_total", 0) - m0.get("mpp_joins_total", 0)
+                  > 0)
+        sess_m.execute("set tidb_allow_mpp = 0")
+        sess_m.execute("set tidb_enforce_mpp = 0")
+        _, mpp_host = time_query(sess_m, MPPQ, 1)
+        state["mpp_join"] = {
+            "rows": n_li, "build_rows": n_ord,
+            "warm_s": round(mpp_warm, 4),
+            "steady_s": round(mpp_best, 5),
+            "host_join_s": round(mpp_host, 4),
+            "speedup": round(mpp_host / mpp_best, 2),
+            "plan_is_exchange": in_mpp,
+            "served_by_mpp": served,
+            "exchange_bytes": round(
+                m1.get("mpp_exchange_bytes_total", 0)
+                - m0.get("mpp_exchange_bytes_total", 0)),
+        }
+        log(f"MPP join: steady={mpp_best:.4f}s host={mpp_host:.3f}s "
+            f"speedup={mpp_host / mpp_best:.1f}x exchange-plan={in_mpp}")
+        state["phases"]["mpp_join_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
     # CPU oracle baseline on a bounded subsample, scaled linearly
     n = state.get("loaded_rows", 0)
     if n and remaining() > 60:
@@ -453,6 +497,7 @@ def emit(state: dict):
                     else None
                 ),
                 "q3": state.get("q3"),
+                "mpp_join": state.get("mpp_join"),
                 "devices": state.get("devices"),
                 "complete": bool(state.get("done")),
                 "worker_error": state.get("worker_error"),

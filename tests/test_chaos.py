@@ -340,6 +340,105 @@ def test_chaos_sweep_write_and_ddl_path():
 
 
 # ---------------------------------------------------------------------------
+# mpp/exchange: the eighth chaos site (device failure mid-shuffle)
+# ---------------------------------------------------------------------------
+
+
+def test_mpp_exchange_device_failure_degrades_down_ladder():
+    """A device killed mid-shuffle must degrade, not fail: a transient
+    kill retries on the REBUILT mesh (still MPP), a persistent one lands
+    on the host hash join — CPU parity throughout, zero leaked threads,
+    zero leaked failpoints."""
+    from tidb_tpu.copr import parallel as pl
+
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table mo (k bigint primary key, f bigint)")
+    s.execute("create table ml (k bigint, q bigint)")
+    rng = np.random.default_rng(13)
+    t_o = d.catalog.info_schema().table("test", "mo")
+    t_l = d.catalog.info_schema().table("test", "ml")
+    d.storage.table(t_o.id).bulk_load_arrays(
+        [np.arange(4000, dtype=np.int64), rng.integers(0, 3, 4000)],
+        ts=d.storage.current_ts())
+    d.storage.table(t_l.id).bulk_load_arrays(
+        [rng.integers(0, 12000, 16000), rng.integers(1, 9, 16000)],
+        ts=d.storage.current_ts())
+    s.execute("analyze table mo")
+    s.execute("analyze table ml")
+    s.execute("set tidb_enforce_mpp = 1")
+    q = "select count(*), sum(q), max(f) from ml join mo on ml.k = mo.k"
+    want = _cpu_rows(s, q)
+    _rows_eq(s.query(q), want, "warm")
+
+    # transient: one kill -> breaker trips, mesh rebuilds, SAME rung
+    m0, f0 = _snap("mpp_joins_total", "mpp_fallback_total")
+    with failpoint("mpp/exchange",
+                   once(DeviceFailure("chip 3 died mid-shuffle",
+                                      device_ids=(3,)))):
+        got = s.query(q)
+    _rows_eq(got, want, "transient mid-shuffle kill")
+    m1, f1 = _snap("mpp_joins_total", "mpp_fallback_total")
+    assert m1 > m0 and f1 == f0, "transient kill left the mpp rung"
+    ids = tuple(dd.id for dd in pl._MESH.devices.ravel())
+    assert 3 not in ids and len(ids) == 7, ids
+    DEVICE_HEALTH.reset()
+
+    # persistent: every retry dies -> host hash join serves with parity
+    f0 = _snap("mpp_fallback_total")[0]
+    from tidb_tpu.store.fault import always
+
+    with failpoint("mpp/exchange",
+                   always(DeviceFailure("chip 4 stays dead",
+                                        device_ids=(4,)))):
+        got = s.query(q)
+    _rows_eq(got, want, "persistent mid-shuffle failure")
+    assert _snap("mpp_fallback_total")[0] > f0, "host rung never served"
+    DEVICE_HEALTH.reset()
+    # drop this throwaway domain's sharded arrays: they were (re)loaded
+    # on degraded meshes and must not linger for later modules
+    uids = {d.storage.table(t_o.id).store_uid,
+            d.storage.table(t_l.id).store_uid}
+    pl.MESH_CACHE._c.evict_if(lambda k: k[0] in uids)
+    _assert_no_leaks(d)
+
+
+def test_tile_path_routes_around_tripped_default_device(sess):
+    """ROADMAP PR-2 follow-up (a): the per-region tile path
+    (jax_engine.run_base_jax) must not pin work to a tripped default
+    device — tiles place on the surviving devices and a completed scan
+    closes half-open breakers."""
+    import jax
+
+    from tidb_tpu.copr import jax_engine as je
+
+    default_id = jax.devices()[0].id
+    DEVICE_HEALTH.record_error(default_id, RuntimeError("chip 0 sick"))
+    assert DEVICE_HEALTH.state_of(default_id) == "tripped"
+    devs = je._tile_devices()
+    assert default_id not in [d.id for d in devs]
+
+    # drive a real per-region scan (mesh path disabled via many ranges is
+    # intrusive; call the tile engine directly like distsql's fallback)
+    d = sess.domain
+    t = d.catalog.info_schema().table("test", "t")
+    store = d.storage.table(t.id)
+    from tidb_tpu.copr.ir import DAG, TableScanIR
+
+    dag = DAG([TableScanIR(t.id, [0], [t.columns[0].ftype])])
+    je.DEVICE_CACHE.clear()
+    chunks = je.run_base_jax(store, dag, 0, store.base_rows, set())
+    assert sum(c.num_rows for c in chunks) == store.base_rows
+    placed = {k[4] for k in je.DEVICE_CACHE._c.items_view}
+    assert default_id not in placed, placed
+    # the completed scan recorded success for the devices it used; the
+    # tripped default stays tripped until its half-open probe
+    assert DEVICE_HEALTH.state_of(default_id) == "tripped"
+    DEVICE_HEALTH.reset()
+    je.DEVICE_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
 # fail-fast fan-out + configurable equal-jitter backoff (satellites)
 # ---------------------------------------------------------------------------
 

@@ -120,6 +120,34 @@ def test_http_endpoints(d):
         srv.stop()
 
 
+def test_http_device_health_surfaces_breaker_trips(d):
+    """PR-2 follow-up (d): circuit-breaker trips are visible on the
+    status port, not just information_schema — /status carries the
+    tripped-device summary and /device-health the full breaker state."""
+    from tidb_tpu.copr.device_health import DEVICE_HEALTH
+    from tidb_tpu.server import StatusServer
+
+    DEVICE_HEALTH.reset()
+    srv = StatusServer(d, port=0)
+    host, port = srv.start()
+    try:
+        base = f"http://{host}:{port}"
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["tripped_devices"] == []
+        DEVICE_HEALTH.record_error(3, RuntimeError("chip 3 halted"))
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["tripped_devices"] == [3]
+        health = json.loads(
+            urllib.request.urlopen(base + "/device-health").read())
+        assert health["tripped"] == [3]
+        st = {h["device_id"]: h for h in health["devices"]}
+        assert st[3]["state"] == "tripped" and st[3]["trip_count"] >= 1
+        assert "chip 3 halted" in st[3]["last_error"]
+    finally:
+        srv.stop()
+        DEVICE_HEALTH.reset()
+
+
 def test_infoschema_breadth(d):
     s = d.new_session()
     s.execute("create table ib (k bigint primary key, v varchar(4))"

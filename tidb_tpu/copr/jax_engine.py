@@ -585,6 +585,22 @@ def _to_state_dtype(d, src_ft: FieldType, state_ft: FieldType):
 # ---------------------------------------------------------------------------
 
 
+def _tile_devices():
+    """Devices the per-tile path may place work on: the visible set minus
+    tripped breakers (ROADMAP PR-2 follow-up (a) — this path used to pin
+    the default device even while its breaker was open).  Multi-process
+    runs skip filtering, same rule as the mesh (copr/parallel.py
+    _eligible_devices); an all-tripped set falls back to the full list
+    (the distsql layer steps down to the CPU engine on failure)."""
+    devs = list(jax.devices())
+    if jax.process_count() > 1:
+        return devs
+    from .device_health import DEVICE_HEALTH
+
+    healthy = DEVICE_HEALTH.select_devices(devs)
+    return healthy if healthy else devs
+
+
 def run_base_jax(table, dag: DAG, start: int, end: int,
                  deleted: Sequence[int], aux=None) -> List[Chunk]:
     """Execute `dag` over base rows [start, end) on the device; returns
@@ -608,13 +624,15 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         fn = _build_tile_fn(an, kind, col_order)
         _COMPILED[fp] = fn
 
-    del_arr = np.asarray(sorted(deleted), dtype=np.int64)
+    del_arr = np.fromiter(sorted(deleted), dtype=np.int64,
+                          count=len(deleted))
     out_chunks: List[Chunk] = []
     agg_accum = None
     topn_parts: List[Chunk] = []
     remaining_limit = an.limit
 
-    devices = jax.devices()
+    devices = _tile_devices()
+    used_ids: set = set()
     for tile_start in range((start // TILE) * TILE, end, TILE):
         t0 = max(tile_start, start)
         t1 = min(tile_start + TILE, end)
@@ -626,7 +644,9 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         # sub-tile regions reuse resident device data (no re-transfer).
         # Multi-chip: tiles round-robin across devices — async dispatch
         # runs per-tile kernels concurrently (DP over shards, SURVEY §2.6)
-        dev = devices[tile_idx % len(devices)] if len(devices) > 1 else None
+        dev = devices[tile_idx % len(devices)] if len(devices) > 1 else (
+            devices[0] if devices[0].id != jax.devices()[0].id else None)
+        used_ids.add(devices[0].id if dev is None else dev.id)
         datas, valids = [], []
         for j, ci in enumerate(col_order):
             store_ci = an.scan.columns[ci]
@@ -649,8 +669,7 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
 
         if kind == "filter":
             m, outs = fn(datas, valids, lo, hi, del_mask)
-            m = np.asarray(m)
-            sel = np.flatnonzero(m)
+            sel = np.flatnonzero(_np_tree(m))
             if remaining_limit is not None:
                 sel = sel[:remaining_limit]
             if len(sel) == 0:
@@ -658,10 +677,8 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
             if outs is not None:
                 cols = []
                 for (dv, vv), p in zip(outs, an.proj_exprs):
-                    cols.append(
-                        Column(p.ftype, np.asarray(dv)[sel],
-                               np.asarray(vv)[sel])
-                    )
+                    dv, vv = _np_tree((dv, vv))
+                    cols.append(Column(p.ftype, dv[sel], vv[sel]))
                 chunk = Chunk(cols)
             else:
                 chunk = _gather_rows(table, an.scan, base0, sel)
@@ -673,15 +690,22 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         elif kind == "agg":
             gcount, results = fn(datas, valids, lo, hi, del_mask)
             agg_accum = _merge_device_agg(
-                agg_accum, np.asarray(gcount),
+                agg_accum, _np_tree(gcount),
                 [(t, _np_tree(r)) for t, r in results],
                 table, an, base0,
             )
         else:  # topn
             idx, cnt = fn(datas, valids, lo, hi, del_mask)
-            idx = np.asarray(idx)[: int(cnt)]
+            idx = _np_tree(idx)[: int(cnt)]
             if len(idx):
                 topn_parts.append(_gather_rows(table, an.scan, base0, idx))
+
+    # every tile kernel completed: reset error streaks for the devices
+    # that ACTUALLY ran a tile — a half-open chip the round-robin never
+    # touched must not have its breaker closed by someone else's scan
+    from .device_health import DEVICE_HEALTH
+
+    DEVICE_HEALTH.record_success(sorted(used_ids))
 
     if kind == "agg":
         if agg_accum is None:

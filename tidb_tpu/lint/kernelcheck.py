@@ -52,13 +52,23 @@ CANONICAL_KERNEL_QUERIES = [
      " from lineitem group by l_returnflag"),
 ]
 
+#: MPP exchange kernels (mpp/exchange.py): traced over a 1-device mesh so
+#: the jaxpr stats are deterministic regardless of how many virtual
+#: devices the harness exposes; covers the partition/all_to_all shuffle
+#: and the all_gather broadcast rung of the partitioned join.
+MPP_EXCHANGE_KERNELS = ("mpp-shuffle-join", "mpp-broadcast-join")
+
 
 def _iter_eqns(jaxpr):
-    """All equations including nested call/pjit sub-jaxprs."""
+    """All equations including nested call/pjit sub-jaxprs.  shard_map
+    stores its body as a raw Jaxpr (no .jaxpr attribute), so anything
+    with .eqns descends too — the exchange kernels live in there."""
     for eqn in jaxpr.eqns:
         yield eqn
         for v in eqn.params.values():
             sub = getattr(v, "jaxpr", None)
+            if sub is None and hasattr(v, "eqns"):
+                sub = v
             if sub is not None:
                 yield from _iter_eqns(sub)
 
@@ -224,6 +234,30 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced (TPUs emulate i64 pairwise; VERDICT.md "
                  "names this the Q1 VPU bottleneck)")
+
+    # -- MPP exchange / partitioned-join kernels ------------------------
+    for name in MPP_EXCHANGE_KERNELS:
+        mode = "shuffle" if "shuffle" in name else "broadcast"
+        try:
+            from ..mpp.exchange import trace_exchange_kernel
+
+            stats = _jaxpr_stats(trace_exchange_kernel(mode))
+        except Exception as e:  # noqa: BLE001 — contract break
+            emit(name, f"exchange kernel trace failed: "
+                       f"{type(e).__name__}: {e}")
+            continue
+        if collect_stats is not None:
+            collect_stats[name] = stats
+            continue
+        base = baseline_kernels.get(name)
+        if base is None:
+            emit(name, f"kernel not in baseline (measured {stats}); run "
+                       "python -m tidb_tpu.lint --update-baseline")
+        elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+            emit(name,
+                 f"int64 equation count grew {base.get('i64_eqns')} -> "
+                 f"{stats['i64_eqns']}: an int64-emulation chain was "
+                 "reintroduced into the exchange program")
 
     # -- recompile-bomb guard -------------------------------------------
     # count only signatures the corpus itself compiles: the engine caches

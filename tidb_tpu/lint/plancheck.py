@@ -222,6 +222,43 @@ class _Checker:
                              f"{lk.payload_ftypes[j].kind.name} but build "
                              f"schema col is {build_fts[pos].kind.name}")
 
+    # the sender IS a table reader (schema == cop DAG output); receivers
+    # are pass-through markers
+    _chk_PhysExchangeSender = _chk_PhysTableReader
+
+    def _chk_PhysExchangeReceiver(self, p):
+        if len(p.schema) != len(self._child_fts(p)):
+            self.fail(p, "exchange receiver must preserve sender schema")
+
+    def _chk_PhysMPPJoin(self, p):
+        lfts = self._child_fts(p, 0)
+        rfts = self._child_fts(p, 1)
+        probe = p.probe_sender
+        build = p.build_sender
+        if not (0 <= probe.key_pos < len(probe.schema)):
+            self.fail(p, f"probe key pos {probe.key_pos} out of range")
+        if not (0 <= build.key_pos < len(build.schema)):
+            self.fail(p, f"build key pos {build.key_pos} out of range")
+        pkft = probe.schema.col(probe.key_pos).ftype
+        bkft = build.schema.col(build.key_pos).ftype
+        if pkft.kind != bkft.kind or pkft.scale != bkft.scale:
+            self.fail(p, f"join key domains differ: {pkft.kind.name}"
+                         f"(s{pkft.scale}) vs {bkft.kind.name}"
+                         f"(s{bkft.scale})")
+        if p.aggs is not None:
+            width = sum(len(a.partial_types()) for a in p.aggs)
+            if len(p.schema) != width:
+                self.fail(p, f"partial-agg schema width {len(p.schema)} "
+                             f"!= {width} partial state cols")
+            return
+        if len(p.schema) != len(lfts) + len(rfts):
+            self.fail(p, f"join schema width {len(p.schema)} != "
+                         f"{len(lfts)} + {len(rfts)} child cols")
+        for i, (ft, sc) in enumerate(zip(lfts + rfts, p.schema.cols)):
+            if not _kinds_ok(ft, sc.ftype):
+                self.fail(p, f"join schema col #{i} {sc.ftype.kind.name} "
+                             f"!= child output {ft.kind.name}")
+
     def _chk_PhysProjection(self, p):
         fts = self._child_fts(p)
         if len(p.exprs) != len(p.schema):

@@ -1,0 +1,33 @@
+"""MPP exchange engine: device-resident partitioned shuffle joins.
+
+The TPU-native analog of TiFlash's MPP engine (ExchangeSender /
+ExchangeReceiver hash shuffles feeding per-node hash joins).  Where the
+reference ships rows between TiFlash nodes over gRPC, this engine keeps
+both join sides device-resident and exchanges hash partitions between
+mesh shards with `jax.lax.all_to_all` inside ONE compiled `shard_map`
+program — the join never touches the host until its (row or partial-agg)
+output is read back.
+
+Layering:
+
+- exchange.py — device-side primitives: hash partition ids, static-
+  capacity bucket packing with an overflow sentinel, all_to_all /
+  all_gather wrappers, and the abstract-trace entry the lint
+  kernelcheck registers.
+- engine.py — run_mpp_join: eligibility, mesh + _MeshCache reuse,
+  compiled-program cache, the shuffle -> broadcast -> host failover
+  ladder (device-health aware, `mpp/exchange` failpoint), host chunk
+  assembly and scalar partial aggregation.
+- reader.py — MPPReaderExec, the root executor the planner's
+  PhysMPPJoin builds; falls back to the host HashJoinExec when the
+  engine declines.
+"""
+
+from .engine import (  # noqa: F401
+    MPPIneligible,
+    MPPJoinSide,
+    MPPJoinSpec,
+    MPPPartitionOverflow,
+    run_mpp_join,
+)
+from .reader import MPPReaderExec  # noqa: F401

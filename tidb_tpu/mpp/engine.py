@@ -1,0 +1,584 @@
+"""run_mpp_join: the device-resident partitioned shuffle join engine.
+
+Reference: TiFlash's MPP task graph — ExchangeSender hash-partitions each
+plan fragment's rows, ExchangeReceiver reassembles partitions per node,
+and a per-node hash join runs on co-partitioned inputs.  Mapped onto the
+mesh: both sides' base tables are already sharded over the device mesh
+(`copr.parallel.MESH_CACHE`), so the "fragments" are shard_map shards,
+the sender/receiver pair is one `jax.lax.all_to_all` per column, and the
+co-partitioned local join is argsort + searchsorted — one compiled XLA
+program from scan to joined rows (or scalar partials).
+
+Join-strategy ladder (README "MPP exchange engine"):
+
+1. shuffle    — both sides hash-partitioned by join key and exchanged;
+                per-(src,dst) buckets have static capacity, so skew
+                overflows are detected on device and demote to
+2. broadcast  — the build side is replicated to every shard via
+                all_gather (no probe exchange, immune to probe skew);
+                build sides above DEVICE_JOIN_BUILD_MAX skip to
+3. host       — MPPIneligible is raised and the caller (MPPReaderExec)
+                runs the root HashJoinExec.
+
+Device failures ride the copr.device_health ladder: a classified error
+trips the chip's breaker, evicts poisoned sharded arrays, REBUILDS the
+mesh and retries; exhausted retries or an all-open breaker set demote to
+the host rung instead of failing the query.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import ops  # noqa: F401  (configures x64)
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 stable API
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..chunk import Chunk, Column
+from ..copr import jax_engine as je
+from ..copr.device_health import classify_failure
+from ..copr.jax_engine import _Analyzed, _fingerprint, _to_state_dtype
+from ..copr.jax_eval import JaxUnsupported, compile_expr
+from ..copr.parallel import (
+    _all_true,
+    _cols_env,
+    _handle_mesh_failure,
+    _layout,
+    _no_eligible_devices,
+    _packed_jit,
+    get_mesh,
+)
+from ..copr.ir import DAG
+from ..metrics import REGISTRY
+from ..store.fault import FAILPOINTS
+from ..store.kv import KeyRange
+from ..types import TypeKind
+from . import exchange as ex
+
+# broadcast rung ceiling: replicating the build side to every shard costs
+# S * build bytes of HBM; above this the only safe rung is the host join
+# (same constant the planner's broadcast lookup join gates on)
+from ..planner.physical import DEVICE_JOIN_BUILD_MAX  # noqa: E402
+
+
+class MPPIneligible(Exception):
+    """The MPP engine declines this join; the caller takes the host
+    rung.  Message = reason string (surfaced in EXPLAIN ANALYZE)."""
+
+
+class MPPPartitionOverflow(Exception):
+    """A (source, destination) exchange bucket exceeded its static
+    capacity: the compiled program dropped rows, so the result is
+    incomplete and the run must step down the ladder."""
+
+
+@dataclass
+class MPPJoinSide:
+    """One side of the join: a scan[+selection] cop DAG over one table."""
+
+    table_id: int
+    dag: dict                   # serialized DAG (TableScanIR + SelectionIR*)
+    ranges: List[KeyRange]
+    key_pos: int                # scan-output position of the join key
+    out_ftypes: list = field(default_factory=list)  # schema ftypes by pos
+
+
+@dataclass
+class MPPJoinSpec:
+    probe: MPPJoinSide
+    build: MPPJoinSide
+    kind: str                   # "inner" | "left_outer"
+    probe_is_left: bool
+    ts: int = 0
+    # scalar partial-agg pushdown: AggDescs over the JOINED layout
+    # (probe scan positions, then build positions at probe_width+j);
+    # only set for inner joins with probe_is_left
+    aggs: Optional[list] = None
+
+
+_COMPILED: Dict[str, object] = {}
+
+OUT_CHUNK_ROWS = 1 << 16
+
+
+def _pow2ceil(n: int) -> int:
+    c = 16
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _slack() -> float:
+    import os
+
+    return float(os.environ.get("TIDB_TPU_MPP_SLACK", "2.0"))
+
+
+class _SideState:
+    """Everything one side contributes to the program: analysis, layout,
+    device arrays, range bounds."""
+
+    def __init__(self, storage, side: MPPJoinSide, ts: int, mesh):
+        self.side = side
+        self.table = storage.table(side.table_id)
+        t = self.table
+        if t.base_rows == 0:
+            raise MPPIneligible(f"table {side.table_id} empty")
+        if t.base_ts > ts:
+            raise MPPIneligible("stale snapshot")
+        deleted, inserted = t.delta_overlay(ts, 0, 1 << 62)
+        if inserted:
+            # committed delta rows live host-side; joining them against
+            # device-resident rows needs the host join
+            raise MPPIneligible("delta rows present")
+        self.deleted = deleted
+        if any(kr.table_id != side.table_id for kr in side.ranges):
+            raise MPPIneligible("partitioned ranges")
+        if len(side.ranges) > 4:
+            raise MPPIneligible(f"{len(side.ranges)} disjoint ranges")
+        dag = DAG.from_dict(side.dag)
+        try:
+            self.an = _Analyzed(dag, t)
+        except JaxUnsupported as e:
+            raise MPPIneligible(str(e))
+        an = self.an
+        if an.agg or an.topn or an.probes or an.lookups or an.projection:
+            raise MPPIneligible("side DAG is not scan+selection")
+        kft = an.scan.ftypes[side.key_pos]
+        if kft.kind in (TypeKind.FLOAT, TypeKind.STRING):
+            raise MPPIneligible(f"non-int join key {kft.kind.name}")
+        for ft in an.scan.ftypes:
+            if ft.kind == TypeKind.DECIMAL and ft.is_wide_decimal:
+                raise MPPIneligible("wide-decimal column")
+        S = len(mesh.devices.ravel())
+        self.n_tiles, self.n_pad, self.Tl = _layout(t.base_rows, S)
+        self.n_local = self.Tl * je.TILE
+        self.col_order = list(range(len(an.scan.columns)))
+        self.bounds = [(max(kr.start, 0), min(kr.end, t.base_rows))
+                       for kr in side.ranges]
+
+    def load(self, mesh):
+        """Device arrays: cached sharded columns + deletion mask."""
+        from ..copr.parallel import load_columns
+
+        datas, valids = [], []
+        for d, v in load_columns(
+                mesh, self.table,
+                [self.an.scan.columns[ci] for ci in self.col_order]):
+            datas.append(d)
+            valids.append(v)
+        self.datas, self.valids = datas, valids
+        self.wire_sig = [(str(d.dtype), v is None)
+                         for d, v in zip(datas, valids)]
+        if self.deleted:
+            dm = np.ones((self.n_pad, je.TILE), dtype=np.bool_)
+            flat = dm.reshape(-1)
+            flat[np.fromiter(sorted(self.deleted), dtype=np.int64,
+                             count=len(self.deleted))] = False
+            self.del_mask = jax.device_put(
+                dm, NamedSharding(mesh, P("dp")))
+        else:
+            self.del_mask = _all_true(mesh, self.n_pad)
+
+    def exchange_cols(self):
+        """(scan position, env dtype itemsize) for every exchanged
+        column — the bytes-metric accounting."""
+        from ..copr.parallel import _full_dtype
+
+        return [(ci, _full_dtype(self.an.scan.ftypes[ci].kind).itemsize)
+                for ci in self.col_order]
+
+
+def _shard_side(an: _Analyzed, col_order, n_local: int, n_ranges: int):
+    """Returns fn(datas, valids, del_mask, bounds) -> (cols env, selected
+    row mask) for one side, evaluated per shard pre-exchange."""
+
+    def prep(datas, valids, del_mask, bounds):
+        cols = _cols_env(an, col_order, datas, valids, n_local)
+        shard = jax.lax.axis_index("dp").astype(jnp.int64)
+        gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
+        m = jnp.zeros(n_local, dtype=jnp.bool_)
+        for r in range(n_ranges):
+            m = m | ((gofs >= bounds[2 * r]) & (gofs < bounds[2 * r + 1]))
+        m = m & del_mask.reshape(n_local)
+        for c in an.conds:
+            d, v = compile_expr(c, cols, n_local)
+            m = m & v & (d != 0)
+        return cols, m
+
+    return prep
+
+
+def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
+                  mode: str, mesh, cap_p: int, cap_b: int):
+    """One shard_map program: per-shard scan+filter on both sides,
+    partition exchange (or build broadcast), co-partitioned local join,
+    then row emission or scalar partial aggregation."""
+    S = len(mesh.devices.ravel())
+    p_an, b_an = ps.an, bs.an
+    # capture ONLY scalars/analysis objects in the shard closure: the
+    # compiled program lives in _COMPILED for the process lifetime, and
+    # closing over the _SideState objects would pin both sides' sharded
+    # device arrays (and their table stores) against any cache eviction
+    p_order, b_order = list(ps.col_order), list(bs.col_order)
+    p_key_pos, b_key_pos = ps.side.key_pos, bs.side.key_pos
+    p_prep = _shard_side(p_an, p_order, ps.n_local, len(ps.bounds))
+    b_prep = _shard_side(b_an, b_order, bs.n_local, len(bs.bounds))
+    n_pb, n_bb = len(ps.bounds), len(bs.bounds)
+    louter = spec.kind == "left_outer"
+    n_out = S * cap_p if mode == "shuffle" else ps.n_local
+    aggs = spec.aggs
+
+    def shard_fn(p_datas, p_valids, p_del, p_bounds,
+                 b_datas, b_valids, b_del, b_bounds):
+        # ---- build side: filter, partition, exchange ------------------
+        b_cols, bm = b_prep(b_datas, b_valids, b_del, b_bounds)
+        bk_d, bk_v = b_cols[b_key_pos]
+        bk = bk_d.astype(jnp.int64)
+        bsel = bm & bk_v  # NULL build keys never match: drop pre-exchange
+        b_arrays = [bk]
+        for ci in b_order:
+            d, v = b_cols[ci]
+            b_arrays.append(d)
+            b_arrays.append(v)
+        if mode == "shuffle":
+            bpid = ex.partition_ids(bk, S)
+            bucketed, bval, b_over = ex.pack_buckets(
+                bpid, bsel, S, cap_b, b_arrays)
+            recv_b = [ex.exchange(a) for a in bucketed]
+            b_ok = ex.exchange(bval)
+        else:  # broadcast: replicate the whole filtered build side
+            recv_b = [ex.replicate(a) for a in b_arrays]
+            b_ok = ex.replicate(bsel)
+            b_over = jnp.int64(0)
+        rbk = recv_b[0]
+        sbk, bord, nb = ex.sorted_build(rbk, b_ok)
+        dups = jax.lax.psum(ex.duplicate_keys(sbk, nb), "dp")
+
+        # ---- probe side ----------------------------------------------
+        p_cols, pm = p_prep(p_datas, p_valids, p_del, p_bounds)
+        pk_d, pk_v = p_cols[p_key_pos]
+        pk = pk_d.astype(jnp.int64)
+        # left outer keeps NULL-key probe rows (they emit with NULL build
+        # cols); inner drops them pre-exchange
+        psel = pm & (pk_v if not louter else jnp.bool_(True))
+        p_arrays = [jnp.where(pk_v, pk, 0), pk_v]
+        for ci in p_order:
+            d, v = p_cols[ci]
+            p_arrays.append(d)
+            p_arrays.append(v)
+        if mode == "shuffle":
+            ppid = ex.partition_ids(p_arrays[0], S)
+            bucketed, pval, p_over = ex.pack_buckets(
+                ppid, psel, S, cap_p, p_arrays)
+            recv_p = [ex.exchange(a) for a in bucketed]
+            p_ok = ex.exchange(pval)
+        else:  # probe rows stay local on the broadcast rung
+            recv_p = p_arrays
+            p_ok = psel
+            p_over = jnp.int64(0)
+        rpk, rpk_v = recv_p[0], recv_p[1]
+
+        # ---- co-partitioned local join -------------------------------
+        hit, bidx = ex.probe_sorted(sbk, bord, nb, rpk, rpk_v & p_ok)
+        overflow = jax.lax.psum(b_over + p_over, "dp")
+
+        probe_out = []
+        for j, ci in enumerate(p_order):
+            probe_out.append((recv_p[2 + 2 * j], recv_p[3 + 2 * j]))
+        build_out = []
+        for j, ci in enumerate(b_order):
+            d = recv_b[1 + 2 * j][bidx]
+            v = hit & recv_b[2 + 2 * j][bidx]
+            build_out.append((d, v))
+
+        if aggs is None:
+            flat = []
+            for d, v in probe_out + build_out:
+                flat.append(d)
+                flat.append(v)
+            return (overflow, dups, p_ok, hit, tuple(flat))
+
+        # ---- scalar partial aggregation (inner join only) ------------
+        wp = len(p_order)
+        env = {ci: probe_out[j] for j, ci in enumerate(p_order)}
+        for j in range(len(b_order)):
+            env[wp + j] = build_out[j]
+        row_mask = p_ok & hit
+        states = []
+        for a in aggs:
+            if a.name == "count":
+                if a.args:
+                    d, v = compile_expr(a.args[0], env, n_out)
+                    states.append(jax.lax.psum(
+                        (row_mask & v).sum().astype(jnp.int64), "dp"))
+                else:
+                    states.append(jax.lax.psum(
+                        row_mask.sum().astype(jnp.int64), "dp"))
+                continue
+            d, v = compile_expr(a.args[0], env, n_out)
+            mv = row_mask & v
+            if a.name in ("sum", "avg"):
+                st = a.partial_types()[0]
+                dd = _to_state_dtype(d, a.args[0].ftype, st)
+                states.append((
+                    jax.lax.psum(jnp.where(mv, dd, 0).sum(), "dp"),
+                    jax.lax.psum(mv.sum().astype(jnp.int64), "dp"),
+                ))
+            else:  # min / max: per-shard partial, host merges (the axon
+                # backend only lowers Sum all-reduces)
+                if a.name == "min":
+                    sent = (jnp.inf if jnp.issubdtype(d.dtype, jnp.floating)
+                            else ex.I64_MAX)
+                    part = jnp.where(mv, d, sent).min()
+                else:
+                    sent = (-jnp.inf if jnp.issubdtype(d.dtype, jnp.floating)
+                            else -ex.I64_MAX - 1)
+                    part = jnp.where(mv, d, sent).max()
+                states.append((
+                    part.reshape(1),
+                    jax.lax.psum(mv.sum().astype(jnp.int64), "dp"),
+                ))
+        return (overflow, dups, tuple(states))
+
+    if aggs is None:
+        out_specs = (P(), P(), P("dp"), P("dp"), tuple(
+            P("dp") for _ in range(2 * (len(p_order) + len(b_order)))))
+    else:
+        out_states = []
+        for a in aggs:
+            if a.name == "count":
+                out_states.append(P())
+            elif a.name in ("sum", "avg"):
+                out_states.append((P(), P()))
+            else:
+                out_states.append((P("dp"), P()))
+        out_specs = (P(), P(), tuple(out_states))
+
+    fn = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp"), tuple(P() for _ in
+                                                   range(2 * n_pb)),
+                  P("dp"), P("dp"), P("dp"), tuple(P() for _ in
+                                                   range(2 * n_bb))),
+        out_specs=out_specs,
+    )
+    return _packed_jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# host-side assembly
+# ---------------------------------------------------------------------------
+
+
+def _to_column(table, an: _Analyzed, pos: int, ft, data: np.ndarray,
+               valid: np.ndarray) -> Column:
+    """Device env array (widened dtype) -> host Column of `ft`, decoding
+    dictionary codes for STRING columns through the side's own store."""
+    if ft.kind == TypeKind.STRING:
+        from ..store.blockstore import _decode_dict
+
+        store_ci = an.scan.columns[pos]
+        obj = _decode_dict(data.astype(np.int64),
+                           table.cols[store_ci].dictionary)
+        return Column(ft, obj, valid)
+    return Column(ft, data.astype(ft.np_dtype), valid)
+
+
+def _assemble_rows(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
+                   p_ok, hit, flat) -> List[Chunk]:
+    louter = spec.kind == "left_outer"
+    sel = np.flatnonzero(p_ok & hit) if not louter else np.flatnonzero(p_ok)
+    wp = len(ps.col_order)
+    probe_cols, build_cols = [], []
+    for j, ci in enumerate(ps.col_order):
+        d, v = flat[2 * j], flat[2 * j + 1]
+        ft = spec.probe.out_ftypes[ci]
+        probe_cols.append(_to_column(
+            ps.table, ps.an, ci, ft, d[sel], v[sel].astype(np.bool_)))
+    for j, ci in enumerate(bs.col_order):
+        d, v = flat[2 * wp + 2 * j], flat[2 * wp + 2 * j + 1]
+        ft = spec.build.out_ftypes[ci]
+        if louter:
+            ft = ft.with_nullable(True)
+        build_cols.append(_to_column(
+            bs.table, bs.an, ci, ft, d[sel], v[sel].astype(np.bool_)))
+    cols = (probe_cols + build_cols if spec.probe_is_left
+            else build_cols + probe_cols)
+    big = Chunk(cols)
+    return [c for c in big.split(OUT_CHUNK_ROWS) if c.num_rows]
+
+
+def _assemble_partials(spec: MPPJoinSpec, states, S: int) -> List[Chunk]:
+    """Per-agg partial states -> ONE partial row in the same
+    [states...] layout the cop partial-agg paths emit (the root final
+    HashAgg merges it)."""
+    cols: List[Column] = []
+    for a, st in zip(spec.aggs, states):
+        pts = a.partial_types()
+        if a.name == "count":
+            cols.append(Column(pts[0], np.array([int(st)], np.int64)))
+        elif a.name in ("sum", "avg"):
+            sm, c = st
+            c = int(c)
+            sum_col = Column(pts[0],
+                             np.array([sm]).astype(pts[0].np_dtype),
+                             np.array([c > 0]))
+            cols.append(sum_col)
+            if a.name == "avg":
+                cols.append(Column(pts[1], np.array([c], np.int64)))
+        else:  # min / max: merge the S per-shard partials host-side
+            part, c = st
+            c = int(c)
+            v = part.min() if a.name == "min" else part.max()
+            cols.append(Column(pts[0],
+                               np.array([v]).astype(pts[0].np_dtype),
+                               np.array([c > 0])))
+    return [Chunk(cols)]
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
+    mesh = get_mesh()
+    S = len(mesh.devices.ravel())
+    mesh_ids = tuple(d.id for d in mesh.devices.ravel())
+    ps = _SideState(storage, spec.probe, spec.ts, mesh)
+    bs = _SideState(storage, spec.build, spec.ts, mesh)
+    if mode == "broadcast" and bs.table.base_rows > DEVICE_JOIN_BUILD_MAX:
+        raise MPPIneligible(
+            f"build side {bs.table.base_rows} rows exceeds broadcast cap")
+    slack = _slack()
+    cap_p = min(_pow2ceil(int(slack * ps.n_local / S) + 1), ps.n_local)
+    cap_b = min(_pow2ceil(int(slack * bs.n_local / S) + 1), bs.n_local)
+
+    # column arrays load before the program lookup (compiled programs are
+    # specialized on wire dtypes / null patterns, like the mesh scan)
+    ps.load(mesh)
+    bs.load(mesh)
+
+    import json as _json
+
+    from ..copr.ir import serialize_expr
+
+    agg_sig = ""
+    if spec.aggs is not None:
+        agg_sig = _json.dumps(
+            [[a.name] + [serialize_expr(x) for x in a.args]
+             for a in spec.aggs], sort_keys=True)
+    fp = (f"mpp|{mode}|{spec.kind}|pil={spec.probe_is_left}"
+          f"|S={S} devs={mesh_ids} caps={cap_p},{cap_b}"
+          f"|p:{_fingerprint(ps.an, 'filter')}|Tl={ps.Tl}"
+          f"|k={spec.probe.key_pos}|wire={ps.wire_sig}|R={len(ps.bounds)}"
+          f"|b:{_fingerprint(bs.an, 'filter')}|Tl={bs.Tl}"
+          f"|k={spec.build.key_pos}|wire={bs.wire_sig}|R={len(bs.bounds)}"
+          f"|aggs={agg_sig}")
+    fn = _COMPILED.get(fp)
+    if fn is None:
+        fn = _build_mpp_fn(spec, ps, bs, mode, mesh, cap_p, cap_b)
+        _COMPILED[fp] = fn
+
+    # deterministic mid-shuffle fault injection (chaos harness): fires
+    # after both sides are device-resident, before the exchange program
+    FAILPOINTS.hit("mpp/exchange", mode=mode, device_ids=mesh_ids,
+                   kind=spec.kind)
+
+    def bounds_args(st: _SideState):
+        out = []
+        for lo, hi in st.bounds:
+            out.append(jnp.int64(lo))
+            out.append(jnp.int64(hi))
+        return tuple(out)
+
+    out = fn(tuple(ps.datas), tuple(ps.valids), ps.del_mask,
+             bounds_args(ps),
+             tuple(bs.datas), tuple(bs.valids), bs.del_mask,
+             bounds_args(bs))
+    overflow, dups = int(out[0]), int(out[1])
+    if dups:
+        # the planner's uniqueness inference was wrong: the device picks
+        # one arbitrary match per probe row, so its output cannot be
+        # trusted — demote to the host join, which expands duplicates
+        REGISTRY.inc("mpp_build_dup_fallback_total")
+        raise MPPIneligible(
+            "build keys not unique (planner uniqueness inference "
+            "violated); host join handles duplicates")
+    if overflow:
+        raise MPPPartitionOverflow(
+            f"{overflow} rows over per-partition capacity "
+            f"(cap_p={cap_p}, cap_b={cap_b}, mode={mode})")
+
+    # exchange traffic accounting (static shapes: what the program moved)
+    if mode == "shuffle":
+        per_pair = 8 + 1  # key + bucket validity
+        for _ci, isz in ps.exchange_cols():
+            per_pair += isz + 1
+        nbytes = S * S * cap_p * per_pair + S * S * cap_p  # + key-valid
+        per_pair_b = 8 + 1
+        for _ci, isz in bs.exchange_cols():
+            per_pair_b += isz + 1
+        nbytes += S * S * cap_b * per_pair_b
+    else:
+        per_row = 8 + 1
+        for _ci, isz in bs.exchange_cols():
+            per_row += isz + 1
+        nbytes = S * S * bs.n_local * per_row
+    REGISTRY.inc("mpp_exchange_bytes_total", float(nbytes))
+
+    from ..copr.device_health import DEVICE_HEALTH
+
+    DEVICE_HEALTH.record_success(mesh_ids)
+    if spec.aggs is not None:
+        return _assemble_partials(spec, out[2], S)
+    return _assemble_rows(spec, ps, bs, out[2], out[3], out[4])
+
+
+def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
+    """Run the join over the mesh; (chunks, mode) on success, raises
+    MPPIneligible when the host rung must serve it.  Overflow and device
+    failures step down the ladder internally."""
+    mode = "shuffle"
+    attempts = 0
+    while True:
+        if _no_eligible_devices():
+            raise MPPIneligible("all device breakers open")
+        try:
+            chunks = _run_once(storage, spec, mode)
+            REGISTRY.inc("mpp_joins_total")
+            REGISTRY.inc(f"mpp_joins_{mode}_total")
+            return chunks, mode
+        except MPPPartitionOverflow as e:
+            REGISTRY.inc("mpp_partition_overflow_total")
+            if mode == "shuffle":
+                mode = "broadcast"  # immune to probe-side skew
+                continue
+            raise MPPIneligible(f"partition overflow: {e}")
+        except (MPPIneligible, KeyboardInterrupt, SystemExit,
+                GeneratorExit):
+            raise
+        except BaseException as e:
+            from ..errors import TiDBTPUError
+
+            if isinstance(e, TiDBTPUError):
+                # semantic errors (kill/quota/lock) keep their meaning;
+                # they are never device-health events
+                raise
+            if not _handle_mesh_failure(None, e, attempts):
+                if classify_failure(e) is not None:
+                    # classified device failure, retries exhausted:
+                    # step down to the host rung instead of failing
+                    raise MPPIneligible(f"device failure: {e}")
+                raise
+            attempts += 1

@@ -1,0 +1,174 @@
+"""Device-side exchange primitives for the MPP shuffle join.
+
+The partition/exchange shape follows TQP's relational-algebra-on-tensors
+mapping (PAPERS.md): a hash shuffle is a static-shape bucket pack + one
+`all_to_all` per column, and the local join is argsort + searchsorted —
+all fixed-shape XLA ops, so the whole exchange compiles into the same
+shard_map program as the scans feeding it.
+
+Static capacities: each (source shard -> destination shard) bucket holds
+at most `cap` rows.  Data-dependent overflow cannot resize a compiled
+program, so it is *counted* on device and surfaced as a scalar the host
+checks — the MeshAggOverflow contract (copr/parallel.py) applied to
+exchanges; the caller then steps down the join-strategy ladder.
+
+Backend notes (mirrors copr/parallel.py): no 64-bit bitcasts (the axon
+TPU x64 rewriter cannot lower them), so the partition hash stays in
+int64 value arithmetic (wrapping multiply + arithmetic-shift xor), and
+all_to_all payloads keep their widened column dtypes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .. import ops  # noqa: F401  (configures x64)
+import jax
+import jax.numpy as jnp
+
+# splitmix64's multiplicative constant, wrapped into int64 — spreads
+# clustered keys (sequential order keys, FK ranges) across partitions so
+# the static bucket capacity sees near-uniform load
+_MIX = np.int64(np.uint64(0x9E3779B97F4A7C15).astype(np.int64))
+
+I64_MAX = np.iinfo(np.int64).max
+
+
+def partition_ids(key, n_parts: int):
+    """[0, n_parts) partition id per int64 key, identical on both join
+    sides (the ExchangeSender hash of tipb.ExchangeType_Hash)."""
+    h = key * _MIX
+    h = h ^ (h >> 31)  # arithmetic shift: sign bits only perturb, not bias
+    return jnp.mod(h, n_parts)
+
+
+def pack_buckets(pid, pack_mask, n_parts: int, cap: int,
+                 arrays: Sequence) -> Tuple[List, object, object]:
+    """Scatter local rows into [n_parts, cap] destination buckets.
+
+    One argsort on partition id groups each destination's rows
+    contiguously; bucket d then gathers rows [offset_d, offset_d+cap).
+    Returns (bucketed arrays, bucket validity [n_parts, cap], overflow =
+    max rows any bucket wanted minus cap, clamped at 0).  Rows beyond a
+    bucket's capacity are DROPPED on device — the overflow scalar is how
+    the host learns the result is incomplete and must fall back.
+    """
+    n = pid.shape[0]
+    # unselected rows sort last (pid n_parts), never land in a bucket
+    skey = jnp.where(pack_mask, pid, n_parts)
+    order = jnp.argsort(skey)
+    ssorted = skey[order]
+    offsets = jnp.searchsorted(ssorted, jnp.arange(n_parts + 1))
+    counts = offsets[1:] - offsets[:-1]
+    overflow = jnp.maximum(counts.max() - cap, 0)
+    slot = jnp.arange(cap)
+    idx = offsets[:-1][:, None] + slot[None, :]          # [n_parts, cap]
+    bucket_valid = slot[None, :] < counts[:, None]
+    rows = order[jnp.clip(idx, 0, n - 1)]
+    out = [a[rows] for a in arrays]
+    return out, bucket_valid, overflow
+
+
+def exchange(bucketed, axis_name: str = "dp"):
+    """all_to_all one [S, cap] bucketed array: row d of the input is this
+    shard's partition destined for shard d; row j of the output is the
+    partition shard j sent here.  Flattened to [S*cap] local rows."""
+    out = jax.lax.all_to_all(bucketed, axis_name, split_axis=0,
+                             concat_axis=0, tiled=True)
+    return out.reshape(-1)
+
+
+def replicate(local, axis_name: str = "dp"):
+    """all_gather a per-shard array to every shard (the broadcast-join
+    rung: the build side is replicated instead of partitioned)."""
+    return jax.lax.all_gather(local, axis_name).reshape(-1)
+
+
+def sorted_build(keys, valid):
+    """(sorted keys with invalid rows pushed to +inf, source order,
+    valid count) — the device hash table: searchsorted probes against
+    the sorted unique build keys."""
+    sortk = jnp.where(valid, keys, I64_MAX)
+    order = jnp.argsort(sortk)
+    return sortk[order], order, valid.sum()
+
+
+def probe_sorted(sbk, bord, nb, probe_keys, probe_ok):
+    """(hit mask, matched build source index) for each probe row against
+    a sorted unique build key set."""
+    pos = jnp.searchsorted(sbk, probe_keys)
+    posc = jnp.clip(pos, 0, sbk.shape[0] - 1)
+    hit = (pos < nb) & (sbk[posc] == probe_keys) & probe_ok
+    return hit, bord[posc]
+
+
+def duplicate_keys(sbk, nb):
+    """Count adjacent equal VALID keys in a sorted build key array — the
+    planner's uniqueness inference is re-verified on device; a nonzero
+    count demotes the join to the host (which handles duplicates)."""
+    ar = jnp.arange(sbk.shape[0])
+    return ((sbk == jnp.roll(sbk, 1)) & (ar > 0) & (ar < nb)).sum()
+
+
+# ---------------------------------------------------------------------------
+# kernelcheck registration: abstract-trace the exchange + partitioned join
+# ---------------------------------------------------------------------------
+
+
+def _canonical_join_fn(S: int, cap: int, n_local: int, mode: str):
+    """The canonical partition -> exchange -> local-join program shape
+    the lint kernelcheck traces (no tables, no engine state): one int64
+    key + one f64 payload per side, inner-join semantics."""
+
+    def shard_fn(pk, pm, bk, bm, pv):
+        if mode == "shuffle":
+            bpid = partition_ids(bk, S)
+            (bkb, bvb), bval, b_over = pack_buckets(
+                bpid, bm, S, cap, (bk, pv))
+            rbk = exchange(bkb)
+            rbv = exchange(bvb)
+            b_ok = exchange(bval)
+            ppid = partition_ids(pk, S)
+            (pkb,), pval, p_over = pack_buckets(ppid, pm, S, cap, (pk,))
+            rpk = exchange(pkb)
+            p_ok = exchange(pval)
+        else:  # broadcast
+            rbk = replicate(jnp.where(bm, bk, I64_MAX))
+            rbv = replicate(pv)
+            b_ok = replicate(bm)
+            rpk, p_ok = pk, pm
+            b_over = p_over = jnp.int64(0)
+        sbk, bord, nb = sorted_build(rbk, b_ok)
+        hit, bidx = probe_sorted(sbk, bord, nb, rpk, p_ok)
+        payload = jnp.where(hit, rbv[bidx], 0.0)
+        overflow = jax.lax.psum(b_over + p_over, "dp")
+        return overflow, hit, payload
+
+    return shard_fn
+
+
+def trace_exchange_kernel(mode: str = "shuffle"):
+    """make_jaxpr stats for the canonical exchange join over a 1-device
+    mesh (deterministic across environments regardless of how many
+    virtual devices the harness exposes); used by lint.kernelcheck."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    S, cap, n_local = 1, 64, 256
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    fn = shard_map(
+        _canonical_join_fn(S, cap, n_local, mode), mesh=mesh,
+        in_specs=(P("dp"),) * 5,
+        out_specs=(P(), P("dp"), P("dp")),
+    )
+    args = (
+        jnp.zeros(n_local, jnp.int64), jnp.ones(n_local, jnp.bool_),
+        jnp.zeros(n_local, jnp.int64), jnp.ones(n_local, jnp.bool_),
+        jnp.zeros(n_local, jnp.float64),
+    )
+    return jax.make_jaxpr(fn)(*args)

@@ -1,0 +1,196 @@
+"""MPPReaderExec: the root executor driving the MPP exchange engine.
+
+The role of executor/table_reader.go for MPP fragments: own the two cop
+DAGs (probe + build), hand them to the device engine, and stream joined
+chunks (or one scalar-partial chunk) to the parent.  When the engine
+declines — ineligible shapes, partition overflow past the broadcast
+rung, exhausted device retries — the SAME plan runs as a root
+HashJoinExec over two TableReaderExecs, so the ladder always terminates
+in a correct host join (EXPLAIN ANALYZE shows which rung served it).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..chunk import Chunk
+from ..executor.base import ExecContext, Executor
+from ..expr.expression import ColumnExpr
+from ..metrics import REGISTRY
+from .engine import MPPIneligible, MPPJoinSpec, run_mpp_join
+
+
+class MPPReaderExec(Executor):
+    def __init__(self, ctx: ExecContext, spec: MPPJoinSpec, ftypes,
+                 plan_id: int = -1):
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.spec = spec
+        self._chunks: Optional[List[Chunk]] = None
+        self._pos = 0
+        self._fallback: Optional[Executor] = None
+
+    def _open(self):
+        self._chunks = None
+        self._pos = 0
+        self._fallback = None
+
+    def _attribute(self, engine: str):
+        if self.plan_id >= 0:
+            self.ctx.op_stats(self.plan_id).engine = engine
+
+    def _run(self):
+        spec = self.spec
+        spec.ts = self.ctx.snapshot_ts()
+        if self.ctx.engine != "tpu":
+            self._start_fallback("engine=cpu")
+            return
+        try:
+            self._chunks, mode = run_mpp_join(self.ctx.storage, spec)
+            self._attribute(f"mpp-{mode}")
+        except MPPIneligible as e:
+            self._start_fallback(str(e))
+
+    # ---- host rung -----------------------------------------------------
+    def _side_reader(self, side, probe_ir=None) -> Executor:
+        from ..copr.ir import DAG
+        from ..executor.readers import TableReaderExec
+
+        dag = DAG.from_dict(side.dag)
+        if probe_ir is not None:
+            dag.executors.append(probe_ir)
+        return TableReaderExec(self.ctx, dag, list(side.ranges),
+                               dag.output_ftypes(), plan_id=-1)
+
+    def _start_fallback(self, reason: str):
+        """Root hash join over the same two cop DAGs (always correct:
+        handles deltas, duplicates, overflow shapes).  Inner joins keep
+        the MPP plan's selectivity win: the build side's distinct keys
+        ship to the probe scan as a runtime semi-join filter
+        (JoinProbeIR), so non-matching probe rows die in the coprocessor
+        instead of streaming to the host join."""
+        from ..copr.ir import JoinProbeIR
+        from ..executor.join import HashJoinExec
+
+        REGISTRY.inc("mpp_fallback_total")
+        self._attribute(f"host-join [mpp rejected: {reason}]")
+        spec = self.spec
+        pk = ColumnExpr(spec.probe.key_pos,
+                        spec.probe.out_ftypes[spec.probe.key_pos], "pk", -1)
+        bk = ColumnExpr(spec.build.key_pos,
+                        spec.build.out_ftypes[spec.build.key_pos], "bk", -1)
+        probe_ir = JoinProbeIR(pk, filter_id=0) \
+            if spec.kind == "inner" else None
+        probe = self._side_reader(spec.probe, probe_ir)
+        build = self._side_reader(spec.build)
+        join = HashJoinExec(
+            self.ctx, build, probe, spec.kind, [bk], [pk], [],
+            probe_is_left=spec.probe_is_left, plan_id=-1,
+            rf_reader=probe if probe_ir is not None else None,
+            rf_key_idx=0, rf_filter_id=0)
+        if spec.aggs is None:
+            self._fallback = join
+            self._fallback.open()
+            return
+        # partial-agg pushdown plan: the parent is a FINAL HashAgg, so
+        # the host rung must emit the same [states...] partial layout.
+        # Fold per chunk — an MPP-eligible join is big by construction,
+        # so the joined rows must never be materialized whole
+        folds = [_AggFold(a) for a in spec.aggs]
+        join.open()
+        try:
+            while True:
+                c = join.next()
+                if c is None:
+                    break
+                if c.num_rows:
+                    for f in folds:
+                        f.consume(c)
+        finally:
+            join.close()
+        self._chunks = [Chunk([col for f in folds for col in f.partials()])]
+
+    def _next(self) -> Optional[Chunk]:
+        if self._fallback is not None:
+            return self._fallback.next()
+        if self._chunks is None:
+            self._run()
+            if self._fallback is not None:
+                return self._fallback.next()
+        if self._pos >= len(self._chunks):
+            return None
+        c = self._chunks[self._pos]
+        self._pos += 1
+        return c
+
+    def _close(self):
+        if self._fallback is not None:
+            self._fallback.close()
+            self._fallback = None
+
+
+class _AggFold:
+    """Streaming scalar-partial accumulator for one AggDesc over joined
+    chunks, emitting the device engine's partial layout
+    (engine._assemble_partials) without materializing the join."""
+
+    def __init__(self, a):
+        self.a = a
+        self.rows = 0      # count(*) input rows
+        self.count = 0     # non-NULL arg rows
+        self.sum = 0       # int or float, in the arg's physical domain
+        self.minmax = None
+
+    def consume(self, chunk: Chunk):
+        a = self.a
+        self.rows += chunk.num_rows
+        if not a.args:
+            return
+        v = a.args[0].eval(chunk)
+        data = v.data[v.validity()]
+        c = len(data)
+        self.count += c
+        if not c:
+            return
+        if a.name in ("sum", "avg"):
+            from ..types import TypeKind
+
+            if a.partial_types()[0].kind == TypeKind.FLOAT:
+                self.sum += float(data.astype(np.float64).sum())
+            else:
+                self.sum += int(data.astype(np.int64).sum())
+        elif a.name in ("min", "max"):
+            ext = data.min() if a.name == "min" else data.max()
+            if self.minmax is None:
+                self.minmax = ext
+            else:
+                self.minmax = (min(self.minmax, ext) if a.name == "min"
+                               else max(self.minmax, ext))
+
+    def partials(self) -> List:
+        from ..chunk import Column
+        from ..types import TypeKind
+
+        a = self.a
+        pts = a.partial_types()
+        if a.name == "count":
+            n = self.count if a.args else self.rows
+            return [Column(pts[0], np.array([n], np.int64))]
+        if a.name in ("sum", "avg"):
+            st, arg_ft = pts[0], a.args[0].ftype
+            sm = self.sum
+            if self.count:
+                if st.kind == TypeKind.FLOAT:
+                    if arg_ft.kind == TypeKind.DECIMAL:
+                        sm /= 10.0 ** arg_ft.scale
+                else:
+                    sm *= 10 ** (st.scale - arg_ft.scale)
+            cols = [Column(pts[0], np.array([sm]).astype(st.np_dtype),
+                           np.array([self.count > 0]))]
+            if a.name == "avg":
+                cols.append(Column(pts[1], np.array([self.count], np.int64)))
+            return cols
+        val = self.minmax if self.minmax is not None else 0
+        return [Column(pts[0], np.array([val]).astype(pts[0].np_dtype),
+                       np.array([self.count > 0]))]

@@ -156,8 +156,8 @@ class PhysTableReader(PhysicalPlan):
     def explain_tree(self, indent: int = 0, lines=None):
         lines = lines if lines is not None else []
         pad = ("  " * indent + "└─") if indent else ""
-        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(), "root",
-                      self.info()))
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(),
+                      self.task(), self.info()))
         for i, ex in enumerate(self.dag.executors):
             pad2 = "  " * (indent + 1 + i) + "└─"
             nm = type(ex).__name__.replace("IR", "")
@@ -226,6 +226,103 @@ class PhysDeviceJoinReader(PhysicalPlan):
         self.reader.explain_tree(indent + 1, lines)
         self.build_plan.explain_tree(indent + 1, lines)
         return lines
+
+
+class PhysExchangeSender(PhysTableReader):
+    """MPP fragment boundary: this scan's shards hash-partition their
+    rows by the join key and exchange them across the mesh
+    (tipb.ExchangeSender with ExchangeType Hash; TiFlash's
+    mpp.ExchangeSenderBlockInputStream role, realized as a
+    `jax.lax.all_to_all` inside the shard_map program)."""
+
+    def __init__(self, schema: Schema, task: CopTask, key_pos: int,
+                 ranges: Optional[List[KeyRange]] = None):
+        super().__init__(schema, task, keep_order=False, ranges=ranges)
+        self.key_pos = key_pos
+
+    def task(self) -> str:
+        return "mpp[tpu]"
+
+    def info(self) -> str:
+        key = self.cop.scan_cols[self.key_pos].name
+        return (f"ExchangeType: HashPartition, key:{key}, "
+                f"table:{self.cop.table.name}")
+
+
+class PhysExchangeReceiver(PhysicalPlan):
+    """Receiving end of the exchange: reassembles one hash partition per
+    mesh shard (tipb.ExchangeReceiver).  Pure plan-shape marker — the
+    sender/receiver pair compiles into the all_to_all collective."""
+
+    def __init__(self, sender: PhysExchangeSender):
+        super().__init__(sender.schema, [sender])
+
+    def task(self) -> str:
+        return "mpp[tpu]"
+
+    def info(self) -> str:
+        return "stream: hash-partitioned"
+
+
+class PhysMPPJoin(PhysicalPlan):
+    """Device-resident partitioned shuffle join over the mesh: children
+    = [left receiver, right receiver] in schema order; both sides stay
+    on device, partitions exchange via all_to_all, and the
+    co-partitioned local join (+ optional scalar partial aggregation)
+    completes inside the same compiled program.  Strategy ladder at
+    runtime: shuffle -> broadcast -> host hash join (mpp/engine.py)."""
+
+    def __init__(self, left_recv: PhysExchangeReceiver,
+                 right_recv: PhysExchangeReceiver, kind: str,
+                 probe_is_left: bool, schema: Schema,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 aggs=None, reason: str = ""):
+        super().__init__(schema, [left_recv, right_recv])
+        self.kind = kind
+        self.probe_is_left = probe_is_left
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.aggs = aggs  # scalar partial-agg pushdown (joined layout)
+        self.reason = reason  # cost-choice note surfaced in EXPLAIN
+
+    @property
+    def probe_sender(self) -> PhysExchangeSender:
+        recv = self.children[0 if self.probe_is_left else 1]
+        return recv.children[0]
+
+    @property
+    def build_sender(self) -> PhysExchangeSender:
+        recv = self.children[1 if self.probe_is_left else 0]
+        return recv.children[0]
+
+    def info(self) -> str:
+        keys = ", ".join(
+            f"{l}=={r}" for l, r in zip(self.left_keys, self.right_keys))
+        s = f"{self.kind} [{keys}] shuffle"
+        s += ", build:" + ("right" if self.probe_is_left else "left")
+        if self.aggs is not None:
+            s += f", partial aggs:[{', '.join(map(str, self.aggs))}]"
+        if self.reason:
+            s += f" ({self.reason})"
+        return s
+
+    def build(self, ctx):
+        from ..mpp import MPPJoinSide, MPPJoinSpec, MPPReaderExec
+
+        def side(sender: PhysExchangeSender) -> MPPJoinSide:
+            return MPPJoinSide(
+                table_id=sender.cop.table.id,
+                dag=sender.dag.to_dict(),
+                ranges=list(sender.ranges),
+                key_pos=sender.key_pos,
+                out_ftypes=sender.dag.output_ftypes(),
+            )
+
+        spec = MPPJoinSpec(
+            probe=side(self.probe_sender), build=side(self.build_sender),
+            kind=self.kind, probe_is_left=self.probe_is_left,
+            aggs=self.aggs)
+        return MPPReaderExec(ctx, spec, self.schema.ftypes(), self.id)
 
 
 class PhysIndexLookUp(PhysicalPlan):
@@ -818,6 +915,12 @@ class PhysicalContext:
     # tidb_check_plan: run the lint.plancheck schema/dtype verifier over
     # every finished physical plan (vet-for-plans; cheap host-side walk)
     check_plan: bool = False
+    # MPP shuffle-join routing (tidb_allow_mpp / tidb_enforce_mpp /
+    # tidb_broadcast_join_threshold_count): build sides at or below the
+    # threshold stay on the broadcast/host lanes; bigger ones shuffle
+    allow_mpp: bool = True
+    enforce_mpp: bool = False
+    mpp_threshold: int = 10240
 
 
 def to_physical(plan: LogicalPlan, pctx: PhysicalContext) -> PhysicalPlan:
@@ -1199,11 +1302,16 @@ def _physical_agg(plan: LogicalAggregation,
                 return PhysHashAgg(reader, fin_gb, plan.aggs, True,
                                    plan.schema)
     # agg over an eligible inner join: push scan+filter+JOIN+partial agg
-    # into one device program (the Q3/SSB star-aggregate shape)
+    # into one device program (the Q3/SSB star-aggregate shape); when the
+    # build side is too big to broadcast, the MPP shuffle join carries
+    # the same partial-agg pushdown (scalar aggs)
     if isinstance(child_l, LogicalJoin) and pctx.enable_pushdown:
         dj = _try_device_join_agg(plan, child_l, pctx)
         if dj is not None:
             return dj
+        mj = _try_mpp_join_agg(plan, child_l, pctx)
+        if mj is not None:
+            return mj
     child = to_physical(child_l, pctx)
     gb = _remap(plan.group_by, child.schema)
     aggs = [a.remap_columns(child.schema.position_map()) for a in plan.aggs]
@@ -1281,6 +1389,8 @@ def _try_device_join_agg(plan: LogicalAggregation, join: LogicalJoin,
         return None
     if pctx.prefer_merge_join:
         return None  # MERGE_JOIN hint/binding pins the root algorithm
+    if pctx.enforce_mpp:
+        return None  # tidb_enforce_mpp pins the exchange engine
     left, right = join.children
     le, re_ = join.eq_conds[0]
     for probe_l, build_l, pk_e, bk_e in (
@@ -1541,11 +1651,189 @@ def _ij_type_ok(ct: FieldType, inner_ft: FieldType) -> bool:
     return True
 
 
+# MPP shuffle joins exchange full column payloads between shards, so the
+# output columns must be device-representable (int-domain, float, or
+# dict-coded strings the host decodes after readback)
+_MPP_OUT_KINDS = _DJ_PAYLOAD_KINDS + (TypeKind.STRING,)
+
+
+def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
+    """Structural + cost gates for the MPP shuffle join; returns
+    (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
+    build_est) or None.  Mirrors TiFlash's MPP eligibility:
+    single int-domain equi-key, unique build key (device joins are
+    lookup joins), plain scan[+selection] fragments on both sides."""
+    if join.kind not in ("inner", "left_outer") or len(join.eq_conds) != 1 \
+            or join.other_conds:
+        return None
+    if not pctx.allow_mpp or not pctx.enable_pushdown \
+            or pctx.prefer_merge_join:
+        return None
+    le, re_ = join.eq_conds[0]
+    if not isinstance(le, ColumnExpr) or not isinstance(re_, ColumnExpr):
+        return None
+    left, right = join.children
+    orders = [(left, right, le, re_, True)]
+    if join.kind == "inner":
+        orders.append((right, left, re_, le, False))
+    for probe_l, build_l, pk, bk, probe_is_left in orders:
+        if not isinstance(probe_l, LogicalDataSource) \
+                or not isinstance(build_l, LogicalDataSource):
+            continue
+        if probe_l.table.is_partitioned or build_l.table.is_partitioned:
+            continue  # partition stores shard per-partition, not per-mesh
+        if pk.ftype.kind not in _DJ_KEY_KINDS \
+                or bk.ftype.kind != pk.ftype.kind:
+            continue
+        if pk.ftype.kind == TypeKind.DECIMAL \
+                and bk.ftype.scale != pk.ftype.scale:
+            continue
+        if bk.unique_id < 0 or not _build_key_unique(build_l, bk.unique_id):
+            continue  # device join is a lookup join: <=1 match per probe
+        if any(c.ftype.kind not in _MPP_OUT_KINDS
+               or (c.ftype.kind == TypeKind.DECIMAL
+                   and c.ftype.is_wide_decimal)
+               for c in list(probe_l.schema.cols) + list(build_l.schema.cols)):
+            continue
+        p_task, p_resid = _start_cop(probe_l, pctx)
+        if p_task is None or p_resid or p_task.ranges == []:
+            continue
+        b_task, b_resid = _start_cop(build_l, pctx)
+        if b_task is None or b_resid or b_task.ranges == []:
+            continue
+        if any(not isinstance(x, SelectionIR)
+               for x in p_task.dag_execs + b_task.dag_execs):
+            continue
+        pk_pos = p_task.scan_pos_map().get(pk.unique_id)
+        bk_pos = b_task.scan_pos_map().get(bk.unique_id)
+        if pk_pos is None or bk_pos is None:
+            continue
+        # cost gate: small build sides are served better by the
+        # broadcast lookup / host lanes (no exchange); the shuffle wins
+        # once the build side is too big to broadcast or hash cheaply
+        build_est = _est_rows(
+            PhysTableReader(Schema(b_task.scan_cols), b_task, False,
+                            build_l.ranges), pctx)
+        if not pctx.enforce_mpp and build_est <= pctx.mpp_threshold:
+            continue
+        return (probe_l, build_l, p_task, b_task, pk_pos, bk_pos,
+                probe_is_left, build_est)
+    return None
+
+
+def _mpp_reason(pctx: PhysicalContext, build_est: float) -> str:
+    if pctx.enforce_mpp and build_est <= pctx.mpp_threshold:
+        return "enforced"
+    return f"build est {build_est:.0f} > broadcast threshold"
+
+
+def _mpp_exchange_pair(probe_l, build_l, p_task, b_task, pk_pos, bk_pos,
+                       probe_is_left):
+    """(left receiver, right receiver, probe sender, build sender) in
+    schema order."""
+    p_sender = PhysExchangeSender(Schema(p_task.scan_cols), p_task, pk_pos,
+                                  ranges=probe_l.ranges)
+    b_sender = PhysExchangeSender(Schema(b_task.scan_cols), b_task, bk_pos,
+                                  ranges=build_l.ranges)
+    p_recv = PhysExchangeReceiver(p_sender)
+    b_recv = PhysExchangeReceiver(b_sender)
+    if probe_is_left:
+        return p_recv, b_recv
+    return b_recv, p_recv
+
+
+def _try_mpp_join(plan: LogicalJoin,
+                  pctx: PhysicalContext) -> Optional[PhysicalPlan]:
+    """Join(big scan, big unique-key scan) -> device-resident shuffle
+    join: ExchangeSender/Receiver pair per side under one PhysMPPJoin."""
+    parts = _mpp_join_parts(plan, pctx)
+    if parts is None:
+        return None
+    (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
+     build_est) = parts
+    left_l, right_l = plan.children
+    want = [c.uid for c in list(left_l.schema.cols)
+            + list(right_l.schema.cols)]
+    if [c.uid for c in plan.schema.cols] != want:
+        return None  # schema is not the plain left++right concatenation
+    left_recv, right_recv = _mpp_exchange_pair(
+        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left)
+    le, re_ = plan.eq_conds[0]
+    lmap = {c.uid: i for i, c in enumerate(left_l.schema.cols)}
+    rmap = {c.uid: i for i, c in enumerate(right_l.schema.cols)}
+    return PhysMPPJoin(
+        left_recv, right_recv, plan.kind, probe_is_left, plan.schema,
+        [le.remap_columns(lmap)], [re_.remap_columns(rmap)],
+        reason=_mpp_reason(pctx, build_est))
+
+
+def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
+                      pctx: PhysicalContext) -> Optional[PhysicalPlan]:
+    """Scalar agg over an MPP-eligible inner join -> the partial
+    aggregation runs inside the exchange program (psum-merged sums and
+    counts; min/max partials merge on host) and only G=1 partials leave
+    the device; a FINAL HashAgg merges.  The multi-stage MPP aggregation
+    shape (TiFlash's partial agg above the exchange join)."""
+    if plan.group_by or not plan.aggs or join.kind != "inner":
+        return None
+    parts = _mpp_join_parts(join, pctx)
+    if parts is None:
+        return None
+    (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
+     build_est) = parts
+    if not probe_is_left:
+        return None  # host-rung partial layout assumes probe==left
+    from ..expr.pushdown import can_push_agg
+
+    dict_uids = _dict_uids(probe_l, pctx) | _dict_uids(build_l, pctx)
+    probe_uids = {c.uid for c in probe_l.schema.cols}
+    build_pos = {c.uid: i for i, c in enumerate(build_l.schema.cols)}
+    wp = len(p_task.scan_cols)
+    mapping = dict(p_task.scan_pos_map())
+    for u, i in build_pos.items():
+        mapping[u] = wp + i
+    aggs = []
+    for a in plan.aggs:
+        if a.name not in ("count", "sum", "avg", "min", "max") \
+                or a.distinct:
+            return None
+        if not can_push_agg(a, pctx.pushdown_blacklist, dict_uids):
+            return None
+        refs: set = set()
+        for x in a.args:
+            x.collect_columns(refs)
+        if any(u not in probe_uids and u not in build_pos for u in refs):
+            return None
+        if any(x.ftype.kind == TypeKind.STRING for x in a.args):
+            return None  # dict codes don't aggregate
+        aggs.append(a.remap_columns(mapping))
+    left_recv, right_recv = _mpp_exchange_pair(
+        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left)
+    le, re_ = join.eq_conds[0]
+    lmap = {c.uid: i for i, c in enumerate(probe_l.schema.cols)}
+    rmap = {c.uid: i for i, c in enumerate(build_l.schema.cols)}
+    mpp = PhysMPPJoin(
+        left_recv, right_recv, "inner", True, _partial_schema(plan),
+        [le.remap_columns(lmap)], [re_.remap_columns(rmap)], aggs=aggs,
+        reason=_mpp_reason(pctx, build_est))
+    return PhysHashAgg(mpp, [], plan.aggs, True, plan.schema)
+
+
 def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
     if not pctx.prefer_merge_join:
+        # tidb_enforce_mpp pins the exchange engine whenever structurally
+        # eligible — it outranks the index-join cost choice too
+        if pctx.enforce_mpp:
+            mpp = _try_mpp_join(plan, pctx)
+            if mpp is not None:
+                return mpp
         ij = _try_index_join(plan, pctx)
         if ij is not None:
             return ij
+        if not pctx.enforce_mpp:
+            mpp = _try_mpp_join(plan, pctx)
+            if mpp is not None:
+                return mpp
     left = to_physical(plan.children[0], pctx)
     right = to_physical(plan.children[1], pctx)
     lmap = left.schema.position_map()
@@ -1659,7 +1947,7 @@ def _key_ndv(child: PhysicalPlan, key, child_rows: float,
     if not isinstance(key, ColumnExpr) or key.unique_id < 0:
         return None
     node = child
-    while isinstance(node, (PhysSelection, PhysSort)):
+    while isinstance(node, (PhysSelection, PhysSort, PhysExchangeReceiver)):
         node = node.children[0]
     if not isinstance(node, PhysTableReader) or pctx.stats is None:
         return None
@@ -1713,6 +2001,20 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
             # merge keeps roughly the group count
             return max(_est_rows(p.children[0], pctx), 1)
         return max(_est_rows(p.children[0], pctx) * 0.1, 1)
+    if isinstance(p, PhysMPPJoin):
+        if p.aggs is not None:
+            return 1.0  # scalar partial: one G=1 partial row
+        l = _est_rows(p.children[0], pctx)
+        r = _est_rows(p.children[1], pctx)
+        if p.left_keys and p.right_keys:
+            nl = _key_ndv(p.children[0], p.left_keys[0], l, pctx)
+            nr = _key_ndv(p.children[1], p.right_keys[0], r, pctx)
+            if nl is not None and nr is not None:
+                est = l * r / max(nl, nr, 1.0)
+                if p.kind == "left_outer":
+                    est = max(est, l)
+                return max(est, 1.0)
+        return max(l, r)
     if isinstance(p, PhysHashJoin):
         l = _est_rows(p.children[0], pctx)
         r = _est_rows(p.children[1], pctx)

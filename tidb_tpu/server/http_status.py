@@ -50,6 +50,8 @@ class StatusServer:
                     self._send(200, body, "text/plain; version=0.0.4")
                     return
                 if path in ("/status", "/"):
+                    from ..copr.device_health import DEVICE_HEALTH
+
                     running = sum(
                         1 for s in domain.sessions.values()
                         if getattr(s, "stmt_start", None) is not None)
@@ -62,6 +64,31 @@ class StatusServer:
                         "running_statements": running,
                         "gc_safe_point":
                             domain.maintenance.last_safepoint,
+                        # circuit-breaker summary (PR-2 follow-up (d)):
+                        # operators watching the status port see a sick
+                        # chip without querying information_schema
+                        "tripped_devices":
+                            list(DEVICE_HEALTH.tripped_ids()),
+                    }).encode()
+                    self._send(200, body, "application/json")
+                    return
+                if path == "/device-health":
+                    # full breaker state, mirroring information_schema.
+                    # TIDB_TPU_DEVICE_HEALTH (region_cache.go's store
+                    # health surfaced on http_status.go's /regions model)
+                    from ..copr.device_health import DEVICE_HEALTH
+
+                    body = json.dumps({
+                        "devices": [{
+                            "device_id": st.device_id,
+                            "state": st.state,
+                            "error_count": st.error_count,
+                            "consecutive_errors": st.consecutive_errors,
+                            "trip_count": st.trip_count,
+                            "last_error": st.last_error,
+                        } for st in DEVICE_HEALTH.snapshot()],
+                        "tripped":
+                            list(DEVICE_HEALTH.tripped_ids()),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

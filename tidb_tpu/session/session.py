@@ -330,12 +330,14 @@ class Session:
         prefer_merge = self.vars.get_bool("tidb_opt_prefer_merge_join")
         enable_ij = self.vars.get_bool("tidb_opt_enable_index_join")
         variant = (self.vars.get("tidb_index_join_variant") or "lookup").lower()
+        allow_mpp = self.vars.get_bool("tidb_allow_mpp")
         if hints:
             # per-statement optimizer hints (binding USING /*+ ... */)
             if "merge_join" in hints:
                 prefer_merge, enable_ij = True, False
             if "hash_join" in hints:
-                prefer_merge, enable_ij = False, False
+                # HASH_JOIN pins the root algorithm: no index/mpp reroute
+                prefer_merge, enable_ij, allow_mpp = False, False, False
             if "inl_join" in hints or "index_join" in hints:
                 enable_ij, prefer_merge = True, False
             if "inl_hash_join" in hints:
@@ -352,6 +354,10 @@ class Session:
             enable_index_join=enable_ij,
             index_join_variant=variant,
             check_plan=self.vars.get_bool("tidb_check_plan"),
+            allow_mpp=allow_mpp,
+            enforce_mpp=self.vars.get_bool("tidb_enforce_mpp"),
+            mpp_threshold=self.vars.get_int(
+                "tidb_broadcast_join_threshold_count", 10240),
         )
 
     def _infoschema(self):
@@ -484,6 +490,10 @@ class Session:
             self.vars.get_bool("tidb_opt_prefer_merge_join"),
             self.vars.get_bool("tidb_opt_enable_index_join"),
             self.vars.get("tidb_index_join_variant"),
+            self.vars.get_bool("tidb_allow_mpp"),
+            self.vars.get_bool("tidb_enforce_mpp"),
+            self.vars.get_int("tidb_broadcast_join_threshold_count",
+                              10240),
         )
 
     def _run_query(self, stmt, params=None) -> ResultSet:

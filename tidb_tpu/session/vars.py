@@ -73,6 +73,15 @@ SYSVAR_DEFAULTS = {
     "tidb_capture_plan_baselines": ("0", "bool"),
     "tidb_opt_agg_push_down": ("1", "bool"),
     "tidb_opt_distinct_agg_push_down": ("0", "bool"),
+    # --- MPP exchange engine (tidb_vars.go TiDBAllowMPP/TiDBEnforceMPP,
+    # TiDBBroadcastJoinThresholdCount) -------------------------------
+    # allow: planner may pick the device shuffle join; enforce: pick it
+    # whenever structurally eligible regardless of the cost threshold;
+    # threshold: build sides at or below this row estimate stay on the
+    # broadcast-lookup / host lanes (no exchange)
+    "tidb_allow_mpp": ("1", "bool"),
+    "tidb_enforce_mpp": ("0", "bool"),
+    "tidb_broadcast_join_threshold_count": ("10240", "int"),
     # --- TPU-native knobs ---------------------------------------------
     "tidb_use_tpu": ("1", "bool"),  # per-session engine routing (cpu|tpu)
     # background device-cache warming after bulk loads (LOAD DATA):
