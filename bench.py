@@ -353,6 +353,28 @@ def _run_inner(state: dict):
             time.perf_counter() - T0, 1)
         persist_partial(state)
 
+    # trace-overhead receipt: the span recorder runs on every statement
+    # when the slow log is enabled (the default) — steady-state Q1 with
+    # tracing on vs off must stay within 2% (ISSUE 4 acceptance)
+    if state.get("q1") and remaining() > 60:
+        sess.execute("set tidb_enable_slow_log = 1")
+        _, t_on = time_query(sess, Q1, ITERS)
+        sess.execute("set tidb_enable_slow_log = 0")
+        _, t_off = time_query(sess, Q1, ITERS)
+        sess.execute("set tidb_enable_slow_log = 1")
+        delta_pct = (t_on - t_off) / t_off * 100.0
+        state["trace_overhead"] = {
+            "traced_s": round(t_on, 5),
+            "untraced_s": round(t_off, 5),
+            "delta_pct": round(delta_pct, 3),
+            "ok": delta_pct < 2.0,
+        }
+        log(f"trace overhead: on={t_on:.4f}s off={t_off:.4f}s "
+            f"delta={delta_pct:+.2f}% ok={delta_pct < 2.0}")
+        state["phases"]["trace_overhead_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
     # Q3-shaped device join: scan+filter+JOIN+partial agg in ONE device
     # program (JoinLookupIR) vs the CPU oracle's root-side hash join
     if state.get("q1") and remaining() > 180:
@@ -498,6 +520,7 @@ def emit(state: dict):
                 ),
                 "q3": state.get("q3"),
                 "mpp_join": state.get("mpp_join"),
+                "trace_overhead": state.get("trace_overhead"),
                 "devices": state.get("devices"),
                 "complete": bool(state.get("done")),
                 "worker_error": state.get("worker_error"),

@@ -47,11 +47,75 @@ def test_finding_keys_stable_under_line_drift():
     """Baseline keys must not contain line numbers: the same violation on
     a different line keeps its identity; a second identical one gets the
     next ordinal."""
-    src = "import numpy as np\n\ndef f(x):\n    a = np.asarray(x)\n    b = np.asarray(x)\n    return a, b\n"
-    shifted = "import numpy as np\n\n# pushed down two lines\n\ndef f(x):\n    a = np.asarray(x)\n    b = np.asarray(x)\n    return a, b\n"
+    src = "import jax\nimport numpy as np\n\ndef f(x):\n    a = np.asarray(x)\n    b = np.asarray(x)\n    return a, b\n"
+    shifted = "import jax\nimport numpy as np\n\n# pushed down two lines\n\ndef f(x):\n    a = np.asarray(x)\n    b = np.asarray(x)\n    return a, b\n"
     k1 = [f.key for f in assign_ordinals(lint_source(src, "tidb_tpu/copr/x.py"))]
     k2 = [f.key for f in assign_ordinals(lint_source(shifted, "tidb_tpu/copr/x.py"))]
     assert k1 == k2 and len(set(k1)) == 2
+
+
+# ---------------------------------------------------------------------------
+# purity: device-array provenance (lint follow-up (a))
+# ---------------------------------------------------------------------------
+
+
+def test_purity_no_jax_import_means_no_host_sync():
+    """A module that never imports jax cannot hold a device array, so
+    np.asarray there is a host normalization, not a sync — the rule that
+    retired 11 baseline allowlist entries."""
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def route(vals):
+            return np.asarray(sorted(vals), dtype=np.int64)
+    """)
+    assert lint_source(src, "tidb_tpu/executor/seeded.py") == []
+
+
+def test_purity_jit_result_readback_is_boundary():
+    """np.asarray on the direct result of a jit-bound callable is the
+    designed readback boundary (program finished, single transfer) —
+    not a hazard; any OTHER np.asarray in the same module still is."""
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def make(fn):
+            jitted = jax.jit(fn)
+
+            def call(*args):
+                out = jitted(*args)
+                buf = np.asarray(out)            # designed readback
+                also = np.asarray(jitted(args))  # direct-call form
+                return buf, also
+
+            return call
+
+        def leak(x):
+            return np.asarray(x)  # unknown provenance: still flagged
+    """)
+    fs = lint_source(src, "tidb_tpu/copr/seeded.py")
+    assert [(f.rule, f.scope) for f in fs] == [("host-sync", "leak")]
+
+
+def test_purity_boundary_names_are_function_scoped():
+    """A boundary name in one function must not whitelist the SAME bare
+    name holding a device array in a sibling function."""
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def reader(fn):
+            jitted = jax.jit(fn)
+            out = jitted(1)
+            return np.asarray(out)      # boundary: fine
+
+        def other(device_array):
+            out = device_array + 1
+            return np.asarray(out)      # same name, NOT a boundary
+    """)
+    fs = lint_source(src, "tidb_tpu/copr/seeded.py")
+    assert [(f.rule, f.scope) for f in fs] == [("host-sync", "other")]
 
 
 # ---------------------------------------------------------------------------

@@ -218,3 +218,72 @@ def test_exchange_bytes_metric_accounts_traffic(sess):
     b0 = _snap("mpp_exchange_bytes_total")[0]
     _run_mpp(sess, INNER)
     assert _snap("mpp_exchange_bytes_total")[0] > b0
+
+
+# ---------------------------------------------------------------------------
+# co-partitioned join elision (ROADMAP PR-3 follow-up (d))
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def copart_sess():
+    """Both sides HASH-partitioned ON the join key with equal partition
+    counts: partition i can only match partition i, so the exchange pair
+    is provably unnecessary."""
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table cli (l_orderkey bigint, l_qty double)"
+              " partition by hash(l_orderkey) partitions 4")
+    s.execute("create table cord (o_orderkey bigint primary key,"
+              " o_price double) partition by hash(o_orderkey) partitions 4")
+    s.execute("insert into cli values "
+              + ", ".join(f"({k % 160}, {k}.5)" for k in range(2400)))
+    s.execute("insert into cord values "
+              + ", ".join(f"({k}, {k * 10}.0)" for k in range(160)))
+    isc = d.catalog.info_schema()
+    for name in ("cli", "cord"):
+        for pid in isc.table("test", name).physical_ids():
+            d.storage.maybe_compact(pid, threshold=0)
+    s.execute("analyze table cli")
+    s.execute("analyze table cord")
+    s.execute("set tidb_enforce_mpp = 1")
+    return s
+
+
+COPQ = ("select count(*), sum(l_qty) from cli join cord"
+        " on l_orderkey = o_orderkey")
+
+
+def test_copartitioned_explain_elides_exchange(copart_sess):
+    plan = "\n".join(
+        " | ".join(str(x) for x in r)
+        for r in copart_sess.execute("explain " + COPQ)[0].rows)
+    assert "exchange elided (co-partitioned)" in plan, plan
+    assert "MPPScan" in plan, plan
+    assert "ExchangeReceiver" not in plan, plan
+    assert "ExchangeType" not in plan, plan
+
+
+def test_copartitioned_join_parity_and_metric(copart_sess):
+    s = copart_sess
+    e0 = REGISTRY.snapshot().get("mpp_exchange_elided_total", 0)
+    got = s.query(COPQ)
+    assert REGISTRY.snapshot().get("mpp_exchange_elided_total", 0) > e0
+    _rows_eq(got, _cpu(s, COPQ), "copart")
+    # row-output (non-agg) shape over the same pairs
+    q = ("select l_orderkey, o_price from cli join cord"
+         " on l_orderkey = o_orderkey where l_qty < 500")
+    got2 = s.query(q)
+    _rows_eq(got2, _cpu(s, q), "copart-rows")
+
+
+def test_copartitioned_unequal_counts_not_elided(copart_sess):
+    s = copart_sess
+    s.execute("create table cord8 (o_orderkey bigint primary key,"
+              " o_price double) partition by hash(o_orderkey) partitions 8")
+    s.execute("insert into cord8 values (1, 1.0)")
+    plan = "\n".join(
+        r[0] for r in s.execute(
+            "explain select count(*) from cli join cord8"
+            " on l_orderkey = o_orderkey")[0].rows)
+    assert "MPPScan" not in plan  # 4 vs 8 partitions: no elision

@@ -247,3 +247,42 @@ def test_concurrent_connections(server_client):
         await srv.stop()
 
     run(body())
+
+
+def test_trace_statement_over_the_wire(server_client):
+    """ISSUE 4 acceptance: TRACE on a TPC-H-shaped query returns the
+    span tree over the MySQL protocol, and the wire layer appends its
+    write span to the finished trace."""
+    async def body():
+        srv, cli = await server_client()
+        await cli.query("create table li (k bigint, qty bigint,"
+                        " price double, flag varchar(1))")
+        rows = ", ".join(f"({i % 9}, {i % 50}, {i}.5, 'A')"
+                         for i in range(512))
+        await cli.query("insert into li values " + rows)
+        r = await cli.query(
+            "trace select flag, sum(qty), avg(price), count(*) from li"
+            " where qty < 40 group by flag")
+        assert r["cols"] == ["operation", "startTS", "duration"]
+        ops = [row[0].strip() for row in r["rows"]]
+        assert ops[0].startswith("session.execute")
+        assert "wire_read_bytes" in ops[0]  # COM_QUERY payload recorded
+        assert any(o.startswith("plan") for o in ops)
+        assert any(o.startswith("executor.next") for o in ops)
+        # json format crosses the wire too
+        r = await cli.query("trace format='json' select count(*) from li")
+        import json as _json
+
+        doc = _json.loads(r["rows"][0][0])
+        assert doc["root"]["name"] == "session.execute"
+        # the finished trace gained a wire.write span from the server
+        sess = next(iter(srv.domain.sessions.values()))
+        tr = sess.last_trace
+        names = [sp.name for sp in tr.root.children]
+        assert "wire.write" in names
+        wired = [sp for sp in tr.root.children if sp.name == "wire.write"]
+        assert wired[0].attrs["bytes"] > 0
+        await cli.close()
+        await srv.stop()
+
+    run(body())

@@ -53,7 +53,14 @@ _OOM_RE = re.compile(
 _DEVICE_RE = re.compile(
     r"xla\w*error|data[_ ]loss|unavailable|device.*(?:fail|lost|halt)"
     r"|internal error", re.I)
-_DEVICE_ID_RE = re.compile(r"(?:device|tpu|chip)[ :_#]{0,2}(\d+)", re.I)
+# device ordinals in real XLA / jaxlib error text.  Shapes seen in the
+# wild (PJRT/StreamExecutor/libtpu): "device ordinal 3", "TPU:2",
+# "/device:TPU:1", "TPU_0", "device 3", "chip 2", "on device #1",
+# "core 5 of chip 0" (chip wins), "TpuDevice(id=3)".  Matched with
+# findall so a multi-chip failure trips every implicated breaker.
+_DEVICE_ID_RE = re.compile(
+    r"(?:device[ _]ordinal|device|tpu|chip|tpudevice\(id=)[ :_#=]{0,2}(\d+)",
+    re.I)
 
 
 def classify_failure(exc: BaseException) -> Optional[str]:
@@ -80,16 +87,27 @@ def classify_failure(exc: BaseException) -> Optional[str]:
 
 def attribute_devices(exc: BaseException) -> Tuple[int, ...]:
     """Device ids implicated by the failure: an explicit DeviceFailure
-    payload first, else a best-effort parse of the runtime's message
-    ("... on device 3 ..." / "TPU:3").  Empty when unattributable — the
-    caller then retries without tripping any breaker."""
+    payload first, else a parse of the runtime's message for XLA/jaxlib
+    ordinal shapes ("device ordinal 3", "TPU:2", "/device:TPU:1",
+    "TpuDevice(id=3)", "chip 0") — ROADMAP PR-2 follow-up (b): real
+    runtime errors now trip the RIGHT breaker instead of retrying
+    blind.  Every distinct ordinal in the text is implicated (a
+    collective abort names several).  Empty when unattributable — the
+    caller then retries without tripping any breaker.  The implicated
+    ids also tag the failing span in the active query trace."""
     ids = getattr(exc, "device_ids", ())
+    if not ids:
+        seen = []
+        for m in _DEVICE_ID_RE.findall(str(exc)):
+            did = int(m)
+            if did not in seen:
+                seen.append(did)
+        ids = tuple(seen)
     if ids:
-        return tuple(ids)
-    m = _DEVICE_ID_RE.search(str(exc))
-    if m:
-        return (int(m.group(1)),)
-    return ()
+        from ..trace import annotate
+
+        annotate(device_ids=list(ids), failed=True)
+    return tuple(ids)
 
 
 @dataclass

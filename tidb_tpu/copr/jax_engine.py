@@ -146,8 +146,15 @@ class _DeviceCache:
                None if device is None else device.id)
 
         def load():
-            data, valid = _gather_tile(table, store_ci, start, end)
-            return jax.device_put(data, device), jax.device_put(valid, device)
+            from ..trace import span
+
+            with span("copr.transfer", col=store_ci, tile=tile_idx) as sp:
+                data, valid = _gather_tile(table, store_ci, start, end)
+                sp.set(bytes=data.nbytes + valid.nbytes)
+                if device is not None:
+                    sp.set(device=device.id)
+                return (jax.device_put(data, device),
+                        jax.device_put(valid, device))
 
         return self._c.get_or_load(key, load)
 
@@ -617,12 +624,19 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
     )
+    from ..trace import span
+
     col_order = an.needed_cols()
     fp = _fingerprint(an, kind) + f"|cols={col_order}"
     fn = _COMPILED.get(fp)
+    compiled_now = fn is None
     if fn is None:
         fn = _build_tile_fn(an, kind, col_order)
         _COMPILED[fp] = fn
+    else:
+        # zero-duration marker: the DAG fingerprint hit the program cache
+        with span("copr.compile", cache="hit", kind=kind):
+            pass
 
     del_arr = np.fromiter(sorted(deleted), dtype=np.int64,
                           count=len(deleted))
@@ -667,18 +681,31 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
                 dm[dd] = False
                 del_mask = jax.device_put(dm, dev)
 
+        # first post-miss dispatch IS the XLA compile (jit compiles
+        # lazily): label it so compile time lands in the compile phase
+        dspan = ("copr.compile" if compiled_now else "copr.execute")
+        dattr = {"cache": "miss"} if compiled_now else {}
+        compiled_now = False
         if kind == "filter":
-            m, outs = fn(datas, valids, lo, hi, del_mask)
-            sel = np.flatnonzero(_np_tree(m))
+            with span(dspan, kind=kind, tile=tile_idx, **dattr):
+                m, outs = fn(datas, valids, lo, hi, del_mask)
+            with span("copr.readback") as rsp:
+                mh = _np_tree(m)
+                rsp.set(bytes=mh.nbytes)
+            sel = np.flatnonzero(mh)
             if remaining_limit is not None:
                 sel = sel[:remaining_limit]
             if len(sel) == 0:
                 continue
             if outs is not None:
                 cols = []
-                for (dv, vv), p in zip(outs, an.proj_exprs):
-                    dv, vv = _np_tree((dv, vv))
-                    cols.append(Column(p.ftype, dv[sel], vv[sel]))
+                with span("copr.readback") as rsp:
+                    nb = 0
+                    for (dv, vv), p in zip(outs, an.proj_exprs):
+                        dv, vv = _np_tree((dv, vv))
+                        nb += dv.nbytes + vv.nbytes
+                        cols.append(Column(p.ftype, dv[sel], vv[sel]))
+                    rsp.set(bytes=nb)
                 chunk = Chunk(cols)
             else:
                 chunk = _gather_rows(table, an.scan, base0, sel)
@@ -688,15 +715,22 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
                 if remaining_limit <= 0:
                     break
         elif kind == "agg":
-            gcount, results = fn(datas, valids, lo, hi, del_mask)
-            agg_accum = _merge_device_agg(
-                agg_accum, _np_tree(gcount),
-                [(t, _np_tree(r)) for t, r in results],
-                table, an, base0,
-            )
+            with span(dspan, kind=kind, tile=tile_idx, **dattr):
+                gcount, results = fn(datas, valids, lo, hi, del_mask)
+            with span("copr.readback") as rsp:
+                gh = _np_tree(gcount)
+                rh = [(t, _np_tree(r)) for t, r in results]
+                rsp.set(bytes=gh.nbytes + sum(
+                    (x.nbytes if not isinstance(x, tuple)
+                     else sum(y.nbytes for y in x)) for _t, x in rh))
+            agg_accum = _merge_device_agg(agg_accum, gh, rh, table, an,
+                                          base0)
         else:  # topn
-            idx, cnt = fn(datas, valids, lo, hi, del_mask)
-            idx = _np_tree(idx)[: int(cnt)]
+            with span(dspan, kind=kind, tile=tile_idx, **dattr):
+                idx, cnt = fn(datas, valids, lo, hi, del_mask)
+            with span("copr.readback") as rsp:
+                idx = _np_tree(idx)[: int(cnt)]
+                rsp.set(bytes=idx.nbytes)
             if len(idx):
                 topn_parts.append(_gather_rows(table, an.scan, base0, idx))
 

@@ -203,32 +203,39 @@ class _MeshCache:
         key = (table.store_uid, table.base_version, store_ci, devs, je.TILE)
 
         def load():
+            from ..trace import span
+
             tile = je.TILE
             n_tiles, n_pad, _ = _layout(table.base_rows, S)
             wire = _wire_dtype(table, store_ci)
             _, _, has_null = table.column_stats(store_ci)
-            # vectorized build: ONE flat buffer filled block-by-block
-            # (memcpy + cast per 64k block — no per-tile Python loop), so
-            # host prep is bandwidth-bound, not interpreter-bound
-            flat = np.zeros(n_pad * tile, dtype=wire)
-            off = 0
-            vflat = None
-            if has_null:
-                vflat = np.zeros(n_pad * tile, dtype=np.bool_)
-            for _s, arrs, vals in table.iter_base_blocks(
-                    [store_ci], 0, table.base_rows):
-                blk, v = arrs[0], vals[0]
-                n = len(blk)
-                flat[off:off + n] = blk  # casts to wire dtype
+            with span("copr.transfer", col=store_ci,
+                      device_ids=list(devs)) as sp:
+                # vectorized build: ONE flat buffer filled block-by-block
+                # (memcpy + cast per 64k block — no per-tile Python
+                # loop), so host prep is bandwidth-bound, not
+                # interpreter-bound
+                flat = np.zeros(n_pad * tile, dtype=wire)
+                off = 0
+                vflat = None
+                if has_null:
+                    vflat = np.zeros(n_pad * tile, dtype=np.bool_)
+                for _s, arrs, vals in table.iter_base_blocks(
+                        [store_ci], 0, table.base_rows):
+                    blk, v = arrs[0], vals[0]
+                    n = len(blk)
+                    flat[off:off + n] = blk  # casts to wire dtype
+                    if vflat is not None:
+                        vflat[off:off + n] = True if v is None else v
+                    off += n
+                sp.set(bytes=flat.nbytes
+                       + (vflat.nbytes if vflat is not None else 0))
+                sh = NamedSharding(mesh, P("dp"))
+                data = jax.device_put(flat.reshape(n_pad, tile), sh)
+                valid = None
                 if vflat is not None:
-                    vflat[off:off + n] = True if v is None else v
-                off += n
-            sh = NamedSharding(mesh, P("dp"))
-            data = jax.device_put(flat.reshape(n_pad, tile), sh)
-            valid = None
-            if vflat is not None:
-                valid = jax.device_put(vflat.reshape(n_pad, tile), sh)
-            return data, valid
+                    valid = jax.device_put(vflat.reshape(n_pad, tile), sh)
+                return data, valid
 
         return self._c.get_or_load(key, load)
 
@@ -294,7 +301,13 @@ def load_columns(mesh: Mesh, table, store_cis):
     cis = list(store_cis)
     if len(cis) <= 1 or jax.process_count() > 1:
         return [MESH_CACHE.get_column(mesh, table, ci) for ci in cis]
-    futs = [_xfer_pool().submit(MESH_CACHE.get_column, mesh, table, ci)
+    # pool workers re-attach to the submitter's span so transfer spans
+    # land in the query's trace (contextvars don't cross threads)
+    from ..trace import current_span, run_attached
+
+    parent = current_span()
+    futs = [_xfer_pool().submit(run_attached, parent,
+                                MESH_CACHE.get_column, mesh, table, ci)
             for ci in cis]
     return [f.result() for f in futs]
 
@@ -496,7 +509,13 @@ def _packed_jit(fn):
     jitted = jax.jit(packed)
 
     def call(*args):
-        buf = np.asarray(jitted(*args))
+        from ..trace import span
+
+        with span("copr.execute"):
+            out = jitted(*args)
+        with span("copr.readback") as sp:
+            buf = np.asarray(out)
+            sp.set(bytes=buf.nbytes)
         leaves, off = [], 0
         for shape, dt in meta["specs"]:
             n = int(np.prod(shape, dtype=np.int64)) if shape else 1
@@ -706,13 +725,37 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     )
 
     def wrapped(datas, valids, del_mask, start, end, pargs=()):
+        from ..trace import span
+
         n_rows = S * n_local
-        bits = np.asarray(jitted(
-            tuple(datas), tuple(valids), del_mask,
-            jnp.int64(start), jnp.int64(end), *pargs,
-        ))
+        with span("copr.execute"):
+            out = jitted(
+                tuple(datas), tuple(valids), del_mask,
+                jnp.int64(start), jnp.int64(end), *pargs,
+            )
+        with span("copr.readback") as sp:
+            bits = np.asarray(out)
+            sp.set(bytes=bits.nbytes)
         return np.unpackbits(bits, count=n_rows).astype(np.bool_)
     return wrapped
+
+
+def _compile_labeled(fn, kind: str):
+    """Wrap a freshly built mesh program so its first dispatch records a
+    copr.compile span (cache=miss); later calls pass straight through —
+    _packed_jit's execute/readback spans nest inside either way."""
+    state = {"first": True}
+
+    def call(*args, **kwargs):
+        if state["first"]:
+            state["first"] = False
+            from ..trace import span
+
+            with span("copr.compile", cache="miss", kind=kind):
+                return fn(*args, **kwargs)
+        return fn(*args, **kwargs)
+
+    return call
 
 
 class MeshAggOverflow(Exception):
@@ -1236,10 +1279,19 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
     fp = (_fingerprint(an, kind)
           + f"|mesh S={S} Tl={Tl} devs={mesh_ids} cols={col_order} "
           + f"kpads={kpads} wire={wire_sig}")
+    from ..trace import annotate, span
+
+    annotate(device_ids=list(mesh_ids))
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mesh_fn(an, kind, col_order, mesh, Tl)
         _COMPILED[fp] = fn
+        # label this query's FIRST dispatch as the compile: jit compiles
+        # lazily, so the program-cache miss pays XLA compilation there
+        fn = _compile_labeled(fn, kind)
+    else:
+        with span("copr.compile", cache="hit", kind=kind):
+            pass
     pargs = tuple(pargs)
 
     # one delta pass for the whole table

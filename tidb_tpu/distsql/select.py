@@ -111,6 +111,13 @@ class SelectResult:
         # EXPLAIN ANALYZE attribution: which engine actually served the scan
         self.scan_engine: str = "pending"
         self.total_tasks = 0
+        # trace propagation: the producer thread (and its pool workers)
+        # re-attach to the span active on the SUBMITTING thread — the
+        # contextvar does not cross thread boundaries by itself
+        from ..trace import current_span
+
+        self._parent_span = current_span()
+        self._fanout_span = None
         # named so leak checks (tests/chaos harness) can find stragglers
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="tidb-tpu-select")
@@ -132,53 +139,86 @@ class SelectResult:
         """One region's cop task: retry transient errors with typed backoff;
         on a device (non-framework) error, rerun the region on the CPU
         engine — the runtime analog of the JaxUnsupported compile-time
-        fallback."""
+        fallback.  Each task records a cop.task span (region clip, the
+        engine that actually served it, accumulated backoff wait)."""
+        from ..trace import attach, span
+
+        with attach(self._fanout_span):
+            with span("cop.task", start=clip.start, end=clip.end) as tsp:
+                return self._run_task_inner(clip, tsp)
+
+    def _run_task_inner(self, clip: KeyRange, tsp) -> List[Chunk]:
         from ..metrics import REGISTRY
 
         client = self.storage.get_client()
         bo = Backoffer(budget_ms=self.req.backoff_budget_ms)
         engine = self.req.engine
-        while True:
-            if self._stop.is_set():
-                raise _Closed()
-            sub = CopRequest(
-                dag=self.req.dag, ranges=[clip], ts=self.req.ts,
-                concurrency=1, keep_order=self.req.keep_order,
-                streaming=self.req.streaming, engine=engine,
-                aux=self.req.aux,
-            )
-            try:
-                FAILPOINTS.hit("distsql/task_error", range=clip)
-                out: List[Chunk] = []
-                for resp in client.send(sub):
-                    out.extend(resp.chunks)
-                REGISTRY.inc("cop_tasks_total")
-                REGISTRY.inc(f"cop_tasks_{engine}_total")
-                return out
-            except TiDBTPUError:
-                # semantic error (lock conflict, kill, quota, bad plan):
-                # surfaces to the consumer, never silently retried here —
-                # region-level routing retry already ran inside CoprClient
-                raise
-            except _Closed:
-                raise
-            except (KeyboardInterrupt, SystemExit, MemoryError):
-                # fatal process conditions are not transient device errors:
-                # surface immediately instead of burning the retry budget
-                raise
-            except BaseException as e:
-                if engine == "tpu":
-                    # runtime device failure: this region falls back to the
-                    # CPU engine (coprocessor.go:912-999 retries a failed
-                    # region; our "other store" is the host oracle engine)
-                    engine = "cpu"
-                    self.fallback_tasks += 1
-                    REGISTRY.inc("cop_tasks_device_fallback_total")
-                    bo.backoff("device_error", e)
-                    continue
-                bo.backoff("task_error", e)
+        fell_back = False
+        try:
+            while True:
+                if self._stop.is_set():
+                    raise _Closed()
+                sub = CopRequest(
+                    dag=self.req.dag, ranges=[clip], ts=self.req.ts,
+                    concurrency=1, keep_order=self.req.keep_order,
+                    streaming=self.req.streaming, engine=engine,
+                    aux=self.req.aux,
+                )
+                try:
+                    FAILPOINTS.hit("distsql/task_error", range=clip)
+                    out: List[Chunk] = []
+                    for resp in client.send(sub):
+                        out.extend(resp.chunks)
+                    REGISTRY.inc("cop_tasks_total")
+                    REGISTRY.inc(f"cop_tasks_{engine}_total")
+                    # a successful retry after a device error must keep
+                    # the fallback attribution visible
+                    tsp.set(engine="cpu-fallback" if fell_back else engine)
+                    return out
+                except TiDBTPUError:
+                    # semantic error (lock conflict, kill, quota, bad
+                    # plan): surfaces to the consumer, never silently
+                    # retried here — region-level routing retry already
+                    # ran inside CoprClient
+                    raise
+                except _Closed:
+                    raise
+                except (KeyboardInterrupt, SystemExit, MemoryError):
+                    # fatal process conditions are not transient device
+                    # errors: surface instead of burning the retry budget
+                    raise
+                except BaseException as e:
+                    if engine == "tpu":
+                        # runtime device failure: this region falls back
+                        # to the CPU engine (coprocessor.go:912-999
+                        # retries a failed region; our "other store" is
+                        # the host oracle engine)
+                        engine = "cpu"
+                        fell_back = True
+                        tsp.set(engine="cpu-fallback")
+                        self.fallback_tasks += 1
+                        REGISTRY.inc("cop_tasks_device_fallback_total")
+                        bo.backoff("device_error", e)
+                        continue
+                    bo.backoff("task_error", e)
+        finally:
+            if bo.slept_ms:
+                tsp.add("backoff_ms", bo.slept_ms)
 
     def _run(self):
+        from ..trace import NOOP, attach, span
+
+        with attach(self._parent_span):
+            with span("distsql.fanout", engine=self.req.engine) as sp:
+                self._fanout_span = None if sp is NOOP else sp
+                try:
+                    self._produce()
+                finally:
+                    sp.set(scan_engine=self.scan_engine,
+                           tasks=self.total_tasks,
+                           fallback_tasks=self.fallback_tasks)
+
+    def _produce(self):
         try:
             if self.req.engine == "tpu":
                 # mesh-parallel path: the whole base scan as ONE shard_map

@@ -8,28 +8,17 @@ runtime stats (rows/loops/duration) for EXPLAIN ANALYZE, and traces.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..chunk import Chunk, DEFAULT_CHUNK_SIZE
 from ..errors import QueryKilledError
 from ..types import FieldType
 
-
-@dataclass
-class OperatorStats:
-    rows: int = 0
-    loops: int = 0
-    time_ns: int = 0
-    # per-operator engine attribution (EXPLAIN ANALYZE honesty: which
-    # engine actually served a cop task, incl. mesh-rejection reasons —
-    # util/execdetails/execdetails.go:326-396 analog)
-    engine: str = ""
-
-    def record(self, rows: int, dur_ns: int):
-        self.rows += rows
-        self.loops += 1
-        self.time_ns += dur_ns
+# per-operator runtime stats live in the trace subsystem now — EXPLAIN
+# ANALYZE, TRACE, the slow log and the statement summary all read the
+# same QueryTrace, so there is ONE execution-stats collection path
+# (re-exported here for executor-facing callers)
+from ..trace import OperatorStats  # noqa: F401
 
 
 class ExecContext:
@@ -48,7 +37,13 @@ class ExecContext:
         self.read_ts = read_ts
         self.killed = False
         self.warnings: List[str] = []
-        self.stats: Dict[int, OperatorStats] = {}
+        # when a trace is active, the operator-stats map IS the trace's
+        # (EXPLAIN ANALYZE and the span tree share one store)
+        from ..trace import current_trace
+
+        tr = current_trace()
+        self.stats: Dict[int, OperatorStats] = (
+            tr.op_stats if tr is not None else {})
         self.affected_rows = 0
         self.last_insert_id = 0
         self.found_rows = 0
@@ -194,18 +189,28 @@ class Executor:
 
 
 def collect_all(exe: Executor) -> List[Chunk]:
-    """Open/drain/close an executor tree (statement driver helper)."""
-    exe.open()
+    """Open/drain/close an executor tree (statement driver helper).
+    Root open/next/close are traced (executor.go:196-212's trace region,
+    mapped onto the span recorder; no-ops when tracing is off)."""
+    from ..trace import span
+
+    with span("executor.open"):
+        exe.open()
     try:
         out = []
-        while True:
-            c = exe.next()
-            if c is None:
-                return out
-            if c.num_rows:
-                out.append(c)
+        with span("executor.next") as sp:
+            n = 0
+            while True:
+                c = exe.next()
+                if c is None:
+                    sp.set(rows=n)
+                    return out
+                if c.num_rows:
+                    n += c.num_rows
+                    out.append(c)
     finally:
-        exe.close()
+        with span("executor.close"):
+            exe.close()
 
 
 class OrderedPipeline:

@@ -117,27 +117,49 @@ def _processlist(domain, isc):
 
 
 @_register("slow_query", [
-    ("query", ty_string()), ("query_time", ty_float()),
+    ("time", ty_string()), ("conn_id", ty_int()),
+    ("query_time", ty_float()), ("parse_ms", ty_float()),
+    ("plan_ms", ty_float()), ("compile_ms", ty_float()),
+    ("compile_hits", ty_int()), ("compile_misses", ty_int()),
+    ("transfer_bytes", ty_int()), ("device_ms", ty_float()),
+    ("readback_ms", ty_float()), ("readback_bytes", ty_int()),
+    ("backoff_ms", ty_float()), ("cop_tasks", ty_int()),
+    ("engines", ty_string()), ("devices", ty_string()),
+    ("rows", ty_int()), ("query", ty_string()),
 ])
 def _slow_query(domain, isc):
-    return [(sql, dur) for sql, dur in domain.slow_queries]
+    """Structured slow-query log (infoschema/slow_log.go role) with the
+    TPU-native per-phase columns from the trace subsystem: XLA compile
+    vs. cache hits, host->device transfer bytes, device execute time,
+    packed readback, backoff waits, engine/device attribution."""
+    return domain.slow_log.rows()
 
 
 @_register("statements_summary", [
     ("digest_text", ty_string()), ("exec_count", ty_int()),
     ("sum_latency", ty_float()), ("avg_latency", ty_float()),
     ("max_latency", ty_float()), ("sum_rows", ty_int()),
-    ("sample_text", ty_string()),
+    ("sum_compile_ms", ty_float()), ("sum_device_ms", ty_float()),
+    ("sum_transfer_bytes", ty_int()), ("sum_readback_ms", ty_float()),
+    ("sum_backoff_ms", ty_float()), ("sample_text", ty_string()),
 ])
 def _statements_summary(domain, isc):
     """Per-digest aggregates (util/stmtsummary/statement_summary.go:59,213):
     literals normalized away, so every execution of a statement shape lands
-    in one row."""
+    in one row; per-phase sums come from the same span trees the slow log
+    and EXPLAIN ANALYZE read."""
     out = []
     for digest, st in sorted(domain.digest_summary.items()):
+        ph = st.get("phases", {})
         out.append((digest, st["count"], st["sum_latency"],
                     st["sum_latency"] / max(st["count"], 1),
-                    st["max_latency"], st["sum_rows"], st["sample"]))
+                    st["max_latency"], st["sum_rows"],
+                    round(ph.get("compile_ms", 0.0), 3),
+                    round(ph.get("device_ms", 0.0), 3),
+                    int(ph.get("transfer_bytes", 0)),
+                    round(ph.get("readback_ms", 0.0), 3),
+                    round(ph.get("backoff_ms", 0.0), 3),
+                    st["sample"]))
     return out
 
 

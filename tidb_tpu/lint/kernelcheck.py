@@ -259,6 +259,42 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
 
+    # -- instrumented scan/agg programs (trace-subsystem guard) ---------
+    # span hooks live strictly OUTSIDE compiled code: re-tracing the
+    # kernels while a query trace is ACTIVE must produce byte-identical
+    # jaxpr stats.  A span (or any trace state) captured into a jitted
+    # function would change the equation census or fail the trace.
+    from ..trace import finish_trace, start_trace
+
+    for name, sql in CANONICAL_KERNEL_QUERIES:
+        if name not in ("q1-dense-agg", "filter-project"):
+            continue
+        try:
+            phys = s._plan(parse_one(sql))
+            dags = [d for _p, d in _reader_dags(phys)]
+            base_stats = traced_stats = None
+            for dag in dags:
+                try:
+                    base_stats = trace_kernel(table, dag)
+                except JaxUnsupported:
+                    continue
+                tr, token = start_trace("kernelcheck-instrumented", 0)
+                try:
+                    traced_stats = trace_kernel(table, dag)
+                finally:
+                    finish_trace(tr, token)
+                break
+        except Exception as e:  # noqa: BLE001 — contract break
+            emit(f"{name}-instrumented",
+                 f"instrumented kernel trace failed: "
+                 f"{type(e).__name__}: {e}")
+            continue
+        if base_stats is not None and traced_stats != base_stats:
+            emit(f"{name}-instrumented",
+                 f"span hooks leaked into the compiled program: jaxpr "
+                 f"stats changed {base_stats} -> {traced_stats} under an "
+                 "active query trace")
+
     # -- recompile-bomb guard -------------------------------------------
     # count only signatures the corpus itself compiles: the engine caches
     # are process-global, and other passes (or the bootstrap INSERT/
@@ -277,6 +313,23 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
              f"re-running the canonical corpus compiled {len(grew)} NEW "
              "jit signature(s) — a recompile bomb (fingerprint must be "
              "stable across identical queries)")
+    # running the same corpus under an ACTIVE trace must not compile
+    # anything either: program fingerprints carry no trace state, so a
+    # new signature here means a span hook captured tracer-varying
+    # state into a compiled program
+    tr, token = start_trace("kernelcheck-traced-corpus", 0)
+    try:
+        for q in queries:
+            s.query(q)
+    finally:
+        finish_trace(tr, token)
+    je3, par3 = _signature_census()
+    grew_traced = (je3 - je2) | (par3 - par2)
+    if grew_traced:
+        emit("trace-capture",
+             f"running the corpus under an active query trace compiled "
+             f"{len(grew_traced)} NEW jit signature(s) — span hooks must "
+             "stay outside compiled code")
     n_sigs = len((je2 - je0)) + len((par2 - par0))
     base_sigs = baseline_kernels.get("__signatures__", {}).get("max")
     if collect_stats is not None:

@@ -349,6 +349,65 @@ class _Checker:
                 self.fail(p, f"union child #{i} width {len(c.schema)} != "
                              f"union schema width {w}")
 
+    # ------------------------------------------------------------------
+    # DML plans: write-column maps (lint follow-up (b)).  INSERT's
+    # col_offsets and UPDATE's assignment offsets re-map positions onto
+    # the table's column layout exactly like the read-side re-maps this
+    # pass exists for — an off-by-one writes the wrong column silently.
+    # Value-kind coercion is legal in SQL (SET a = '5'), so only the
+    # positional maps and expression references are verified.
+    # ------------------------------------------------------------------
+    def _full_row_fts(self, t) -> List[FieldType]:
+        return [c.ftype for c in t.columns]
+
+    def _chk_PhysInsert(self, p):
+        plan = p.plan
+        ncols = len(plan.table.columns)
+        for off in plan.col_offsets:
+            if not (0 <= off < ncols):
+                self.fail(p, f"insert column offset {off} out of range "
+                             f"for {plan.table.name} ({ncols} columns)")
+        if len(set(plan.col_offsets)) != len(plan.col_offsets):
+            self.fail(p, "insert column offsets repeat a target column")
+        if plan.rows is not None:
+            for i, r in enumerate(plan.rows):
+                if len(r) != len(plan.col_offsets):
+                    self.fail(p, f"insert row #{i} has {len(r)} values "
+                                 f"for {len(plan.col_offsets)} columns")
+                    break
+        if p.children:
+            w = len(p.children[0].schema)
+            if w != len(plan.col_offsets):
+                self.fail(p, f"INSERT..SELECT provides {w} columns for "
+                             f"{len(plan.col_offsets)} targets")
+        # on-dup exprs evaluate over [old row cols ++ VALUES() pseudo
+        # cols] (build_insert / InsertExec._apply_on_dup contract)
+        fts = self._full_row_fts(plan.table)
+        dup_fts = fts + fts
+        for off, e in plan.on_dup_update:
+            if not (0 <= off < ncols):
+                self.fail(p, f"ON DUPLICATE KEY UPDATE offset {off} out "
+                             "of range")
+            self.check_expr(p, e, dup_fts, "on-dup-update expr")
+
+    def _chk_PhysUpdate(self, p):
+        plan = p.plan
+        ncols = len(plan.table.columns)
+        fts = self._full_row_fts(plan.table)
+        for off, e in plan.assignments:
+            if not (0 <= off < ncols):
+                self.fail(p, f"update assignment offset {off} out of "
+                             f"range for {plan.table.name} "
+                             f"({ncols} columns)")
+            self.check_expr(p, e, fts, "update assignment")
+        for c in plan.conditions:
+            self.check_expr(p, c, fts, "update condition")
+
+    def _chk_PhysDelete(self, p):
+        fts = self._full_row_fts(p.plan.table)
+        for c in p.plan.conditions:
+            self.check_expr(p, c, fts, "delete condition")
+
     def _chk_PhysWindow(self, p):
         fts = self._child_fts(p)
         for _uid, f in p.funcs:
@@ -402,6 +461,13 @@ _CANONICAL_QUERIES = [
     "select l_orderkey from lineitem union all select o_orderkey from orders",
     "select o_orderkey from orders where o_totalprice >"
     " (select avg(o_totalprice) from orders)",
+    # DML shapes: write-column maps (INSERT targets, INSERT..SELECT
+    # arity, UPDATE assignment offsets) — lint follow-up (b)
+    "insert into lineitem (l_orderkey, l_quantity) values (1, 2.0)",
+    "insert into orders select l_orderkey, l_extendedprice, 'P0'"
+    " from lineitem where l_quantity < 2",
+    "update lineitem set l_quantity = l_quantity + 1 where l_orderkey = 3",
+    "delete from orders where o_totalprice < 0",
 ]
 
 

@@ -12,7 +12,18 @@ baseline.json with a justification.
 Rules
 -----
 host-sync        np.asarray / numpy.asarray / jax.device_get calls and
-                 .block_until_ready() method calls anywhere in engine code.
+                 .block_until_ready() method calls in engine code —
+                 gated on device-array PROVENANCE: (a) a module that
+                 never imports jax cannot hold a device array (device
+                 values are only created by jax APIs, and the engine
+                 contract keeps Chunk columns host-resident), so its
+                 np.asarray calls are host normalizations, not syncs;
+                 (b) np.asarray applied to the direct result of a
+                 jit-bound callable (``out = jitted(...)`` then
+                 ``np.asarray(out)``) is the DESIGNED readback boundary
+                 — the program completed, the sync is the single
+                 intended result transfer.  Both used to need baseline
+                 allowlist entries.
 tracer-coercion  float()/int()/bool() on a value inside a jitted function
                  (concretizes a tracer -> recompile or TracerError).
 row-loop         for-loops / comprehensions iterating chunk rows
@@ -60,6 +71,53 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _imports_jax(tree: ast.Module) -> bool:
+    """True when the module imports jax in any form.  Device arrays are
+    created only by jax APIs; a module that never names jax can only
+    hold host values (the engine contract keeps Chunk columns numpy),
+    so host-sync hazards cannot occur there."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                return True
+    return False
+
+
+_SCOPE_STOPS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _scan_boundary(node, visible: Set[str]) -> Set[str]:
+    """Readback-boundary names bound in ONE scope's immediate body
+    (nested defs excluded — they compute their own set with this one
+    visible, matching closure capture): names bound to jitted callables
+    (`jitted = jax.jit(fn)`) and names assigned from calling one
+    (`out = jitted(*args)`) — the finished device program's output,
+    whose np.asarray is the designed readback boundary.  Scoped per
+    function so an unrelated `out` elsewhere is never whitelisted."""
+    out: Set[str] = set()
+
+    def walk(n):
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _SCOPE_STOPS):
+                continue
+            if isinstance(child, ast.Assign) \
+                    and isinstance(child.value, ast.Call):
+                d = _dotted(child.value.func)
+                if d in JIT_WRAPPERS or d in visible or d in out:
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.add(tgt.id)
+            walk(child)
+
+    walk(node)
+    return out
+
+
 def _jitted_names(tree: ast.Module) -> Set[str]:
     """Function names that get jitted in this module: decorated with a jit
     wrapper, or passed as the first argument to one (`jax.jit(fn, ...)`,
@@ -85,21 +143,33 @@ def _jitted_names(tree: ast.Module) -> Set[str]:
 
 
 class _PurityVisitor(ast.NodeVisitor):
-    def __init__(self, relpath: str, jitted: Set[str]):
+    def __init__(self, relpath: str, jitted: Set[str],
+                 has_jax: bool = True,
+                 module_boundary: Optional[Set[str]] = None):
         self.relpath = relpath
         self.jitted = jitted
+        self.has_jax = has_jax  # module can hold device arrays at all
+        # readback-boundary names, one set per lexical scope (closures
+        # see enclosing scopes' names; siblings never see each other's)
+        self.boundary_stack: List[Set[str]] = [module_boundary or set()]
         self.scope: List[str] = []
         self.jit_depth = 0  # >0 while inside a jitted function body
         self.findings: List[Finding] = []
 
     # -- scope bookkeeping ------------------------------------------------
+    def _visible_boundary(self) -> Set[str]:
+        return set().union(*self.boundary_stack)
+
     def _enter(self, node, is_jitted: bool):
         self.scope.append(node.name)
+        self.boundary_stack.append(
+            _scan_boundary(node, self._visible_boundary()))
         if is_jitted:
             self.jit_depth += 1
         self.generic_visit(node)
         if is_jitted:
             self.jit_depth -= 1
+        self.boundary_stack.pop()
         self.scope.pop()
 
     def visit_FunctionDef(self, node):
@@ -109,7 +179,9 @@ class _PurityVisitor(ast.NodeVisitor):
 
     def visit_ClassDef(self, node):
         self.scope.append(node.name)
+        self.boundary_stack.append(set())
         self.generic_visit(node)
+        self.boundary_stack.pop()
         self.scope.pop()
 
     def _emit(self, rule: str, node: ast.AST, token: str, message: str):
@@ -118,12 +190,27 @@ class _PurityVisitor(ast.NodeVisitor):
             scope=".".join(self.scope), token=token, message=message))
 
     # -- rules ------------------------------------------------------------
+    def _is_readback_boundary(self, node: ast.Call) -> bool:
+        """np.asarray on the direct result of a jit-bound callable: the
+        designed single readback after the program completed.  Names
+        resolve through the lexical boundary-scope stack."""
+        if not node.args:
+            return False
+        visible = self._visible_boundary()
+        a = node.args[0]
+        if isinstance(a, ast.Call) and _dotted(a.func) in visible:
+            return True
+        return isinstance(a, ast.Name) and a.id in visible
+
     def visit_Call(self, node: ast.Call):
         d = _dotted(node.func)
-        if d in HOST_SYNC_DOTTED:
-            self._emit("host-sync", node, d,
-                       f"{d}() forces a device->host sync; on a tunneled "
-                       "TPU this is a full network round trip")
+        if not self.has_jax:
+            pass  # no jax import: no device arrays, no syncs possible
+        elif d in HOST_SYNC_DOTTED:
+            if not self._is_readback_boundary(node):
+                self._emit("host-sync", node, d,
+                           f"{d}() forces a device->host sync; on a "
+                           "tunneled TPU this is a full network round trip")
         elif (isinstance(node.func, ast.Attribute)
               and node.func.attr in HOST_SYNC_METHODS):
             self._emit("host-sync", node, f".{node.func.attr}",
@@ -262,7 +349,9 @@ def _lint_static_args(tree: ast.Module, relpath: str,
 def lint_source(src: str, relpath: str) -> List[Finding]:
     """Lint one module's source text (also the negative-test entry)."""
     tree = ast.parse(src)
-    visitor = _PurityVisitor(relpath, _jitted_names(tree))
+    visitor = _PurityVisitor(relpath, _jitted_names(tree),
+                             has_jax=_imports_jax(tree),
+                             module_boundary=_scan_boundary(tree, set()))
     visitor.visit(tree)
     _lint_static_args(tree, relpath, visitor.findings)
     return visitor.findings

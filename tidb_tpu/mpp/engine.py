@@ -103,6 +103,10 @@ class MPPJoinSpec:
     # (probe scan positions, then build positions at probe_width+j);
     # only set for inner joins with probe_is_left
     aggs: Optional[list] = None
+    # co-partitioned elision (PhysMPPJoin.elided): ordinal-aligned
+    # (probe partition id, build partition id) pairs — the join runs per
+    # pair with NO exchange between partitions (inner joins only)
+    copartitions: Optional[List[Tuple[int, int]]] = None
 
 
 _COMPILED: Dict[str, object] = {}
@@ -536,6 +540,9 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
             per_row += isz + 1
         nbytes = S * S * bs.n_local * per_row
     REGISTRY.inc("mpp_exchange_bytes_total", float(nbytes))
+    from ..trace import annotate
+
+    annotate(bytes=nbytes, device_ids=list(mesh_ids))
 
     from ..copr.device_health import DEVICE_HEALTH
 
@@ -549,13 +556,16 @@ def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
     """Run the join over the mesh; (chunks, mode) on success, raises
     MPPIneligible when the host rung must serve it.  Overflow and device
     failures step down the ladder internally."""
+    from ..trace import span
+
     mode = "shuffle"
     attempts = 0
     while True:
         if _no_eligible_devices():
             raise MPPIneligible("all device breakers open")
         try:
-            chunks = _run_once(storage, spec, mode)
+            with span("mpp.exchange", rung=mode, kind=spec.kind):
+                chunks = _run_once(storage, spec, mode)
             REGISTRY.inc("mpp_joins_total")
             REGISTRY.inc(f"mpp_joins_{mode}_total")
             return chunks, mode

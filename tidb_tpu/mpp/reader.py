@@ -46,11 +46,60 @@ class MPPReaderExec(Executor):
         if self.ctx.engine != "tpu":
             self._start_fallback("engine=cpu")
             return
+        if spec.copartitions is not None:
+            self._run_copartitioned()
+            return
         try:
             self._chunks, mode = run_mpp_join(self.ctx.storage, spec)
             self._attribute(f"mpp-{mode}")
         except MPPIneligible as e:
             self._start_fallback(str(e))
+
+    def _run_copartitioned(self):
+        """Exchange elision: both sides hash-partitioned on the join
+        key, so partition i joins ONLY partition i — one engine run per
+        partition pair, no cross-partition exchange at all (TiFlash's
+        same-zone optimization).  A pair the engine declines host-joins
+        alone; pruned/empty pairs contribute nothing (inner join)."""
+        import dataclasses
+
+        from ..trace import span
+
+        spec = self.spec
+        REGISTRY.inc("mpp_exchange_elided_total")
+        probe_rngs: dict = {}
+        for kr in spec.probe.ranges:
+            probe_rngs.setdefault(kr.table_id, []).append(kr)
+        build_rngs: dict = {}
+        for kr in spec.build.ranges:
+            build_rngs.setdefault(kr.table_id, []).append(kr)
+        chunks, modes = [], []
+        for ppid, bpid in spec.copartitions:
+            pr = probe_rngs.get(ppid)
+            br = build_rngs.get(bpid)
+            if not pr or not br:
+                continue  # partition pruned on one side: no matches
+            pair = dataclasses.replace(
+                spec, copartitions=None,
+                probe=dataclasses.replace(spec.probe, table_id=ppid,
+                                          ranges=pr),
+                build=dataclasses.replace(spec.build, table_id=bpid,
+                                          ranges=br))
+            try:
+                stores = [self.ctx.storage.table(pid)
+                          for pid in (ppid, bpid)]
+                if any(t.base_rows == 0 and not t.delta for t in stores):
+                    continue  # empty partition pair
+                with span("mpp.copart", probe=ppid, build=bpid):
+                    out, mode = run_mpp_join(self.ctx.storage, pair)
+                chunks.extend(out)
+                modes.append(mode)
+            except MPPIneligible as e:
+                chunks.extend(self._host_join_pair(pair, str(e)))
+                modes.append("host")
+        self._chunks = chunks
+        rungs = ",".join(sorted(set(modes))) if modes else "empty"
+        self._attribute(f"mpp-elided[{rungs}]")
 
     # ---- host rung -----------------------------------------------------
     def _side_reader(self, side, probe_ir=None) -> Executor:
@@ -63,8 +112,8 @@ class MPPReaderExec(Executor):
         return TableReaderExec(self.ctx, dag, list(side.ranges),
                                dag.output_ftypes(), plan_id=-1)
 
-    def _start_fallback(self, reason: str):
-        """Root hash join over the same two cop DAGs (always correct:
+    def _build_host_join(self, spec):
+        """Root hash join over a spec's two cop DAGs (always correct:
         handles deltas, duplicates, overflow shapes).  Inner joins keep
         the MPP plan's selectivity win: the build side's distinct keys
         ship to the probe scan as a runtime semi-join filter
@@ -73,9 +122,6 @@ class MPPReaderExec(Executor):
         from ..copr.ir import JoinProbeIR
         from ..executor.join import HashJoinExec
 
-        REGISTRY.inc("mpp_fallback_total")
-        self._attribute(f"host-join [mpp rejected: {reason}]")
-        spec = self.spec
         pk = ColumnExpr(spec.probe.key_pos,
                         spec.probe.out_ftypes[spec.probe.key_pos], "pk", -1)
         bk = ColumnExpr(spec.build.key_pos,
@@ -84,11 +130,47 @@ class MPPReaderExec(Executor):
             if spec.kind == "inner" else None
         probe = self._side_reader(spec.probe, probe_ir)
         build = self._side_reader(spec.build)
-        join = HashJoinExec(
+        return HashJoinExec(
             self.ctx, build, probe, spec.kind, [bk], [pk], [],
             probe_is_left=spec.probe_is_left, plan_id=-1,
             rf_reader=probe if probe_ir is not None else None,
             rf_key_idx=0, rf_filter_id=0)
+
+    def _host_join_pair(self, pair, reason: str) -> List[Chunk]:
+        """Host-join ONE co-partitioned pair to completion (collected:
+        pairs are 1/N of the table by construction)."""
+        REGISTRY.inc("mpp_fallback_total")
+        from ..trace import span
+
+        with span("mpp.host_join", reason=reason[:80]):
+            join = self._build_host_join(pair)
+            folds = ([_AggFold(a) for a in pair.aggs]
+                     if pair.aggs is not None else None)
+            out: List[Chunk] = []
+            join.open()
+            try:
+                while True:
+                    c = join.next()
+                    if c is None:
+                        break
+                    if not c.num_rows:
+                        continue
+                    if folds is None:
+                        out.append(c)
+                    else:
+                        for f in folds:
+                            f.consume(c)
+            finally:
+                join.close()
+            if folds is not None:
+                out = [Chunk([col for f in folds for col in f.partials()])]
+            return out
+
+    def _start_fallback(self, reason: str):
+        REGISTRY.inc("mpp_fallback_total")
+        self._attribute(f"host-join [mpp rejected: {reason}]")
+        spec = self.spec
+        join = self._build_host_join(spec)
         if spec.aggs is None:
             self._fallback = join
             self._fallback.open()

@@ -428,6 +428,7 @@ class ExplainStmt(Stmt):
 @dataclass
 class TraceStmt(Stmt):
     target: Stmt
+    fmt: str = "row"  # TRACE FORMAT='row'|'json'
 
 
 @dataclass

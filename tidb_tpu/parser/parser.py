@@ -1244,7 +1244,15 @@ class Parser:
 
     def _parse_trace(self) -> ast.Stmt:
         self.expect_kw("trace")
-        return ast.TraceStmt(self.parse_statement())
+        fmt = "row"
+        if self.accept_kw("format"):
+            self.expect_op("=")
+            t = self.next()
+            fmt = str(t.value).lower()
+            if fmt not in ("row", "json"):
+                raise ParseError(f"unknown TRACE format {fmt!r}",
+                                 t.line, t.col)
+        return ast.TraceStmt(self.parse_statement(), fmt)
 
     def _parse_set(self) -> ast.Stmt:
         self.expect_kw("set")

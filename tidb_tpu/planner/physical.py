@@ -28,7 +28,7 @@ from ..copr.ir import (
     TableScanIR,
     TopNIR,
 )
-from ..errors import PlanError
+from ..errors import KVError, PlanError
 from ..expr.aggregation import AggDesc
 from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
 from ..expr.pushdown import can_push_agg, can_push_expr
@@ -236,15 +236,28 @@ class PhysExchangeSender(PhysTableReader):
     `jax.lax.all_to_all` inside the shard_map program)."""
 
     def __init__(self, schema: Schema, task: CopTask, key_pos: int,
-                 ranges: Optional[List[KeyRange]] = None):
+                 ranges: Optional[List[KeyRange]] = None,
+                 elided: bool = False):
         super().__init__(schema, task, keep_order=False, ranges=ranges)
         self.key_pos = key_pos
+        # co-partitioned elision: this fragment IS already partitioned on
+        # the join key (hash-partitioned table), so no exchange runs —
+        # the node renders as a plain MPP scan
+        self.elided = elided
+
+    @property
+    def name(self) -> str:
+        return "MPPScan" if self.elided else "ExchangeSender"
 
     def task(self) -> str:
         return "mpp[tpu]"
 
     def info(self) -> str:
         key = self.cop.scan_cols[self.key_pos].name
+        if self.elided:
+            return (f"co-partitioned on {key} "
+                    f"(hash, {len(self.cop.table.partition_info.defs)} "
+                    f"partitions), table:{self.cop.table.name}")
         return (f"ExchangeType: HashPartition, key:{key}, "
                 f"table:{self.cop.table.name}")
 
@@ -272,11 +285,10 @@ class PhysMPPJoin(PhysicalPlan):
     completes inside the same compiled program.  Strategy ladder at
     runtime: shuffle -> broadcast -> host hash join (mpp/engine.py)."""
 
-    def __init__(self, left_recv: PhysExchangeReceiver,
-                 right_recv: PhysExchangeReceiver, kind: str,
+    def __init__(self, left_recv, right_recv, kind: str,
                  probe_is_left: bool, schema: Schema,
                  left_keys: List[Expression], right_keys: List[Expression],
-                 aggs=None, reason: str = ""):
+                 aggs=None, reason: str = "", elided: bool = False):
         super().__init__(schema, [left_recv, right_recv])
         self.kind = kind
         self.probe_is_left = probe_is_left
@@ -284,21 +296,27 @@ class PhysMPPJoin(PhysicalPlan):
         self.right_keys = right_keys
         self.aggs = aggs  # scalar partial-agg pushdown (joined layout)
         self.reason = reason  # cost-choice note surfaced in EXPLAIN
+        # co-partitioned elision: children are bare MPPScan fragments
+        # (no sender/receiver pair); the join runs per partition pair
+        self.elided = elided
+
+    def _sender(self, child) -> "PhysExchangeSender":
+        return child if isinstance(child, PhysExchangeSender) \
+            else child.children[0]
 
     @property
-    def probe_sender(self) -> PhysExchangeSender:
-        recv = self.children[0 if self.probe_is_left else 1]
-        return recv.children[0]
+    def probe_sender(self) -> "PhysExchangeSender":
+        return self._sender(self.children[0 if self.probe_is_left else 1])
 
     @property
-    def build_sender(self) -> PhysExchangeSender:
-        recv = self.children[1 if self.probe_is_left else 0]
-        return recv.children[0]
+    def build_sender(self) -> "PhysExchangeSender":
+        return self._sender(self.children[1 if self.probe_is_left else 0])
 
     def info(self) -> str:
         keys = ", ".join(
             f"{l}=={r}" for l, r in zip(self.left_keys, self.right_keys))
-        s = f"{self.kind} [{keys}] shuffle"
+        s = f"{self.kind} [{keys}] "
+        s += "exchange elided (co-partitioned)" if self.elided else "shuffle"
         s += ", build:" + ("right" if self.probe_is_left else "left")
         if self.aggs is not None:
             s += f", partial aggs:[{', '.join(map(str, self.aggs))}]"
@@ -322,6 +340,13 @@ class PhysMPPJoin(PhysicalPlan):
             probe=side(self.probe_sender), build=side(self.build_sender),
             kind=self.kind, probe_is_left=self.probe_is_left,
             aggs=self.aggs)
+        if self.elided:
+            # partition pairs aligned by ordinal: partition i of the
+            # probe table joins ONLY partition i of the build table
+            ppi = self.probe_sender.cop.table.partition_info
+            bpi = self.build_sender.cop.table.partition_info
+            spec.copartitions = list(zip(
+                (d.id for d in ppi.defs), (d.id for d in bpi.defs)))
         return MPPReaderExec(ctx, spec, self.schema.ftypes(), self.id)
 
 
@@ -1680,8 +1705,20 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
         if not isinstance(probe_l, LogicalDataSource) \
                 or not isinstance(build_l, LogicalDataSource):
             continue
+        copart = False
         if probe_l.table.is_partitioned or build_l.table.is_partitioned:
-            continue  # partition stores shard per-partition, not per-mesh
+            # co-partitioned elision (TiFlash's same-zone optimization):
+            # both sides HASH-partitioned on the join key with equal
+            # partition counts means partition i of one side can only
+            # match partition i of the other — the join runs per
+            # partition pair with NO exchange operators.  Inner joins
+            # only: a pruned build partition then simply contributes
+            # nothing.  Anything else stays per-partition-store sharded
+            # and takes the host lanes (ROADMAP PR-3 follow-up (d)).
+            copart = (join.kind == "inner"
+                      and _co_partitioned(probe_l, pk, build_l, bk))
+            if not copart:
+                continue
         if pk.ftype.kind not in _DJ_KEY_KINDS \
                 or bk.ftype.kind != pk.ftype.kind:
             continue
@@ -1717,8 +1754,30 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
         if not pctx.enforce_mpp and build_est <= pctx.mpp_threshold:
             continue
         return (probe_l, build_l, p_task, b_task, pk_pos, bk_pos,
-                probe_is_left, build_est)
+                probe_is_left, build_est, copart)
     return None
+
+
+def _co_partitioned(probe_l, pk, build_l, bk) -> bool:
+    """True when both sides are HASH-partitioned ON THE JOIN KEY with
+    equal partition counts: rows with equal keys land in same-ordinal
+    partitions (the same abs(v) %% N routing on both sides), so the
+    exchange pair is provably unnecessary."""
+    pi = probe_l.table.partition_info
+    bi = build_l.table.partition_info
+    if pi is None or bi is None:
+        return False
+    if pi.kind != "hash" or bi.kind != "hash" or len(pi.defs) != len(bi.defs):
+        return False
+
+    def key_is_part_col(ds, key, info):
+        col = next((c for c in ds.schema.cols
+                    if c.uid == key.unique_id), None)
+        return (col is not None
+                and col.name.lower() == info.column.lower())
+
+    return (key_is_part_col(probe_l, pk, pi)
+            and key_is_part_col(build_l, bk, bi))
 
 
 def _mpp_reason(pctx: PhysicalContext, build_est: float) -> str:
@@ -1728,13 +1787,18 @@ def _mpp_reason(pctx: PhysicalContext, build_est: float) -> str:
 
 
 def _mpp_exchange_pair(probe_l, build_l, p_task, b_task, pk_pos, bk_pos,
-                       probe_is_left):
-    """(left receiver, right receiver, probe sender, build sender) in
-    schema order."""
+                       probe_is_left, elided: bool = False):
+    """(left, right) fragment plans in schema order: sender/receiver
+    pairs normally, bare co-partitioned scans when the exchange is
+    elided (no exchange operators in the plan at all)."""
     p_sender = PhysExchangeSender(Schema(p_task.scan_cols), p_task, pk_pos,
-                                  ranges=probe_l.ranges)
+                                  ranges=probe_l.ranges, elided=elided)
     b_sender = PhysExchangeSender(Schema(b_task.scan_cols), b_task, bk_pos,
-                                  ranges=build_l.ranges)
+                                  ranges=build_l.ranges, elided=elided)
+    if elided:
+        left, right = ((p_sender, b_sender) if probe_is_left
+                       else (b_sender, p_sender))
+        return left, right
     p_recv = PhysExchangeReceiver(p_sender)
     b_recv = PhysExchangeReceiver(b_sender)
     if probe_is_left:
@@ -1750,21 +1814,22 @@ def _try_mpp_join(plan: LogicalJoin,
     if parts is None:
         return None
     (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
-     build_est) = parts
+     build_est, copart) = parts
     left_l, right_l = plan.children
     want = [c.uid for c in list(left_l.schema.cols)
             + list(right_l.schema.cols)]
     if [c.uid for c in plan.schema.cols] != want:
         return None  # schema is not the plain left++right concatenation
     left_recv, right_recv = _mpp_exchange_pair(
-        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left)
+        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
+        elided=copart)
     le, re_ = plan.eq_conds[0]
     lmap = {c.uid: i for i, c in enumerate(left_l.schema.cols)}
     rmap = {c.uid: i for i, c in enumerate(right_l.schema.cols)}
     return PhysMPPJoin(
         left_recv, right_recv, plan.kind, probe_is_left, plan.schema,
         [le.remap_columns(lmap)], [re_.remap_columns(rmap)],
-        reason=_mpp_reason(pctx, build_est))
+        reason=_mpp_reason(pctx, build_est), elided=copart)
 
 
 def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
@@ -1780,7 +1845,7 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
     if parts is None:
         return None
     (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
-     build_est) = parts
+     build_est, copart) = parts
     if not probe_is_left:
         return None  # host-rung partial layout assumes probe==left
     from ..expr.pushdown import can_push_agg
@@ -1808,14 +1873,15 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
             return None  # dict codes don't aggregate
         aggs.append(a.remap_columns(mapping))
     left_recv, right_recv = _mpp_exchange_pair(
-        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left)
+        probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
+        elided=copart)
     le, re_ = join.eq_conds[0]
     lmap = {c.uid: i for i, c in enumerate(probe_l.schema.cols)}
     rmap = {c.uid: i for i, c in enumerate(build_l.schema.cols)}
     mpp = PhysMPPJoin(
         left_recv, right_recv, "inner", True, _partial_schema(plan),
         [le.remap_columns(lmap)], [re_.remap_columns(rmap)], aggs=aggs,
-        reason=_mpp_reason(pctx, build_est))
+        reason=_mpp_reason(pctx, build_est), elided=copart)
     return PhysHashAgg(mpp, [], plan.aggs, True, plan.schema)
 
 
@@ -1912,11 +1978,18 @@ def _attach_runtime_filter(kind, left, right, lkeys, rkeys, build_right,
         return None
     from ..expr.pushdown import can_push_expr
 
-    dict_cols = {
-        i for i, ci in enumerate(probe.dag.scan.columns)
-        if ci in pctx.storage.table(probe.dag.scan.table_id)
-        .dict_encoded_cols()
-    }
+    # dict encoding lives on PHYSICAL stores: a partitioned probe's scan
+    # carries the logical id, which has no storage — resolve through the
+    # first range's physical id (encoding is uniform per column family)
+    try:
+        store_tid = probe.ranges[0].table_id if probe.ranges \
+            else probe.dag.scan.table_id
+        dict_cols = {
+            i for i, ci in enumerate(probe.dag.scan.columns)
+            if ci in pctx.storage.table(store_tid).dict_encoded_cols()
+        }
+    except KVError:
+        return None  # no physical store reachable: skip the filter
     from ..copr.ir import deserialize_expr, serialize_expr
 
     for i, pk in enumerate(pkeys):

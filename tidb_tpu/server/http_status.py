@@ -51,10 +51,29 @@ class StatusServer:
                     return
                 if path in ("/status", "/"):
                     from ..copr.device_health import DEVICE_HEALTH
+                    from ..trace import TRACE_RING
 
                     running = sum(
                         1 for s in domain.sessions.values()
                         if getattr(s, "stmt_start", None) is not None)
+                    recent = []
+                    for tr in list(TRACE_RING)[-8:]:
+                        try:
+                            tot = tr.phase_totals()
+                            recent.append({
+                                "sql": tr.sql[:128],
+                                "conn_id": tr.conn_id,
+                                "duration_ms": round(tr.duration_ms(), 3),
+                                "compile_ms": round(tot["compile_ms"], 3),
+                                "transfer_bytes": tot["transfer_bytes"],
+                                "device_ms": round(tot["device_ms"], 3),
+                                "readback_ms": round(tot["readback_ms"], 3),
+                                "backoff_ms": round(tot["backoff_ms"], 3),
+                                "wire_bytes": tot["wire_bytes"],
+                                "engines": tot["engines"],
+                            })
+                        except Exception:
+                            continue  # a live trace mutating mid-walk
                     body = json.dumps({
                         "version": VERSION,
                         "git_hash": "",
@@ -69,6 +88,9 @@ class StatusServer:
                         # chip without querying information_schema
                         "tripped_devices":
                             list(DEVICE_HEALTH.tripped_ids()),
+                        # N most recent finished query traces with their
+                        # per-phase totals (the trace subsystem's ring)
+                        "recent_traces": recent,
                     }).encode()
                     self._send(200, body, "application/json")
                     return

@@ -164,6 +164,11 @@ class MySQLServer:
 
     async def _run_sql(self, sess, sql: str, pw: PacketWriter, loop,
                        params=None, binary: bool = False):
+        import time as _time
+
+        # wire.read attribution: the statement's trace root records how
+        # many bytes the COM_QUERY/COM_STMT_EXECUTE payload carried
+        sess._pending_wire_read = len(sql.encode("utf8", "replace"))
         try:
             rss = await loop.run_in_executor(
                 self.pool, lambda: sess.execute(sql, params)
@@ -180,6 +185,8 @@ class MySQLServer:
             await pw.send(P.ok_packet(rs.affected_rows, rs.last_insert_id,
                                       warnings=len(rs.warnings)))
             return
+        t0 = _time.perf_counter_ns()
+        nbytes = 0
         fts = rs.ftypes
         await pw.send(bytes([len(rs.headers)]))
         for i, h in enumerate(rs.headers):
@@ -189,8 +196,16 @@ class MySQLServer:
         await pw.send(P.eof_packet())
         encode = (lambda r: P.binary_row(r, fts)) if binary else P.text_row
         for row in rs.rows:
-            await pw.send(encode(row))
+            pkt = encode(row)
+            nbytes += len(pkt)
+            await pw.send(pkt)
         await pw.send(P.eof_packet())
+        tr = getattr(sess, "last_trace", None)
+        if tr is not None and tr.finished and tr.sql == sql:
+            # result encode+write time, appended onto the finished trace
+            # (the statement ended before its rows hit the socket)
+            tr.add_span("wire.write", _time.perf_counter_ns() - t0,
+                        bytes=nbytes, rows=len(rs.rows))
 
 
 def _count_params(sql: str) -> int:

@@ -72,8 +72,18 @@ class Domain:
         # "owners": {conn_id}} — read locks shard across sessions, write
         # locks have one owner (reference: ddl/table_lock.go role)
         self.table_locks: Dict[tuple, dict] = {}
-        self.slow_threshold_ms = 300
-        self.slow_queries = []
+        # structured slow-query log (trace/slowlog.py): file-backed when
+        # the domain persists, memory-ring otherwise; feeds
+        # INFORMATION_SCHEMA.SLOW_QUERY with per-phase columns
+        from ..trace import SlowQueryLog
+
+        slow_path = None
+        if data_dir:
+            import os as _os
+
+            _os.makedirs(data_dir, exist_ok=True)
+            slow_path = _os.path.join(data_dir, "slow_query.log")
+        self.slow_log = SlowQueryLog(slow_path)
         if data_dir:
             self._recover(data_dir)
         self._bootstrap()
@@ -198,10 +208,54 @@ class Domain:
             st["sum_latency"] += dur_s
             st["max_latency"] = max(st["max_latency"], dur_s)
             st["sum_rows"] += rows
-            if dur_s * 1000 >= self.slow_threshold_ms:
-                self.slow_queries.append((sql, dur_s))
-                if len(self.slow_queries) > 100:
-                    self.slow_queries = self.slow_queries[-50:]
+
+    def record_trace(self, tr, totals: dict, dur_ms: float, slow: bool):
+        """Fold a finished QueryTrace into the per-digest statement
+        summary (phase aggregates from the span tree — the one
+        execution-stats path) and, when it crossed the threshold, build
+        the structured slow-log entry with per-phase columns."""
+        digest = sql_digest(tr.sql)
+        with self._mu:
+            st = self.digest_summary.get(digest)
+            if st is not None:
+                ph = st.setdefault("phases", {
+                    "compile_ms": 0.0, "device_ms": 0.0,
+                    "transfer_bytes": 0, "readback_ms": 0.0,
+                    "backoff_ms": 0.0})
+                ph["compile_ms"] += totals["compile_ms"]
+                ph["device_ms"] += totals["device_ms"]
+                ph["transfer_bytes"] += totals["transfer_bytes"]
+                ph["readback_ms"] += totals["readback_ms"]
+                ph["backoff_ms"] += totals["backoff_ms"]
+        if not slow:
+            return
+        import time as _time
+
+        entry = {
+            "time": _time.strftime("%Y-%m-%d %H:%M:%S",
+                                   _time.localtime(tr.start_time)),
+            "conn_id": tr.conn_id,
+            "query": tr.sql[:512],
+            "query_time": round(dur_ms / 1000.0, 6),
+            "parse_ms": round(totals["parse_ms"], 3),
+            "plan_ms": round(totals["plan_ms"], 3),
+            "compile_ms": round(totals["compile_ms"], 3),
+            "compile_hits": totals["compile_hits"],
+            "compile_misses": totals["compile_misses"],
+            "transfer_bytes": totals["transfer_bytes"],
+            "device_ms": round(totals["device_ms"], 3),
+            "readback_ms": round(totals["readback_ms"], 3),
+            "readback_bytes": totals["readback_bytes"],
+            "backoff_ms": round(totals["backoff_ms"], 3),
+            "cop_tasks": totals["cop_tasks"],
+            "engines": totals["engines"],
+            "devices": totals["devices"],
+            "rows": totals.get("result_rows", 0),
+        }
+        self.slow_log.record(entry)
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("slow_queries_total")
 
 
 class _RingLogHandler(logging.Handler):

@@ -121,6 +121,8 @@ class Session:
         self._prepared: dict = {}  # name -> sql
         self.last_exec_ctx: Optional[ExecContext] = None
         self.last_plan = None
+        self.last_trace = None  # finished QueryTrace of the last execute()
+        self._pending_wire_read = None  # server-set COM_QUERY payload size
         from collections import OrderedDict
 
         self._plan_cache: "OrderedDict" = OrderedDict()
@@ -133,26 +135,62 @@ class Session:
 
         if bindinfo.is_binding_stmt(sql):
             return [bindinfo.handle(self, sql)]
-        out = []
-        stmts = parse(sql)
-        if len(stmts) == 1:
-            # plan-cache key: single-statement texts cache their plan
-            stmts[0]._sql_text = sql
-        for stmt in stmts:
-            t0 = time.time()
-            self.stmt_start, self.stmt_sql = t0, sql  # watchdog visibility
-            try:
-                rs = self._execute_stmt(stmt, params)
-            finally:
-                self.stmt_start = None
-            dur = time.time() - t0
-            self.domain.record_stmt(sql, dur, len(rs.rows))
-            out.append(rs)
-        return out
+        from ..trace import finish_trace, span, start_trace, tracing_active
+
+        # one trace per top-level execute() call: slow-log-enabled
+        # sessions trace every statement; nested executes (EXECUTE
+        # prepared, TRACE targets, subplans) record into the outer trace
+        tr = token = None
+        if not tracing_active() and self.vars.get_bool("tidb_enable_slow_log"):
+            tr, token = start_trace(sql, self.conn_id)
+            wr = getattr(self, "_pending_wire_read", None)
+            if wr:
+                tr.root.set(wire_read_bytes=wr)
+                self._pending_wire_read = None
+        try:
+            out = []
+            with span("parse"):
+                stmts = parse(sql)
+            if len(stmts) == 1:
+                # plan-cache key: single-statement texts cache their plan
+                stmts[0]._sql_text = sql
+            for stmt in stmts:
+                t0 = time.time()
+                self.stmt_start, self.stmt_sql = t0, sql  # watchdog
+                try:
+                    rs = self._execute_stmt(stmt, params)
+                finally:
+                    self.stmt_start = None
+                dur = time.time() - t0
+                self.domain.record_stmt(sql, dur, len(rs.rows))
+                out.append(rs)
+            return out
+        finally:
+            if tr is not None:
+                self.last_trace = tr
+                totals = finish_trace(tr, token)
+                self._maybe_slow_log(tr, totals)
 
     def query(self, sql: str, params: Optional[list] = None) -> List[tuple]:
         """Convenience: rows of the last result set."""
         return self.execute(sql, params)[-1].rows
+
+    def _maybe_slow_log(self, tr, totals):
+        """Account a finished trace: phase aggregates always fold into
+        the statement summary; the slow log gets an entry when the
+        statement crossed tidb_slow_log_threshold ms (0 logs all)."""
+        try:
+            dur_ms = tr.duration_ms()
+            threshold = self.vars.get_int("tidb_slow_log_threshold", 300)
+            self.domain.record_trace(tr, totals, dur_ms,
+                                     slow=dur_ms >= threshold)
+        except Exception:
+            # the slow log is advisory and must never fail the
+            # statement — but silent breakage would disable the whole
+            # accounting pipeline invisibly, so count it
+            from ..metrics import REGISTRY
+
+            REGISTRY.inc("trace_accounting_errors_total")
 
     def kill(self, query_only: bool = True):
         """KILL QUERY (default): cancel the in-flight statement only.
@@ -401,31 +439,35 @@ class Session:
         return rows
 
     def _plan(self, stmt, params=None):
+        from ..trace import span
         from . import bindinfo
 
-        stmt, hints = bindinfo.apply_binding(self, stmt)
-        key = self._plan_cache_key(stmt, params)
-        if key is not None:
-            hit = self._plan_cache.get(key)
-            if hit is not None:
+        with span("plan") as sp:
+            stmt, hints = bindinfo.apply_binding(self, stmt)
+            key = self._plan_cache_key(stmt, params)
+            if key is not None:
+                hit = self._plan_cache.get(key)
+                if hit is not None:
+                    from ..metrics import REGISTRY
+
+                    REGISTRY.inc("plan_cache_hits_total")
+                    self._plan_cache.move_to_end(key)
+                    sp.set(plan_cache="hit")
+                    return hit
+            phys = plan_statement(
+                stmt, self._infoschema(), self.current_db,
+                self._pctx(hints), exec_subplan=self._exec_subplan,
+                param_values=params,
+            )
+            if key is not None:
                 from ..metrics import REGISTRY
 
-                REGISTRY.inc("plan_cache_hits_total")
-                self._plan_cache.move_to_end(key)
-                return hit
-        phys = plan_statement(
-            stmt, self._infoschema(), self.current_db,
-            self._pctx(hints), exec_subplan=self._exec_subplan,
-            param_values=params,
-        )
-        if key is not None:
-            from ..metrics import REGISTRY
-
-            REGISTRY.inc("plan_cache_misses_total")
-            self._plan_cache[key] = phys
-            if len(self._plan_cache) > 128:
-                self._plan_cache.popitem(last=False)
-        return phys
+                REGISTRY.inc("plan_cache_misses_total")
+                self._plan_cache[key] = phys
+                if len(self._plan_cache) > 128:
+                    self._plan_cache.popitem(last=False)
+                sp.set(plan_cache="miss")
+            return phys
 
     def _plan_cache_key(self, stmt, params):
         """Cache key for repeated statements (planner/core/cache.go analog:
@@ -644,15 +686,34 @@ class Session:
                          is_query=True)
 
     def _run_trace(self, s: ast.TraceStmt) -> ResultSet:
-        t0 = time.time()
-        rs = self._execute_stmt(s.target)
-        dur = time.time() - t0
-        rows = [("session.execute", f"{dur*1e3:.3f}ms")]
-        if self.last_exec_ctx:
-            for pid, st in sorted(self.last_exec_ctx.stats.items()):
-                rows.append((f"operator#{pid}", f"{st.time_ns/1e6:.3f}ms"))
-        return ResultSet(headers=["span", "duration"], rows=rows,
-                         is_query=True)
+        """TRACE [FORMAT='row'|'json'] <stmt> (executor/trace.go): run the
+        target under the span recorder and return its span tree.  When the
+        session already traces (slow log enabled) the target's spans land
+        in the active trace; otherwise TRACE forces one of its own."""
+        import json as _json
+
+        from ..trace import current_trace, finish_trace, start_trace
+
+        tr = current_trace()
+        owned = False
+        if tr is None:
+            tr, token = start_trace(getattr(self, "stmt_sql", "") or "trace",
+                                    self.conn_id)
+            owned = True
+        try:
+            self._execute_stmt(s.target)
+        finally:
+            if owned:
+                finish_trace(tr, token)
+        self.last_trace = tr
+        fmt = getattr(s, "fmt", "row")
+        if fmt == "json":
+            return ResultSet(
+                headers=["operation"],
+                rows=[(_json.dumps(tr.to_dict(), sort_keys=True),)],
+                is_query=True)
+        return ResultSet(headers=["operation", "startTS", "duration"],
+                         rows=tr.rows(), is_query=True)
 
     # ------------------------------------------------------------------
     # SET / SHOW / DESC

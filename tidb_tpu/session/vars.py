@@ -68,6 +68,14 @@ SYSVAR_DEFAULTS = {
     "tidb_snapshot": ("", "str"),
     # domain-wide cProfile collector -> information_schema.tidb_profile
     "tidb_profiling": ("0", "bool"),
+    # --- query tracing / slow log (tidb_tpu/trace) --------------------
+    # enable: every statement records a span tree (wire -> parse -> plan
+    # -> executor -> distsql -> copr compile/transfer/execute/readback);
+    # threshold: statements at or above this many ms land in
+    # INFORMATION_SCHEMA.SLOW_QUERY with per-phase columns (0 logs all).
+    # Disabled, span hooks are a single contextvar read (zero-cost).
+    "tidb_enable_slow_log": ("1", "bool"),
+    "tidb_slow_log_threshold": ("300", "int"),
     # auto-capture plan baselines for repeated statements
     # (bindinfo/handle.go:545 CaptureBaselines)
     "tidb_capture_plan_baselines": ("0", "bool"),

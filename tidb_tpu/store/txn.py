@@ -164,23 +164,27 @@ class Transaction:
         # upgraded in place when a mutation exists)
         for tid, h in self._locked - set(keys):
             self.storage.table(tid).rollback(h, self.start_ts)
+        from ..trace import span
+
         # phase 1: prewrite all keys (primary first), grouped per region
         prewritten = []
         try:
-            for tid, h in keys:
-                FAILPOINTS.hit("2pc/prewrite", table_id=tid, handle=h)
-                m = self.buffer[(tid, h)]
-                store = self.storage.table(tid)
-                pess = (tid, h) in self._locked
-                # upgrade IN PLACE: prewrite overwrites our own lock
-                # atomically (blockstore allows same-start_ts rewrite), so
-                # no waiter can steal the row between release and rewrite.
-                # Keys we hold pessimistic locks on conflict-check at
-                # for_update_ts (the lock horizon), not start_ts.
-                self._prewrite_waiting(
-                    tid, h, m.op, m.values, primary,
-                    check_ts=(self.for_update_ts if pess else None))
-                prewritten.append((tid, h))
+            with span("txn.prewrite", keys=len(keys)):
+                for tid, h in keys:
+                    FAILPOINTS.hit("2pc/prewrite", table_id=tid, handle=h)
+                    m = self.buffer[(tid, h)]
+                    store = self.storage.table(tid)
+                    pess = (tid, h) in self._locked
+                    # upgrade IN PLACE: prewrite overwrites our own lock
+                    # atomically (blockstore allows same-start_ts
+                    # rewrite), so no waiter can steal the row between
+                    # release and rewrite.  Keys we hold pessimistic
+                    # locks on conflict-check at for_update_ts (the lock
+                    # horizon), not start_ts.
+                    self._prewrite_waiting(
+                        tid, h, m.op, m.values, primary,
+                        check_ts=(self.for_update_ts if pess else None))
+                    prewritten.append((tid, h))
         except (LockedError, TxnConflictError, DeadlockError,
                 LockWaitTimeoutError):
             for tid, h in prewritten:
@@ -202,12 +206,15 @@ class Transaction:
         commit_ts = self.storage.oracle.get_timestamp()
         FAILPOINTS.hit("2pc/before_commit_primary", start_ts=self.start_ts)
         # phase 2: commit primary; after that the txn is decided
-        self.storage.table(primary[0]).commit(primary[1], self.start_ts, commit_ts)
-        for tid, h in keys:
-            if (tid, h) == primary:
-                continue
-            FAILPOINTS.hit("2pc/commit_secondary", table_id=tid, handle=h)
-            self.storage.table(tid).commit(h, self.start_ts, commit_ts)
+        with span("txn.commit", keys=len(keys)):
+            self.storage.table(primary[0]).commit(
+                primary[1], self.start_ts, commit_ts)
+            for tid, h in keys:
+                if (tid, h) == primary:
+                    continue
+                FAILPOINTS.hit("2pc/commit_secondary", table_id=tid,
+                               handle=h)
+                self.storage.table(tid).commit(h, self.start_ts, commit_ts)
         self.committed = True
         self.storage.deadlock.clean_up(self.start_ts)
         self.storage.txn_finished(self.start_ts)

@@ -1,0 +1,382 @@
+"""Span-tree recorder: the low-overhead core of the trace subsystem.
+
+A QueryTrace is a tree of Spans rooted at one statement execution.  The
+CURRENT span travels in a contextvar; `span(name)` opens a child under
+it.  Worker threads do not inherit the contextvar automatically — the
+fan-out layers capture `current_span()` on the submitting thread and
+re-enter with `attach(parent)` (the reference's opentracing
+span-context propagation, contextvar-shaped).
+
+Phase attribution: span names beginning with a known phase prefix (see
+PHASES) aggregate into the per-phase totals the slow log, the statement
+summary and the /metrics histograms consume; byte counts ride in span
+attrs (`bytes=`), engine/rung attribution in `engine=` attrs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass
+class OperatorStats:
+    """Per-operator runtime stats (rows/loops/time) for EXPLAIN ANALYZE —
+    owned by the trace subsystem so the span tree and the operator table
+    are one collection path (util/execdetails RuntimeStatsColl role)."""
+
+    rows: int = 0
+    loops: int = 0
+    time_ns: int = 0
+    # engine attribution (which engine actually served a cop task, incl.
+    # mesh-rejection reasons — execdetails.go:326-396 analog)
+    engine: str = ""
+
+    def record(self, rows: int, dur_ns: int):
+        self.rows += rows
+        self.loops += 1
+        self.time_ns += dur_ns
+
+
+class Span:
+    """One timed operation.  Children append under the owning trace's
+    lock (fan-out workers record concurrently); attrs are written only
+    by the thread inside the span, so they need no lock."""
+
+    __slots__ = ("name", "start_ns", "dur_ns", "attrs", "children",
+                 "_trace")
+
+    def __init__(self, name: str, trace: "QueryTrace"):
+        self.name = name
+        self.start_ns = time.perf_counter_ns()
+        self.dur_ns = 0
+        self.attrs: Optional[Dict[str, object]] = None
+        self.children: List["Span"] = []
+        self._trace = trace
+
+    def set(self, **attrs):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+        return self
+
+    def add(self, key: str, value):
+        """Accumulate a numeric attr (bytes, backoff_ms, ...)."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = self.attrs.get(key, 0) + value
+
+    def finish(self):
+        self.dur_ns = time.perf_counter_ns() - self.start_ns
+
+
+class QueryTrace:
+    """The span tree of one statement execution plus its EXPLAIN ANALYZE
+    operator stats — the single execution-stats carrier."""
+
+    def __init__(self, sql: str, conn_id: int = 0):
+        self.sql = sql
+        self.conn_id = conn_id
+        self.start_time = time.time()
+        self._mu = threading.Lock()
+        self.root = Span("session.execute", self)
+        self.op_stats: Dict[int, OperatorStats] = {}
+        self.finished = False
+
+    # ---- tree assembly --------------------------------------------------
+    def child(self, parent: Span, name: str) -> Span:
+        s = Span(name, self)
+        with self._mu:
+            parent.children.append(s)
+        return s
+
+    def add_span(self, name: str, dur_ns: int = 0, **attrs) -> Span:
+        """Append a pre-timed span under the root after the fact — the
+        wire layer records result write time onto the already-finished
+        trace (the statement ended before the rows hit the socket)."""
+        s = Span(name, self)
+        s.dur_ns = dur_ns
+        if attrs:
+            s.set(**attrs)
+        with self._mu:
+            self.root.children.append(s)
+        return s
+
+    # ---- rendering ------------------------------------------------------
+    def duration_ms(self) -> float:
+        return (self.root.dur_ns or
+                (time.perf_counter_ns() - self.root.start_ns)) / 1e6
+
+    def rows(self, indent_root: bool = True) -> List[tuple]:
+        """(operation, start_offset_ms, duration_ms) rows, depth-first,
+        with two-space indentation showing the tree (TRACE row format)."""
+        out: List[tuple] = []
+        t0 = self.root.start_ns
+
+        def walk(s: Span, depth: int):
+            dur = s.dur_ns or (time.perf_counter_ns() - s.start_ns)
+            label = "  " * depth + s.name
+            if s.attrs:
+                kv = ", ".join(f"{k}: {v}" for k, v in sorted(s.attrs.items()))
+                label += f" {{{kv}}}"
+            out.append((label, f"{(s.start_ns - t0) / 1e6:.3f}ms",
+                        f"{dur / 1e6:.3f}ms"))
+            for c in s.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        return out
+
+    def to_dict(self) -> dict:
+        def walk(s: Span) -> dict:
+            d = {
+                "name": s.name,
+                "start_us": (s.start_ns - self.root.start_ns) // 1000,
+                "duration_us": (s.dur_ns or 0) // 1000,
+            }
+            if s.attrs:
+                d["attrs"] = {k: (v if isinstance(v, (int, float, str, bool))
+                                  else str(v))
+                              for k, v in s.attrs.items()}
+            if s.children:
+                d["children"] = [walk(c) for c in s.children]
+            return d
+
+        return {"sql": self.sql[:512], "conn_id": self.conn_id,
+                "start_time": self.start_time, "root": walk(self.root)}
+
+    # ---- phase aggregation ---------------------------------------------
+    def phase_totals(self) -> dict:
+        """Aggregate the tree into the per-phase columns SLOW_QUERY and
+        the statement summary expose.  ms totals per phase prefix, byte
+        totals for transfer/readback, backoff from attr accumulation,
+        and engine/rung attribution collected from span attrs."""
+        tot = {
+            "parse_ms": 0.0, "plan_ms": 0.0, "compile_ms": 0.0,
+            "transfer_ms": 0.0, "transfer_bytes": 0,
+            "device_ms": 0.0, "readback_ms": 0.0, "readback_bytes": 0,
+            "backoff_ms": 0.0, "exchange_ms": 0.0, "commit_ms": 0.0,
+            "compile_hits": 0, "compile_misses": 0, "cop_tasks": 0,
+            "wire_bytes": 0, "result_rows": 0,
+            "engines": set(), "devices": set(),
+        }
+
+        def nested_phase_ms(s: Span) -> float:
+            """Descendant time already attributed to other copr phases."""
+            out = 0.0
+            for c in s.children:
+                if c.name in ("copr.execute", "copr.readback",
+                              "copr.transfer"):
+                    out += (c.dur_ns or 0) / 1e6
+                out += nested_phase_ms(c)
+            return out
+
+        def walk(s: Span):
+            ms = (s.dur_ns or 0) / 1e6
+            a = s.attrs or {}
+            n = s.name
+            if n == "copr.compile":
+                # a cache miss labels the whole first dispatch; the
+                # execute/readback spans nested inside it are attributed
+                # to their own phases, so compile keeps only its SELF
+                # time (no double counting across phase columns)
+                tot["compile_ms"] += max(ms - nested_phase_ms(s), 0.0)
+            elif n in PHASES:
+                tot[PHASES[n]] += ms
+            if n == "copr.compile":
+                if a.get("cache") == "hit":
+                    tot["compile_hits"] += 1
+                else:
+                    tot["compile_misses"] += 1
+            elif n in ("copr.transfer",):
+                tot["transfer_bytes"] += int(a.get("bytes", 0))
+            elif n == "copr.readback":
+                tot["readback_bytes"] += int(a.get("bytes", 0))
+            elif n == "cop.task":
+                tot["cop_tasks"] += 1
+            elif n.startswith("wire."):
+                tot["wire_bytes"] += int(a.get("bytes", 0))
+            tot["wire_bytes"] += int(a.get("wire_read_bytes", 0))
+            tot["backoff_ms"] += float(a.get("backoff_ms", 0.0))
+            eng = a.get("engine") or a.get("rung")
+            if eng:
+                tot["engines"].add(str(eng))
+            for d in a.get("device_ids", ()) or ():
+                tot["devices"].add(int(d))
+            if "device" in a:
+                tot["devices"].add(int(a["device"]))
+            for c in s.children:
+                walk(c)
+
+        walk(self.root)
+        # result rows = the TOP-LEVEL drain loops' row counts (nested
+        # subplan drains during planning don't count toward the result)
+        tot["result_rows"] = sum(
+            int((c.attrs or {}).get("rows", 0))
+            for c in self.root.children if c.name == "executor.next")
+        tot["engines"] = ",".join(sorted(tot["engines"]))
+        tot["devices"] = ",".join(str(d) for d in sorted(tot["devices"]))
+        return tot
+
+
+#: span name -> phase-total key (ms sums)
+PHASES = {
+    "parse": "parse_ms",
+    "plan": "plan_ms",
+    "copr.compile": "compile_ms",
+    "copr.transfer": "transfer_ms",
+    "copr.execute": "device_ms",
+    "copr.readback": "readback_ms",
+    "mpp.exchange": "exchange_ms",
+    "txn.prewrite": "commit_ms",
+    "txn.commit": "commit_ms",
+}
+
+#: phases surfaced as /metrics histograms on every finished trace
+_METRIC_PHASES = ("parse_ms", "plan_ms", "compile_ms", "transfer_ms",
+                  "device_ms", "readback_ms", "backoff_ms")
+
+# the CURRENT span (None = tracing disabled for this context)
+_CUR: ContextVar[Optional[Span]] = ContextVar("tidb_tpu_trace", default=None)
+
+#: most recent finished traces (process-global; /status + tests)
+TRACE_RING: deque = deque(maxlen=32)
+
+
+class _NoopSpan:
+    """Singleton returned when tracing is off: every operation is a
+    no-op, so the disabled path costs one contextvar read."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def add(self, key, value):
+        return self
+
+
+NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    """Context manager entering/leaving one real span."""
+
+    __slots__ = ("span", "_token")
+
+    def __init__(self, s: Span):
+        self.span = s
+        self._token = None
+
+    def __enter__(self):
+        self._token = _CUR.set(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        self.span.finish()
+        _CUR.reset(self._token)
+        return False
+
+
+def tracing_active() -> bool:
+    return _CUR.get() is not None
+
+
+def current_span() -> Optional[Span]:
+    return _CUR.get()
+
+
+def current_trace() -> Optional[QueryTrace]:
+    s = _CUR.get()
+    return s._trace if s is not None else None
+
+
+def span(name: str, **attrs):
+    """Open a child span under the current one; no-op when disabled."""
+    cur = _CUR.get()
+    if cur is None:
+        return NOOP
+    s = cur._trace.child(cur, name)
+    if attrs:
+        s.set(**attrs)
+    return _SpanCtx(s)
+
+
+def annotate(**attrs):
+    """Attach attrs to the current span; no-op when disabled."""
+    cur = _CUR.get()
+    if cur is not None:
+        cur.set(**attrs)
+
+
+def attach(parent: Optional[Span]):
+    """Re-enter a span context on another thread (fan-out workers):
+    `with attach(parent): ...` makes `parent` the current span there.
+    Passing None or the no-op (captured while tracing was off) no-ops."""
+    if not isinstance(parent, Span):
+        return NOOP
+    return _AttachCtx(parent)
+
+
+def run_attached(parent: Optional[Span], fn, *args, **kwargs):
+    """Run fn under a re-attached span context (thread-pool submit
+    wrapper for the transfer/fan-out pools)."""
+    with attach(parent):
+        return fn(*args, **kwargs)
+
+
+class _AttachCtx:
+    __slots__ = ("_parent", "_token")
+
+    def __init__(self, parent: Span):
+        self._parent = parent
+        self._token = None
+
+    def __enter__(self):
+        self._token = _CUR.set(self._parent)
+        return self._parent
+
+    def __exit__(self, *exc):
+        _CUR.reset(self._token)
+        return False
+
+
+def start_trace(sql: str, conn_id: int = 0) -> tuple:
+    """Begin a trace for one statement execution; returns (trace, token).
+    The caller MUST pass both to finish_trace (try/finally)."""
+    tr = QueryTrace(sql, conn_id)
+    token = _CUR.set(tr.root)
+    return tr, token
+
+
+def finish_trace(tr: QueryTrace, token):
+    """Close the root span, restore the context, publish the ring entry
+    and the per-phase metrics histograms."""
+    _CUR.reset(token)
+    tr.root.finish()
+    tr.finished = True
+    TRACE_RING.append(tr)
+    from ..metrics import REGISTRY
+
+    totals = tr.phase_totals()
+    for key in _METRIC_PHASES:
+        v = totals.get(key, 0)
+        if v:
+            REGISTRY.observe(f"trace_phase_{key}", float(v))
+    if totals["transfer_bytes"]:
+        REGISTRY.inc("trace_transfer_bytes_total",
+                     float(totals["transfer_bytes"]))
+    if totals["readback_bytes"]:
+        REGISTRY.inc("trace_readback_bytes_total",
+                     float(totals["readback_bytes"]))
+    return totals

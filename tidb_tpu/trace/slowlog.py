@@ -1,0 +1,130 @@
+"""Structured slow-query log backing INFORMATION_SCHEMA.SLOW_QUERY.
+
+Reference: infoschema/slow_log.go — tidb-slow.log parsed back into a
+virtual table.  Here each entry is one JSON line with the TPU-native
+per-phase columns (compile/transfer/device/readback/backoff, engine and
+device attribution) computed from the statement's QueryTrace, plus an
+in-memory ring serving the memtable without touching disk.
+
+Durability follows the delta-log torn-tail contract (store/persist):
+an append interrupted mid-record (process kill, full disk) leaves a
+torn final line; recovery DROPS the torn tail (that statement's entry
+was never acknowledged anywhere) and counts it in
+`slow_log_torn_tail_total` — it never poisons the table or fails the
+server.  Mid-file corruption is equally non-fatal here (the log is
+advisory, unlike the delta log) but counts separately.  Writes never
+raise into the query path and never leak a file handle: the append
+handle is scoped per record.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import List, Optional
+
+from ..store.fault import FAILPOINTS
+
+#: column order of INFORMATION_SCHEMA.SLOW_QUERY (infoschema_tables.py)
+ENTRY_FIELDS = (
+    "time", "conn_id", "query_time", "parse_ms", "plan_ms", "compile_ms",
+    "compile_hits", "compile_misses", "transfer_bytes", "device_ms",
+    "readback_ms", "readback_bytes", "backoff_ms", "cop_tasks",
+    "engines", "devices", "rows", "query",
+)
+
+
+class SlowQueryLog:
+    def __init__(self, path: Optional[str] = None, capacity: int = 256):
+        self.path = path
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        if path is not None:
+            self._recover()
+
+    # ---- write path ----------------------------------------------------
+    def record(self, entry: dict):
+        """Append one entry; ring first (the memtable's source of truth
+        for this process), then best-effort durable append.  A writer
+        killed mid-record must neither corrupt the table nor leak a
+        handle — the failpoint models the kill between partial writes."""
+        with self._mu:
+            self._ring.append(dict(entry))
+        if self.path is None:
+            return
+        from ..metrics import REGISTRY
+
+        line = json.dumps(entry, sort_keys=True, default=str)
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                # torn-write window: the chaos harness kills the writer
+                # here, leaving a prefix of the record on disk
+                f.write(line[: len(line) // 2])
+                FAILPOINTS.hit("trace/slow_log_write", entry=entry)
+                f.write(line[len(line) // 2:] + "\n")
+        except Exception:
+            # advisory log: a failed append never fails the statement.
+            # Resync the stream: terminate whatever partial bytes landed
+            # so the NEXT (successful) record never merges into the torn
+            # one and get lost with it at recovery time.
+            REGISTRY.inc("slow_log_write_errors_total")
+            try:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write("\n")
+            except Exception:
+                pass
+
+    # ---- read / recovery ----------------------------------------------
+    def entries(self) -> List[dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def rows(self) -> List[tuple]:
+        """Entries in SLOW_QUERY column order, oldest first."""
+        out = []
+        for e in self.entries():
+            out.append(tuple(e.get(k) for k in ENTRY_FIELDS))
+        return out
+
+    def _recover(self):
+        """Load persisted entries, tolerating a torn final record (the
+        delta-log torn-tail pattern): the tail line is dropped and
+        counted; earlier undecodable lines are dropped and counted under
+        their own metric (advisory data, never fatal)."""
+        from ..metrics import REGISTRY
+
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return
+        if not raw:
+            return
+        lines = raw.split(b"\n")
+        torn = lines[-1] != b""  # no trailing newline: torn final record
+        body, tail = (lines[:-1], lines[-1]) if torn else (lines[:-1], None)
+        if torn and tail:
+            REGISTRY.inc("slow_log_torn_tail_total")
+            # TRUNCATE the torn bytes from disk (the delta-log recovery
+            # contract, store/persist torn-tail handling): leaving them
+            # would merge the first post-restart append into the torn
+            # record and lose it at the next recovery
+            try:
+                with open(self.path, "r+b") as f:
+                    f.truncate(len(raw) - len(tail))
+            except OSError:
+                pass
+        with self._mu:
+            for i, ln in enumerate(body):
+                if not ln:
+                    continue
+                try:
+                    self._ring.append(json.loads(ln.decode("utf-8",
+                                                           "replace")))
+                except ValueError:
+                    if i == len(body) - 1:
+                        # a torn record terminated by a resync newline
+                        REGISTRY.inc("slow_log_torn_tail_total")
+                    else:
+                        REGISTRY.inc("slow_log_corrupt_records_total")
