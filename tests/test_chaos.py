@@ -535,3 +535,109 @@ def test_backoff_budget_session_var():
             s.query("select sum(a) from bb")
     assert time.perf_counter() - t0 < 2.0  # not the default 10s budget
     _assert_no_leaks(d)
+
+
+# ---------------------------------------------------------------------------
+# exec/cancel: statement killed mid-distsql / mid-MPP / mid-backfill
+# (ISSUE 5 chaos coverage)
+# ---------------------------------------------------------------------------
+
+
+def _cancel_at(site_wanted):
+    """Failpoint action for exec/cancel: cancel the statement's scope
+    (the way KILL QUERY does) the first time the named site is hit."""
+    fired = {"n": 0}
+
+    def action(site=None, scope=None, **ctx):
+        if site == site_wanted and scope is not None:
+            fired["n"] += 1
+            if fired["n"] == 1:
+                scope.cancel("killed")
+
+    return action, fired
+
+
+def test_exec_cancel_mid_distsql(sess):
+    """Kill landing between distsql task dispatches: the statement errors
+    with ER_QUERY_INTERRUPTED, leaks nothing, and an immediate re-run
+    returns full parity."""
+    from tidb_tpu.errors import QueryKilledError
+
+    want = _cpu_rows(sess, Q1)
+    sess.execute("set tidb_use_tpu = 0")
+    action, fired = _cancel_at("distsql")
+    with failpoint("exec/cancel", action):
+        with pytest.raises(QueryKilledError):
+            sess.query(Q1)
+    assert fired["n"] >= 1, "exec/cancel never hit the distsql site"
+    assert sess.last_termination == "killed"
+    sess.execute("set tidb_use_tpu = 1")
+    _assert_no_leaks(sess.domain)
+    _rows_eq(sess.query(Q1), want, "post-cancel re-run parity")
+
+
+def test_exec_cancel_mid_mpp():
+    """Kill landing at an MPP rung transition: the exchange engine
+    surfaces the termination error instead of stepping down the ladder,
+    and the rebuilt state serves a clean re-run."""
+    from tidb_tpu.errors import QueryKilledError
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table co (k bigint primary key, f bigint)")
+    s.execute("create table cl (k bigint, q bigint)")
+    rng = np.random.default_rng(23)
+    t_o = d.catalog.info_schema().table("test", "co")
+    t_l = d.catalog.info_schema().table("test", "cl")
+    d.storage.table(t_o.id).bulk_load_arrays(
+        [np.arange(3000, dtype=np.int64), rng.integers(0, 3, 3000)],
+        ts=d.storage.current_ts())
+    d.storage.table(t_l.id).bulk_load_arrays(
+        [rng.integers(0, 9000, 12000), rng.integers(1, 9, 12000)],
+        ts=d.storage.current_ts())
+    s.execute("analyze table co")
+    s.execute("analyze table cl")
+    s.execute("set tidb_enforce_mpp = 1")
+    q = "select count(*), sum(q) from cl join co on cl.k = co.k"
+    want = _cpu_rows(s, q)
+
+    action, fired = _cancel_at("mpp")
+    with failpoint("exec/cancel", action):
+        with pytest.raises(QueryKilledError):
+            s.query(q)
+    assert fired["n"] >= 1, "exec/cancel never hit the mpp site"
+    assert s.last_termination == "killed"
+    _assert_no_leaks(d)
+    _rows_eq(s.query(q), want, "post-cancel mpp re-run parity")
+
+
+def test_exec_cancel_mid_backfill():
+    """Kill landing between DDL backfill batches: the online add-index
+    job rolls back (name reusable, data unharmed), no reorg checkpoints
+    leak, and a clean re-run builds the index."""
+    from tidb_tpu.errors import QueryKilledError
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table cb (a bigint, b bigint)")
+    t = d.catalog.info_schema().table("test", "cb")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(9000, dtype=np.int64),
+         np.arange(9000, dtype=np.int64) % 10],
+        ts=d.storage.current_ts())
+
+    action, fired = _cancel_at("backfill")
+    with failpoint("exec/cancel", action):
+        with pytest.raises(QueryKilledError):
+            s.execute("create index icb on cb (b)")
+    assert fired["n"] >= 1, "exec/cancel never hit the backfill site"
+    assert d.catalog.info_schema().table("test", "cb") \
+        .find_index("icb") is None
+    jobs = [j for j in d.catalog.jobs if j.table == "cb"]
+    assert jobs and jobs[-1].state == "rollback"
+    assert s.query("select count(*) from cb") == [(9000,)]
+    _assert_no_leaks(d)
+    s.execute("create index icb on cb (b)")
+    assert s.query("select count(*) from cb where b = 3") == [(900,)]

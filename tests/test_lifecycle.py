@@ -1,0 +1,653 @@
+"""Query lifecycle & admission control (ISSUE 5).
+
+- QueryScope: deadline/cancel propagation to every blocking host seam —
+  backoff sleeps wake on KILL with bounded latency, max_execution_time
+  terminates long scans between device dispatches, the termination
+  reason (killed/timeout/mem_quota/overload/shutdown) flows into the
+  slow log, the statement summary, /metrics and the trace;
+- the server front door: connection cap, bounded admission queue with a
+  queue deadline (fast MySQL-level rejection past the bound), and
+  graceful drain that finishes in-flight statements before the listener
+  closes.  None of it may leak producer threads.
+"""
+
+import asyncio
+import random
+import struct
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.distsql.backoff import Backoffer
+from tidb_tpu.errors import (
+    MaxExecutionTimeExceeded,
+    QueryKilledError,
+    TiDBTPUError,
+)
+from tidb_tpu.lifecycle import (
+    NULL_SCOPE,
+    QueryScope,
+    activate_scope,
+    classify_termination,
+    current_scope,
+    deactivate_scope,
+)
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.session import Domain
+from tidb_tpu.store.fault import failpoint
+
+
+def _wait_no_select_threads(timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "tidb-tpu-select" and t.is_alive()]
+        if not alive:
+            return []
+        time.sleep(0.01)
+    return alive
+
+
+def _metric(name):
+    return REGISTRY.snapshot().get(name, 0)
+
+
+@pytest.fixture()
+def domain():
+    d = Domain()
+    yield d
+    d.maintenance.stop()
+
+
+@pytest.fixture()
+def sess(domain):
+    import numpy as np
+
+    s = domain.new_session()
+    s.execute("create table t (k bigint, g bigint, x double)")
+    t = domain.catalog.info_schema().table("test", "t")
+    store = domain.storage.table(t.id)
+    n = 2000
+    # bulk-load into BASE blocks so the mesh/tile device paths engage
+    # (INSERTed rows live in the delta and run on the CPU engine)
+    store.bulk_load_arrays(
+        [np.arange(n, dtype=np.int64),
+         np.arange(n, dtype=np.int64) % 5,
+         np.arange(n, dtype=np.float64) + 0.5],
+        ts=domain.storage.current_ts(),
+    )
+    domain.storage.regions.split_even(t.id, 4, store.base_rows)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# QueryScope unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestScope:
+    def test_first_cancel_wins(self):
+        sc = QueryScope()
+        sc.cancel("timeout")
+        sc.cancel("killed")
+        assert sc.reason == "timeout"
+        with pytest.raises(MaxExecutionTimeExceeded):
+            sc.check()
+
+    def test_deadline_fires_as_timeout(self):
+        sc = QueryScope(timeout_s=0.01)
+        sc.check()  # not yet
+        time.sleep(0.02)
+        assert sc.cancelled()
+        assert sc.reason == "timeout"
+        with pytest.raises(MaxExecutionTimeExceeded):
+            sc.check()
+
+    def test_wait_wakes_on_cancel(self):
+        sc = QueryScope()
+        threading.Timer(0.03, lambda: sc.cancel("killed")).start()
+        t0 = time.monotonic()
+        assert sc.wait(5.0) is True
+        assert time.monotonic() - t0 < 0.5
+
+    def test_null_scope_is_inert(self):
+        NULL_SCOPE.cancel("killed")
+        NULL_SCOPE.check()  # never raises
+        assert not NULL_SCOPE.cancelled()
+        assert current_scope() is NULL_SCOPE  # no scope active here
+
+    def test_classification_precedence(self):
+        sc = QueryScope()
+        sc.cancel("shutdown")
+        # the scope's recorded reason wins over exception-type inference
+        assert classify_termination(QueryKilledError(), sc) == "shutdown"
+        assert classify_termination(None, QueryScope()) == "ok"
+        assert classify_termination(RuntimeError("x"), QueryScope()) \
+            == "error"
+
+
+# ---------------------------------------------------------------------------
+# Backoffer: KILL mid-backoff with bounded latency (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_backoffer_kill_mid_backoff_bounded_latency():
+    """A Backoffer sleeping a multi-second expo wait must wake within the
+    <500ms acceptance bound when the scope is cancelled."""
+    sc = QueryScope()
+    bo = Backoffer(budget_ms=60_000, rng=random.Random(7), scope=sc)
+    # grow the device_error schedule to its 2s cap so the next sleep is
+    # long enough that an uninterruptible sleep would blow the bound
+    result = {}
+
+    def run():
+        try:
+            for _ in range(12):
+                bo.backoff("device_error", RuntimeError("sick device"))
+        except TiDBTPUError as e:
+            result["err"] = e
+            result["t"] = time.monotonic()
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.15)  # deep inside a backoff sleep by now
+    t_kill = time.monotonic()
+    sc.cancel("killed")
+    th.join(timeout=2.0)
+    assert not th.is_alive(), "backoff sleep ignored the kill"
+    assert isinstance(result["err"], QueryKilledError)
+    assert result["t"] - t_kill < 0.5, "kill latency exceeded bound"
+
+
+def test_backoffer_deadline_is_honored():
+    sc = QueryScope(timeout_s=0.05)
+    bo = Backoffer(budget_ms=60_000, rng=random.Random(3), scope=sc)
+    t0 = time.monotonic()
+    with pytest.raises(MaxExecutionTimeExceeded):
+        for _ in range(12):
+            bo.backoff("device_error")
+    assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# KILL QUERY while a statement sits in a distsql backoff sleep
+# ---------------------------------------------------------------------------
+
+
+def test_kill_query_mid_distsql_backoff(domain, sess):
+    """ISSUE 5 acceptance: KILL QUERY issued while a statement sits in a
+    distsql backoff sleep returns the connection an error within 500ms,
+    with termination reason 'killed' everywhere."""
+    sess.execute("set tidb_use_tpu = 0")  # per-region fan-out path
+    killer = domain.new_session()
+    k0 = _metric("stmt_terminated_killed_total")
+    result = {}
+
+    def run():
+        try:
+            sess.query("select sum(x) from t where x < 1e9")
+        except TiDBTPUError as e:
+            result["err"] = e
+        result["t"] = time.monotonic()
+
+    # every cop task fails -> tasks retry inside equal-jitter backoff
+    # sleeps against a 10s budget; only the kill can end this early
+    def sick_store(**ctx):
+        raise RuntimeError("store unreachable")
+
+    with failpoint("distsql/task_error", sick_store):
+        th = threading.Thread(target=run)
+        th.start()
+        time.sleep(0.2)  # statements are now inside backoff sleeps
+        t_kill = time.monotonic()
+        killer.execute(f"kill query {sess.conn_id}")
+        th.join(timeout=2.0)
+    assert not th.is_alive(), "statement survived KILL QUERY"
+    assert isinstance(result.get("err"), QueryKilledError), result
+    assert result["t"] - t_kill < 0.5, "KILL latency exceeded bound"
+    assert sess.last_termination == "killed"
+    assert _metric("stmt_terminated_killed_total") == k0 + 1
+    assert _wait_no_select_threads() == [], "leaked producer threads"
+    sess.execute("set tidb_use_tpu = 1")
+    # the session is healthy afterwards (KILL QUERY, not CONNECTION)
+    assert sess.query("select count(*) from t") == [(2000,)]
+
+
+# ---------------------------------------------------------------------------
+# max_execution_time: deadline between device dispatches
+# ---------------------------------------------------------------------------
+
+
+def test_max_execution_time_terminates_scan(domain, sess):
+    """A long scan is terminated between host-side dispatch units with
+    termination reason 'timeout' visible in SLOW_QUERY, /metrics and the
+    trace (ISSUE 5 acceptance)."""
+    sess.execute("set tidb_slow_log_threshold = 0")
+    sess.execute("set max_execution_time = 50")
+    t0 = _metric("stmt_terminated_timeout_total")
+    sql = "select sum(x), count(*) from t"
+
+    # each mesh range dispatch is preceded by an 80ms stall (an injected
+    # slow device), so the 50ms deadline passes before the next host seam
+    def slow_device(**ctx):
+        time.sleep(0.08)
+
+    with failpoint("mesh/device_error", slow_device):
+        with pytest.raises(MaxExecutionTimeExceeded) as ei:
+            sess.query(sql)
+    assert ei.value.code == 3024
+    assert sess.last_termination == "timeout"
+    # the trace tags the failing statement
+    assert (sess.last_trace.root.attrs or {}).get("termination") == "timeout"
+    sess.execute("set max_execution_time = 0")
+    assert _metric("stmt_terminated_timeout_total") == t0 + 1
+    # ... and SLOW_QUERY exposes the TERMINATION column
+    rows = sess.query(
+        "select termination, query from information_schema.slow_query")
+    assert ("timeout", sql) in [(r[0], r[1]) for r in rows]
+    # ... and the statement summary counts it per digest
+    srows = sess.query(
+        "select terminations from information_schema.statements_summary"
+        " where sample_text = '%s'" % sql)
+    assert srows and "timeout:1" in srows[0][0]
+    assert _wait_no_select_threads() == []
+
+
+def test_timeout_interrupts_sleep(sess):
+    sess.execute("set max_execution_time = 60")
+    t0 = time.monotonic()
+    with pytest.raises(MaxExecutionTimeExceeded):
+        sess.query("select sleep(5)")
+    assert time.monotonic() - t0 < 1.0
+    assert sess.last_termination == "timeout"
+    sess.execute("set max_execution_time = 0")
+
+
+def test_kill_interrupts_sleep(domain, sess):
+    killer = domain.new_session()
+
+    def kill_soon():
+        time.sleep(0.1)
+        killer.execute(f"kill query {sess.conn_id}")
+
+    th = threading.Thread(target=kill_soon)
+    th.start()
+    t0 = time.monotonic()
+    with pytest.raises(QueryKilledError):
+        sess.query("select sleep(5)")
+    th.join()
+    assert time.monotonic() - t0 < 1.0
+    assert sess.last_termination == "killed"
+
+
+def test_mem_quota_termination_reason(domain):
+    s = domain.new_session()
+    s.execute("create table big (a bigint)")
+    rows = ", ".join(f"({i})" for i in range(5000))
+    s.execute("insert into big values " + rows)
+    s.execute("set tidb_mem_quota_query = 1000")
+    s.execute("set tidb_oom_action = 'cancel'")
+    from tidb_tpu.errors import MemoryQuotaExceededError
+
+    with pytest.raises(MemoryQuotaExceededError):
+        # cross join blows the tiny quota before spilling can save it
+        s.query("select count(*) from big b1, big b2 where b1.a > b2.a")
+    assert s.last_termination == "mem_quota"
+
+
+# ---------------------------------------------------------------------------
+# 2PC + row-lock waits honor the scope
+# ---------------------------------------------------------------------------
+
+
+def test_prewrite_cancellation_rolls_back_locks(domain, sess):
+    """A kill mid-prewrite aborts the txn and leaks no locks."""
+    sess.execute("begin")
+    sess.execute("insert into t values (9001, 0, 1.0), (9002, 0, 2.0),"
+                 " (9003, 0, 3.0)")
+
+    fired = {"n": 0}
+
+    def cancel_on_second(**ctx):
+        fired["n"] += 1
+        if fired["n"] == 2:
+            sess.cancel_query("killed")
+
+    with failpoint("2pc/prewrite", cancel_on_second):
+        with pytest.raises(QueryKilledError):
+            sess.execute("commit")
+    for tid in domain.storage.table_ids():
+        assert domain.storage.table(tid).locks == {}, "leaked locks"
+    assert sess.query("select count(*) from t where k >= 9001") == [(0,)]
+
+
+def test_lock_wait_interruptible(domain, sess):
+    """KILL wakes a session parked in a pessimistic row-lock wait
+    instead of letting it poll out innodb_lock_wait_timeout (50s)."""
+    holder = domain.new_session()
+    holder.execute("begin")
+    holder.execute("select x from t where k = 1 for update")
+
+    waiter = domain.new_session()
+    waiter.execute("begin")
+    result = {}
+
+    def run():
+        try:
+            # blocks in the pessimistic row-lock wait on holder's lock
+            waiter.execute("select x from t where k = 1 for update")
+        except TiDBTPUError as e:
+            result["err"] = e
+        result["t"] = time.monotonic()
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.15)  # waiter is inside the lock-wait loop now
+    t_kill = time.monotonic()
+    waiter.kill()
+    th.join(timeout=2.0)
+    assert not th.is_alive(), "lock wait ignored the kill"
+    assert isinstance(result.get("err"), QueryKilledError)
+    assert result["t"] - t_kill < 0.5
+    waiter.rollback()
+    holder.execute("rollback")
+
+
+# ---------------------------------------------------------------------------
+# contextvar hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_scope_deactivates_after_statement(sess):
+    sess.query("select count(*) from t")
+    assert current_scope() is NULL_SCOPE
+
+
+def test_nested_execute_shares_outer_scope(sess):
+    """EXECUTE of a prepared statement runs under the OUTER statement's
+    scope: one deadline governs the whole top-level statement."""
+    seen = {}
+    sc = QueryScope(timeout_s=30.0)
+    token = activate_scope(sc)
+    try:
+        sess.execute("prepare p1 from 'select count(*) from t'")
+        sess.execute("execute p1")
+        seen["scope"] = current_scope()
+    finally:
+        deactivate_scope(token)
+    assert seen["scope"] is sc
+    # the nested statements did not clobber the session's view
+    assert sess.last_termination == "ok"
+
+
+# ---------------------------------------------------------------------------
+# the server front door: admission + drain (async, over the real wire)
+# ---------------------------------------------------------------------------
+
+from tidb_tpu.server import MySQLServer  # noqa: E402
+from tidb_tpu.server import protocol as P  # noqa: E402
+from tidb_tpu.server.packet import (  # noqa: E402
+    PacketReader,
+    PacketWriter,
+    read_lenenc_int,
+    read_lenenc_str,
+)
+
+
+class WireClient:
+    """Just enough protocol 4.1 for lifecycle tests (handshake +
+    COM_QUERY text results/errors)."""
+
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+
+    async def connect(self, db="test"):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+        self.pr = PacketReader(self.reader)
+        self.pw = PacketWriter(self.writer)
+        greeting = await self.pr.recv()
+        if greeting and greeting[0] == 0xFF:  # rejected pre-handshake
+            code = struct.unpack_from("<H", greeting, 1)[0]
+            raise ConnectionRefusedError(f"server rejected: {code}")
+        assert greeting[0] == 10
+        caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+        if db:
+            caps |= P.CLIENT_CONNECT_WITH_DB
+        resp = struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+        resp += bytes([33]) + b"\x00" * 23
+        resp += b"root\x00" + b"\x00"
+        if db:
+            resp += db.encode() + b"\x00"
+        self.pw.seq = self.pr.seq
+        await self.pw.send(resp)
+        ok = await self.pr.recv()
+        assert ok[0] == 0x00, ok
+
+    async def send_query(self, sql: str):
+        self.pw.reset_seq()
+        await self.pw.send(b"\x03" + sql.encode())
+
+    async def read_result(self):
+        first = await self.pr.recv()
+        if first[0] == 0x00:
+            return {"ok": True}
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            return {"error": code, "message": first[9:].decode()}
+        ncols, _ = read_lenenc_int(first, 0)
+        for _ in range(ncols):
+            await self.pr.recv()
+        await self.pr.recv()  # eof
+        rows = []
+        while True:
+            pkt = await self.pr.recv()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            pos, row = 0, []
+            for _ in range(ncols):
+                if pkt[pos] == 0xFB:
+                    row.append(None)
+                    pos += 1
+                else:
+                    v, pos = read_lenenc_str(pkt, pos)
+                    row.append(v.decode())
+            rows.append(tuple(row))
+        return {"rows": rows}
+
+    async def query(self, sql: str):
+        await self.send_query(sql)
+        return await self.read_result()
+
+    def close(self):
+        self.writer.close()
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+def test_connection_cap_fast_rejects():
+    """Connections past max_connections get ERR 1040 instead of a
+    handshake (no unbounded accept queue)."""
+    async def body():
+        srv = MySQLServer(port=0, max_connections=2)
+        await srv.start()
+        try:
+            c1, c2 = WireClient(srv.host, srv.port), \
+                WireClient(srv.host, srv.port)
+            await c1.connect()
+            await c2.connect()
+            r0 = REGISTRY.snapshot().get(
+                "server_connections_rejected_total", 0)
+            c3 = WireClient(srv.host, srv.port)
+            with pytest.raises(ConnectionRefusedError, match="1040"):
+                await c3.connect()
+            assert REGISTRY.snapshot().get(
+                "server_connections_rejected_total", 0) == r0 + 1
+            # a freed slot admits the next client
+            c1.close()
+            await asyncio.sleep(0.05)
+            c4 = WireClient(srv.host, srv.port)
+            await c4.connect()
+            r = await c4.query("select 1")
+            assert r["rows"] == [("1",)]
+            c2.close()
+            c4.close()
+        finally:
+            await srv.stop()
+            srv.domain.maintenance.stop()
+
+    run(body())
+
+
+def test_admission_queue_full_fast_rejects():
+    """With one worker busy and a zero-length queue, a concurrent
+    statement is rejected immediately with a MySQL-level error — no
+    unbounded queue growth (ISSUE 5 acceptance)."""
+    async def body():
+        srv = MySQLServer(port=0, workers=1, max_queued=0)
+        await srv.start()
+        try:
+            busy, probe = WireClient(srv.host, srv.port), \
+                WireClient(srv.host, srv.port)
+            await busy.connect()
+            await probe.connect()
+            await busy.send_query("select sleep(0.6)")
+            await asyncio.sleep(0.1)  # the worker slot is now held
+            a0 = REGISTRY.snapshot().get("admission_rejected_total", 0)
+            t0 = time.monotonic()
+            r = await probe.query("select 1")
+            assert r.get("error") == 1040, r
+            assert "overloaded" in r["message"]
+            assert time.monotonic() - t0 < 0.4, "rejection was not fast"
+            assert REGISTRY.snapshot().get(
+                "admission_rejected_total", 0) == a0 + 1
+            assert REGISTRY.snapshot().get(
+                "stmt_terminated_overload_total", 0) >= 1
+            # the running statement is unaffected
+            r = await busy.read_result()
+            assert r["rows"] == [("0",)]
+            # with the slot free, the same client is admitted again
+            r = await probe.query("select 1")
+            assert r["rows"] == [("1",)]
+            busy.close()
+            probe.close()
+        finally:
+            await srv.stop()
+            srv.domain.maintenance.stop()
+
+    run(body())
+
+
+def test_admission_queue_deadline():
+    """A statement allowed to queue but not served within the queue
+    deadline is rejected (bounded wait, not unbounded)."""
+    async def body():
+        srv = MySQLServer(port=0, workers=1, max_queued=4,
+                          queue_deadline_s=0.15)
+        await srv.start()
+        try:
+            busy, waiter = WireClient(srv.host, srv.port), \
+                WireClient(srv.host, srv.port)
+            await busy.connect()
+            await waiter.connect()
+            await busy.send_query("select sleep(0.8)")
+            await asyncio.sleep(0.1)
+            t0 = time.monotonic()
+            r = await waiter.query("select 1")
+            dt = time.monotonic() - t0
+            assert r.get("error") == 1040 and "deadline" in r["message"]
+            assert 0.1 < dt < 0.6, f"queue deadline not honored ({dt:.2f}s)"
+            r = await busy.read_result()
+            assert r["rows"] == [("0",)]
+            busy.close()
+            waiter.close()
+        finally:
+            await srv.stop()
+            srv.domain.maintenance.stop()
+
+    run(body())
+
+
+def test_graceful_drain_finishes_inflight_then_closes():
+    """shutdown(): the in-flight statement completes and its rows reach
+    the client, new connections are refused, and the listener closes —
+    leaking no producer threads (ISSUE 5 acceptance)."""
+    async def body():
+        srv = MySQLServer(port=0)
+        await srv.start()
+        cli = WireClient(srv.host, srv.port)
+        await cli.connect()
+        await cli.query("create table d (a bigint)")
+        await cli.query("insert into d values (42)")
+        await cli.send_query("select a, sleep(0.3) from d")
+        await asyncio.sleep(0.05)  # statement is in flight now
+        drain = asyncio.ensure_future(srv.shutdown(drain_s=5.0))
+        await asyncio.sleep(0.05)
+        # mid-drain: the listener is closed to NEW work
+        with pytest.raises((ConnectionRefusedError, OSError)):
+            c2 = WireClient(srv.host, srv.port)
+            await c2.connect()
+        # ... but the in-flight statement runs to completion
+        r = await cli.read_result()
+        assert r["rows"] == [("42", "0")]
+        await drain
+        srv.domain.maintenance.stop()
+
+    run(body())
+    assert _wait_no_select_threads() == []
+
+
+def test_drain_cancels_survivors_with_shutdown_reason():
+    """A statement still running past the drain budget is cancelled
+    through its scope: the client gets ERR 1053 (shutdown in progress)
+    rather than a hang or a bare connection reset."""
+    async def body():
+        srv = MySQLServer(port=0)
+        await srv.start()
+        cli = WireClient(srv.host, srv.port)
+        await cli.connect()
+        await cli.send_query("select sleep(30)")
+        await asyncio.sleep(0.1)
+        t0 = time.monotonic()
+        await srv.shutdown(drain_s=0.1)
+        r = await cli.read_result()
+        assert r.get("error") == 1053, r
+        assert time.monotonic() - t0 < 5.0
+        s0 = REGISTRY.snapshot()
+        assert s0.get("server_drain_cancelled_total", 0) >= 1
+        assert s0.get("stmt_terminated_shutdown_total", 0) >= 1
+        srv.domain.maintenance.stop()
+
+    run(body())
+    assert _wait_no_select_threads() == []
+
+
+def test_wire_read_span_records_socket_wait(domain):
+    """ROADMAP PR-4 (c): the statement's trace carries an asyncio-level
+    wire.read span with the measured socket wait, distinct from the
+    admission.wait span."""
+    async def body():
+        srv = MySQLServer(domain, port=0)
+        await srv.start()
+        try:
+            cli = WireClient(srv.host, srv.port)
+            await cli.connect()
+            await cli.query("create table w (a bigint)")
+            await asyncio.sleep(0.12)  # client think time = socket wait
+            await cli.query("select a from w")
+            sess = next(iter(srv.domain.sessions.values()))
+            tr = sess.last_trace
+            spans = {sp.name: sp for sp in tr.root.children}
+            assert "wire.read" in spans
+            # the span carries the payload size AND the measured wait
+            assert spans["wire.read"].attrs["bytes"] > 0
+            assert spans["wire.read"].dur_ns >= int(0.1 * 1e9)
+            cli.close()
+        finally:
+            await srv.stop()
+
+    run(body())

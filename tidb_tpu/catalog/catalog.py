@@ -672,10 +672,18 @@ class Catalog:
         store = self.storage.table(t.id)
         offs = t.col_offsets(ix.columns)
         ncols = len(offs)
+        from ..lifecycle import current_scope
+
+        scope = current_scope()
         while True:
             parts, scan_version = self._load_reorg_parts(job, store)
             start = job.reorg_progress
             while start < store.base_rows:
+                # cancellation seam per backfill batch: a KILLed (or
+                # timed-out, or drained) online DDL unwinds here and the
+                # job handler rolls the half-added index back
+                FAILPOINTS.hit("exec/cancel", site="backfill", scope=scope)
+                scope.check()
                 if store.base_version != scan_version:
                     # compaction renumbered handles: restart the scan
                     parts, start = [], 0

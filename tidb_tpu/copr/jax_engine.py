@@ -645,9 +645,15 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     topn_parts: List[Chunk] = []
     remaining_limit = an.limit
 
+    from ..lifecycle import scope_check
+
     devices = _tile_devices()
     used_ids: set = set()
     for tile_start in range((start // TILE) * TILE, end, TILE):
+        # host-side cancellation seam: an in-flight XLA dispatch cannot
+        # be interrupted, so KILL/deadline land between tile dispatches
+        # (strictly host Python — never traced into the compiled program)
+        scope_check()
         t0 = max(tile_start, start)
         t1 = min(tile_start + TILE, end)
         if t0 >= t1:

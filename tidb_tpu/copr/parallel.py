@@ -1021,8 +1021,11 @@ def _mesh_over_partitions(storage, req: CopRequest, tids):
     import dataclasses
     import itertools
 
+    from ..lifecycle import scope_check
+
     outs = []
     for tid in tids:
+        scope_check()  # between per-partition mesh programs
         sub = dataclasses.replace(
             req, ranges=[kr for kr in req.ranges if kr.table_id == tid])
         table = storage.table(tid)
@@ -1316,11 +1319,16 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
         return _stream_filter(req, table, an, fn, datas, valids, del_mask,
                               inserted, pargs, mesh_ids=mesh_ids)
 
+    from ..lifecycle import scope_check
+
     chunks: List[Chunk] = []
     agg_accum = None
     topn_parts: List[Chunk] = []
     remaining = an.limit
     for kr in req.ranges:
+        # cancellation seam between shard_map dispatches (a dispatch in
+        # flight runs to completion; the next range must not start)
+        scope_check()
         start = max(kr.start, 0)
         end = min(kr.end, table.base_rows)
         if start >= end:
@@ -1392,10 +1400,12 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
     """Generator over a mesh filter's result chunks: one bit-packed mask
     readback per range, then STREAM_ROWS-sized host gathers on demand
     (distsql/stream.go:33-124; kv.Request.Streaming kv/kv.go:270)."""
+    from ..lifecycle import scope_check
     from ..metrics import REGISTRY
 
     remaining = an.limit
     for kr in req.ranges:
+        scope_check()  # between mask dispatches
         start = max(kr.start, 0)
         end = min(kr.end, table.base_rows)
         if start >= end:
@@ -1409,6 +1419,7 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
             handles = handles[:remaining]
             remaining -= len(handles)
         for off in range(0, len(handles), STREAM_ROWS):
+            scope_check()  # between streamed host gathers
             sub = handles[off: off + STREAM_ROWS]
             chunk = table.gather_chunk(list(an.scan.columns), sub)
             if an.proj_exprs is not None:

@@ -3,15 +3,19 @@
 Reference: store/tikv/backoff.go:243-298 — a Backoffer carries a total sleep
 budget per request; each backoff *type* has its own base/cap growth schedule,
 and exceeding the budget surfaces the last error instead of retrying forever.
+The reference's Backoffer also polls vars.Killed inside the sleep; here the
+sleep is an interruptible wait on the statement's QueryScope cancel event,
+so `KILL QUERY` (or a deadline, or server drain) takes effect mid-backoff
+with bounded latency instead of after the full expo sleep.
 """
 
 from __future__ import annotations
 
 import random
-import time
-from typing import Dict
+from typing import Dict, Optional
 
 from ..errors import KVError
+from ..lifecycle import QueryScope, current_scope
 from ..store.kv import DEFAULT_BACKOFF_BUDGET_MS as DEFAULT_BUDGET_MS
 
 # (base_ms, cap_ms) per backoff type — mirrors backoff.go's NewBackoffFn
@@ -31,20 +35,30 @@ class Backoffer:
     """Sleep with equal-jitter exponential growth per type, bounded by a
     total budget (backoff.go NewBackoffFn EqualJitter: half the expo value
     deterministic, half uniform-random — retries from concurrent tasks
-    de-synchronize instead of stampeding the same sick store/device)."""
+    de-synchronize instead of stampeding the same sick store/device).
+
+    Sleeps wait on the statement scope's cancel event: cancellation wakes
+    the sleeper immediately and raises the scope's termination error.  The
+    scope is captured at construction (fan-out workers build their
+    Backoffer on the worker thread, where the contextvar is not set — the
+    submitting layer passes the captured scope explicitly)."""
 
     def __init__(self, budget_ms: int = DEFAULT_BUDGET_MS, *,
-                 sleep=time.sleep, rng: random.Random | None = None):
+                 sleep=None, rng: random.Random | None = None,
+                 scope: Optional[QueryScope] = None):
         self.budget_ms = budget_ms
         self.slept_ms = 0.0
         self._attempts: Dict[str, int] = {}
-        self._sleep = sleep
+        self._sleep = sleep  # test injection; None = interruptible wait
+        self.scope = scope if scope is not None else current_scope()
         self._rng = rng if rng is not None else random.Random()
         self.errors: list = []
 
     def backoff(self, typ: str, err: BaseException | None = None):
         if err is not None:
             self.errors.append(err)
+        # a cancelled statement must not start (or continue) a retry sleep
+        self.scope.check()
         base, cap = BACKOFF_TYPES.get(typ, (5, 1000))
         n = self._attempts.get(typ, 0)
         self._attempts[typ] = n + 1
@@ -55,7 +69,11 @@ class Backoffer:
                 f"backoff budget exhausted after {self.slept_ms:.0f}ms "
                 f"({typ}); last error: {self.errors[-1] if self.errors else None}"
             ) from err
-        self._sleep(ms / 1000.0)
+        if self._sleep is not None:
+            self._sleep(ms / 1000.0)
+        elif self.scope.wait(ms / 1000.0):
+            # woken by KILL / deadline / drain mid-sleep
+            self.scope.check()
         self.slept_ms += ms
 
     def attempts(self, typ: str) -> int:

@@ -114,9 +114,15 @@ class SelectResult:
         # trace propagation: the producer thread (and its pool workers)
         # re-attach to the span active on the SUBMITTING thread — the
         # contextvar does not cross thread boundaries by itself
+        from ..lifecycle import current_scope
         from ..trace import current_span
 
         self._parent_span = current_span()
+        # lifecycle propagation rides the same capture: workers observe
+        # the statement's cancel event so KILL/deadline/drain stops
+        # queued tasks, retry loops and backoff sleeps, not just the
+        # consumer-side Next() boundary
+        self._scope = current_scope()
         self._fanout_span = None
         # named so leak checks (tests/chaos harness) can find stragglers
         self._thread = threading.Thread(
@@ -129,6 +135,9 @@ class SelectResult:
         while True:
             if self._stop.is_set():
                 raise _Closed()
+            # a cancelled statement stops producing; the error surfaces
+            # to the consumer via _finish_error (the producer catches it)
+            self._scope.check()
             try:
                 self._chunks.put(item, timeout=0.05)
                 return
@@ -141,9 +150,10 @@ class SelectResult:
         engine — the runtime analog of the JaxUnsupported compile-time
         fallback.  Each task records a cop.task span (region clip, the
         engine that actually served it, accumulated backoff wait)."""
+        from ..lifecycle import attach_scope
         from ..trace import attach, span
 
-        with attach(self._fanout_span):
+        with attach_scope(self._scope), attach(self._fanout_span):
             with span("cop.task", start=clip.start, end=clip.end) as tsp:
                 return self._run_task_inner(clip, tsp)
 
@@ -151,13 +161,21 @@ class SelectResult:
         from ..metrics import REGISTRY
 
         client = self.storage.get_client()
-        bo = Backoffer(budget_ms=self.req.backoff_budget_ms)
+        bo = Backoffer(budget_ms=self.req.backoff_budget_ms,
+                       scope=self._scope)
         engine = self.req.engine
         fell_back = False
         try:
             while True:
                 if self._stop.is_set():
                     raise _Closed()
+                # host-side cancellation seam: checked before every
+                # dispatch attempt (and inside the backoff sleeps via the
+                # Backoffer's scope); exec/cancel is the chaos harness's
+                # mid-fan-out kill site
+                FAILPOINTS.hit("exec/cancel", site="distsql",
+                               scope=self._scope)
+                self._scope.check()
                 sub = CopRequest(
                     dag=self.req.dag, ranges=[clip], ts=self.req.ts,
                     concurrency=1, keep_order=self.req.keep_order,
@@ -206,9 +224,10 @@ class SelectResult:
                 tsp.add("backoff_ms", bo.slept_ms)
 
     def _run(self):
+        from ..lifecycle import attach_scope
         from ..trace import NOOP, attach, span
 
-        with attach(self._parent_span):
+        with attach_scope(self._scope), attach(self._parent_span):
             with span("distsql.fanout", engine=self.req.engine) as sp:
                 self._fanout_span = None if sp is NOOP else sp
                 try:

@@ -161,6 +161,39 @@ class QueryKilledError(ExecutorError):
         super().__init__("Query execution was interrupted")
 
 
+class MaxExecutionTimeExceeded(QueryKilledError):
+    """Statement ran past max_execution_time; the scope's deadline fired
+    at a host-side seam (expensivequery.go's kill, enforced in-line)."""
+
+    code = 3024  # ER_QUERY_TIMEOUT
+
+    def __init__(self):
+        ExecutorError.__init__(
+            self, "Query execution was interrupted, maximum statement "
+                  "execution time exceeded")
+
+
+class ServerShutdownError(QueryKilledError):
+    """Statement cancelled by graceful drain: it outlived the drain
+    budget after SIGTERM/shutdown() stopped the listener."""
+
+    code = 1053  # ER_SERVER_SHUTDOWN
+
+    def __init__(self):
+        ExecutorError.__init__(self, "Server shutdown in progress")
+
+
+class ServerOverloadedError(TiDBTPUError):
+    """Fast admission rejection: the bounded executor queue is full or
+    the statement waited past the queue deadline (the server's front
+    door sheds load instead of queueing unboundedly)."""
+
+    code = 1040  # ER_CON_COUNT_ERROR family: resource exhaustion
+
+    def __init__(self, what: str = "admission queue full"):
+        super().__init__(f"Server overloaded: {what}")
+
+
 class MemoryQuotaExceededError(ExecutorError):
     """OOM action 'cancel' — reference util/memory/action.go PanicOnExceed."""
 

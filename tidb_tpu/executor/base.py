@@ -36,6 +36,13 @@ class ExecContext:
         self.txn = txn
         self.read_ts = read_ts
         self.killed = False
+        # the statement's lifecycle scope (deadline + cancel event),
+        # captured from the contextvar plane the session activated —
+        # check_killed() honors it between chunks, and fan-out layers
+        # carry it onto worker threads
+        from ..lifecycle import current_scope
+
+        self.scope = current_scope()
         self.warnings: List[str] = []
         # when a trace is active, the operator-stats map IS the trace's
         # (EXPLAIN ANALYZE and the span tree share one store)
@@ -98,6 +105,9 @@ class ExecContext:
         return "tpu"
 
     def check_killed(self):
+        # scope first: it raises the TYPED termination error (timeout/
+        # shutdown subclasses) where the legacy flag can only say killed
+        self.scope.check()
         if self.killed:
             raise QueryKilledError()
 

@@ -1722,10 +1722,16 @@ def _found_rows(func, args, n):
 
 @register("sleep", lambda t, m: ty_int(False))
 def _sleep(func, args, n):
-    import time
+    """SLEEP(n): interruptible wait on the statement scope — KILL QUERY,
+    max_execution_time and server drain wake the sleeper immediately and
+    terminate the statement (MySQL's SLEEP is the canonical kill-latency
+    probe; an uninterruptible time.sleep would pin the connection)."""
+    from ..lifecycle import current_scope
 
     if n:
-        time.sleep(float(max(_to_float(args[0]).max(), 0)))
+        sc = current_scope()
+        if sc.wait(float(max(_to_float(args[0]).max(), 0))):
+            sc.check()
     return Vec(func.ftype, np.zeros(n, dtype=np.int64), None)
 
 

@@ -125,13 +125,16 @@ def _processlist(domain, isc):
     ("readback_ms", ty_float()), ("readback_bytes", ty_int()),
     ("backoff_ms", ty_float()), ("cop_tasks", ty_int()),
     ("engines", ty_string()), ("devices", ty_string()),
-    ("rows", ty_int()), ("query", ty_string()),
+    ("rows", ty_int()), ("termination", ty_string()),
+    ("query", ty_string()),
 ])
 def _slow_query(domain, isc):
     """Structured slow-query log (infoschema/slow_log.go role) with the
     TPU-native per-phase columns from the trace subsystem: XLA compile
     vs. cache hits, host->device transfer bytes, device execute time,
-    packed readback, backoff waits, engine/device attribution."""
+    packed readback, backoff waits, engine/device attribution, and the
+    statement's TERMINATION reason (ok|killed|timeout|mem_quota|
+    overload|shutdown|error)."""
     return domain.slow_log.rows()
 
 
@@ -141,16 +144,20 @@ def _slow_query(domain, isc):
     ("max_latency", ty_float()), ("sum_rows", ty_int()),
     ("sum_compile_ms", ty_float()), ("sum_device_ms", ty_float()),
     ("sum_transfer_bytes", ty_int()), ("sum_readback_ms", ty_float()),
-    ("sum_backoff_ms", ty_float()), ("sample_text", ty_string()),
+    ("sum_backoff_ms", ty_float()), ("terminations", ty_string()),
+    ("sample_text", ty_string()),
 ])
 def _statements_summary(domain, isc):
     """Per-digest aggregates (util/stmtsummary/statement_summary.go:59,213):
     literals normalized away, so every execution of a statement shape lands
     in one row; per-phase sums come from the same span trees the slow log
-    and EXPLAIN ANALYZE read."""
+    and EXPLAIN ANALYZE read.  `terminations` counts abnormal statement
+    endings per reason (killed/timeout/mem_quota/overload/shutdown)."""
     out = []
     for digest, st in sorted(domain.digest_summary.items()):
         ph = st.get("phases", {})
+        terms = ",".join(f"{k}:{v}" for k, v in
+                         sorted(st.get("terminations", {}).items()))
         out.append((digest, st["count"], st["sum_latency"],
                     st["sum_latency"] / max(st["count"], 1),
                     st["max_latency"], st["sum_rows"],
@@ -159,7 +166,7 @@ def _statements_summary(domain, isc):
                     int(ph.get("transfer_bytes", 0)),
                     round(ph.get("readback_ms", 0.0), 3),
                     round(ph.get("backoff_ms", 0.0), 3),
-                    st["sample"]))
+                    terms, st["sample"]))
     return out
 
 

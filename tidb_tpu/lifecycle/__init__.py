@@ -1,0 +1,19 @@
+"""Statement lifecycle: deadlines, cancellation and termination reasons.
+
+One QueryScope per top-level statement, threaded through every blocking
+host-side seam (see scope.py).  The server layers admission control and
+graceful drain on top of the same scope plane (server/server.py).
+"""
+
+from .scope import (  # noqa: F401
+    NULL_SCOPE,
+    REASONS,
+    QueryScope,
+    activate_scope,
+    attach_scope,
+    classify_termination,
+    current_scope,
+    deactivate_scope,
+    scope_active,
+    scope_check,
+)

@@ -259,41 +259,76 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
 
-    # -- instrumented scan/agg programs (trace-subsystem guard) ---------
-    # span hooks live strictly OUTSIDE compiled code: re-tracing the
-    # kernels while a query trace is ACTIVE must produce byte-identical
-    # jaxpr stats.  A span (or any trace state) captured into a jitted
-    # function would change the equation census or fail the trace.
+    # -- context-capture guards (trace spans + lifecycle scope) ---------
+    # span hooks AND lifecycle scope checks live strictly OUTSIDE
+    # compiled code: re-tracing the kernels while (a) a query trace is
+    # ACTIVE and (b) a QueryScope with an ACTIVE DEADLINE is current
+    # must produce byte-identical jaxpr stats.  Any trace/scope state
+    # captured into a jitted function would change the equation census —
+    # and make compiled programs trace- or deadline-dependent.
+    import contextlib
+
+    from ..lifecycle import QueryScope, activate_scope, deactivate_scope
     from ..trace import finish_trace, start_trace
 
+    @contextlib.contextmanager
+    def active_trace():
+        tr, token = start_trace("kernelcheck-instrumented", 0)
+        try:
+            yield
+        finally:
+            finish_trace(tr, token)
+
+    @contextlib.contextmanager
+    def active_deadline():
+        token = activate_scope(QueryScope(timeout_s=3600.0))
+        try:
+            yield
+        finally:
+            deactivate_scope(token)
+
+    guards = (
+        ("instrumented", active_trace,
+         "span hooks leaked into the compiled program", "query trace"),
+        ("scoped", active_deadline,
+         "lifecycle scope leaked into the compiled program", "deadline"),
+    )
+    # the context-free baseline (plan + jaxpr trace, the costly part)
+    # is computed ONCE per query; each guard pays only its own re-trace
     for name, sql in CANONICAL_KERNEL_QUERIES:
         if name not in ("q1-dense-agg", "filter-project"):
             continue
         try:
             phys = s._plan(parse_one(sql))
-            dags = [d for _p, d in _reader_dags(phys)]
-            base_stats = traced_stats = None
-            for dag in dags:
+            base_dag = base_stats = None
+            for _p, dag in _reader_dags(phys):
                 try:
                     base_stats = trace_kernel(table, dag)
                 except JaxUnsupported:
                     continue
-                tr, token = start_trace("kernelcheck-instrumented", 0)
-                try:
-                    traced_stats = trace_kernel(table, dag)
-                finally:
-                    finish_trace(tr, token)
+                base_dag = dag
                 break
         except Exception as e:  # noqa: BLE001 — contract break
-            emit(f"{name}-instrumented",
-                 f"instrumented kernel trace failed: "
-                 f"{type(e).__name__}: {e}")
+            for suffix, _c, _m, _n in guards:
+                emit(f"{name}-{suffix}",
+                     f"baseline kernel trace failed: "
+                     f"{type(e).__name__}: {e}")
             continue
-        if base_stats is not None and traced_stats != base_stats:
-            emit(f"{name}-instrumented",
-                 f"span hooks leaked into the compiled program: jaxpr "
-                 f"stats changed {base_stats} -> {traced_stats} under an "
-                 "active query trace")
+        if base_dag is None:
+            continue
+        for suffix, ctx, leak_msg, ctx_name in guards:
+            try:
+                with ctx():
+                    ctx_stats = trace_kernel(table, base_dag)
+            except Exception as e:  # noqa: BLE001 — contract break
+                emit(f"{name}-{suffix}",
+                     f"{suffix} kernel trace failed: "
+                     f"{type(e).__name__}: {e}")
+                continue
+            if ctx_stats != base_stats:
+                emit(f"{name}-{suffix}",
+                     f"{leak_msg}: jaxpr stats changed {base_stats} -> "
+                     f"{ctx_stats} under an active {ctx_name}")
 
     # -- recompile-bomb guard -------------------------------------------
     # count only signatures the corpus itself compiles: the engine caches

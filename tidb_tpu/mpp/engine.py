@@ -561,6 +561,14 @@ def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
     mode = "shuffle"
     attempts = 0
     while True:
+        # cancellation seam at every rung transition/retry: a cancelled
+        # statement must not start the next exchange program (the typed
+        # termination error is a TiDBTPUError, so the handler below
+        # surfaces it instead of stepping down the ladder)
+        from ..lifecycle import current_scope
+
+        FAILPOINTS.hit("exec/cancel", site="mpp", scope=current_scope())
+        current_scope().check()
         if _no_eligible_devices():
             raise MPPIneligible("all device breakers open")
         try:

@@ -75,6 +75,7 @@ class MPPReaderExec(Executor):
             build_rngs.setdefault(kr.table_id, []).append(kr)
         chunks, modes = [], []
         for ppid, bpid in spec.copartitions:
+            self.ctx.check_killed()  # seam between partition-pair runs
             pr = probe_rngs.get(ppid)
             br = build_rngs.get(bpid)
             if not pr or not br:

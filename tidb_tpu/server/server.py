@@ -5,6 +5,20 @@ Reference: server/server.go (Server, connection loop), server/conn.go:800
 execution itself runs in a thread pool (sessions are synchronous; numpy/JAX
 release the GIL), so one slow query doesn't stall other connections —
 the goroutine-per-conn model mapped onto asyncio + executor threads.
+
+Admission control & graceful drain (server.go onConn/kickIdleConnection +
+tidb-server SIGTERM handling):
+
+- a hard connection cap: past `max_connections` the client gets a fast
+  ERR 1040 instead of a handshake (MySQL's Too many connections);
+- a bounded executor queue: statements past the worker pool's capacity
+  wait in a bounded admission queue with a queue deadline; past the bound
+  (or the deadline) the statement is REJECTED with a MySQL error instead
+  of queueing unboundedly — overload sheds load at the front door;
+- graceful drain: shutdown()/SIGTERM stops the listener, lets in-flight
+  statements run to their own deadlines within the drain budget, then
+  cancels survivors through their QueryScope (reason 'shutdown') and
+  closes connections cleanly.
 """
 
 from __future__ import annotations
@@ -12,10 +26,12 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from ..errors import TiDBTPUError
+from ..metrics import REGISTRY
 from ..session import Domain, ResultSet
 from . import protocol as P
 from .packet import PacketReader, PacketWriter, read_lenenc_int
@@ -33,14 +49,33 @@ COM_STMT_RESET = 0x1A
 
 class MySQLServer:
     def __init__(self, domain: Optional[Domain] = None, host: str = "127.0.0.1",
-                 port: int = 4000, workers: int = 8):
+                 port: int = 4000, workers: int = 8,
+                 max_connections: int = 512,
+                 max_queued: Optional[int] = None,
+                 queue_deadline_s: float = 10.0):
         self.domain = domain or Domain()
         self.host = host
         self.port = port
+        self.workers = workers
         self.pool = ThreadPoolExecutor(max_workers=workers)
         self._server: Optional[asyncio.AbstractServer] = None
+        # ---- admission bounds (server.go Server.rwlock + clients map) --
+        self.max_connections = max_connections
+        # waiters allowed behind the busy worker pool; past this the
+        # statement fast-rejects instead of queueing unboundedly
+        self.max_queued = workers * 4 if max_queued is None else max_queued
+        self.queue_deadline_s = queue_deadline_s
+        self._admission: Optional[asyncio.Semaphore] = None  # loop-bound
+        self._queued = 0
+        self._nconns = 0
+        self._draining = False
+        # live connections: asyncio task -> (session, writer); drain
+        # cancels scopes and closes writers through this registry
+        self._conns: Dict[object, tuple] = {}
 
     async def start(self):
+        self._admission = asyncio.Semaphore(self.workers)
+        self._draining = False
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port
         )
@@ -49,20 +84,92 @@ class MySQLServer:
         return addr
 
     async def stop(self):
+        """Immediate stop: drain with a zero budget (in-flight statements
+        are cancelled right away with reason 'shutdown')."""
+        await self.shutdown(drain_s=0.0)
+
+    async def shutdown(self, drain_s: float = 15.0):
+        """Graceful drain (tidb-server SIGTERM: gracefulShutdown):
+        1. stop accepting — the listener closes, new connects fail fast;
+        2. in-flight statements keep running up to `drain_s` (each still
+           bounded by its own max_execution_time deadline);
+        3. survivors are cancelled through their QueryScope with reason
+           'shutdown' (ERR 1053 to the client at the next host seam);
+        4. connections close and the worker pool shuts down."""
+        self._draining = True
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(drain_s, 0.0)
+        while loop.time() < deadline:
+            busy = [s for _t, (s, _w) in list(self._conns.items())
+                    if getattr(s, "stmt_start", None) is not None]
+            if not busy:
+                break
+            await asyncio.sleep(0.02)
+        # cancel survivors: the scope wakes backoff sleeps, fan-out
+        # workers and SLEEP()s; the statement errors at its next seam.
+        # The sweep REPEATS while waiting for statements to unwind — a
+        # statement that raced past the draining checks into execution
+        # is cancelled on the next pass instead of surviving the drain.
+        cancelled = 0
+        unwind_deadline = loop.time() + 5.0
+        while True:
+            busy = [s for _t, (s, _w) in list(self._conns.items())
+                    if getattr(s, "stmt_start", None) is not None]
+            for sess in busy:
+                sc = getattr(sess, "_scope", None)
+                if sc is None or not sc.cancelled():
+                    cancelled += 1
+                sess.cancel_query("shutdown")
+            if not busy or loop.time() >= unwind_deadline:
+                break
+            await asyncio.sleep(0.02)
+        if cancelled:
+            REGISTRY.inc("server_drain_cancelled_total", cancelled)
+            await asyncio.sleep(0.05)  # flush the ERR 1053 writes
+        # unblock connection loops parked in pr.recv() and wait for the
+        # handlers to unwind (they run their own session cleanup)
+        for _t, (_s, writer) in list(self._conns.items()):
+            try:
+                writer.close()
+            except Exception:
+                pass
+        tasks = list(self._conns)
+        if tasks:
+            await asyncio.wait(tasks, timeout=5.0)
         self.pool.shutdown(wait=False)
 
     # ------------------------------------------------------------------
     async def _handle(self, reader, writer):
-        sess = self.domain.new_session()
-        pr, pw = PacketReader(reader), PacketWriter(writer)
-        loop = asyncio.get_running_loop()
-        prepared: Dict[int, str] = {}
-        next_stmt_id = [1]
+        pw0 = PacketWriter(writer)
+        if self._draining:
+            # reject-at-accept during drain (a connect can race the
+            # listener close): MySQL's shutdown-in-progress error
+            await pw0.send(P.err_packet(
+                1053, "Server shutdown in progress", "08S01"))
+            writer.close()
+            return
+        if self._nconns >= self.max_connections:
+            # hard cap (MySQL max_connections): ERR instead of handshake,
+            # so overload costs the client one round trip, not a stall
+            REGISTRY.inc("server_connections_rejected_total")
+            await pw0.send(P.err_packet(
+                1040, "Too many connections", "08004"))
+            writer.close()
+            return
+        self._nconns += 1
+        task = asyncio.current_task()
+        sess = None
         try:
+            sess = self.domain.new_session()
+            self._conns[task] = (sess, writer)
+            pr, pw = PacketReader(reader), pw0
+            loop = asyncio.get_running_loop()
+            prepared: Dict[int, str] = {}
+            next_stmt_id = [1]
             salt = os.urandom(20)
             await pw.send(P.handshake_v10(sess.conn_id, salt))
             resp = await pr.recv()
@@ -96,7 +203,12 @@ class MySQLServer:
 
             while True:
                 pr.seq = 0
+                # socket wait measured at the asyncio level: it becomes
+                # the statement's wire.read span, so traces distinguish
+                # network/client wait from admission-queue wait
+                t_recv = _time.perf_counter_ns()
                 data = await pr.recv()
+                recv_wait_ns = _time.perf_counter_ns() - t_recv
                 if not data:
                     break
                 pw.seq = pr.seq
@@ -108,12 +220,14 @@ class MySQLServer:
                     continue
                 if cmd == COM_INIT_DB:
                     await self._run_sql(
-                        sess, f"use {payload.decode()}", pw, loop
+                        sess, f"use {payload.decode()}", pw, loop,
+                        recv_wait_ns=recv_wait_ns,
                     )
                     continue
                 if cmd == COM_QUERY:
                     sql = payload.decode("utf8", "replace")
-                    await self._run_sql(sess, sql, pw, loop)
+                    await self._run_sql(sess, sql, pw, loop,
+                                        recv_wait_ns=recv_wait_ns)
                     continue
                 if cmd == COM_FIELD_LIST:
                     await pw.send(P.eof_packet())
@@ -145,7 +259,8 @@ class MySQLServer:
                         payload, st["n"], st["types"]
                     )
                     await self._run_sql(sess, st["sql"], pw, loop,
-                                        params=params, binary=True)
+                                        params=params, binary=True,
+                                        recv_wait_ns=recv_wait_ns)
                     continue
                 if cmd in (COM_STMT_CLOSE, COM_STMT_RESET):
                     sid = struct.unpack_from("<I", payload, 0)[0]
@@ -157,18 +272,89 @@ class MySQLServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            sess.close()  # unpin snapshots + rollback
-            sess._release_table_locks()  # MySQL frees them on disconnect
-            self.domain.sessions.pop(sess.conn_id, None)
+            self._conns.pop(task, None)
+            self._nconns -= 1
+            if sess is not None:
+                sess.close()  # unpin snapshots + rollback
+                sess._release_table_locks()  # MySQL frees on disconnect
+                self.domain.sessions.pop(sess.conn_id, None)
             writer.close()
 
     async def _run_sql(self, sess, sql: str, pw: PacketWriter, loop,
-                       params=None, binary: bool = False):
-        import time as _time
+                       params=None, binary: bool = False,
+                       recv_wait_ns: int = 0):
+        # ---- bounded admission (the overload front door) --------------
+        # the worker pool admits `workers` statements; up to max_queued
+        # more wait (bounded by queue_deadline_s); anything past that is
+        # REJECTED NOW — under overload the queue must not grow without
+        # bound, and a fast error beats a stuck client
+        if self._draining:
+            # statements arriving after drain started are refused (the
+            # survivor-cancel sweep must not race freshly admitted work)
+            await self._reject_shutdown(pw, sql)
+            return
+        sem = self._admission
+        wait_ns = 0
+        if sem is not None:
+            if sem.locked() and self._queued >= self.max_queued:
+                await self._reject_overload(pw, sql, "admission queue full")
+                return
+            t0 = _time.perf_counter_ns()
+            self._queued += 1
+            try:
+                await asyncio.wait_for(sem.acquire(),
+                                       timeout=self.queue_deadline_s)
+            except asyncio.TimeoutError:
+                await self._reject_overload(
+                    pw, sql, "admission queue deadline exceeded "
+                             f"({self.queue_deadline_s:.1f}s)")
+                return
+            finally:
+                self._queued -= 1
+            wait_ns = _time.perf_counter_ns() - t0
+            REGISTRY.observe("admission_wait_ms", wait_ns / 1e6)
+        try:
+            if self._draining:
+                # drain began while this statement waited in the queue
+                await self._reject_shutdown(pw, sql)
+                return
+            await self._run_sql_admitted(sess, sql, pw, loop, params,
+                                         binary, recv_wait_ns, wait_ns)
+        finally:
+            if sem is not None:
+                sem.release()
 
+    async def _reject_overload(self, pw: PacketWriter, sql: str, what: str):
+        """Fast overload rejection: one source of truth for the error
+        (ServerOverloadedError), the metrics and the termination record."""
+        from ..errors import ServerOverloadedError
+
+        err = ServerOverloadedError(what)
+        REGISTRY.inc("admission_rejected_total")
+        REGISTRY.inc("stmt_terminated_overload_total")
+        self.domain.record_termination(sql, "overload")
+        await pw.send(P.err_packet(err.code, str(err), "08004"))
+
+    async def _reject_shutdown(self, pw: PacketWriter, sql: str):
+        """Refuse a statement arriving mid-drain: same metric + summary
+        accounting as every other termination reason."""
+        from ..errors import ServerShutdownError
+
+        err = ServerShutdownError()
+        REGISTRY.inc("stmt_terminated_shutdown_total")
+        self.domain.record_termination(sql, "shutdown")
+        await pw.send(P.err_packet(err.code, str(err), "08S01"))
+
+    async def _run_sql_admitted(self, sess, sql: str, pw: PacketWriter,
+                                loop, params, binary: bool,
+                                recv_wait_ns: int, admission_wait_ns: int):
         # wire.read attribution: the statement's trace root records how
-        # many bytes the COM_QUERY/COM_STMT_EXECUTE payload carried
-        sess._pending_wire_read = len(sql.encode("utf8", "replace"))
+        # many bytes the COM_QUERY/COM_STMT_EXECUTE payload carried and
+        # how long the server waited on the socket for it (an asyncio-
+        # level wire.read span, distinct from admission-queue wait)
+        sess._pending_wire_read = (
+            len(sql.encode("utf8", "replace")), recv_wait_ns)
+        sess._pending_admission_wait_ns = admission_wait_ns
         try:
             rss = await loop.run_in_executor(
                 self.pool, lambda: sess.execute(sql, params)
@@ -273,14 +459,42 @@ def _parse_exec_params(payload: bytes, n_params: int, cached_types):
 
 
 def serve_forever(host: str = "127.0.0.1", port: int = 4000,
-                  domain: Optional[Domain] = None):
-    """Blocking entry point (tidb-server/main.go analog)."""
+                  domain: Optional[Domain] = None,
+                  drain_s: float = 15.0):
+    """Blocking entry point (tidb-server/main.go analog).
+
+    Shutdown-aware: SIGTERM/SIGINT resolve a future instead of the old
+    `while True: sleep(3600)` loop (which ignored both and could only be
+    SIGKILLed).  On signal the server drains gracefully — stops
+    accepting, lets in-flight statements finish within `drain_s`, cancels
+    survivors with termination reason 'shutdown' — and this function
+    RETURNS."""
 
     async def main():
         srv = MySQLServer(domain, host, port)
         await srv.start()
         print(f"tidb-tpu listening on {srv.host}:{srv.port}")
-        while True:
-            await asyncio.sleep(3600)
+        loop = asyncio.get_running_loop()
+        stop = loop.create_future()
+
+        def request_stop(*_a):
+            if not stop.done():
+                stop.set_result(None)
+
+        import signal
+
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(signum, request_stop)
+            except (NotImplementedError, RuntimeError):
+                # platforms/loops without signal-handler support fall
+                # back to the interpreter-level handler
+                signal.signal(signum,
+                              lambda *_a: loop.call_soon_threadsafe(
+                                  request_stop))
+        await stop
+        print("tidb-tpu draining...")
+        await srv.shutdown(drain_s=drain_s)
+        print("tidb-tpu stopped")
 
     asyncio.run(main())

@@ -187,6 +187,19 @@ class Domain:
             except Exception:
                 pass  # stats are advisory; never fail the statement
 
+    def _digest_row(self, digest: str, sql: str) -> dict:
+        """Get-or-create one statement summary row; caller holds _mu.
+        Bounded like the reference's stmtsummary cap."""
+        st = self.digest_summary.get(digest)
+        if st is None:
+            if len(self.digest_summary) >= 5000:
+                self.digest_summary.clear()
+            st = self.digest_summary[digest] = {
+                "count": 0, "sum_latency": 0.0, "max_latency": 0.0,
+                "sum_rows": 0, "sample": sql[:256],
+            }
+        return st
+
     def record_stmt(self, sql: str, dur_s: float, rows: int):
         from ..metrics import REGISTRY
 
@@ -196,18 +209,26 @@ class Domain:
         with self._mu:
             # per-digest aggregates (util/stmtsummary/statement_summary.go
             # :59,:213 — keyed on the normalized statement)
-            st = self.digest_summary.get(digest)
-            if st is None:
-                if len(self.digest_summary) >= 5000:
-                    self.digest_summary.clear()  # bounded, like the ref cap
-                st = self.digest_summary[digest] = {
-                    "count": 0, "sum_latency": 0.0, "max_latency": 0.0,
-                    "sum_rows": 0, "sample": sql[:256],
-                }
+            st = self._digest_row(digest, sql)
             st["count"] += 1
             st["sum_latency"] += dur_s
             st["max_latency"] = max(st["max_latency"], dur_s)
             st["sum_rows"] += rows
+
+    def record_termination(self, sql: str, term: str):
+        """Per-digest abnormal-ending counts for the statement summary
+        (expensivequery.go's kill accounting, folded into stmtsummary).
+        'ok'/'error' endings are the count/latency aggregates' job; only
+        lifecycle terminations are tallied here."""
+        if term in ("ok", "error"):
+            return
+        digest = sql_digest(sql)
+        with self._mu:
+            # terminated statements may never reach record_stmt: get-or-
+            # create the digest row so the termination is not invisible
+            st = self._digest_row(digest, sql)
+            tm = st.setdefault("terminations", {})
+            tm[term] = tm.get(term, 0) + 1
 
     def record_trace(self, tr, totals: dict, dur_ms: float, slow: bool):
         """Fold a finished QueryTrace into the per-digest statement
@@ -251,6 +272,7 @@ class Domain:
             "engines": totals["engines"],
             "devices": totals["devices"],
             "rows": totals.get("result_rows", 0),
+            "termination": (tr.root.attrs or {}).get("termination", "ok"),
         }
         self.slow_log.record(entry)
         from ..metrics import REGISTRY

@@ -178,7 +178,13 @@ class MaintenanceWorker:
                 REGISTRY.inc("expensive_queries_killed_total")
                 log.warning("killing over-time query (conn %s): %.200s",
                             conn_id, sql)
-                sess.kill()
+                # backstop only: the statement's own QueryScope carries
+                # the max_execution_time deadline and fires at the next
+                # host seam; the watchdog covers sessions whose deadline
+                # was raised mid-flight and legacy ctx-only paths.  The
+                # reason stays 'timeout' so the termination report does
+                # not depend on who noticed first.
+                sess.cancel_query("timeout")
         # bounded memory for the once-per-statement markers
         if len(self.flagged) > 1024:
             dead = [k for k in self.flagged

@@ -122,7 +122,15 @@ class Session:
         self.last_exec_ctx: Optional[ExecContext] = None
         self.last_plan = None
         self.last_trace = None  # finished QueryTrace of the last execute()
+        # lifecycle: the in-flight statement's QueryScope (deadline +
+        # cancel event) — KILL, the expensive-query watchdog and server
+        # drain all cancel through it; and the last statement's
+        # termination reason (ok|killed|timeout|mem_quota|overload|
+        # shutdown|error) for the slow log / summary / metrics
+        self._scope = None
+        self.last_termination = "ok"
         self._pending_wire_read = None  # server-set COM_QUERY payload size
+        self._pending_admission_wait_ns = 0  # server-set queue wait
         from collections import OrderedDict
 
         self._plan_cache: "OrderedDict" = OrderedDict()
@@ -135,18 +143,51 @@ class Session:
 
         if bindinfo.is_binding_stmt(sql):
             return [bindinfo.handle(self, sql)]
+        from ..lifecycle import (
+            QueryScope,
+            activate_scope,
+            classify_termination,
+            deactivate_scope,
+            scope_active,
+        )
         from ..trace import finish_trace, span, start_trace, tracing_active
 
+        # one lifecycle scope per top-level execute(): the statement's
+        # deadline (max_execution_time) + cancel event, observed at every
+        # blocking host-side seam.  Nested executes (EXECUTE prepared,
+        # TRACE targets, subplans) inherit the outer statement's scope.
+        sc = sc_token = None
+        if not scope_active():
+            timeout_ms = self.vars.get_int("max_execution_time")
+            sc = QueryScope(timeout_ms / 1000.0 if timeout_ms > 0 else None)
         # one trace per top-level execute() call: slow-log-enabled
-        # sessions trace every statement; nested executes (EXECUTE
-        # prepared, TRACE targets, subplans) record into the outer trace
+        # sessions trace every statement; nested executes record into the
+        # outer trace
         tr = token = None
         if not tracing_active() and self.vars.get_bool("tidb_enable_slow_log"):
             tr, token = start_trace(sql, self.conn_id)
             wr = getattr(self, "_pending_wire_read", None)
             if wr:
-                tr.root.set(wire_read_bytes=wr)
+                # (bytes, socket-wait ns) from the wire layer; the wait
+                # becomes an asyncio-level wire.read span so admission
+                # wait and network wait are distinguishable in traces
+                nb, wait_ns = wr if isinstance(wr, tuple) else (wr, 0)
+                tr.root.set(wire_read_bytes=nb)
+                if wait_ns:
+                    tr.add_span("wire.read", wait_ns, bytes=nb)
                 self._pending_wire_read = None
+            aw = getattr(self, "_pending_admission_wait_ns", 0)
+            if aw:
+                tr.add_span("admission.wait", aw)
+                self._pending_admission_wait_ns = 0
+        exc: Optional[BaseException] = None
+        # activation happens IMMEDIATELY before the try whose finally
+        # deactivates: an exception in the setup above must not leak the
+        # scope contextvar onto this pooled executor thread (a poisoned
+        # worker would kill every later statement scheduled on it)
+        if sc is not None:
+            sc_token = activate_scope(sc)
+            self._scope = sc  # KILL / watchdog / drain cancel through this
         try:
             out = []
             with span("parse"):
@@ -165,8 +206,23 @@ class Session:
                 self.domain.record_stmt(sql, dur, len(rs.rows))
                 out.append(rs)
             return out
+        except BaseException as e:
+            exc = e
+            raise
         finally:
+            term = None
+            if sc is not None:
+                term = classify_termination(exc, sc)
+                self.last_termination = term
+                deactivate_scope(sc_token)
+                if term not in ("ok", "error"):
+                    from ..metrics import REGISTRY
+
+                    REGISTRY.inc(f"stmt_terminated_{term}_total")
+                self.domain.record_termination(sql, term)
             if tr is not None:
+                if term is not None:
+                    tr.root.set(termination=term)
                 self.last_trace = tr
                 totals = finish_trace(tr, token)
                 self._maybe_slow_log(tr, totals)
@@ -197,6 +253,17 @@ class Session:
         KILL CONNECTION (query_only=False): poison the session."""
         if not query_only:
             self._killed = True
+        self.cancel_query("killed")
+
+    def cancel_query(self, reason: str):
+        """Cancel the in-flight statement's scope (KILL, the watchdog's
+        max_execution_time enforcement, server drain).  The statement
+        unwinds at its next host-side seam — backoff sleeps, fan-out
+        tasks, tile/mesh chunk loops, MPP rungs, 2PC prewrite batches
+        and DDL backfill batches all observe the same event."""
+        sc = self._scope
+        if sc is not None:
+            sc.cancel(reason)
         if self.last_exec_ctx is not None:
             self.last_exec_ctx.killed = True
 

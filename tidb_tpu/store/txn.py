@@ -101,6 +101,9 @@ class Transaction:
         CURRENT row, not the txn snapshot (pessimistic for_update_ts)."""
         import time as _time
 
+        from ..lifecycle import current_scope
+
+        scope = current_scope()
         detector = self.storage.deadlock
         deadline = _time.monotonic() + self.lock_wait_timeout_s
         waiting_on = None
@@ -139,7 +142,11 @@ class Transaction:
                             pass
                     if _time.monotonic() >= deadline:
                         raise LockWaitTimeoutError()
-                    _time.sleep(self.LOCK_WAIT_POLL_S)
+                    # interruptible row-lock wait: KILL/deadline/drain
+                    # wakes the waiter instead of letting it poll out
+                    # the full innodb_lock_wait_timeout
+                    if scope.wait(self.LOCK_WAIT_POLL_S):
+                        scope.check()
         finally:
             if waiting_on is not None:
                 detector.clean_up_wait_for(self.start_ts, waiting_on)
@@ -166,11 +173,20 @@ class Transaction:
             self.storage.table(tid).rollback(h, self.start_ts)
         from ..trace import span
 
+        from ..lifecycle import current_scope
+
+        scope = current_scope()
         # phase 1: prewrite all keys (primary first), grouped per region
         prewritten = []
         try:
             with span("txn.prewrite", keys=len(keys)):
                 for tid, h in keys:
+                    # cancellation seam per prewrite batch unit: before
+                    # the decision point a kill aborts cleanly (all
+                    # prewritten locks roll back below).  Phase 2 never
+                    # checks — once the primary commits, the txn is
+                    # decided and must run to completion.
+                    scope.check()
                     FAILPOINTS.hit("2pc/prewrite", table_id=tid, handle=h)
                     m = self.buffer[(tid, h)]
                     store = self.storage.table(tid)
@@ -185,8 +201,10 @@ class Transaction:
                         tid, h, m.op, m.values, primary,
                         check_ts=(self.for_update_ts if pess else None))
                     prewritten.append((tid, h))
-        except (LockedError, TxnConflictError, DeadlockError,
-                LockWaitTimeoutError):
+        except Exception:
+            # conflicts/deadlocks/lock-timeouts AND lifecycle
+            # cancellations (kill/timeout/drain) all abort the same way:
+            # every prewritten lock rolls back so no orphan locks leak
             for tid, h in prewritten:
                 self.storage.table(tid).rollback(h, self.start_ts)
             self.rolled_back = True

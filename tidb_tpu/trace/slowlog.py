@@ -31,7 +31,7 @@ ENTRY_FIELDS = (
     "time", "conn_id", "query_time", "parse_ms", "plan_ms", "compile_ms",
     "compile_hits", "compile_misses", "transfer_bytes", "device_ms",
     "readback_ms", "readback_bytes", "backoff_ms", "cop_tasks",
-    "engines", "devices", "rows", "query",
+    "engines", "devices", "rows", "termination", "query",
 )
 
 

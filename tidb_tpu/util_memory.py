@@ -37,8 +37,12 @@ class MemTracker:
         return self._max
 
     def register_spill(self, hook: Callable[[], int]):
-        """hook() frees memory and returns bytes released."""
-        self._spill_hooks.append(hook)
+        """hook() frees memory and returns bytes released.  Registration
+        is locked: parallel operators (hash-join build workers, fan-out
+        pipelines) register concurrently, and an unlocked list.append
+        racing _on_exceed's snapshot can drop a hook."""
+        with self._mu:
+            self._spill_hooks.append(hook)
 
     def consume(self, nbytes: int):
         with self._mu:
@@ -56,13 +60,20 @@ class MemTracker:
 
     def _on_exceed(self):
         # try spilling first (action.go SpillDiskAction analog)
-        for hook in list(self._spill_hooks):
+        with self._mu:
+            hooks = list(self._spill_hooks)
+        for hook in hooks:
             freed = hook()
             if freed > 0 and self._consumed <= self.quota:
                 return
         if self._consumed <= self.quota:
             return
         if self.action == "cancel":
+            # mark the statement scope first so sibling fan-out workers
+            # stop promptly and the termination reason reads mem_quota
+            from .lifecycle import current_scope
+
+            current_scope().cancel("mem_quota")
             raise MemoryQuotaExceededError(self.quota, self._consumed)
         # log action: keep going (the reference logs; we count it)
         from .metrics import REGISTRY
