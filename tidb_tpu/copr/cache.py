@@ -9,7 +9,9 @@ invalidates without explicit eviction.
 
 from __future__ import annotations
 
+import os
 import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 
@@ -117,3 +119,80 @@ class ByteCapCache:
     @property
     def items_view(self):
         return self._cache
+
+
+#: every ProgramCache registers here so /status can report one
+#: compiled-cache section across the tile/mesh/MPP/micro-batch engines
+PROGRAM_CACHES: List["ProgramCache"] = []
+
+
+class ProgramCache:
+    """LRU-bounded compiled-program cache (the `_COMPILED` dicts, bounded).
+
+    Unbounded program caches were a slow leak: every new fingerprint —
+    parameter-different before hoisting, shape-different before
+    bucketing, every rebuilt mesh — pinned a compiled XLA executable
+    forever.  With shape buckets the steady-state key population is
+    small, so a modest LRU cap holds the working set while long-tail
+    shapes age out.  Counters feed `compiled_programs_{hits,misses,
+    evictions}_total` and the /status compiled-cache section.
+    """
+
+    def __init__(self, name: str, capacity: Optional[int] = None):
+        self.name = name
+        self.capacity = capacity if capacity is not None else int(
+            os.environ.get("TIDB_TPU_PROGRAM_CACHE_SIZE", "256"))
+        self._d: "OrderedDict" = OrderedDict()
+        self._mu = threading.Lock()
+        self.hits = self.misses = self.evictions = 0
+        PROGRAM_CACHES.append(self)
+
+    def get(self, key):
+        from ..metrics import REGISTRY
+
+        with self._mu:
+            fn = self._d.get(key)
+            if fn is not None:
+                self._d.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        REGISTRY.inc("compiled_programs_hits_total" if fn is not None
+                     else "compiled_programs_misses_total")
+        return fn
+
+    def put(self, key, fn):
+        from ..metrics import REGISTRY
+
+        evicted = 0
+        with self._mu:
+            self._d[key] = fn
+            self._d.move_to_end(key)
+            while len(self._d) > max(self.capacity, 1):
+                self._d.popitem(last=False)
+                self.evictions += 1
+                evicted += 1
+        if evicted:
+            REGISTRY.inc("compiled_programs_evictions_total", evicted)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"size": len(self._d), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+    def clear(self):
+        with self._mu:
+            self._d.clear()
+
+    def __len__(self):
+        with self._mu:
+            return len(self._d)
+
+    def __iter__(self):
+        with self._mu:
+            return iter(list(self._d))
+
+    def __contains__(self, key):
+        with self._mu:
+            return key in self._d

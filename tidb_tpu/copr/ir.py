@@ -45,6 +45,14 @@ def serialize_expr(e: Expression) -> dict:
     if isinstance(e, ColumnExpr):
         return {"t": "col", "i": e.index, "ft": serialize_ftype(e.ftype)}
     if isinstance(e, Constant):
+        slot = getattr(e, "param_slot", None)
+        if slot is not None:
+            # hoisted parameter (serving/params.py): the fingerprint keys
+            # the SLOT, not the literal, so parameter-different queries
+            # share one compiled program.  Engine-internal only — these
+            # never cross the wire codec.
+            return {"t": "param", "s": list(slot),
+                    "ft": serialize_ftype(e.ftype)}
         return {"t": "const", "v": e.value, "ft": serialize_ftype(e.ftype)}
     if isinstance(e, ScalarFunc):
         meta = {}

@@ -402,7 +402,9 @@ class _Analyzed:
 # compiled tile programs
 # ---------------------------------------------------------------------------
 
-_COMPILED: Dict[str, object] = {}
+from .cache import ProgramCache  # noqa: E402
+
+_COMPILED = ProgramCache("tile")
 
 
 def _fingerprint(an: _Analyzed, kind: str) -> str:
@@ -432,22 +434,41 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
             ],
         }
     if an.topn is not None:
+        from ..serving import topn_budget
+
         e, desc = an.topn.order_by[0]
+        # pow2-bucketed device budget: LIMIT 5 and LIMIT 7 share one
+        # compiled kernel; the exact limit re-applies at the host merge
         payload["topn"] = {
-            "key": serialize_expr(e), "desc": desc, "k": an.topn.limit,
+            "key": serialize_expr(e), "desc": desc,
+            "k": topn_budget(an.topn.limit),
         }
     return json.dumps(payload, sort_keys=True, default=str)
 
 
-def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
-    """Returns a jitted fn(datas, valids, lo, hi, del_mask) -> outputs.
+def _agg_tags(agg_ir) -> List[str]:
+    """Static result layout: tag per agg (jit returns arrays only)."""
+    tags = []
+    for a in agg_ir.aggs:
+        if a.name == "count":
+            tags.append("count")
+        elif a.name in ("sum", "avg"):
+            tags.append("sumcount")
+        elif a.name in ("min", "max"):
+            tags.append("minmax")
+        else:
+            tags.append("argfirst")
+    return tags
 
-    The row mask is built ON DEVICE from the [lo, hi) scalars (region clip
-    within the tile) AND'd with del_mask (a cached device-resident all-true
-    array unless the tile has MVCC-deleted rows).  Keeping masks device-side
-    means a steady-state query moves ZERO scan data over PCIe/tunnel: tiles
-    are cached device arrays (keyed on base_version), and only G-sized
-    partials come back.
+
+def _tile_core(an: _Analyzed, kind: str, col_order: List[int],
+               with_params: bool = False):
+    """The raw (un-jitted) per-tile program.
+
+    Signature: fn(datas, valids, lo, hi, del_mask[, pi, pf]) — the pi/pf
+    trailing args (hoisted predicate parameters, serving/params.py) are
+    present only when `with_params`; the micro-batcher vmaps this same
+    core over stacked parameter vectors.
     """
     if an.lookups:
         # the broadcast lookup join runs in the mesh engine only; the
@@ -455,10 +476,13 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
         raise JaxUnsupported("join lookup needs the mesh engine")
     n = TILE
 
-    def cols_env(datas, valids):
-        return {
+    def cols_env(datas, valids, params=None):
+        env = {
             ci: (datas[j], valids[j]) for j, ci in enumerate(col_order)
         }
+        if params is not None:
+            env["__params__"] = params
+        return env
 
     def row_mask_of(lo, hi, del_mask):
         ar = jnp.arange(n, dtype=jnp.int64)
@@ -472,33 +496,22 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
         return m
 
     if kind == "filter":
-        def fn(datas, valids, lo, hi, del_mask):
-            cols = cols_env(datas, valids)
+        def fn(datas, valids, lo, hi, del_mask, *params):
+            cols = cols_env(datas, valids, params if with_params else None)
             m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             outs = None
             if an.proj_exprs is not None:
                 outs = [compile_expr(p, cols, n) for p in an.proj_exprs]
             return m, outs
 
-        return jax.jit(fn)
+        return fn
 
     if kind == "agg":
         agg_ir = an.agg
         G = an.num_groups
-        # static result layout: tag per agg (jit returns arrays only)
-        tags = []
-        for a in agg_ir.aggs:
-            if a.name == "count":
-                tags.append("count")
-            elif a.name in ("sum", "avg"):
-                tags.append("sumcount")
-            elif a.name in ("min", "max"):
-                tags.append("minmax")
-            else:
-                tags.append("argfirst")
 
-        def fn(datas, valids, lo, hi, del_mask):
-            cols = cols_env(datas, valids)
+        def fn(datas, valids, lo, hi, del_mask, *params):
+            cols = cols_env(datas, valids, params if with_params else None)
             m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             # mixed-radix group codes (NULL keys excluded by _Analyzed)
             gidx = jnp.zeros(n, dtype=jnp.int64)
@@ -542,20 +555,16 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
                     results.append(ops.masked_segment_argfirst(gidx, mv, G))
             return gcount, results
 
-        jitted = jax.jit(fn)
-
-        def wrapped(datas, valids, lo, hi, del_mask):
-            gcount, results = jitted(datas, valids, lo, hi, del_mask)
-            return gcount, list(zip(tags, results))
-
-        return wrapped
+        return fn
 
     if kind == "topn":
-        key_expr, desc = an.topn.order_by[0]
-        k = min(an.topn.limit, TILE)
+        from ..serving import topn_budget
 
-        def fn(datas, valids, lo, hi, del_mask):
-            cols = cols_env(datas, valids)
+        key_expr, desc = an.topn.order_by[0]
+        k = min(topn_budget(an.topn.limit), TILE)
+
+        def fn(datas, valids, lo, hi, del_mask, *params):
+            cols = cols_env(datas, valids, params if with_params else None)
             m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
             d, v = compile_expr(key_expr, cols, n)
             # MySQL NULL order: first ascending, last descending.  The
@@ -568,9 +577,33 @@ def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int]):
             idx, cnt = ops.masked_top_k(key, m, k, desc)
             return idx, cnt
 
-        return jax.jit(fn)
+        return fn
 
     raise JaxUnsupported(kind)
+
+
+def _build_tile_fn(an: _Analyzed, kind: str, col_order: List[int],
+                   with_params: bool = False):
+    """Returns a jitted fn(datas, valids, lo, hi, del_mask[, pi, pf]).
+
+    The row mask is built ON DEVICE from the [lo, hi) scalars (region clip
+    within the tile) AND'd with del_mask (a cached device-resident all-true
+    array unless the tile has MVCC-deleted rows).  Keeping masks device-side
+    means a steady-state query moves ZERO scan data over PCIe/tunnel: tiles
+    are cached device arrays (keyed on base_version), and only G-sized
+    partials come back.
+    """
+    core = _tile_core(an, kind, col_order, with_params=with_params)
+    if kind != "agg":
+        return jax.jit(core)
+    tags = _agg_tags(an.agg)
+    jitted = jax.jit(core)
+
+    def wrapped(datas, valids, lo, hi, del_mask, *params):
+        gcount, results = jitted(datas, valids, lo, hi, del_mask, *params)
+        return gcount, list(zip(tags, results))
+
+    return wrapped
 
 
 def _to_state_dtype(d, src_ft: FieldType, state_ft: FieldType):
@@ -627,12 +660,25 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
     from ..trace import span
 
     col_order = an.needed_cols()
-    fp = _fingerprint(an, kind) + f"|cols={col_order}"
+    # hoist predicate constants into runtime parameter slots (serving):
+    # the fingerprint below serializes SLOTS, so parameter-different
+    # queries of the same shape class share one compiled tile program
+    from ..serving import hoist_conds
+
+    hoisted = hoist_conds(an)
+    pextra = ()
+    if hoisted is not None:
+        pi, pf = hoisted
+        pextra = (jnp.asarray(pi), jnp.asarray(pf))
+    fp = (_fingerprint(an, kind) + f"|cols={col_order}"
+          + (f"|hp={len(hoisted[0])},{len(hoisted[1])}"
+             if hoisted is not None else ""))
     fn = _COMPILED.get(fp)
     compiled_now = fn is None
     if fn is None:
-        fn = _build_tile_fn(an, kind, col_order)
-        _COMPILED[fp] = fn
+        fn = _build_tile_fn(an, kind, col_order,
+                            with_params=hoisted is not None)
+        _COMPILED.put(fp, fn)
     else:
         # zero-duration marker: the DAG fingerprint hit the program cache
         with span("copr.compile", cache="hit", kind=kind):
@@ -694,7 +740,7 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         compiled_now = False
         if kind == "filter":
             with span(dspan, kind=kind, tile=tile_idx, **dattr):
-                m, outs = fn(datas, valids, lo, hi, del_mask)
+                m, outs = fn(datas, valids, lo, hi, del_mask, *pextra)
             with span("copr.readback") as rsp:
                 mh = _np_tree(m)
                 rsp.set(bytes=mh.nbytes)
@@ -722,7 +768,8 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
                     break
         elif kind == "agg":
             with span(dspan, kind=kind, tile=tile_idx, **dattr):
-                gcount, results = fn(datas, valids, lo, hi, del_mask)
+                gcount, results = fn(datas, valids, lo, hi, del_mask,
+                                     *pextra)
             with span("copr.readback") as rsp:
                 gh = _np_tree(gcount)
                 rh = [(t, _np_tree(r)) for t, r in results]
@@ -733,7 +780,7 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
                                           base0)
         else:  # topn
             with span(dspan, kind=kind, tile=tile_idx, **dattr):
-                idx, cnt = fn(datas, valids, lo, hi, del_mask)
+                idx, cnt = fn(datas, valids, lo, hi, del_mask, *pextra)
             with span("copr.readback") as rsp:
                 idx = _np_tree(idx)[: int(cnt)]
                 rsp.set(bytes=idx.nbytes)

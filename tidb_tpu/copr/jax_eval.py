@@ -50,6 +50,9 @@ def compile_expr(e: Expression, cols: Dict[int, JVal], n: int) -> JVal:
             raise JaxUnsupported(f"column {e.index} not device-resident")
         return cols[e.index]
     if isinstance(e, Constant):
+        slot = getattr(e, "param_slot", None)
+        if slot is not None and "__params__" in cols:
+            return _param_const(e, slot, cols["__params__"], n)
         return _const(e, n)
     if isinstance(e, ScalarFunc):
         fn = _FUNCS.get(e.name)
@@ -58,6 +61,21 @@ def compile_expr(e: Expression, cols: Dict[int, JVal], n: int) -> JVal:
         args = [compile_expr(a, cols, n) for a in e.args]
         return fn(e, args, n)
     raise JaxUnsupported(f"expression {e!r}")
+
+
+def _param_const(e: Constant, slot, params, n: int) -> JVal:
+    """A hoisted constant (serving/params.py ParamConst): its value reads
+    from the runtime parameter vectors at EXECUTION time instead of being
+    baked into the program as an XLA literal — parameter-different queries
+    of the same shape class share one compiled program, and the
+    micro-batcher vmaps over a stack of these vectors."""
+    which, idx = slot
+    pi, pf = params
+    src = pf[idx] if which == "f" else pi[idx]
+    return (
+        jnp.broadcast_to(src.astype(_np_dtype_for(e.ftype)), (n,)),
+        jnp.ones(n, dtype=jnp.bool_),
+    )
 
 
 def _const(e: Constant, n: int) -> JVal:

@@ -64,6 +64,16 @@ _MESH: Optional[Mesh] = None
 _MESH_LOCK = threading.Lock()
 _DIST_INIT = False
 
+# ONE collective program in flight per process: concurrent shard_map
+# launches from different server worker threads interleave their XLA
+# collective-rendezvous participants and DEADLOCK (observed on the
+# 8-virtual-device CPU harness the moment the concurrent-client bench
+# drove N connections; a single-stream workload never trips it).  The
+# mesh is one shared resource — dispatches serialize on it, and the
+# serving layer's micro-batcher is the mechanism that turns that
+# serialization back into parallelism (N queries -> one dispatch).
+DISPATCH_LOCK = threading.Lock()
+
 
 def _maybe_init_multihost():
     """Multi-host (DCN) bring-up seam: when TIDB_TPU_COORDINATOR is set,
@@ -138,9 +148,21 @@ def get_mesh() -> Mesh:
 
 
 def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
-    """(n_tiles, n_tiles_padded, tiles_per_shard) for a table."""
+    """(n_tiles, n_tiles_padded, tiles_per_shard) for a table.
+
+    With shape buckets on (tidb_tpu_shape_buckets, the default) the tile
+    count pads UP to the next power of two before sharding: tables whose
+    row counts fall in the same bucket class — and the SAME table as it
+    grows within a class — share one compiled shard_map program shape.
+    Padded tiles are zeros and always masked (the row mask clips to
+    [start, end) which never exceeds base_rows), so results are
+    identical; the cost is bounded extra masked compute."""
+    from ..serving import shape_bucket, shape_buckets_enabled
+
     tile = je.TILE
     n_tiles = max((base_rows + tile - 1) // tile, 1)
+    if shape_buckets_enabled():
+        n_tiles = shape_bucket(n_tiles)
     n_pad = ((n_tiles + n_shards - 1) // n_shards) * n_shards
     return n_tiles, n_pad, n_pad // n_shards
 
@@ -198,15 +220,18 @@ class _MeshCache:
     def get_column(self, mesh: Mesh, table, store_ci: int):
         S = len(mesh.devices.ravel())
         # device ids in the key so a rebuilt same-size mesh never serves
-        # arrays placed on a dead device set (matches _ONES_CACHE)
+        # arrays placed on a dead device set (matches _ONES_CACHE);
+        # n_pad in the key so a shape-bucket policy change never pairs a
+        # stale-shaped cached array with a newly laid-out program
         devs = tuple(d.id for d in mesh.devices.ravel())
-        key = (table.store_uid, table.base_version, store_ci, devs, je.TILE)
+        _, n_pad, _ = _layout(table.base_rows, S)
+        key = (table.store_uid, table.base_version, store_ci, devs, je.TILE,
+               n_pad)
 
         def load():
             from ..trace import span
 
             tile = je.TILE
-            n_tiles, n_pad, _ = _layout(table.base_rows, S)
             wire = _wire_dtype(table, store_ci)
             _, _, has_null = table.column_stats(store_ci)
             with span("copr.transfer", col=store_ci,
@@ -383,12 +408,13 @@ def _all_true(mesh: Mesh, n_pad: int):
 # ---------------------------------------------------------------------------
 
 def _cols_env(an: _Analyzed, col_order: List[int], datas, valids,
-              n_local: int):
+              n_local: int, params=None):
     """Per-shard column environment for compile_expr: widen the narrow
     wire arrays to the canonical dtype in-register (XLA fuses the convert
     into every consumer — HBM reads stay narrow), and substitute a traced
     constant mask for columns cached without a validity array (no NULLs:
-    zero transfer, zero HBM)."""
+    zero transfer, zero HBM).  `params` carries the hoisted predicate
+    parameter vectors (pi, pf) for ParamConst slots."""
     env = {}
     for j, ci in enumerate(col_order):
         d = datas[j].reshape(n_local)
@@ -399,10 +425,23 @@ def _cols_env(an: _Analyzed, col_order: List[int], datas, valids,
         v = (jnp.ones(n_local, dtype=jnp.bool_) if v is None
              else v.reshape(n_local))
         env[ci] = (d, v)
+    if params is not None:
+        env["__params__"] = params
     return env
 
 
-_COMPILED: Dict[str, object] = {}
+def _split_hoisted(pargs, hoisted: bool):
+    """Peel the trailing (pi, pf) parameter vectors off the variadic parg
+    tail when predicate constants were hoisted; probes/lookups keep
+    reading their positional prefix unchanged."""
+    if not hoisted:
+        return pargs, None
+    return pargs[:-2], (pargs[-2], pargs[-1])
+
+
+from .cache import ProgramCache  # noqa: E402
+
+_COMPILED = ProgramCache("mesh")
 
 # max selected rows gathered host-side per streamed chunk (kv.Request
 # Streaming / distsql stream.go: bounded-memory result consumption)
@@ -460,10 +499,12 @@ def _apply_probes(an: _Analyzed, cols, m, pargs, n_local: int):
     return m
 
 
-def _probe_specs(an: _Analyzed):
+def _probe_specs(an: _Analyzed, hoisted: bool = False):
     specs = [P(), P()] * len(an.probes)
     for lk in an.lookups:
         specs += [P(), P()] + [P(), P()] * len(lk.payload_ftypes)
+    if hoisted:
+        specs += [P(), P()]  # replicated (pi, pf) parameter vectors
     return tuple(specs)
 
 
@@ -534,21 +575,23 @@ def _packed_jit(fn):
 
 
 def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
-                   mesh: Mesh, tiles_per_shard: int):
+                   mesh: Mesh, tiles_per_shard: int, hoisted: bool = False):
     """One shard_map program over the whole table.
 
     Inputs (pytree): datas [n_pad, TILE] x cols, valids likewise, del_mask
-    [n_pad, TILE], start/end scalars.  Each shard flattens its local tiles
-    to a [Tl*TILE] vector and runs the same fused program as the per-tile
-    engine; the partial/final agg merge is on-device collectives.
+    [n_pad, TILE], start/end scalars, then the variadic parg tail (probe
+    key sets, lookup payloads, and — when `hoisted` — the replicated
+    (pi, pf) predicate parameter vectors).  Each shard flattens its local
+    tiles to a [Tl*TILE] vector and runs the same fused program as the
+    per-tile engine; the partial/final agg merge is on-device collectives.
     """
     S = len(mesh.devices.ravel())
     Tl = tiles_per_shard
     n_local = Tl * je.TILE
     n_global = S * n_local
 
-    def cols_env(datas, valids):
-        return _cols_env(an, col_order, datas, valids, n_local)
+    def cols_env(datas, valids, params=None):
+        return _cols_env(an, col_order, datas, valids, n_local, params)
 
     def masks(del_mask, start, end):
         shard = jax.lax.axis_index("dp").astype(jnp.int64)
@@ -564,24 +607,17 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         return _apply_probes(an, cols, m, pargs, n_local)
 
     if kind == "agg" and an.agg_mode == "sort":
-        return _build_sort_agg_fn(an, col_order, mesh, tiles_per_shard)
+        return _build_sort_agg_fn(an, col_order, mesh, tiles_per_shard,
+                                  hoisted=hoisted)
 
     if kind == "agg":
         agg_ir = an.agg
         G = an.num_groups
-        tags = []
-        for a in agg_ir.aggs:
-            if a.name == "count":
-                tags.append("count")
-            elif a.name in ("sum", "avg"):
-                tags.append("sumcount")
-            elif a.name in ("min", "max"):
-                tags.append("minmax")
-            else:
-                tags.append("argfirst")
+        tags = je._agg_tags(agg_ir)
 
         def shard_fn(datas, valids, del_mask, start, end, *pargs):
-            cols = cols_env(datas, valids)
+            pargs, params = _split_hoisted(pargs, hoisted)
+            cols = cols_env(datas, valids, params)
             gofs, row_mask = masks(del_mask, start, end)
             m = selected(cols, row_mask, pargs)
             gidx = jnp.zeros(n_local, dtype=jnp.int64)
@@ -652,7 +688,8 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
                 out_results.append(P("dp"))
         fn = shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
+            in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
+            + _probe_specs(an, hoisted),
             out_specs=(P(), tuple(out_results)),
         )
         packed = _packed_jit(fn)
@@ -679,11 +716,14 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         return wrapped
 
     if kind == "topn":
+        from ..serving import topn_budget
+
         key_expr, desc = an.topn.order_by[0]
-        k = min(an.topn.limit, n_local)
+        k = min(topn_budget(an.topn.limit), n_local)
 
         def shard_fn(datas, valids, del_mask, start, end, *pargs):
-            cols = cols_env(datas, valids)
+            pargs, params = _split_hoisted(pargs, hoisted)
+            cols = cols_env(datas, valids, params)
             gofs, row_mask = masks(del_mask, start, end)
             m = selected(cols, row_mask, pargs)
             d, v = compile_expr(key_expr, cols, n_local)
@@ -694,7 +734,8 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
 
         fn = shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
+            in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
+            + _probe_specs(an, hoisted),
             out_specs=P("dp"),
         )
         packed = _packed_jit(fn)
@@ -711,13 +752,15 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     # back bit-packed: the tunnel's d2h bandwidth is low (~30MB/s measured),
     # so 1 bit/row instead of 1 byte/row is an 8x cheaper readback.
     def shard_fn(datas, valids, del_mask, start, end, *pargs):
-        cols = cols_env(datas, valids)
+        pargs, params = _split_hoisted(pargs, hoisted)
+        cols = cols_env(datas, valids, params)
         _, row_mask = masks(del_mask, start, end)
         return selected(cols, row_mask, pargs)
 
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
+        in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
+        + _probe_specs(an, hoisted),
         out_specs=P("dp"),
     )
     jitted = jax.jit(
@@ -789,7 +832,7 @@ def _fd_sort_lookup(an: _Analyzed):
 
 
 def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
-                       tiles_per_shard: int):
+                       tiles_per_shard: int, hoisted: bool = False):
     """Sort-based per-shard partial aggregation for arbitrary group keys
     (any NDV, float, NULLable, expression keys).
 
@@ -809,23 +852,14 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
     OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
     agg_ir = an.agg
     fd_lookup = _fd_sort_lookup(an)
+    tags = je._agg_tags(agg_ir)
 
-    tags = []
-    for a in agg_ir.aggs:
-        if a.name == "count":
-            tags.append("count")
-        elif a.name in ("sum", "avg"):
-            tags.append("sumcount")
-        elif a.name in ("min", "max"):
-            tags.append("minmax")
-        else:
-            tags.append("argfirst")
-
-    def cols_env(datas, valids):
-        return _cols_env(an, col_order, datas, valids, n_local)
+    def cols_env(datas, valids, params=None):
+        return _cols_env(an, col_order, datas, valids, n_local, params)
 
     def shard_fn(datas, valids, del_mask, start, end, *pargs):
-        cols = cols_env(datas, valids)
+        pargs, params = _split_hoisted(pargs, hoisted)
+        cols = cols_env(datas, valids, params)
         shard = jax.lax.axis_index("dp").astype(jnp.int64)
         gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
         m = (gofs >= start) & (gofs < end) & del_mask.reshape(n_local)
@@ -916,7 +950,8 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
 
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P()) + _probe_specs(an),
+        in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
+        + _probe_specs(an, hoisted),
         out_specs=P("dp"),
     )
     packed = _packed_jit(fn)
@@ -1196,6 +1231,13 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
     )
+    # hoist predicate constants into runtime parameter slots (serving/
+    # params.py): the fingerprint serializes slots, so parameter-different
+    # queries — a changed date literal, a different point-lookup key —
+    # reuse the SAME compiled shard_map program instead of recompiling
+    from ..serving import hoist_conds
+
+    hoisted = hoist_conds(an)
 
     mesh = get_mesh()
     S = len(mesh.devices.ravel())
@@ -1281,14 +1323,17 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
     mesh_ids = tuple(d.id for d in mesh.devices.ravel())
     fp = (_fingerprint(an, kind)
           + f"|mesh S={S} Tl={Tl} devs={mesh_ids} cols={col_order} "
-          + f"kpads={kpads} wire={wire_sig}")
+          + f"kpads={kpads} wire={wire_sig}"
+          + (f"|hp={len(hoisted[0])},{len(hoisted[1])}"
+             if hoisted is not None else ""))
     from ..trace import annotate, span
 
     annotate(device_ids=list(mesh_ids))
     fn = _COMPILED.get(fp)
     if fn is None:
-        fn = _build_mesh_fn(an, kind, col_order, mesh, Tl)
-        _COMPILED[fp] = fn
+        fn = _build_mesh_fn(an, kind, col_order, mesh, Tl,
+                            hoisted=hoisted is not None)
+        _COMPILED.put(fp, fn)
         # label this query's FIRST dispatch as the compile: jit compiles
         # lazily, so the program-cache miss pays XLA compilation there
         fn = _compile_labeled(fn, kind)
@@ -1296,6 +1341,10 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
         with span("copr.compile", cache="hit", kind=kind):
             pass
     pargs = tuple(pargs)
+    if hoisted is not None:
+        # replicated parameter vectors ride the variadic parg tail (the
+        # shard program peels them back off via _split_hoisted)
+        pargs = pargs + (jnp.asarray(hoisted[0]), jnp.asarray(hoisted[1]))
 
     # one delta pass for the whole table
     deleted, inserted = table.delta_overlay(req.ts, 0, 1 << 62)
@@ -1340,22 +1389,26 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
         FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=start, end=end)
         if kind == "agg" and an.agg_mode == "sort":
             try:
-                chunks.extend(_sort_agg_chunks(
-                    fn(datas, valids, del_mask, start, end, pargs), table, an,
-                ))
+                with DISPATCH_LOCK:
+                    out = fn(datas, valids, del_mask, start, end, pargs)
+                chunks.extend(_sort_agg_chunks(out, table, an))
             except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
                 # shard — hand the whole request to the host hash agg
                 req.mesh_reject_reason = str(e)
                 return None
         elif kind == "agg":
-            gcount, results = fn(datas, valids, del_mask, start, end, pargs)
+            with DISPATCH_LOCK:
+                gcount, results = fn(datas, valids, del_mask, start, end,
+                                     pargs)
             # wrapped() already unpacked to numpy and merged shard partials
             agg_accum = _merge_mesh_agg(
                 agg_accum, gcount, results, table, an,
             )
         elif kind == "topn":
-            gidx, cnts, k = fn(datas, valids, del_mask, start, end, pargs)
+            with DISPATCH_LOCK:
+                gidx, cnts, k = fn(datas, valids, del_mask, start, end,
+                                   pargs)
             picks = []
             for s in range(S):
                 c = int(cnts[s])
@@ -1413,7 +1466,8 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
         FAILPOINTS.hit("mesh/device_error", kind="filter",
                        device_ids=mesh_ids, start=start, end=end)
         FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=start, end=end)
-        mask = fn(datas, valids, del_mask, start, end, pargs)
+        with DISPATCH_LOCK:
+            mask = fn(datas, valids, del_mask, start, end, pargs)
         handles = np.flatnonzero(mask)
         if remaining is not None:
             handles = handles[:remaining]

@@ -240,6 +240,20 @@ class SelectResult:
     def _produce(self):
         try:
             if self.req.engine == "tpu":
+                # micro-batch rung (tidb_tpu/serving): identical-shape
+                # point/agg statements arriving within the batching
+                # window coalesce into one vmapped device dispatch; None
+                # when ineligible/disabled or on a benign batch failure
+                # (the solo rungs below re-run with identical results)
+                from ..serving import try_run_microbatch
+
+                mb = try_run_microbatch(self.storage, self.req)
+                if mb is not None:
+                    self.scan_engine = "microbatch"
+                    for c in mb:
+                        self._put(c)
+                    self._put(_DONE)
+                    return
                 # mesh-parallel path: the whole base scan as ONE shard_map
                 # program over the device mesh (copr/parallel.py); falls
                 # back to per-region fan-out when ineligible or on a

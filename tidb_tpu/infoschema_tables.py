@@ -503,6 +503,15 @@ def _tidb_tpu_engine(domain, isc):
         rows.append(("programs", "mesh_compiled", str(len(pl._COMPILED))))
         rows.append(("programs", "tile_compiled",
                      str(len(je._COMPILED))))
+        from .copr.cache import PROGRAM_CACHES
+
+        for c in PROGRAM_CACHES:
+            st = c.stats()
+            rows.append((
+                "programs", f"{c.name}_cache",
+                f"size={st['size']}/{st['capacity']} hits={st['hits']} "
+                f"misses={st['misses']} evictions={st['evictions']}",
+            ))
         tile_cache = je.DEVICE_CACHE._c
         rows.append(("tile_cache", "entries", str(len(tile_cache))))
         rows.append(("tile_cache", "bytes", str(tile_cache._bytes)))

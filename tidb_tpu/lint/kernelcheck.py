@@ -58,6 +58,12 @@ CANONICAL_KERNEL_QUERIES = [
 #: and the all_gather broadcast rung of the partitioned join.
 MPP_EXCHANGE_KERNELS = ("mpp-shuffle-join", "mpp-broadcast-join")
 
+#: the micro-batcher's vmapped padded-batch kernel (serving/batcher.py):
+#: the q6-scalar-agg shape with predicate constants hoisted to parameter
+#: slots, vmapped over a pow2-padded batch of parameter vectors.
+VMAP_BATCH_KERNEL = "serving-vmapped-batch"
+VMAP_BATCH_B = 4
+
 
 def _iter_eqns(jaxpr):
     """All equations including nested call/pjit sub-jaxprs.  shard_map
@@ -154,6 +160,47 @@ def trace_kernel(table, dag) -> Dict[str, int]:
     else:
         closed = jax.make_jaxpr(fn)(*args)
     return _jaxpr_stats(closed)
+
+
+def trace_batch_kernel(table, dag, B: int = VMAP_BATCH_B,
+                       masked: bool = False):
+    """Abstract-trace the micro-batcher's vmapped padded-batch kernel.
+
+    `masked=True` traces with a partially-false deletion mask, a clipped
+    [lo, hi) and shifted parameter values: bucket members differ only in
+    DATA, so the jaxpr must be identical either way — any divergence
+    means value-dependent tracing crept into the batch path (a program
+    whose arity changes with bucket fill would defeat batching)."""
+    import jax
+
+    from ..copr.ir import DAG
+    from ..copr.jax_engine import TILE, _Analyzed, _tile_core
+    from ..serving import shape_bucket
+    from ..serving.params import hoist_conds
+
+    dag = DAG.from_dict(dag.to_dict())
+    an = _Analyzed(dag, table)
+    kind = "agg" if an.agg is not None else (
+        "topn" if an.topn is not None else "filter")
+    col_order = an.needed_cols()
+    hoisted = hoist_conds(an)
+    pi, pf = hoisted if hoisted is not None else (
+        np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.float64))
+    b_pad = shape_bucket(B)
+    PI = np.stack([pi] * b_pad)
+    PF = np.stack([pf] * b_pad)
+    datas, valids, lo, hi, del_mask = canonical_inputs(table, an, col_order)
+    if masked:
+        del_mask = del_mask.copy()
+        del_mask[::7] = False
+        lo, hi = np.int64(3), np.int64(TILE - 5)
+        if PI.size:
+            PI = PI + np.arange(b_pad, dtype=np.int64).reshape(-1, 1)
+        if PF.size:
+            PF = PF * 0.5
+    core = _tile_core(an, kind, col_order, with_params=True)
+    vfn = jax.vmap(core, in_axes=(None, None, None, None, None, 0, 0))
+    return jax.make_jaxpr(vfn)(datas, valids, lo, hi, del_mask, PI, PF)
 
 
 def _signature_census() -> Tuple[set, set]:
@@ -258,6 +305,44 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"int64 equation count grew {base.get('i64_eqns')} -> "
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
+
+    # -- micro-batch vmapped padded-batch kernel ------------------------
+    name = VMAP_BATCH_KERNEL
+    try:
+        sql = dict(CANONICAL_KERNEL_QUERIES)["q6-scalar-agg"]
+        phys = s._plan(parse_one(sql))
+        stats = mstats = None
+        for _p, dag in _reader_dags(phys):
+            try:
+                stats = _jaxpr_stats(trace_batch_kernel(table, dag))
+                mstats = _jaxpr_stats(
+                    trace_batch_kernel(table, dag, masked=True))
+                break
+            except JaxUnsupported:
+                continue
+        if stats is None:
+            emit(name, "no device-eligible DAG for the vmapped batch "
+                       "kernel — micro-batch coverage regressed")
+        elif stats != mstats:
+            emit(name,
+                 f"padding mask / bucket-fill values changed the vmapped "
+                 f"batch kernel's jaxpr ({stats} vs {mstats}) — batch "
+                 "members must share one program regardless of fill")
+        elif collect_stats is not None:
+            collect_stats[name] = stats
+        else:
+            base = baseline_kernels.get(name)
+            if base is None:
+                emit(name, f"kernel not in baseline (measured {stats}); "
+                           "run python -m tidb_tpu.lint --update-baseline")
+            elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+                emit(name,
+                     f"int64 equation count grew {base.get('i64_eqns')} "
+                     f"-> {stats['i64_eqns']}: an int64-emulation chain "
+                     "was reintroduced into the batch kernel")
+    except Exception as e:  # noqa: BLE001 — contract break
+        emit(name, f"vmapped batch kernel trace failed: "
+                   f"{type(e).__name__}: {e}")
 
     # -- context-capture guards (trace spans + lifecycle scope) ---------
     # span hooks AND lifecycle scope checks live strictly OUTSIDE

@@ -109,7 +109,9 @@ class MPPJoinSpec:
     copartitions: Optional[List[Tuple[int, int]]] = None
 
 
-_COMPILED: Dict[str, object] = {}
+from ..copr.cache import ProgramCache
+
+_COMPILED = ProgramCache("mpp")
 
 OUT_CHUNK_ROWS = 1 << 16
 
@@ -492,7 +494,7 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mpp_fn(spec, ps, bs, mode, mesh, cap_p, cap_b)
-        _COMPILED[fp] = fn
+        _COMPILED.put(fp, fn)
 
     # deterministic mid-shuffle fault injection (chaos harness): fires
     # after both sides are device-resident, before the exchange program
@@ -506,10 +508,15 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
             out.append(jnp.int64(hi))
         return tuple(out)
 
-    out = fn(tuple(ps.datas), tuple(ps.valids), ps.del_mask,
-             bounds_args(ps),
-             tuple(bs.datas), tuple(bs.valids), bs.del_mask,
-             bounds_args(bs))
+    from ..copr.parallel import DISPATCH_LOCK
+
+    with DISPATCH_LOCK:
+        # collective programs serialize per process (see parallel.py:
+        # concurrent shard_map launches deadlock at the rendezvous)
+        out = fn(tuple(ps.datas), tuple(ps.valids), ps.del_mask,
+                 bounds_args(ps),
+                 tuple(bs.datas), tuple(bs.valids), bs.del_mask,
+                 bounds_args(bs))
     overflow, dups = int(out[0]), int(out[1])
     if dups:
         # the planner's uniqueness inference was wrong: the device picks

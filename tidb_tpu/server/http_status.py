@@ -50,6 +50,7 @@ class StatusServer:
                     self._send(200, body, "text/plain; version=0.0.4")
                     return
                 if path in ("/status", "/"):
+                    from ..copr.cache import PROGRAM_CACHES
                     from ..copr.device_health import DEVICE_HEALTH
                     from ..trace import TRACE_RING
 
@@ -91,6 +92,12 @@ class StatusServer:
                         # N most recent finished query traces with their
                         # per-phase totals (the trace subsystem's ring)
                         "recent_traces": recent,
+                        # LRU-bounded compiled-program caches (tile/mesh/
+                        # MPP/micro-batch): with shape buckets on, hit
+                        # rate tracks query SHAPE CLASSES, not literals
+                        "compiled_programs": {
+                            c.name: c.stats() for c in PROGRAM_CACHES
+                        },
                     }).encode()
                     self._send(200, body, "application/json")
                     return

@@ -1,0 +1,28 @@
+"""Shape-class bucketing policy.
+
+One rule everywhere: sizes pad UP to the next power of two (with a small
+floor), and padded slots are masked — never read as data.  A compiled
+XLA program is specialized on its operand shapes, so bucketing makes the
+program cache key a function of the size CLASS rather than the literal
+size: a table growing 33 -> 50 tiles, a TopN limit changing 5 -> 7, or a
+micro-batch filling 3 of 4 slots all reuse the same compiled program.
+"""
+
+from __future__ import annotations
+
+
+def shape_bucket(n: int, floor: int = 1) -> int:
+    """Next power of two >= max(n, floor)."""
+    n = max(int(n), int(floor), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def topn_budget(limit: int) -> int:
+    """Device TopN budget for a LIMIT: pow2-bucketed with a floor of 16
+    so nearby limits share one compiled kernel (the exact limit is
+    re-applied host-side by the final merge)."""
+    from . import shape_buckets_enabled
+
+    if not shape_buckets_enabled():
+        return max(int(limit), 1)
+    return shape_bucket(limit, floor=16)

@@ -1,0 +1,126 @@
+"""Predicate-constant hoisting: literal -> runtime parameter slot.
+
+Compiled device programs bake `Constant` leaves in as XLA literals, so
+`WHERE k = 5` and `WHERE k = 7` compile two programs even though they
+are the same query SHAPE.  Hoisting rewrites comparison constants into
+`ParamConst` slots that read from a runtime parameter vector instead:
+the program fingerprint serializes the SLOT (not the value), parameter-
+different queries share one cached program, and the micro-batcher can
+vmap that program over a stack of per-query parameter vectors.
+
+Scope is deliberately narrow: only constants that are direct operands
+of comparison predicates (=, !=, <, <=, >, >=, IN) hoist — those are
+what vary between parameterized point/agg statements.  Structural
+constants (arithmetic like `1 - l_discount`, ROUND digits, CASE arms)
+stay baked: they define the query shape itself.
+
+This module is host-only (no jax): hoisting happens after the dict
+rewrite, before fingerprint/compile, and the host CPU engine still
+evaluates `ParamConst` by its retained literal value.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..expr.expression import Constant, Expression, ScalarFunc
+from ..types import TypeKind
+from ..types.values import parse_date, parse_datetime
+
+#: predicate heads whose constant operands hoist into parameter slots
+_CMP_OPS = frozenset({"=", "!=", "<", "<=", ">", ">=", "in"})
+
+
+@dataclass
+class ParamConst(Constant):
+    """A hoisted constant: serializes as its slot for fingerprinting and
+    compiles as a read from the runtime parameter vector, but keeps its
+    literal `value` so host-side evaluation is unchanged."""
+
+    #: ("i" | "f", index) — which parameter vector, and where in it
+    param_slot: Optional[tuple] = None
+
+
+def _numeric_value(c: Constant):
+    """The hoistable numeric payload of a constant, or None.
+
+    DATE/DATETIME string literals pre-parse here (the device `_const`
+    path parses them at trace time — a hoisted slot must carry the
+    already-parsed int).  Anything non-numeric (raw strings that the
+    dict rewrite did not code, wide decimals, JSON) stays baked."""
+    v = c.value
+    if v is None or c.ftype is None:
+        return None
+    k = c.ftype.kind
+    if k == TypeKind.JSON or (k == TypeKind.DECIMAL
+                              and getattr(c.ftype, "is_wide_decimal", False)):
+        return None
+    if isinstance(v, str):
+        try:
+            if k == TypeKind.DATE:
+                return int(parse_date(v))
+            if k == TypeKind.DATETIME:
+                return int(parse_datetime(v))
+        except (ValueError, TypeError):
+            return None
+        return None  # raw string constant (dict rewrite handles or rejects)
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v) if k == TypeKind.FLOAT else None
+    return None
+
+
+def _hoist_leaf(e: Expression, i64: List[int], f64: List[float]):
+    """ParamConst for a hoistable constant operand, else None."""
+    if not isinstance(e, Constant) or isinstance(e, ParamConst):
+        return None
+    v = _numeric_value(e)
+    if v is None:
+        return None
+    if e.ftype.kind == TypeKind.FLOAT:
+        f64.append(float(v))
+        slot = ("f", len(f64) - 1)
+    else:
+        i64.append(int(v))
+        slot = ("i", len(i64) - 1)
+    return ParamConst(e.value, e.ftype, param_slot=slot)
+
+
+def _walk(e: Expression, i64: List[int], f64: List[float]) -> Expression:
+    if not isinstance(e, ScalarFunc):
+        return e
+    if e.name in _CMP_OPS:
+        new_args = []
+        for a in e.args:
+            hoisted = _hoist_leaf(a, i64, f64)
+            new_args.append(hoisted if hoisted is not None
+                            else _walk(a, i64, f64))
+        return ScalarFunc(e.name, new_args, e.ftype, e.meta)
+    return ScalarFunc(e.name, [_walk(a, i64, f64) for a in e.args],
+                      e.ftype, e.meta)
+
+
+def hoist_conds(an) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Hoist comparison constants out of `an.conds` in place.
+
+    Returns (i64_params, f64_params) when anything hoisted (an.conds now
+    carries ParamConst slots), else None (an untouched).  Gated on the
+    shape-bucket sysvar so disabling buckets restores literal-baked
+    programs exactly."""
+    from . import shape_buckets_enabled
+
+    if not shape_buckets_enabled() or not getattr(an, "conds", None):
+        return None
+    i64: List[int] = []
+    f64: List[float] = []
+    new_conds = [_walk(c, i64, f64) for c in an.conds]
+    if not i64 and not f64:
+        return None
+    an.conds = new_conds
+    return (np.array(i64, dtype=np.int64), np.array(f64, dtype=np.float64))

@@ -531,7 +531,8 @@ class Session:
 
                 REGISTRY.inc("plan_cache_misses_total")
                 self._plan_cache[key] = phys
-                if len(self._plan_cache) > 128:
+                cap = max(self.vars.get_int("tidb_plan_cache_size", 128), 1)
+                while len(self._plan_cache) > cap:
                     self._plan_cache.popitem(last=False)
                 sp.set(plan_cache="miss")
             return phys
@@ -555,6 +556,15 @@ class Session:
         refs: list = []
         _walk_tables(stmt, refs)
         isc = self.domain.catalog.info_schema()
+        # shape-bucketed per-table version (serving): key plans on the
+        # table's ROW-COUNT BUCKET + base version instead of the raw
+        # committed-write counter — steady-state DML that stays within a
+        # table's pow2 size class keeps its cached plans valid (plans
+        # read data at execution time; only stats/schema/bindings shifts,
+        # all keyed separately, change what the planner would pick)
+        use_buckets = self.vars.get_bool("tidb_tpu_shape_buckets")
+        from ..serving import shape_bucket
+
         vers = []
         seen = set()
         for tn in refs:
@@ -585,7 +595,13 @@ class Session:
                     store = self.domain.storage.table(pid)
                 except KVError:
                     return None
-                vers.append((pid, store.mutations, stats_ver))
+                if use_buckets:
+                    vers.append((pid, store.base_version,
+                                 shape_bucket(store.base_rows
+                                              + len(store.delta) + 1),
+                                 stats_ver))
+                else:
+                    vers.append((pid, store.mutations, stats_ver))
         return (
             sql, self.current_db,
             self.domain.catalog.schema_version,
@@ -812,6 +828,12 @@ class Session:
                 self.vars.set_global(name, value)
             else:
                 self.vars.set_session(name, value)
+            from .. import serving
+
+            if name.lower() in serving._SYSVARS:
+                # serving knobs configure a process-wide resource (the
+                # batcher / bucket policy), mirroring max_connections
+                serving.refresh_from_vars(self.vars)
         return ResultSet()
 
     def _snapshot_write_guard(self, s):

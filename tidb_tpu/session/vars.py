@@ -90,6 +90,20 @@ SYSVAR_DEFAULTS = {
     "tidb_allow_mpp": ("1", "bool"),
     "tidb_enforce_mpp": ("0", "bool"),
     "tidb_broadcast_join_threshold_count": ("10240", "int"),
+    # plan-cache capacity per session (planner/core/cache.go's
+    # plan-cache-size; used to be a hard-coded 128)
+    "tidb_plan_cache_size": ("128", "int"),
+    # --- shape-bucketed serving & micro-batching (tidb_tpu/serving) ---
+    # shape buckets: compiled programs and plan-cache entries key on
+    # pow2 shape CLASSES (row-count buckets, hoisted predicate params,
+    # bucketed TopN budgets) instead of literal shapes/constants
+    "tidb_tpu_shape_buckets": ("1", "bool"),
+    # micro-batching window (ms; 0 disables): identical-fingerprint
+    # point/agg statements arriving within the window coalesce into one
+    # vmapped device dispatch.  Process-wide knobs (the batcher is a
+    # server-level resource, like max_connections).
+    "tidb_tpu_microbatch_window_ms": ("0", "int"),
+    "tidb_tpu_microbatch_max": ("32", "int"),
     # --- TPU-native knobs ---------------------------------------------
     "tidb_use_tpu": ("1", "bool"),  # per-session engine routing (cpu|tpu)
     # background device-cache warming after bulk loads (LOAD DATA):
