@@ -239,6 +239,14 @@ def _host_fallback_worker():
         out["concurrent"] = cstate.get("concurrent")
     except BaseException as e:  # noqa: BLE001
         out["concurrent"] = {"error": repr(e)}
+    # whole-fragment fusion receipt on the CPU harness: fused one-launch
+    # mesh program vs the per-tile dispatch loop (TIDB_TPU_TILE is
+    # shrunk by the parent so the table spans multiple tiles)
+    try:
+        sess.execute("set tidb_use_tpu = 1")
+        out["fusion"] = fusion_bench(sess, n)
+    except BaseException as e:  # noqa: BLE001
+        out["fusion"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -256,7 +264,13 @@ def host_side_fallback(state: dict):
     phases = state.setdefault("phases", {})
     fb = state["host_fallback"] = {}
     try:
-        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1",
+                   # multi-tile + multi-shard so the fusion receipt's
+                   # fused-vs-per-tile comparison is meaningful on CPU
+                   TIDB_TPU_TILE="65536",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count=8"
+                              ).strip())
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
              "--host-fallback-worker"],
@@ -568,6 +582,64 @@ def time_query(sess, sql: str, iters: int):
     return warm, best
 
 
+def _count_device_dispatches(sess, sql: str) -> int:
+    """Run `sql` once under TRACE and count fused device launches —
+    `copr.device.execute` spans (plus compile-labeled first dispatches)."""
+    try:
+        sess.execute("trace " + sql)
+        tr = sess.last_trace
+        if tr is None:
+            return -1
+        n = {"d": 0}
+
+        def walk(s):
+            if s.name == "copr.device.execute" or (
+                    s.name == "copr.compile"
+                    and (s.attrs or {}).get("cache") == "miss"):
+                n["d"] += 1
+            for c in s.children:
+                walk(c)
+
+        walk(tr.root)
+        return n["d"]
+    except BaseException:  # noqa: BLE001 — receipt survives trace issues
+        return -1
+
+
+def fusion_bench(sess, n: int) -> dict:
+    """Whole-fragment fusion receipt: fused (ONE XLA launch per mesh
+    dispatch) vs the per-tile dispatch loop (one launch + readback per
+    tile with host glue between them — the unfused comparator,
+    TIDB_TPU_FUSION=0), rows/s and dispatch counts for Q1/Q6."""
+    out = {}
+    prior = os.environ.get("TIDB_TPU_FUSION")
+    for qname, sql in (("q1", Q1), ("q6", Q6)):
+        try:
+            os.environ["TIDB_TPU_FUSION"] = "1"
+            _, fused_s = time_query(sess, sql, ITERS)
+            fused_d = _count_device_dispatches(sess, sql)
+            os.environ["TIDB_TPU_FUSION"] = "0"
+            _, unf_s = time_query(sess, sql, ITERS)
+            unf_d = _count_device_dispatches(sess, sql)
+        finally:
+            # restore the operator's setting, not a hardcoded default
+            if prior is None:
+                os.environ.pop("TIDB_TPU_FUSION", None)
+            else:
+                os.environ["TIDB_TPU_FUSION"] = prior
+        out[qname] = {
+            "fused_rows_per_sec": round(n / fused_s, 1),
+            "per_phase_rows_per_sec": round(n / unf_s, 1),
+            "fused_dispatches": fused_d,
+            "per_phase_dispatches": unf_d,
+            "speedup": round(unf_s / fused_s, 2),
+        }
+        log(f"fusion {qname}: fused={n / fused_s:,.0f} rows/s "
+            f"({fused_d} dispatches) vs per-phase={n / unf_s:,.0f} rows/s "
+            f"({unf_d} dispatches) -> {unf_s / fused_s:.2f}x")
+    return out
+
+
 def _run(state: dict):
     try:
         _run_inner(state)
@@ -618,6 +690,15 @@ def _run_inner(state: dict):
             "rows_per_sec": round(n / q6_best, 1),
         }
         state["load_s"] = round(load_s, 2)
+        # whole-fragment fusion receipt: fused one-launch dispatch vs the
+        # per-tile dispatch loop, with dispatch counts (ISSUE 7)
+        fus = None
+        if remaining() > 0.2 * WALL_LIMIT:
+            try:
+                fus = fusion_bench(sess, n)
+                state["fusion"] = fus
+            except BaseException as e:  # noqa: BLE001 — receipt survives
+                fus = {"error": repr(e)}
         # per-scale receipt: a later-scale wedge (load hang, tunnel drop)
         # must never zero the measured trajectory — every completed scale
         # survives in the emitted detail
@@ -625,6 +706,7 @@ def _run_inner(state: dict):
             "rows": n, "load_s": round(load_s, 2),
             "q1_rows_per_sec": round(n / q1_best, 1),
             "q6_rows_per_sec": round(n / q6_best, 1),
+            "fusion": fus,
             "at_s": round(time.perf_counter() - T0, 1),
         })
         state["phases"][f"scale_{n}_done"] = round(
@@ -814,6 +896,7 @@ def emit(state: dict):
                 "q3": state.get("q3"),
                 "mpp_join": state.get("mpp_join"),
                 "concurrent": state.get("concurrent"),
+                "fusion": state.get("fusion"),
                 "scales": state.get("scales"),
                 "trace_overhead": state.get("trace_overhead"),
                 "devices": state.get("devices"),

@@ -96,14 +96,14 @@ def test_trace_row_output_has_device_phases(env):
         assert any(o.startswith(needed) for o in ops), (needed, ops)
     # device phases with nonzero durations
     tr = s.last_trace
-    for phase in ("copr.compile", "copr.transfer", "copr.execute",
+    for phase in ("copr.compile", "copr.transfer", "copr.device.execute",
                   "copr.readback"):
         assert _spans_by_name(tr, phase), (phase, _span_names(tr))
     xfer = _spans_by_name(tr, "copr.transfer")
     assert sum(sp.attrs.get("bytes", 0) for sp in xfer) > 0
     rb = _spans_by_name(tr, "copr.readback")
     assert sum(sp.attrs.get("bytes", 0) for sp in rb) > 0
-    exe = _spans_by_name(tr, "copr.execute")
+    exe = _spans_by_name(tr, "copr.device.execute")
     assert any(sp.dur_ns > 0 for sp in exe)
     # indentation encodes the tree
     assert any(r[0].startswith("  ") for r in rs.rows)
@@ -176,7 +176,7 @@ def test_slow_query_covers_tile_fanout_engine(env, monkeypatch):
     for phase in ("copr.transfer", "copr.readback"):
         assert _spans_by_name(tr, phase), (phase, _span_names(tr))
     assert (_spans_by_name(tr, "copr.compile")
-            or _spans_by_name(tr, "copr.execute"))
+            or _spans_by_name(tr, "copr.device.execute"))
 
 
 def test_statement_summary_gains_phase_aggregates(env):

@@ -690,17 +690,26 @@ class Catalog:
                     scan_version = store.base_version
                     self._drop_reorg_parts(job)
                 end = min(start + self.BACKFILL_BATCH, store.base_rows)
-                chunk = store.base_chunk(list(offs), start, end,
-                                         decode_strings=False)
-                valid = np.ones(end - start, dtype=np.bool_)
-                cols = []
-                for i in range(len(offs)):
-                    c = chunk.col(i)
-                    valid &= c.validity()
-                    cols.append(c.data)
-                handles = np.arange(start, end, dtype=np.int64)[valid]
-                part = [c[valid] for c in cols] + [handles]
-                self._save_reorg_part(job, len(parts), part, end, scan_version)
+                # per-batch trace span: online index builds surface in
+                # TRACE / SLOW_QUERY.backfill_ms / /status instead of
+                # being an invisible stall inside the DDL statement
+                from ..trace import span as _span
+
+                with _span("ddl.backfill", job=job.id, index=ix.name,
+                           start=start, end=end) as bsp:
+                    chunk = store.base_chunk(list(offs), start, end,
+                                             decode_strings=False)
+                    valid = np.ones(end - start, dtype=np.bool_)
+                    cols = []
+                    for i in range(len(offs)):
+                        c = chunk.col(i)
+                        valid &= c.validity()
+                        cols.append(c.data)
+                    handles = np.arange(start, end, dtype=np.int64)[valid]
+                    part = [c[valid] for c in cols] + [handles]
+                    self._save_reorg_part(job, len(parts), part, end,
+                                          scan_version)
+                    bsp.set(rows=int(len(handles)))
                 parts.append(part)
                 job.reorg_progress = end
                 FAILPOINTS.hit("ddl/backfill_batch", job=job.id, upto=end)

@@ -39,11 +39,16 @@ def run_dag_on_region(storage, req: CopRequest, region, clipped) -> CopResponse:
     if table.base_ts <= ts and clipped.start < base_end:
         if req.engine == "tpu":
             try:
-                from .jax_engine import run_base_jax
+                # fused-region execution with the per-phase fallback
+                # ladder (copr/fusion.py): an unfusable suffix runs as a
+                # host tail over the fused region's output; only a
+                # fragment with no device-eligible region at all steps
+                # down to the CPU interpreter
+                from .fusion import run_fragment
 
                 chunks.extend(
-                    run_base_jax(table, dag, clipped.start, base_end, deleted,
-                                 aux=aux)
+                    run_fragment(table, dag, clipped.start, base_end,
+                                 deleted, aux=aux)
                 )
             except JaxUnsupported:
                 chunks.extend(

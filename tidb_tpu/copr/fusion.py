@@ -1,0 +1,345 @@
+"""Whole-fragment kernel fusion: one XLA launch per pushed-down fragment.
+
+Flare (PAPERS.md) showed the order-of-magnitude wins come from compiling
+an ENTIRE stage natively instead of operator-at-a-time; "Query Processing
+on Tensor Computation Runtimes" maps full relational fragments onto
+single tensor programs.  This module is that idea applied to the copr
+engines:
+
+- **Phase emitters** (`selection_mask`, `dense_group_codes`,
+  `dense_agg_results`, `topn_key`, `projection_outputs`): each pushed
+  phase of a fragment — filter, project, group-code, aggregate, topN —
+  emits jax ops into a shared tracing context instead of owning its own
+  device dispatch.  Both engines' program builders
+  (`jax_engine._tile_core` per tile, `parallel._build_mesh_fn` per mesh
+  shard) compose these emitters, so scan→filter→project→agg→topN lowers
+  into ONE jitted/shard_map program: intermediates never leave HBM and a
+  steady-state fragment is exactly one `copr.device.execute` span per
+  mesh dispatch.  The collective axis rides in the context (`axis="dp"`
+  under shard_map, None per tile) so the same emitter serves both.
+
+- **Fusion regions + fallback ladder** (`plan_regions`,
+  `run_fragment`): a fragment containing one unfusable operator no
+  longer demotes the WHOLE fragment to the CPU interpreter.  The
+  splitter finds the longest device-compilable executor prefix (the
+  fused region) and peels the remainder into a host tail evaluated by
+  the CPU engine over the region's output chunks — split the region at
+  the unfusable boundary, never fail the query.  The chaos site
+  `copr/fusion_split` forces splits at arbitrary boundaries so parity
+  under every split point is test-asserted.
+
+Compiled fused programs key on the existing DAG fingerprint compile
+cache (`copr/cache.py` ProgramCache) and compose with the serving
+layer's ParamConst slots and pow2 shape buckets: parameter-different
+literals, growing tables, and (on the mesh) any range count up to
+`parallel.MESH_RANGE_SLOTS` all share one compiled program.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .. import ops  # noqa: F401  (configures x64)
+import jax
+import jax.numpy as jnp
+
+from ..store.fault import FAILPOINTS
+from .ir import DAG
+from .jax_eval import JaxUnsupported, compile_expr
+
+#: chaos site: an armed action may raise JaxUnsupported to force the
+#: splitter to cut the fused region at an arbitrary executor boundary
+SPLIT_FAILPOINT = "copr/fusion_split"
+
+
+def fusion_enabled() -> bool:
+    """Whole-fragment fusion switch (TIDB_TPU_FUSION=0 restores the
+    per-tile dispatch loop — the bench's unfused comparator)."""
+    return os.environ.get("TIDB_TPU_FUSION", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# phase emitters: fragment phases emit into a shared tracing context
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RegionContext:
+    """The shared tracing context one fused program body emits into.
+
+    Phase emitters read/extend `cols` (the column environment) and AND
+    into `mask` (live-row mask); nothing dispatches — the caller jits the
+    composed body once per fragment shape class.
+    """
+
+    an: object                  # jax_engine._Analyzed of the fused region
+    cols: dict                  # col index -> (data, valid) device arrays
+    n: int                      # vector length (TILE or shard-local rows)
+    mask: object                # live-row bool vector
+    axis: Optional[str] = None  # collective axis under shard_map, else None
+    gofs: object = None         # global row offsets (mesh), else None
+    n_global: int = 0           # total rows across shards (argfirst sentinel)
+
+    def psum(self, x):
+        return jax.lax.psum(x, self.axis) if self.axis is not None else x
+
+
+def selection_mask(ctx: RegionContext):
+    """Emit the fused selection: AND every pushed condition into the
+    live-row mask (one fused elementwise program, no dispatch)."""
+    m = ctx.mask
+    for c in ctx.an.conds:
+        d, v = compile_expr(c, ctx.cols, ctx.n)
+        m = m & v & (d != 0)
+    ctx.mask = m
+    return m
+
+
+def dense_group_codes(ctx: RegionContext):
+    """Emit mixed-radix dense group codes; NULL key rows drop from the
+    mask (NULL keys are excluded by _Analyzed's dense-mode gate)."""
+    an = ctx.an
+    gidx = jnp.zeros(ctx.n, dtype=jnp.int64)
+    stride = 1
+    m = ctx.mask
+    for kcol, (klo, card) in zip(an.group_cols, an.group_card):
+        d, v = ctx.cols[kcol]
+        code = jnp.clip(d.astype(jnp.int64) - klo, 0, card - 1)
+        gidx = gidx + code * stride
+        m = m & v
+        stride *= card
+    ctx.mask = m
+    return gidx
+
+
+def dense_agg_results(ctx: RegionContext, gidx):
+    """Emit the dense segment reductions for every aggregate in the
+    region.  Under a mesh (`ctx.axis`) sum/count partials merge across
+    shards ON DEVICE via psum; min/max stay per-shard partials (the axon
+    TPU backend only lowers Sum all-reduces) and first_row emits global
+    row indices.  Per tile (`axis=None`) psum is the identity and
+    first_row emits tile-local argfirst indices — the exact layouts each
+    engine's host merge consumes.
+    """
+    from .jax_engine import _to_state_dtype
+
+    an = ctx.an
+    agg_ir = an.agg
+    G = an.num_groups
+    m = ctx.mask
+    gcount = ctx.psum(ops.masked_segment_count(gidx, m, G))
+    results = []
+    for a in agg_ir.aggs:
+        if a.name == "count":
+            if a.args:
+                d, v = compile_expr(a.args[0], ctx.cols, ctx.n)
+                results.append(
+                    ctx.psum(ops.masked_segment_count(gidx, m & v, G)))
+            else:
+                results.append(gcount)
+            continue
+        d, v = compile_expr(a.args[0], ctx.cols, ctx.n)
+        mv = m & v
+        if a.name in ("sum", "avg"):
+            st = a.partial_types()[0]
+            # NOTE: int64 accumulation measured FASTER than f64 on v5e
+            # (192ms vs 244ms Q1@64M in-process A/B) — keep the
+            # carry-chain emulation, it beats convert+f64 adds
+            dd = _to_state_dtype(d, a.args[0].ftype, st)
+            results.append((
+                ctx.psum(ops.masked_segment_sum(dd, gidx, mv, G)),
+                ctx.psum(ops.masked_segment_count(gidx, mv, G)),
+            ))
+        elif a.name == "min":
+            results.append((
+                ops.masked_segment_min(d, gidx, mv, G),
+                ctx.psum(ops.masked_segment_count(gidx, mv, G)),
+            ))
+        elif a.name == "max":
+            results.append((
+                ops.masked_segment_max(d, gidx, mv, G),
+                ctx.psum(ops.masked_segment_count(gidx, mv, G)),
+            ))
+        elif a.name == "first_row":
+            if ctx.gofs is not None:
+                # per-shard first GLOBAL row index (sentinel n_global when
+                # the shard has none); host takes the min across shards
+                contrib = jnp.where(mv, ctx.gofs, ctx.n_global)
+                results.append(ops.segment_min(contrib, gidx, G))
+            else:
+                results.append(ops.masked_segment_argfirst(gidx, mv, G))
+    return gcount, results
+
+
+def topn_key(ctx: RegionContext):
+    """Emit the TopN sort key with MySQL NULL ordering: first ascending,
+    last descending.  The sentinel stays distinguishable from masked-out
+    rows (masked_top_k uses -inf for those), so NULLs get a finite
+    extreme: -MAX asc (sorts first), -MAX desc (sorts last but still
+    beats masked rows)."""
+    key_expr, _desc = ctx.an.topn.order_by[0]
+    d, v = compile_expr(key_expr, ctx.cols, ctx.n)
+    key = d.astype(jnp.float64)
+    return jnp.where(v, key, -1.7e308)
+
+
+def projection_outputs(ctx: RegionContext):
+    """Emit the fused projection expressions (device-evaluated outputs)."""
+    return [compile_expr(p, ctx.cols, ctx.n) for p in ctx.an.proj_exprs]
+
+
+# ---------------------------------------------------------------------------
+# fusion regions: split a fragment at unfusable boundaries
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FusionPlan:
+    """One fragment's fused region plus its host tail."""
+
+    dag: DAG                      # scan + the fused executor prefix
+    an: object                    # its _Analyzed
+    tail: List = field(default_factory=list)  # host-run executor suffix
+    split_reason: Optional[str] = None        # why the region was cut
+
+
+def plan_regions(dag: DAG, table, max_cut: Optional[int] = None
+                 ) -> FusionPlan:
+    """Longest device-compilable executor prefix → fused region; the
+    suffix becomes the host tail (the per-phase fallback ladder).
+    Raises JaxUnsupported (with the first rejection's reason) when not
+    even the bare scan analyzes — the CPU interpreter owns those
+    fragments outright."""
+    from .jax_engine import _Analyzed
+
+    execs = dag.executors
+    hi = len(execs) if max_cut is None else min(max_cut, len(execs))
+    reason: Optional[str] = None
+    for cut in range(hi, 0, -1):
+        head, tail = execs[:cut], list(execs[cut:])
+        try:
+            if cut > 1:
+                # chaos: an armed action raises JaxUnsupported to force
+                # the split one boundary earlier
+                FAILPOINTS.hit(SPLIT_FAILPOINT, cut=cut,
+                               boundary=type(head[-1]).__name__)
+            sub = DAG(list(head))
+            an = _Analyzed(sub, table)
+        except JaxUnsupported as e:
+            if reason is None:
+                reason = str(e)
+            continue
+        if tail and (an.agg is not None or an.topn is not None
+                     or an.projection is not None):
+            # a host tail is only correct over SCAN-LAYOUT rows: partial
+            # agg / topn / projected output must not feed tail executors
+            # (their column indices address the scan layout, and a Limit
+            # over whole-table partials would drop groups) — keep
+            # peeling until the region is scan+selection shaped
+            continue
+        return FusionPlan(sub, an, tail,
+                          split_reason=reason if tail else None)
+    raise JaxUnsupported(reason or "no device-eligible fused region")
+
+
+def run_tail(dag: DAG, tail: List, chunks, aux=None):
+    """Interpret a host tail over the fused region's output chunks (the
+    CPU engine is the tail's executor).  Partial-agg tails stay partial —
+    the root executor merges, exactly as for an all-host region."""
+    from .cpu_engine import run_dag_on_chunk
+
+    if not tail:
+        return chunks
+    tail_dag = DAG([dag.scan] + list(tail))
+    out = []
+    for c in chunks:
+        r = run_dag_on_chunk(tail_dag, c, aux)
+        if r.num_rows:
+            out.append(r)
+    return out
+
+
+def run_fragment(table, dag: DAG, start: int, end: int, deleted,
+                 aux=None):
+    """Per-region fused execution with the fallback ladder: run the
+    largest region the per-tile engine accepts, stepping the split point
+    down one boundary per runtime JaxUnsupported; the host tail runs over
+    the region's output.  Raises JaxUnsupported only when no region
+    beyond the bare scan is device-eligible (the caller's CPU
+    interpreter is then strictly cheaper than a device scan-only pass).
+    """
+    from .jax_engine import run_base_jax
+
+    cut: Optional[int] = None
+    while True:
+        plan = plan_regions(dag, table, max_cut=cut)
+        if plan.tail and len(plan.dag.executors) == 1:
+            # a device scan-only region reduces nothing; the CPU
+            # interpreter over host blocks is strictly cheaper
+            raise JaxUnsupported(
+                plan.split_reason or "no device-eligible fused region")
+        try:
+            chunks = run_base_jax(table, plan.dag, start, end, deleted,
+                                  aux=aux, an=plan.an)
+            break
+        except JaxUnsupported:
+            if len(plan.dag.executors) == 1:
+                raise
+            cut = len(plan.dag.executors) - 1
+    if plan.tail:
+        from ..metrics import REGISTRY
+        from ..trace import annotate
+
+        REGISTRY.inc("fusion_splits_total")
+        annotate(fusion_split=type(plan.tail[0]).__name__)
+        chunks = run_tail(dag, plan.tail, chunks, aux)
+    return chunks
+
+
+# ---------------------------------------------------------------------------
+# kernelcheck registration: abstract-trace fused mesh fragments
+# ---------------------------------------------------------------------------
+
+
+def trace_fused_fragment(table, dag, n_ranges: int = 1):
+    """make_jaxpr for the whole-fragment MESH program over a 1-device
+    mesh (deterministic regardless of how many virtual devices the
+    harness exposes) — the fused-fragment corpus of lint.kernelcheck.
+    Raises JaxUnsupported when the fragment has no fused mesh form."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from . import jax_engine as je
+    from . import parallel as par
+
+    dag = DAG.from_dict(dag.to_dict())
+    an = je._Analyzed(dag, table)
+    kind = "agg" if an.agg is not None else (
+        "topn" if an.topn is not None else "filter")
+    col_order = an.needed_cols()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    core = par._build_mesh_core(an, kind, col_order, mesh,
+                                tiles_per_shard=1)
+    tile = je.TILE
+    datas, valids = [], []
+    from .jax_eval import _np_dtype_for
+
+    for ci in col_order:
+        meta = table.cols[an.scan.columns[ci]]
+        # the engine's own dtype mapping (raises JaxUnsupported for
+        # host-only columns), so the traced corpus can never green-light
+        # a shape class the production engine rejects
+        dt = np.dtype(_np_dtype_for(meta.ftype))
+        datas.append(np.zeros((1, tile), dtype=dt))
+        valids.append(np.ones((1, tile), dtype=np.bool_))
+    del_mask = np.ones((1, tile), dtype=np.bool_)
+    bounds = []
+    for r in range(par.MESH_RANGE_SLOTS):
+        if r < n_ranges:
+            bounds += [np.int64(r * 8), np.int64(r * 8 + 8)]
+        else:
+            bounds += [np.int64(0), np.int64(0)]
+    return jax.make_jaxpr(core)(
+        tuple(datas), tuple(valids), del_mask, tuple(bounds))

@@ -46,7 +46,7 @@ from .ir import (
     TopNIR,
     serialize_expr,
 )
-from .jax_eval import JaxUnsupported, _np_dtype_for, compile_expr
+from .jax_eval import JaxUnsupported, _np_dtype_for  # noqa: F401
 from .aggstate import finalize as agg_finalize
 
 import os as _os
@@ -463,118 +463,65 @@ def _agg_tags(agg_ir) -> List[str]:
 
 def _tile_core(an: _Analyzed, kind: str, col_order: List[int],
                with_params: bool = False):
-    """The raw (un-jitted) per-tile program.
+    """The raw (un-jitted) per-tile program, composed from the fusion
+    phase emitters (copr/fusion.py) so every pushed phase — filter,
+    project, agg, topN — emits into one shared tracing context and the
+    whole fragment is ONE program.
 
     Signature: fn(datas, valids, lo, hi, del_mask[, pi, pf]) — the pi/pf
     trailing args (hoisted predicate parameters, serving/params.py) are
     present only when `with_params`; the micro-batcher vmaps this same
     core over stacked parameter vectors.
     """
+    from . import fusion
+
     if an.lookups:
         # the broadcast lookup join runs in the mesh engine only; the
         # per-tile fallback hands these regions to the CPU interpreter
         raise JaxUnsupported("join lookup needs the mesh engine")
     n = TILE
 
-    def cols_env(datas, valids, params=None):
+    def region_ctx(datas, valids, lo, hi, del_mask, params):
         env = {
             ci: (datas[j], valids[j]) for j, ci in enumerate(col_order)
         }
-        if params is not None:
+        if with_params and params is not None:
             env["__params__"] = params
-        return env
-
-    def row_mask_of(lo, hi, del_mask):
         ar = jnp.arange(n, dtype=jnp.int64)
-        return (ar >= lo) & (ar < hi) & del_mask
-
-    def selected_mask(cols, row_mask):
-        m = row_mask
-        for c in an.conds:
-            d, v = compile_expr(c, cols, n)
-            m = m & v & (d != 0)
-        return m
+        ctx = fusion.RegionContext(
+            an=an, cols=env, n=n,
+            mask=(ar >= lo) & (ar < hi) & del_mask)
+        fusion.selection_mask(ctx)
+        return ctx
 
     if kind == "filter":
         def fn(datas, valids, lo, hi, del_mask, *params):
-            cols = cols_env(datas, valids, params if with_params else None)
-            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
+            ctx = region_ctx(datas, valids, lo, hi, del_mask, params)
             outs = None
             if an.proj_exprs is not None:
-                outs = [compile_expr(p, cols, n) for p in an.proj_exprs]
-            return m, outs
+                outs = fusion.projection_outputs(ctx)
+            return ctx.mask, outs
 
         return fn
 
     if kind == "agg":
-        agg_ir = an.agg
-        G = an.num_groups
-
         def fn(datas, valids, lo, hi, del_mask, *params):
-            cols = cols_env(datas, valids, params if with_params else None)
-            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
-            # mixed-radix group codes (NULL keys excluded by _Analyzed)
-            gidx = jnp.zeros(n, dtype=jnp.int64)
-            stride = 1
-            for kcol, (klo, card) in zip(an.group_cols, an.group_card):
-                d, v = cols[kcol]
-                code = jnp.clip(d.astype(jnp.int64) - klo, 0, card - 1)
-                gidx = gidx + code * stride
-                m = m & v
-                stride *= card
-            gcount = ops.masked_segment_count(gidx, m, G)
-            results = []
-            for a in agg_ir.aggs:
-                if a.name == "count":
-                    if a.args:
-                        d, v = compile_expr(a.args[0], cols, n)
-                        results.append(ops.masked_segment_count(gidx, m & v, G))
-                    else:
-                        results.append(gcount)
-                    continue
-                d, v = compile_expr(a.args[0], cols, n)
-                mv = m & v
-                if a.name in ("sum", "avg"):
-                    st = a.partial_types()[0]
-                    dd = _to_state_dtype(d, a.args[0].ftype, st)
-                    results.append(
-                        (ops.masked_segment_sum(dd, gidx, mv, G),
-                         ops.masked_segment_count(gidx, mv, G))
-                    )
-                elif a.name == "min":
-                    results.append(
-                        (ops.masked_segment_min(d, gidx, mv, G),
-                         ops.masked_segment_count(gidx, mv, G))
-                    )
-                elif a.name == "max":
-                    results.append(
-                        (ops.masked_segment_max(d, gidx, mv, G),
-                         ops.masked_segment_count(gidx, mv, G))
-                    )
-                elif a.name == "first_row":
-                    results.append(ops.masked_segment_argfirst(gidx, mv, G))
-            return gcount, results
+            ctx = region_ctx(datas, valids, lo, hi, del_mask, params)
+            gidx = fusion.dense_group_codes(ctx)
+            return fusion.dense_agg_results(ctx, gidx)
 
         return fn
 
     if kind == "topn":
         from ..serving import topn_budget
 
-        key_expr, desc = an.topn.order_by[0]
+        _e, desc = an.topn.order_by[0]
         k = min(topn_budget(an.topn.limit), TILE)
 
         def fn(datas, valids, lo, hi, del_mask, *params):
-            cols = cols_env(datas, valids, params if with_params else None)
-            m = selected_mask(cols, row_mask_of(lo, hi, del_mask))
-            d, v = compile_expr(key_expr, cols, n)
-            # MySQL NULL order: first ascending, last descending.  The
-            # sentinel must stay distinguishable from masked-out rows
-            # (masked_top_k uses -inf for those), so NULLs get a finite
-            # extreme: -MAX asc (sorts first), -MAX desc (sorts last but
-            # still beats masked rows).
-            key = d.astype(jnp.float64)
-            key = jnp.where(v, key, -1.7e308)
-            idx, cnt = ops.masked_top_k(key, m, k, desc)
+            ctx = region_ctx(datas, valids, lo, hi, del_mask, params)
+            key = fusion.topn_key(ctx)
+            idx, cnt = ops.masked_top_k(key, ctx.mask, k, desc)
             return idx, cnt
 
         return fn
@@ -642,10 +589,13 @@ def _tile_devices():
 
 
 def run_base_jax(table, dag: DAG, start: int, end: int,
-                 deleted: Sequence[int], aux=None) -> List[Chunk]:
+                 deleted: Sequence[int], aux=None, an=None) -> List[Chunk]:
     """Execute `dag` over base rows [start, end) on the device; returns
-    result chunks (partial-agg rows, topn rows, or filtered rows)."""
-    an = _Analyzed(dag, table)
+    result chunks (partial-agg rows, topn rows, or filtered rows).
+    `an` lets the fusion ladder pass its already-built analysis instead
+    of paying a second _Analyzed walk per cop task."""
+    if an is None:
+        an = _Analyzed(dag, table)
     if an.agg is not None and an.agg_mode != "dense":
         # sort-based grouping needs the mesh program (copr/parallel.py);
         # the per-tile fallback path hands these to the CPU engine
@@ -735,7 +685,7 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
 
         # first post-miss dispatch IS the XLA compile (jit compiles
         # lazily): label it so compile time lands in the compile phase
-        dspan = ("copr.compile" if compiled_now else "copr.execute")
+        dspan = ("copr.compile" if compiled_now else "copr.device.execute")
         dattr = {"cache": "miss"} if compiled_now else {}
         compiled_now = False
         if kind == "filter":

@@ -447,6 +447,36 @@ _COMPILED = ProgramCache("mesh")
 # Streaming / distsql stream.go: bounded-memory result consumption)
 STREAM_ROWS = 1 << 16
 
+#: range-bound parameter slots per fused mesh program: EVERY program
+#: takes this many (lo, hi) runtime scalars (unused slots are (0, 0),
+#: which mask to nothing), so a fragment's range COUNT never enters the
+#: program fingerprint — 1-range and 3-range scans of the same shape
+#: share one compiled program, and all ranges run in ONE XLA launch
+#: instead of one dispatch per range with host glue between them.
+MESH_RANGE_SLOTS = 4
+
+
+def _bounds_args(bounds):
+    """[(lo, hi), ...] -> the 2*MESH_RANGE_SLOTS runtime scalars the
+    fused program's range mask reads (pad slots are empty ranges)."""
+    out = []
+    for r in range(MESH_RANGE_SLOTS):
+        lo, hi = bounds[r] if r < len(bounds) else (0, 0)
+        out.append(jnp.int64(lo))
+        out.append(jnp.int64(hi))
+    return tuple(out)
+
+
+def _mesh_masks(del_mask, bounds, n_local: int):
+    """(global row offsets, live-row mask) for one shard: the union of
+    every range slot's [lo, hi) clip, ANDed with the deletion mask."""
+    shard = jax.lax.axis_index("dp").astype(jnp.int64)
+    gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
+    m = jnp.zeros(n_local, dtype=jnp.bool_)
+    for r in range(MESH_RANGE_SLOTS):
+        m = m | ((gofs >= bounds[2 * r]) & (gofs < bounds[2 * r + 1]))
+    return gofs, m & del_mask.reshape(n_local)
+
 
 def _key_device(d):
     """Device-side canonical join/group key: float keys stay in VALUE domain
@@ -552,7 +582,7 @@ def _packed_jit(fn):
     def call(*args):
         from ..trace import span
 
-        with span("copr.execute"):
+        with span("copr.device.execute"):
             out = jitted(*args)
         with span("copr.readback") as sp:
             buf = np.asarray(out)
@@ -574,130 +604,130 @@ def _packed_jit(fn):
     return call
 
 
-def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
-                   mesh: Mesh, tiles_per_shard: int, hoisted: bool = False):
-    """One shard_map program over the whole table.
+def _mesh_in_specs(an: _Analyzed, hoisted: bool):
+    """shard_map input specs shared by every fused mesh program: sharded
+    column/validity/deletion arrays, the replicated range-bound slots,
+    then the variadic parg tail."""
+    return (P("dp"), P("dp"), P("dp"),
+            tuple(P() for _ in range(2 * MESH_RANGE_SLOTS))
+            ) + _probe_specs(an, hoisted)
 
-    Inputs (pytree): datas [n_pad, TILE] x cols, valids likewise, del_mask
-    [n_pad, TILE], start/end scalars, then the variadic parg tail (probe
-    key sets, lookup payloads, and — when `hoisted` — the replicated
-    (pi, pf) predicate parameter vectors).  Each shard flattens its local
-    tiles to a [Tl*TILE] vector and runs the same fused program as the
-    per-tile engine; the partial/final agg merge is on-device collectives.
+
+def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
+                     mesh: Mesh, tiles_per_shard: int,
+                     hoisted: bool = False):
+    """The raw shard_map'd whole-fragment program (pre-jit).
+
+    One body per mesh: each shard flattens its local tiles to a
+    [Tl*TILE] vector, builds the union row mask over MESH_RANGE_SLOTS
+    range slots, and composes the fusion phase emitters
+    (copr/fusion.py) — selection, probes/lookups, dense agg or topN —
+    so the whole fragment is ONE program with the partial/final agg
+    merge on-device (psum over ICI).  Used by `_build_mesh_fn` (which
+    jits + packs it) and by kernelcheck's fused-fragment corpus
+    (jax.make_jaxpr over a 1-device mesh).
+
+    Signature: core(datas, valids, del_mask, bounds, *pargs) where
+    bounds is the 2*MESH_RANGE_SLOTS scalar tuple from _bounds_args.
     """
+    from . import fusion
+
     S = len(mesh.devices.ravel())
     Tl = tiles_per_shard
     n_local = Tl * je.TILE
     n_global = S * n_local
 
-    def cols_env(datas, valids, params=None):
-        return _cols_env(an, col_order, datas, valids, n_local, params)
-
-    def masks(del_mask, start, end):
-        shard = jax.lax.axis_index("dp").astype(jnp.int64)
-        gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
-        row_mask = (gofs >= start) & (gofs < end) & del_mask.reshape(n_local)
-        return gofs, row_mask
-
-    def selected(cols, row_mask, pargs=()):
-        m = row_mask
-        for c in an.conds:
-            d, v = compile_expr(c, cols, n_local)
-            m = m & v & (d != 0)
-        return _apply_probes(an, cols, m, pargs, n_local)
-
     if kind == "agg" and an.agg_mode == "sort":
-        return _build_sort_agg_fn(an, col_order, mesh, tiles_per_shard,
-                                  hoisted=hoisted)
+        return _build_sort_agg_core(an, col_order, mesh, tiles_per_shard,
+                                    hoisted=hoisted)
+
+    def region_ctx(datas, valids, del_mask, bounds, pargs):
+        pargs, params = _split_hoisted(pargs, hoisted)
+        cols = _cols_env(an, col_order, datas, valids, n_local, params)
+        gofs, row_mask = _mesh_masks(del_mask, bounds, n_local)
+        ctx = fusion.RegionContext(an=an, cols=cols, n=n_local,
+                                   mask=row_mask, axis="dp", gofs=gofs,
+                                   n_global=n_global)
+        fusion.selection_mask(ctx)
+        ctx.mask = _apply_probes(an, cols, ctx.mask, pargs, n_local)
+        return ctx
 
     if kind == "agg":
-        agg_ir = an.agg
-        G = an.num_groups
-        tags = je._agg_tags(agg_ir)
-
-        def shard_fn(datas, valids, del_mask, start, end, *pargs):
-            pargs, params = _split_hoisted(pargs, hoisted)
-            cols = cols_env(datas, valids, params)
-            gofs, row_mask = masks(del_mask, start, end)
-            m = selected(cols, row_mask, pargs)
-            gidx = jnp.zeros(n_local, dtype=jnp.int64)
-            stride = 1
-            for kcol, (klo, card) in zip(an.group_cols, an.group_card):
-                d, v = cols[kcol]
-                code = jnp.clip(d.astype(jnp.int64) - klo, 0, card - 1)
-                gidx = gidx + code * stride
-                m = m & v
-                stride *= card
-            gcount = jax.lax.psum(
-                ops.masked_segment_count(gidx, m, G), "dp"
-            )
-            results = []
-            for ai, a in enumerate(agg_ir.aggs):
-                if a.name == "count":
-                    if a.args:
-                        d, v = compile_expr(a.args[0], cols, n_local)
-                        results.append(jax.lax.psum(
-                            ops.masked_segment_count(gidx, m & v, G), "dp"
-                        ))
-                    else:
-                        results.append(gcount)
-                    continue
-                d, v = compile_expr(a.args[0], cols, n_local)
-                mv = m & v
-                if a.name in ("sum", "avg"):
-                    st = a.partial_types()[0]
-                    # NOTE: int64 accumulation measured FASTER than f64 on
-                    # v5e (192ms vs 244ms Q1@64M in-process A/B) — keep
-                    # the carry-chain emulation, it beats convert+f64 adds
-                    dd = _to_state_dtype(d, a.args[0].ftype, st)
-                    results.append((
-                        jax.lax.psum(ops.masked_segment_sum(dd, gidx, mv, G), "dp"),
-                        jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
-                    ))
-                elif a.name == "min":
-                    # per-shard partial: the axon TPU compiler only lowers
-                    # Sum all-reduces ("Supported lowering only of Sum all
-                    # reduce"), so min/max merge across shards on the host
-                    # ([S, G] is tiny) — the reference's partial/final agg
-                    # split (aggregate.go:101-169) with the final on root
-                    results.append((
-                        ops.masked_segment_min(d, gidx, mv, G),
-                        jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
-                    ))
-                elif a.name == "max":
-                    results.append((
-                        ops.masked_segment_max(d, gidx, mv, G),
-                        jax.lax.psum(ops.masked_segment_count(gidx, mv, G), "dp"),
-                    ))
-                elif a.name == "first_row":
-                    # per-shard first row index (sentinel n_global when the
-                    # shard has none); host takes the min across shards
-                    contrib = jnp.where(mv, gofs, n_global)
-                    results.append(ops.segment_min(contrib, gidx, G))
+        def shard_fn(datas, valids, del_mask, bounds, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+            gidx = fusion.dense_group_codes(ctx)
+            gcount, results = fusion.dense_agg_results(ctx, gidx)
             return gcount, tuple(results)
 
         out_results = []
-        for a in agg_ir.aggs:
+        for a in an.agg.aggs:
             if a.name == "count":
                 out_results.append(P())
             elif a.name in ("sum", "avg"):
                 out_results.append((P(), P()))
             elif a.name in ("min", "max"):
-                out_results.append((P("dp"), P()))  # sharded partial, psum'd count
+                # per-shard partial: the axon TPU compiler only lowers
+                # Sum all-reduces, so min/max merge across shards on the
+                # host ([S, G] is tiny) — the reference's partial/final
+                # agg split (aggregate.go:101-169) with the final on root
+                out_results.append((P("dp"), P()))
             else:
                 out_results.append(P("dp"))
-        fn = shard_map(
-            shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
-            + _probe_specs(an, hoisted),
-            out_specs=(P(), tuple(out_results)),
-        )
-        packed = _packed_jit(fn)
+        out_specs = (P(), tuple(out_results))
+    elif kind == "topn":
+        from ..serving import topn_budget
 
-        def wrapped(datas, valids, del_mask, start, end, pargs=()):
+        _e, desc = an.topn.order_by[0]
+        k = min(topn_budget(an.topn.limit), n_local)
+
+        def shard_fn(datas, valids, del_mask, bounds, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+            key = fusion.topn_key(ctx)
+            idx, cnt = ops.masked_top_k(key, ctx.mask, k, desc)
+            return ctx.gofs[idx], cnt.reshape(1)
+
+        out_specs = P("dp")
+    else:  # filter: the fused selection mask (projection reads it later)
+        def shard_fn(datas, valids, del_mask, bounds, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+            return ctx.mask
+
+        out_specs = P("dp")
+
+    return shard_map(shard_fn, mesh=mesh,
+                     in_specs=_mesh_in_specs(an, hoisted),
+                     out_specs=out_specs)
+
+
+def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
+                   mesh: Mesh, tiles_per_shard: int, hoisted: bool = False):
+    """One jitted shard_map program over the whole fragment.
+
+    Inputs: datas [n_pad, TILE] x cols, valids likewise, del_mask
+    [n_pad, TILE], the range-bound list (padded to MESH_RANGE_SLOTS
+    runtime scalars), then the variadic parg tail (probe key sets,
+    lookup payloads, and — when `hoisted` — the replicated (pi, pf)
+    predicate parameter vectors).  Every range of a steady-state
+    fragment runs in this ONE dispatch; intermediates never leave HBM.
+    """
+    S = len(mesh.devices.ravel())
+    n_local = tiles_per_shard * je.TILE
+    core = _build_mesh_core(an, kind, col_order, mesh, tiles_per_shard,
+                            hoisted=hoisted)
+
+    if kind == "agg" and an.agg_mode == "sort":
+        return _wrap_sort_agg(an, core, S, n_local)
+
+    if kind == "agg":
+        agg_ir = an.agg
+        G = an.num_groups
+        tags = je._agg_tags(agg_ir)
+        packed = _packed_jit(core)
+
+        def wrapped(datas, valids, del_mask, bounds, pargs=()):
             gcount, results = packed(
                 tuple(datas), tuple(valids), del_mask,
-                jnp.int64(start), jnp.int64(end), *pargs,
+                _bounds_args(bounds), *pargs,
             )
             merged = []
             for tag, r in zip(tags, results):
@@ -718,32 +748,13 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     if kind == "topn":
         from ..serving import topn_budget
 
-        key_expr, desc = an.topn.order_by[0]
         k = min(topn_budget(an.topn.limit), n_local)
+        packed = _packed_jit(core)
 
-        def shard_fn(datas, valids, del_mask, start, end, *pargs):
-            pargs, params = _split_hoisted(pargs, hoisted)
-            cols = cols_env(datas, valids, params)
-            gofs, row_mask = masks(del_mask, start, end)
-            m = selected(cols, row_mask, pargs)
-            d, v = compile_expr(key_expr, cols, n_local)
-            key = d.astype(jnp.float64)
-            key = jnp.where(v, key, -1.7e308)  # NULL ordering (see jax_engine)
-            idx, cnt = ops.masked_top_k(key, m, k, desc)
-            return gofs[idx], cnt.reshape(1)
-
-        fn = shard_map(
-            shard_fn, mesh=mesh,
-            in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
-            + _probe_specs(an, hoisted),
-            out_specs=P("dp"),
-        )
-        packed = _packed_jit(fn)
-
-        def wrapped(datas, valids, del_mask, start, end, pargs=()):
+        def wrapped(datas, valids, del_mask, bounds, pargs=()):
             gidx, cnt = packed(
                 tuple(datas), tuple(valids), del_mask,
-                jnp.int64(start), jnp.int64(end), *pargs,
+                _bounds_args(bounds), *pargs,
             )
             return gidx, cnt, k
         return wrapped
@@ -751,30 +762,18 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
     # filter (with optional projection evaluated on device).  The mask comes
     # back bit-packed: the tunnel's d2h bandwidth is low (~30MB/s measured),
     # so 1 bit/row instead of 1 byte/row is an 8x cheaper readback.
-    def shard_fn(datas, valids, del_mask, start, end, *pargs):
-        pargs, params = _split_hoisted(pargs, hoisted)
-        cols = cols_env(datas, valids, params)
-        _, row_mask = masks(del_mask, start, end)
-        return selected(cols, row_mask, pargs)
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
-        + _probe_specs(an, hoisted),
-        out_specs=P("dp"),
-    )
     jitted = jax.jit(
-        lambda *a: jnp.packbits(fn(*a).astype(jnp.uint8))
+        lambda *a: jnp.packbits(core(*a).astype(jnp.uint8))
     )
 
-    def wrapped(datas, valids, del_mask, start, end, pargs=()):
+    def wrapped(datas, valids, del_mask, bounds, pargs=()):
         from ..trace import span
 
         n_rows = S * n_local
-        with span("copr.execute"):
+        with span("copr.device.execute"):
             out = jitted(
                 tuple(datas), tuple(valids), del_mask,
-                jnp.int64(start), jnp.int64(end), *pargs,
+                _bounds_args(bounds), *pargs,
             )
         with span("copr.readback") as sp:
             bits = np.asarray(out)
@@ -831,10 +830,10 @@ def _fd_sort_lookup(an: _Analyzed):
     return True
 
 
-def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
-                       tiles_per_shard: int, hoisted: bool = False):
+def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
+                         tiles_per_shard: int, hoisted: bool = False):
     """Sort-based per-shard partial aggregation for arbitrary group keys
-    (any NDV, float, NULLable, expression keys).
+    (any NDV, float, NULLable, expression keys) — the shard_map'd core.
 
     Per shard: lexsort rows by (key bits..., null flags..., selected-last),
     mark group boundaries, segment-reduce into a static OUT-sized budget,
@@ -845,6 +844,8 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
     """
     import os as _os
 
+    from . import fusion
+
     S = len(mesh.devices.ravel())
     Tl = tiles_per_shard
     n_local = Tl * je.TILE
@@ -852,21 +853,15 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
     OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
     agg_ir = an.agg
     fd_lookup = _fd_sort_lookup(an)
-    tags = je._agg_tags(agg_ir)
 
-    def cols_env(datas, valids, params=None):
-        return _cols_env(an, col_order, datas, valids, n_local, params)
-
-    def shard_fn(datas, valids, del_mask, start, end, *pargs):
+    def shard_fn(datas, valids, del_mask, bounds, *pargs):
         pargs, params = _split_hoisted(pargs, hoisted)
-        cols = cols_env(datas, valids, params)
-        shard = jax.lax.axis_index("dp").astype(jnp.int64)
-        gofs = shard * n_local + jnp.arange(n_local, dtype=jnp.int64)
-        m = (gofs >= start) & (gofs < end) & del_mask.reshape(n_local)
-        for c in an.conds:
-            d, v = compile_expr(c, cols, n_local)
-            m = m & v & (d != 0)
-        m = _apply_probes(an, cols, m, pargs, n_local)
+        cols = _cols_env(an, col_order, datas, valids, n_local, params)
+        gofs, m = _mesh_masks(del_mask, bounds, n_local)
+        ctx = fusion.RegionContext(an=an, cols=cols, n=n_local, mask=m,
+                                   axis="dp", gofs=gofs, n_global=n_global)
+        fusion.selection_mask(ctx)
+        m = _apply_probes(an, cols, ctx.mask, pargs, n_local)
         key_bits, key_flags = [], []
         for g in agg_ir.group_by:
             d, v = compile_expr(g, cols, n_local)
@@ -948,18 +943,22 @@ def _build_sort_agg_fn(an: _Analyzed, col_order: List[int], mesh: Mesh,
                 )
         return n_uniq.reshape(1), out_keys, tuple(results)
 
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P(), P())
-        + _probe_specs(an, hoisted),
-        out_specs=P("dp"),
-    )
-    packed = _packed_jit(fn)
+    return shard_map(shard_fn, mesh=mesh,
+                     in_specs=_mesh_in_specs(an, hoisted),
+                     out_specs=P("dp"))
 
-    def wrapped(datas, valids, del_mask, start, end, pargs=()):
+
+def _wrap_sort_agg(an: _Analyzed, core, S: int, n_local: int):
+    import os as _os
+
+    OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
+    tags = je._agg_tags(an.agg)
+    packed = _packed_jit(core)
+
+    def wrapped(datas, valids, del_mask, bounds, pargs=()):
         n_uniq, keys, results = packed(
             tuple(datas), tuple(valids), del_mask,
-            jnp.int64(start), jnp.int64(end), *pargs,
+            _bounds_args(bounds), *pargs,
         )
         return {
             "mode": "sort",
@@ -1220,14 +1219,28 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
     if table.base_rows == 0 or table.base_ts > req.ts:
         req.mesh_reject_reason = "empty table or stale snapshot"
         return None
-    if len(req.ranges) > 4:
+    if len(req.ranges) > MESH_RANGE_SLOTS:
         req.mesh_reject_reason = f"{len(req.ranges)} disjoint ranges"
         return None  # many disjoint ranges: per-region fan-out handles it
+    from .fusion import fusion_enabled, plan_regions, run_tail
+
+    if not fusion_enabled():
+        req.mesh_reject_reason = "whole-fragment fusion disabled"
+        return None
+    # fusion-region planning (copr/fusion.py): the longest device-
+    # compilable executor prefix becomes the fused mesh program; an
+    # unfusable suffix runs as a host tail over the region's output
+    # instead of rejecting the whole fragment off the mesh path
     try:
-        an = _Analyzed(dag, table)
+        plan = plan_regions(dag, table)
     except JaxUnsupported as e:
         req.mesh_reject_reason = str(e)
         return None
+    if plan.tail and len(plan.dag.executors) == 1:
+        req.mesh_reject_reason = (
+            plan.split_reason or "fragment not device-eligible")
+        return None
+    an, tail = plan.an, plan.tail
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
     )
@@ -1361,36 +1374,44 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
 
     REGISTRY.inc("mesh_scans_total")
 
+    # every requested range runs in ONE fused dispatch: clip the bounds
+    # host-side and hand them to the program's range slots — no per-range
+    # dispatch loop, no host glue between ranges
+    bounds = []
+    for kr in req.ranges:
+        lo, hi = max(kr.start, 0), min(kr.end, table.base_rows)
+        if lo < hi:
+            bounds.append((lo, hi))
+
     if kind == "filter":
         # large filter outputs STREAM: the generator gathers selected rows
         # in STREAM_ROWS slices as the consumer drains the bounded queue,
         # so peak host memory no longer scales with the selected row count
         return _stream_filter(req, table, an, fn, datas, valids, del_mask,
-                              inserted, pargs, mesh_ids=mesh_ids)
+                              inserted, pargs, mesh_ids=mesh_ids,
+                              bounds=bounds, tail=tail, dag=dag)
 
     from ..lifecycle import scope_check
 
     chunks: List[Chunk] = []
     agg_accum = None
     topn_parts: List[Chunk] = []
-    remaining = an.limit
-    for kr in req.ranges:
-        # cancellation seam between shard_map dispatches (a dispatch in
-        # flight runs to completion; the next range must not start)
+    if bounds:
+        # cancellation seam around the single fused dispatch (a dispatch
+        # in flight runs to completion; an expired statement must not
+        # proceed to the host merge)
         scope_check()
-        start = max(kr.start, 0)
-        end = min(kr.end, table.base_rows)
-        if start >= end:
-            continue
         # deterministic mid-scan fault injection: the chaos harness kills
-        # virtual device k / exhausts HBM exactly here, between ranges
+        # virtual device k / exhausts HBM exactly here, pre-dispatch
         FAILPOINTS.hit("mesh/device_error", kind=kind,
-                       device_ids=mesh_ids, start=start, end=end)
-        FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=start, end=end)
+                       device_ids=mesh_ids, start=bounds[0][0],
+                       end=bounds[-1][1])
+        FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=bounds[0][0],
+                       end=bounds[-1][1])
         if kind == "agg" and an.agg_mode == "sort":
             try:
                 with DISPATCH_LOCK:
-                    out = fn(datas, valids, del_mask, start, end, pargs)
+                    out = fn(datas, valids, del_mask, bounds, pargs)
                 chunks.extend(_sort_agg_chunks(out, table, an))
             except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
@@ -1399,7 +1420,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
                 return None
         elif kind == "agg":
             with DISPATCH_LOCK:
-                gcount, results = fn(datas, valids, del_mask, start, end,
+                gcount, results = fn(datas, valids, del_mask, bounds,
                                      pargs)
             # wrapped() already unpacked to numpy and merged shard partials
             agg_accum = _merge_mesh_agg(
@@ -1407,8 +1428,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
             )
         elif kind == "topn":
             with DISPATCH_LOCK:
-                gidx, cnts, k = fn(datas, valids, del_mask, start, end,
-                                   pargs)
+                gidx, cnts, k = fn(datas, valids, del_mask, bounds, pargs)
             picks = []
             for s in range(S):
                 c = int(cnts[s])
@@ -1419,6 +1439,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
                 topn_parts.append(
                     table.gather_chunk(list(an.scan.columns), handles)
                 )
+        scope_check()  # post-dispatch seam: expired statements stop here
 
     # delta rows (committed inserts/updates) go through the CPU engine
     res = _delta_chunk(req, dag, an, inserted)
@@ -1449,29 +1470,32 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
 
 
 def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
-                   pargs=(), mesh_ids=()):
-    """Generator over a mesh filter's result chunks: one bit-packed mask
-    readback per range, then STREAM_ROWS-sized host gathers on demand
-    (distsql/stream.go:33-124; kv.Request.Streaming kv/kv.go:270)."""
+                   pargs=(), mesh_ids=(), bounds=(), tail=None, dag=None):
+    """Generator over a mesh filter's result chunks: ONE fused bit-packed
+    mask dispatch covering every range, then STREAM_ROWS-sized host
+    gathers on demand (distsql/stream.go:33-124; kv.Request.Streaming
+    kv/kv.go:270).  When the fusion splitter peeled a host tail off the
+    fragment, each streamed scan-layout chunk runs the tail through the
+    CPU interpreter before it is yielded (copr/fusion.py ladder)."""
     from ..lifecycle import scope_check
     from ..metrics import REGISTRY
+    from .fusion import run_tail
 
     remaining = an.limit
-    for kr in req.ranges:
-        scope_check()  # between mask dispatches
-        start = max(kr.start, 0)
-        end = min(kr.end, table.base_rows)
-        if start >= end:
-            continue
+    if bounds:
+        scope_check()  # seam before the fused dispatch
         FAILPOINTS.hit("mesh/device_error", kind="filter",
-                       device_ids=mesh_ids, start=start, end=end)
-        FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=start, end=end)
+                       device_ids=mesh_ids, start=bounds[0][0],
+                       end=bounds[-1][1])
+        FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=bounds[0][0],
+                       end=bounds[-1][1])
         with DISPATCH_LOCK:
-            mask = fn(datas, valids, del_mask, start, end, pargs)
+            mask = fn(datas, valids, del_mask, bounds, pargs)
         handles = np.flatnonzero(mask)
         if remaining is not None:
             handles = handles[:remaining]
-            remaining -= len(handles)
+        if tail:
+            REGISTRY.inc("fusion_splits_total")
         for off in range(0, len(handles), STREAM_ROWS):
             scope_check()  # between streamed host gathers
             sub = handles[off: off + STREAM_ROWS]
@@ -1483,11 +1507,13 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
                     _eval_to_column(p, chunk)
                     for p in an.projection.exprs
                 ])
+            if tail:
+                for tc in run_tail(dag, tail, [chunk], req.aux):
+                    REGISTRY.inc("mesh_stream_chunks_total")
+                    yield tc
+                continue
             REGISTRY.inc("mesh_stream_chunks_total")
             yield chunk
-        if remaining is not None and remaining <= 0:
-            DEVICE_HEALTH.record_success(mesh_ids)
-            return
     DEVICE_HEALTH.record_success(mesh_ids)
     res = _delta_chunk(req, None, an, inserted)
     if res is not None:

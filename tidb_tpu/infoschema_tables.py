@@ -123,7 +123,8 @@ def _processlist(domain, isc):
     ("compile_hits", ty_int()), ("compile_misses", ty_int()),
     ("transfer_bytes", ty_int()), ("device_ms", ty_float()),
     ("readback_ms", ty_float()), ("readback_bytes", ty_int()),
-    ("backoff_ms", ty_float()), ("cop_tasks", ty_int()),
+    ("backoff_ms", ty_float()), ("backfill_ms", ty_float()),
+    ("cop_tasks", ty_int()),
     ("engines", ty_string()), ("devices", ty_string()),
     ("rows", ty_int()), ("termination", ty_string()),
     ("query", ty_string()),

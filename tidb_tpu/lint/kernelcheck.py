@@ -64,6 +64,30 @@ MPP_EXCHANGE_KERNELS = ("mpp-shuffle-join", "mpp-broadcast-join")
 VMAP_BATCH_KERNEL = "serving-vmapped-batch"
 VMAP_BATCH_B = 4
 
+#: whole-fragment fused MESH programs (copr/fusion.py emitters composed
+#: by parallel._build_mesh_core, traced over a 1-device mesh): one entry
+#: per fused shape class.  Each traces the ENTIRE fragment — scan masks
+#: over the range slots, fused selection, dense/sort agg or topN — as
+#: ONE program, guarding int64-emulation chains per shape class.
+FUSED_FRAGMENT_KERNELS = [
+    ("fused-mesh-dense-agg",
+     "select l_returnflag, l_linestatus, sum(l_quantity),"
+     " sum(l_extendedprice * (1 - l_discount)), avg(l_discount), count(*)"
+     " from lineitem where l_shipdate <= '1998-09-02'"
+     " group by l_returnflag, l_linestatus"),
+    ("fused-mesh-scalar-agg",
+     "select sum(l_extendedprice * l_discount) from lineitem"
+     " where l_discount between 0.05 and 0.07 and l_quantity < 24"),
+    ("fused-mesh-sort-agg",
+     "select l_discount, count(*), sum(l_quantity) from lineitem"
+     " group by l_discount"),
+    ("fused-mesh-filter",
+     "select l_orderkey, l_quantity from lineitem where l_quantity < 10"),
+    ("fused-mesh-topn",
+     "select l_orderkey from lineitem order by l_extendedprice desc"
+     " limit 5"),
+]
+
 
 def _iter_eqns(jaxpr):
     """All equations including nested call/pjit sub-jaxprs.  shard_map
@@ -305,6 +329,53 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"int64 equation count grew {base.get('i64_eqns')} -> "
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
+
+    # -- whole-fragment fused mesh programs -----------------------------
+    from ..copr.fusion import trace_fused_fragment
+
+    for name, sql in FUSED_FRAGMENT_KERNELS:
+        try:
+            phys = s._plan(parse_one(sql))
+            stats = None
+            for _p, dag in _reader_dags(phys):
+                try:
+                    stats = _jaxpr_stats(trace_fused_fragment(table, dag))
+                except JaxUnsupported:
+                    continue
+                if name == "fused-mesh-scalar-agg":
+                    # region-boundary signature guard: the range-bound
+                    # SLOTS are runtime scalars, so a 3-range fragment
+                    # must trace to the identical program as a 1-range
+                    # one — any divergence means range layout leaked
+                    # into the compiled shape (a recompile per range set)
+                    multi = _jaxpr_stats(
+                        trace_fused_fragment(table, dag, n_ranges=3))
+                    if multi != stats:
+                        emit(name,
+                             f"range count changed the fused program's "
+                             f"jaxpr ({stats} vs {multi}) — range bounds "
+                             "must stay runtime data, not program shape")
+                break
+            if stats is None:
+                emit(name, "no fused mesh form for canonical fragment — "
+                           "whole-fragment fusion coverage regressed")
+                continue
+        except Exception as e:  # noqa: BLE001 — contract break
+            emit(name, f"fused fragment trace failed: "
+                       f"{type(e).__name__}: {e}")
+            continue
+        if collect_stats is not None:
+            collect_stats[name] = stats
+            continue
+        base = baseline_kernels.get(name)
+        if base is None:
+            emit(name, f"kernel not in baseline (measured {stats}); run "
+                       "python -m tidb_tpu.lint --update-baseline")
+        elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+            emit(name,
+                 f"int64 equation count grew {base.get('i64_eqns')} -> "
+                 f"{stats['i64_eqns']}: an int64-emulation chain was "
+                 "reintroduced into the fused fragment program")
 
     # -- micro-batch vmapped padded-batch kernel ------------------------
     name = VMAP_BATCH_KERNEL

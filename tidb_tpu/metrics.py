@@ -33,6 +33,11 @@ class Registry:
         with self._mu:
             self._counters[name] = value
 
+    def get(self, name: str, default: float = 0.0) -> float:
+        """Point read of one counter/gauge (cheaper than snapshot())."""
+        with self._mu:
+            return self._counters.get(name, default)
+
     def snapshot(self) -> Dict[str, float]:
         with self._mu:
             return dict(self._counters)

@@ -49,7 +49,9 @@ from ..copr.device_health import classify_failure
 from ..copr.jax_engine import _Analyzed, _fingerprint, _to_state_dtype
 from ..copr.jax_eval import JaxUnsupported, compile_expr
 from ..copr.parallel import (
+    MESH_RANGE_SLOTS,
     _all_true,
+    _bounds_args,
     _cols_env,
     _handle_mesh_failure,
     _layout,
@@ -149,7 +151,7 @@ class _SideState:
         self.deleted = deleted
         if any(kr.table_id != side.table_id for kr in side.ranges):
             raise MPPIneligible("partitioned ranges")
-        if len(side.ranges) > 4:
+        if len(side.ranges) > MESH_RANGE_SLOTS:
             raise MPPIneligible(f"{len(side.ranges)} disjoint ranges")
         dag = DAG.from_dict(side.dag)
         try:
@@ -237,9 +239,12 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
     # device arrays (and their table stores) against any cache eviction
     p_order, b_order = list(ps.col_order), list(bs.col_order)
     p_key_pos, b_key_pos = ps.side.key_pos, bs.side.key_pos
-    p_prep = _shard_side(p_an, p_order, ps.n_local, len(ps.bounds))
-    b_prep = _shard_side(b_an, b_order, bs.n_local, len(bs.bounds))
-    n_pb, n_bb = len(ps.bounds), len(bs.bounds)
+    # range bounds ride in MESH_RANGE_SLOTS runtime scalar slots per
+    # side (pad slots are empty ranges), so the range COUNT never enters
+    # the fused program's fingerprint — same policy as the mesh scan
+    p_prep = _shard_side(p_an, p_order, ps.n_local, MESH_RANGE_SLOTS)
+    b_prep = _shard_side(b_an, b_order, bs.n_local, MESH_RANGE_SLOTS)
+    n_pb = n_bb = MESH_RANGE_SLOTS
     louter = spec.kind == "left_outer"
     n_out = S * cap_p if mode == "shuffle" else ps.n_local
     aggs = spec.aggs
@@ -487,9 +492,9 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     fp = (f"mpp|{mode}|{spec.kind}|pil={spec.probe_is_left}"
           f"|S={S} devs={mesh_ids} caps={cap_p},{cap_b}"
           f"|p:{_fingerprint(ps.an, 'filter')}|Tl={ps.Tl}"
-          f"|k={spec.probe.key_pos}|wire={ps.wire_sig}|R={len(ps.bounds)}"
+          f"|k={spec.probe.key_pos}|wire={ps.wire_sig}"
           f"|b:{_fingerprint(bs.an, 'filter')}|Tl={bs.Tl}"
-          f"|k={spec.build.key_pos}|wire={bs.wire_sig}|R={len(bs.bounds)}"
+          f"|k={spec.build.key_pos}|wire={bs.wire_sig}"
           f"|aggs={agg_sig}")
     fn = _COMPILED.get(fp)
     if fn is None:
@@ -502,11 +507,8 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
                    kind=spec.kind)
 
     def bounds_args(st: _SideState):
-        out = []
-        for lo, hi in st.bounds:
-            out.append(jnp.int64(lo))
-            out.append(jnp.int64(hi))
-        return tuple(out)
+        # the mesh scan's slot padding, verbatim (one pad policy)
+        return _bounds_args(st.bounds)
 
     from ..copr.parallel import DISPATCH_LOCK
 

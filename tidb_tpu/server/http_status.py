@@ -70,6 +70,8 @@ class StatusServer:
                                 "device_ms": round(tot["device_ms"], 3),
                                 "readback_ms": round(tot["readback_ms"], 3),
                                 "backoff_ms": round(tot["backoff_ms"], 3),
+                                "backfill_ms": round(
+                                    tot.get("backfill_ms", 0.0), 3),
                                 "wire_bytes": tot["wire_bytes"],
                                 "engines": tot["engines"],
                             })

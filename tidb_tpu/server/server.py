@@ -301,6 +301,11 @@ class MySQLServer:
                 return
             t0 = _time.perf_counter_ns()
             self._queued += 1
+            # live queue-depth gauge: the serving layer's ADAPTIVE
+            # micro-batch window reads this to widen under pressure
+            # (queued statements = batching opportunity) and shrink
+            # back when the queue drains
+            REGISTRY.set("admission_queue_depth", float(self._queued))
             try:
                 await asyncio.wait_for(sem.acquire(),
                                        timeout=self.queue_deadline_s)
@@ -311,6 +316,7 @@ class MySQLServer:
                 return
             finally:
                 self._queued -= 1
+                REGISTRY.set("admission_queue_depth", float(self._queued))
             wait_ns = _time.perf_counter_ns() - t0
             REGISTRY.observe("admission_wait_ms", wait_ns / 1e6)
         try:

@@ -88,6 +88,34 @@ def microbatch_window_s() -> float:
     return float(_CONFIG["microbatch_window_ms"]) / 1000.0
 
 
+#: adaptive-window shape: idle servers halve the configured window (a
+#: lone statement should not sit out a pointless wait), pressure widens
+#: it linearly with admission-queue depth (queued statements ARE the
+#: batching opportunity) up to this cap
+ADAPTIVE_MAX_FACTOR = 8.0
+ADAPTIVE_IDLE_FACTOR = 0.5
+
+
+def effective_window_s() -> float:
+    """The ADAPTIVE micro-batch window: `tidb_tpu_microbatch_window_ms`
+    scaled by live admission-queue pressure (the gauge the server's
+    bounded admission maintains).  depth 0 → half the base window;
+    each queued statement adds half a base window, capped at
+    ADAPTIVE_MAX_FACTOR.  The effective value is published as the
+    `serving_effective_window_ms` gauge on /metrics."""
+    base = microbatch_window_s()
+    if base <= 0.0:
+        return 0.0
+    from ..metrics import REGISTRY
+
+    depth = REGISTRY.get("admission_queue_depth")
+    factor = (ADAPTIVE_IDLE_FACTOR if depth <= 0
+              else min(1.0 + depth / 2.0, ADAPTIVE_MAX_FACTOR))
+    w = base * factor
+    REGISTRY.set("serving_effective_window_ms", w * 1000.0)
+    return w
+
+
 def microbatch_max() -> int:
     return int(_CONFIG["microbatch_max"])
 

@@ -55,12 +55,14 @@ class _Member:
     """One waiting statement's slot in a batch."""
 
     __slots__ = ("pi", "pf", "scope", "event", "result", "error",
-                 "batch_size", "wait_ns")
+                 "batch_size", "wait_ns", "limit")
 
-    def __init__(self, pi: np.ndarray, pf: np.ndarray, scope):
+    def __init__(self, pi: np.ndarray, pf: np.ndarray, scope,
+                 limit: Optional[int] = None):
         self.pi = pi
         self.pf = pf
         self.scope = scope
+        self.limit = limit
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -213,7 +215,10 @@ def _run_batch(ctx: dict, live: List[_Member]):
     accums: List[Optional[dict]] = [None] * B
     handles: List[List[np.ndarray]] = [[] for _ in range(B)]
     counts = [0] * B
-    limit = an.limit
+    # per-member LIMITs: the batch key buckets the limit CLASS (pow2) so
+    # `LIMIT 5` and `LIMIT 7` filters share a batch; each member's exact
+    # limit applies to its own slot here and at result-slice time
+    limits = [m.limit for m in live]
     TILE = je.TILE
 
     done = False
@@ -242,7 +247,7 @@ def _run_batch(ctx: dict, live: List[_Member]):
             hi = np.int64(t1 - tile_start)
             del_mask = je._all_true(None)  # batch eligibility => no deletes
             FAILPOINTS.hit("serving/batch_dispatch", size=B, tile=tile_idx)
-            with span("copr.execute", batch=B, tile=tile_idx):
+            with span("copr.device.execute", batch=B, tile=tile_idx):
                 out = vfn(datas, valids, lo, hi, del_mask, PI, PF)
             if kind == "agg":
                 gcount, results = out
@@ -265,12 +270,13 @@ def _run_batch(ctx: dict, live: List[_Member]):
                     rsp.set(bytes=mh.nbytes)
                 for b in range(B):
                     sel = np.flatnonzero(mh[b])
-                    if limit is not None:
-                        sel = sel[: max(limit - counts[b], 0)]
+                    if limits[b] is not None:
+                        sel = sel[: max(limits[b] - counts[b], 0)]
                     if len(sel):
                         handles[b].append(sel + tile_start)
                         counts[b] += len(sel)
-                if limit is not None and all(c >= limit for c in counts):
+                if all(lm is not None and c >= lm
+                       for lm, c in zip(limits, counts)):
                     done = True
                     break
 
@@ -292,7 +298,7 @@ def try_run_batched(storage, req):
     when the batch attempt failed benignly (callers fall through to the
     mesh / per-region rungs — re-running solo preserves parity).
     Lifecycle errors (kill/timeout/shutdown) propagate."""
-    from . import hoist_conds, microbatch_max, microbatch_window_s
+    from . import effective_window_s, hoist_conds, microbatch_max
     from ..copr import jax_engine as je
     from ..copr.ir import DAG
     from ..copr.jax_eval import JaxUnsupported
@@ -347,14 +353,20 @@ def try_run_batched(storage, req):
         (max(kr.start, 0), min(kr.end, table.base_rows))
         for kr in req.ranges
     )
-    key = (fp, table.store_uid, table.base_version, ranges, an.limit,
+    # LIMIT values hoist out of the batch key into per-member slots: the
+    # key carries only the pow2 limit CLASS (serving follow-up (d)), so
+    # parameter-different LIMITs share one batch and one vmapped program
+    from . import shape_bucket as _bucket
+
+    limit_class = None if an.limit is None else _bucket(an.limit, floor=16)
+    key = (fp, table.store_uid, table.base_version, ranges, limit_class,
            je.TILE)
-    member = _Member(pi, pf, current_scope())
+    member = _Member(pi, pf, current_scope(), limit=an.limit)
     ctx = {"table": table, "an": an, "kind": kind,
            "col_order": col_order, "fp": fp, "ranges": ranges}
     with span("serving.batch", kind=kind) as sp:
         try:
-            res = BATCHER.submit(key, member, microbatch_window_s(),
+            res = BATCHER.submit(key, member, effective_window_s(),
                                  microbatch_max(),
                                  lambda live: _run_batch(ctx, live))
         except TiDBTPUError:

@@ -95,6 +95,24 @@ def _hoist_leaf(e: Expression, i64: List[int], f64: List[float]):
 def _walk(e: Expression, i64: List[int], f64: List[float]) -> Expression:
     if not isinstance(e, ScalarFunc):
         return e
+    if e.name == "in":
+        # IN-lists bucket by pow2 LENGTH: when every list element hoists,
+        # the list pads to the next power of two with repeats of the last
+        # element (x IN (5, 5) ≡ x IN (5)), so `k IN (1,2,3)` and
+        # `k IN (7,8,9,10)` compile ONE program with 4 parameter slots —
+        # IN-lists of nearby length share a fused fragment
+        items = e.args[1:]
+        if items and all(
+                isinstance(a, Constant) and not isinstance(a, ParamConst)
+                and _numeric_value(a) is not None for a in items):
+            from .buckets import shape_bucket
+
+            pad = shape_bucket(len(items))
+            padded = list(items) + [items[-1]] * (pad - len(items))
+            new_args = [_walk(e.args[0], i64, f64)]
+            for a in padded:
+                new_args.append(_hoist_leaf(a, i64, f64))
+            return ScalarFunc("in", new_args, e.ftype, e.meta)
     if e.name in _CMP_OPS:
         new_args = []
         for a in e.args:

@@ -268,6 +268,7 @@ class Domain:
             "readback_ms": round(totals["readback_ms"], 3),
             "readback_bytes": totals["readback_bytes"],
             "backoff_ms": round(totals["backoff_ms"], 3),
+            "backfill_ms": round(totals.get("backfill_ms", 0.0), 3),
             "cop_tasks": totals["cop_tasks"],
             "engines": totals["engines"],
             "devices": totals["devices"],

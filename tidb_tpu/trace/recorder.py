@@ -160,6 +160,7 @@ class QueryTrace:
             "transfer_ms": 0.0, "transfer_bytes": 0,
             "device_ms": 0.0, "readback_ms": 0.0, "readback_bytes": 0,
             "backoff_ms": 0.0, "exchange_ms": 0.0, "commit_ms": 0.0,
+            "backfill_ms": 0.0,
             "compile_hits": 0, "compile_misses": 0, "cop_tasks": 0,
             "wire_bytes": 0, "result_rows": 0,
             "engines": set(), "devices": set(),
@@ -169,7 +170,7 @@ class QueryTrace:
             """Descendant time already attributed to other copr phases."""
             out = 0.0
             for c in s.children:
-                if c.name in ("copr.execute", "copr.readback",
+                if c.name in ("copr.device.execute", "copr.readback",
                               "copr.transfer"):
                     out += (c.dur_ns or 0) / 1e6
                 out += nested_phase_ms(c)
@@ -229,16 +230,21 @@ PHASES = {
     "plan": "plan_ms",
     "copr.compile": "compile_ms",
     "copr.transfer": "transfer_ms",
+    # one fused XLA launch per mesh dispatch (whole-fragment fusion);
+    # the legacy name stays mapped for externally recorded traces
+    "copr.device.execute": "device_ms",
     "copr.execute": "device_ms",
     "copr.readback": "readback_ms",
     "mpp.exchange": "exchange_ms",
     "txn.prewrite": "commit_ms",
     "txn.commit": "commit_ms",
+    # online DDL index builds (ddl.backfill spans per batch)
+    "ddl.backfill": "backfill_ms",
 }
 
 #: phases surfaced as /metrics histograms on every finished trace
 _METRIC_PHASES = ("parse_ms", "plan_ms", "compile_ms", "transfer_ms",
-                  "device_ms", "readback_ms", "backoff_ms")
+                  "device_ms", "readback_ms", "backoff_ms", "backfill_ms")
 
 # the CURRENT span (None = tracing disabled for this context)
 _CUR: ContextVar[Optional[Span]] = ContextVar("tidb_tpu_trace", default=None)

@@ -30,8 +30,8 @@ from ..store.fault import FAILPOINTS
 ENTRY_FIELDS = (
     "time", "conn_id", "query_time", "parse_ms", "plan_ms", "compile_ms",
     "compile_hits", "compile_misses", "transfer_bytes", "device_ms",
-    "readback_ms", "readback_bytes", "backoff_ms", "cop_tasks",
-    "engines", "devices", "rows", "termination", "query",
+    "readback_ms", "readback_bytes", "backoff_ms", "backfill_ms",
+    "cop_tasks", "engines", "devices", "rows", "termination", "query",
 )
 
 
