@@ -178,7 +178,9 @@ def preflight(state: dict) -> bool:
             return False
 
     # tunnel answers (or forced cpu): initialize jax in-process on a
-    # watchdog thread — this should now be quick
+    # watchdog thread.  The subprocess probe above can succeed while the
+    # in-process init still hits a transient flake (round-3/5 failure
+    # mode), so this stage RETRIES too instead of giving up on one shot.
     result: dict = {}
 
     def probe():
@@ -193,13 +195,26 @@ def preflight(state: dict) -> bool:
         except BaseException as e:  # noqa: BLE001
             result["error"] = repr(e)
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(min(180.0, max(remaining() - 60, 30)))
-    if "devices" in result:
-        state["devices"] = result["devices"]
-        log(f"device preflight ok: {result['devices']}")
-        return True
+    for attempt in range(3):
+        result.clear()
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(min(180.0, max(remaining() - 60, 30)))
+        if "devices" in result:
+            state["devices"] = result["devices"]
+            log(f"device preflight ok: {result['devices']}")
+            return True
+        err = result.get("error", "jax.devices() timed out")
+        if attempt < 2 and remaining() > 240 \
+                and classify_probe_error(err) in ("probe-timeout",
+                                                  "unknown"):
+            # a hung in-process init thread can't be cancelled, but a
+            # fresh attempt can still win while the old one lingers
+            log(f"in-process preflight attempt {attempt + 1} failed "
+                f"({err[:120]}); retrying")
+            time.sleep(5 * (attempt + 1))
+            continue
+        break
     state["preflight_error"] = result.get("error", "jax.devices() timed out")
     state["preflight_error_class"] = classify_probe_error(
         state["preflight_error"])
@@ -247,6 +262,17 @@ def _host_fallback_worker():
         out["fusion"] = fusion_bench(sess, n)
     except BaseException as e:  # noqa: BLE001
         out["fusion"] = {"error": repr(e)}
+    # grouped-pushdown receipt on the CPU harness: the device-merged
+    # GROUP BY below the exchange vs the host-merge rows path
+    try:
+        from tidb_tpu.tpch_data import build_q3_tables
+
+        n3 = 131_072
+        sess3 = build_q3_tables(n3, n3 // 8)
+        sess3.execute("set tidb_enforce_mpp = 1")
+        out["mpp_grouped_agg"] = mpp_grouped_bench(sess3, n3)
+    except BaseException as e:  # noqa: BLE001
+        out["mpp_grouped_agg"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -640,6 +666,72 @@ def fusion_bench(sess, n: int) -> dict:
     return out
 
 
+def _trace_span_sum(sess, sql: str, span_name: str, attr: str) -> int:
+    """Run `sql` once under TRACE and sum `attr` over `span_name` spans
+    (e.g. host-readback bytes across copr.readback)."""
+    try:
+        sess.execute("trace " + sql)
+        tr = sess.last_trace
+        if tr is None:
+            return -1
+        total = {"n": 0}
+
+        def walk(s):
+            if s.name == span_name:
+                total["n"] += int((s.attrs or {}).get(attr, 0) or 0)
+            for c in s.children:
+                walk(c)
+
+        walk(tr.root)
+        return total["n"]
+    except BaseException:  # noqa: BLE001 — receipt survives trace issues
+        return -1
+
+
+def mpp_grouped_bench(sess_m, n_li: int) -> dict:
+    """Grouped-pushdown receipt: GROUP BY over the MPP shuffle join with
+    the grouped partial agg merged ON DEVICE (only O(G) rows read back)
+    vs the host-merge comparator (TIDB_TPU_MPP_GROUPED=0: same device
+    join, every joined row ships to the host and aggregates there)."""
+    from tidb_tpu.metrics import REGISTRY
+
+    GQ = ("select o_shippriority, count(*), sum(l_extendedprice),"
+          " max(l_discount) from lineitem join orders"
+          " on l_orderkey = o_orderkey where l_shipdate > '1995-03-15'"
+          " group by o_shippriority")
+    prior = os.environ.get("TIDB_TPU_MPP_GROUPED")
+    try:
+        os.environ["TIDB_TPU_MPP_GROUPED"] = "1"
+        m0 = REGISTRY.snapshot()
+        _, g_s = time_query(sess_m, GQ, ITERS)
+        m1 = REGISTRY.snapshot()
+        g_bytes = _trace_span_sum(sess_m, GQ, "copr.readback", "bytes")
+        pushed = (m1.get("mpp_grouped_agg_pushed_total", 0)
+                  - m0.get("mpp_grouped_agg_pushed_total", 0)) > 0
+        os.environ["TIDB_TPU_MPP_GROUPED"] = "0"
+        _, h_s = time_query(sess_m, GQ, ITERS)
+        h_bytes = _trace_span_sum(sess_m, GQ, "copr.readback", "bytes")
+    finally:
+        if prior is None:
+            os.environ.pop("TIDB_TPU_MPP_GROUPED", None)
+        else:
+            os.environ["TIDB_TPU_MPP_GROUPED"] = prior
+    out = {
+        "rows": n_li,
+        "grouped_s": round(g_s, 5),
+        "host_merge_s": round(h_s, 5),
+        "grouped_rows_per_sec": round(n_li / g_s, 1),
+        "host_merge_rows_per_sec": round(n_li / h_s, 1),
+        "speedup": round(h_s / g_s, 2),
+        "served_by_grouped_pushdown": pushed,
+        "grouped_readback_bytes": g_bytes,
+        "host_merge_readback_bytes": h_bytes,
+    }
+    log(f"MPP grouped agg: pushed={g_s:.4f}s host-merge={h_s:.4f}s "
+        f"-> {h_s / g_s:.2f}x | readback {g_bytes} vs {h_bytes} bytes")
+    return out
+
+
 def _run(state: dict):
     try:
         _run_inner(state)
@@ -659,12 +751,18 @@ def _run_inner(state: dict):
     if not scales:
         scales = [MAX_ROWS]
     scales = sorted(set(scales))
+    # chaos knob: simulate the round-1/3/5 failure mode (a wedge at a
+    # LATE scale) — earlier scales' receipts must survive in the emitted
+    # detail and in BENCH_PARTIAL.json (test-asserted)
+    fail_at = int(os.environ.get("BENCH_FAIL_AT_SCALE", "0"))
     for n in scales:
         # only attempt the next (bigger) scale while at least 35% of the
         # wall budget remains — a completed smaller scale is always kept
         if state.get("q1") and remaining() < 0.35 * WALL_LIMIT:
             log(f"skipping scale {n}: {remaining():.0f}s left")
             break
+        if fail_at and n >= fail_at:
+            raise RuntimeError(f"injected late-scale failure at {n} rows")
         log(f"loading {n} rows...")
         t0 = time.perf_counter()
         sess = build_lineitem(n)
@@ -807,6 +905,19 @@ def _run_inner(state: dict):
             time.perf_counter() - T0, 1)
         persist_partial(state)
 
+        # grouped partial aggregates below the exchange (ISSUE 8):
+        # device-merged GROUP BY pushdown vs the host-merge rows path
+        if remaining() > 90:
+            try:
+                sess_m.execute("set tidb_allow_mpp = 1")
+                sess_m.execute("set tidb_enforce_mpp = 1")
+                state["mpp_grouped_agg"] = mpp_grouped_bench(sess_m, n_li)
+            except BaseException as e:  # noqa: BLE001 — receipt survives
+                state["mpp_grouped_agg"] = {"error": repr(e)}
+            state["phases"]["mpp_grouped_agg_done"] = round(
+                time.perf_counter() - T0, 1)
+            persist_partial(state)
+
     # concurrent-client serving bench: N wire clients of mixed TPC-H +
     # point lookups through the real server (admission, shape buckets,
     # micro-batcher under contention); reports p50/p99 + batched-vs-
@@ -845,13 +956,16 @@ def _run_inner(state: dict):
 
 def persist_partial(state: dict):
     """Crash insurance: after every phase the full state lands in
-    BENCH_PARTIAL.json, so an externally killed run still leaves its best
-    measured numbers on disk for the judge."""
+    BENCH_PARTIAL.json (path overridable via BENCH_PARTIAL_PATH), so an
+    externally killed run still leaves its best measured numbers on disk
+    for the judge."""
     try:
         snap = dict(state)
         snap["phases"] = dict(snap.get("phases") or {})
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_PARTIAL.json")
+        snap["scales"] = list(snap.get("scales") or [])
+        path = os.environ.get("BENCH_PARTIAL_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_PARTIAL.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(snap, f)
@@ -895,6 +1009,7 @@ def emit(state: dict):
                 ),
                 "q3": state.get("q3"),
                 "mpp_join": state.get("mpp_join"),
+                "mpp_grouped_agg": state.get("mpp_grouped_agg"),
                 "concurrent": state.get("concurrent"),
                 "fusion": state.get("fusion"),
                 "scales": state.get("scales"),

@@ -407,3 +407,49 @@ def test_adaptive_window_widens_and_shrinks():
     finally:
         REGISTRY.set("admission_queue_depth", 0.0)
         serving.configure(microbatch_window_ms=0.0)
+
+
+def test_mesh_agg_overflow_peels_agg_to_host_tail():
+    """ROADMAP fusion follow-up (c): a blown sort-agg budget re-enters
+    the fused mesh with the AGG peeled to the host tail (scan+selection
+    stays device-resident and streamed) instead of dropping the whole
+    fragment to the per-tile fan-out rung — parity + mesh_agg_peel
+    metric."""
+    import os
+
+    from tidb_tpu.session import Domain
+
+    prior = os.environ.get("TIDB_TPU_AGG_OUT")
+    os.environ["TIDB_TPU_AGG_OUT"] = "64"
+    try:
+        d = Domain()
+        s = d.new_session()
+        s.execute("create table peelt (k bigint, v double, w bigint)")
+        t = d.catalog.info_schema().table("test", "peelt")
+        rng = np.random.default_rng(5)
+        n = 40000
+        kvalid = [np.ones(n, np.bool_), None, None]
+        kvalid[0][rng.integers(0, n, 500)] = False  # NULLable -> sort agg
+        d.storage.table(t.id).bulk_load_arrays(
+            [rng.integers(0, 20000, n), rng.uniform(0, 10, n),
+             rng.integers(0, 100, n)], kvalid, ts=d.storage.current_ts())
+        s.execute("analyze table peelt")
+        q = "select k, count(*), sum(v) from peelt where w < 80 group by k"
+        m0 = REGISTRY.snapshot().get("mesh_agg_peel_total", 0)
+        got = s.query(q)
+        assert REGISTRY.snapshot().get("mesh_agg_peel_total", 0) > m0, \
+            "sort-agg overflow did not take the agg-peel rung"
+        s.execute("set tidb_use_tpu = 0")
+        want = s.query(q)
+        s.execute("set tidb_use_tpu = 1")
+
+        def key(r):
+            return tuple((0, "") if x is None else (1, float(x))
+                         for x in r)
+
+        assert sorted(got, key=key) == sorted(want, key=key)
+    finally:
+        if prior is None:
+            os.environ.pop("TIDB_TPU_AGG_OUT", None)
+        else:
+            os.environ["TIDB_TPU_AGG_OUT"] = prior

@@ -86,9 +86,12 @@ def _snap(*names):
 
 
 def _run_mpp(sess, sql, want_mode="shuffle"):
-    m0, f0 = _snap(f"mpp_joins_{want_mode}_total", "mpp_fallback_total")
+    # rung names sanitize into the Prometheus grammar for metric names
+    metric = ("mpp_joins_"
+              + want_mode.replace("+", "_").replace("-", "_") + "_total")
+    m0, f0 = _snap(metric, "mpp_fallback_total")
     rows = sess.query(sql)
-    m1, f1 = _snap(f"mpp_joins_{want_mode}_total", "mpp_fallback_total")
+    m1, f1 = _snap(metric, "mpp_fallback_total")
     assert m1 > m0, f"not served by the mpp {want_mode} rung: {sql}"
     assert f1 == f0, f"fell back to the host join: {sql}"
     return rows
@@ -277,6 +280,17 @@ def test_copartitioned_join_parity_and_metric(copart_sess):
     _rows_eq(got2, _cpu(s, q), "copart-rows")
 
 
+def test_copartitioned_grouped_agg_parity(copart_sess):
+    """Grouped agg over the elided co-partitioned join: served via the
+    per-pair rung (grouped pushdown declines copart plans — each pair
+    would budget G independently), parity against the host."""
+    s = copart_sess
+    q = ("select l_orderkey, count(*), sum(l_qty), max(o_price) from cli"
+         " join cord on l_orderkey = o_orderkey group by l_orderkey")
+    got = s.query(q)
+    _rows_eq(got, _cpu(s, q), "copart-grouped")
+
+
 def test_copartitioned_unequal_counts_not_elided(copart_sess):
     s = copart_sess
     s.execute("create table cord8 (o_orderkey bigint primary key,"
@@ -287,3 +301,269 @@ def test_copartitioned_unequal_counts_not_elided(copart_sess):
             "explain select count(*) from cli join cord8"
             " on l_orderkey = o_orderkey")[0].rows)
     assert "MPPScan" not in plan  # 4 vs 8 partitions: no elision
+
+
+# ---------------------------------------------------------------------------
+# grouped partial aggregates below the exchange (ISSUE 8 tentpole)
+# ---------------------------------------------------------------------------
+
+
+GROUPED_CORPUS = [
+    # probe-side int key
+    ("select l_qty, count(*), sum(l_price) from li join orders"
+     " on l_orderkey = o_orderkey group by l_qty"),
+    # build-side key + every pushable agg incl. avg/min/max
+    ("select o_flag, count(*), count(o_total), sum(l_price),"
+     " avg(o_total), min(l_qty), max(o_total) from li join orders"
+     " on l_orderkey = o_orderkey group by o_flag"),
+    # dict-string group keys from BOTH sides
+    ("select o_clerk, count(*), sum(l_qty) from li join orders"
+     " on l_orderkey = o_orderkey group by o_clerk"),
+    ("select l_comment, count(*), max(o_total) from li join orders"
+     " on l_orderkey = o_orderkey where o_flag < 4 group by l_comment"),
+    # multi-column group key spanning both sides
+    ("select o_flag, l_comment, count(*), sum(l_price) from li"
+     " join orders on l_orderkey = o_orderkey"
+     " group by o_flag, l_comment"),
+]
+
+
+def test_grouped_agg_pushdown_parity_corpus(sess):
+    for q in GROUPED_CORPUS:
+        got = _run_mpp(sess, q, want_mode="shuffle+grouped")
+        _rows_eq(got, _cpu(sess, q), q)
+
+
+def test_grouped_agg_pushdown_metric_and_explain(sess):
+    plan = "\n".join(
+        " | ".join(str(x) for x in r)
+        for r in sess.execute("explain " + GROUPED_CORPUS[1])[0].rows)
+    assert "group by:[o_flag]" in plan and "budget:" in plan, plan
+    assert "mode:final" in plan, plan
+    p0 = _snap("mpp_grouped_agg_pushed_total")[0]
+    sess.query(GROUPED_CORPUS[1])
+    assert _snap("mpp_grouped_agg_pushed_total")[0] > p0
+
+
+def test_grouped_pushdown_single_dispatch_and_readback_o_of_g(sess):
+    """Steady-state grouped pushdown: ONE fused device dispatch, and the
+    host readback is O(G) — orders of magnitude below the joined-row
+    readback the forced host-merge comparator pays on the same plan."""
+    import os
+
+    q = GROUPED_CORPUS[1]
+    sess.query(q)  # warm the compiled program
+    sess.query(q)
+
+    def spans(name):
+        out = []
+
+        def walk(s):
+            if s.name == name:
+                out.append(s)
+            for c in s.children:
+                walk(c)
+
+        walk(sess.last_trace.root)
+        return out
+
+    sess.execute("trace " + q)
+    execs = spans("copr.device.execute")
+    grouped_bytes = sum(
+        int((s.attrs or {}).get("bytes", 0)) for s in spans("copr.readback"))
+    assert len(execs) == 1, f"{len(execs)} device dispatches (want 1)"
+    # host-merge comparator: same compiled join, rows ship to the host
+    os.environ["TIDB_TPU_MPP_GROUPED"] = "0"
+    try:
+        sess.execute("trace " + q)
+    finally:
+        os.environ.pop("TIDB_TPU_MPP_GROUPED", None)
+    host_bytes = sum(
+        int((s.attrs or {}).get("bytes", 0)) for s in spans("copr.readback"))
+    assert grouped_bytes * 5 < host_bytes, (grouped_bytes, host_bytes)
+
+
+def test_grouped_overflow_falls_back_to_agg_peel(sess, monkeypatch):
+    """A genuine on-device group-budget overflow (budget pinned tiny,
+    high-NDV key): the join stays device-resident and the agg peels to
+    the host tail, with parity and the overflow/fallback metrics."""
+    monkeypatch.setenv("TIDB_TPU_MPP_GROUP_BUDGET", "8")
+    q = ("select l_orderkey, count(*), sum(o_total) from li join orders"
+         " on l_orderkey = o_orderkey group by l_orderkey")
+    o0, f0 = _snap("mpp_grouped_agg_overflow_total",
+                   "mpp_grouped_agg_fallback_total")
+    got = _run_mpp(sess, q, want_mode="shuffle+agg-peel")
+    o1, f1 = _snap("mpp_grouped_agg_overflow_total",
+                   "mpp_grouped_agg_fallback_total")
+    assert o1 > o0 and f1 > f0
+    _rows_eq(got, _cpu(sess, q), "grouped-overflow-peel")
+    plan = "\n".join(str(r) for r in sess.execute(
+        "explain analyze " + q)[0].rows)
+    assert "engine:mpp-shuffle+agg-peel" in plan, plan
+
+
+def test_grouped_overflow_chaos_failpoint(sess):
+    """The mpp/grouped_agg_overflow chaos site drives the same agg-peel
+    rung a real overflow takes: parity, metrics, no leaked failpoints
+    (autouse conftest fixture)."""
+    from tidb_tpu.mpp.engine import MPPGroupedAggOverflow
+    from tidb_tpu.store.fault import failpoint, once
+
+    q = GROUPED_CORPUS[0]
+    f0 = _snap("mpp_grouped_agg_fallback_total")[0]
+    with failpoint("mpp/grouped_agg_overflow",
+                   once(MPPGroupedAggOverflow("chaos injected"))):
+        got = _run_mpp(sess, q, want_mode="shuffle+agg-peel")
+    assert _snap("mpp_grouped_agg_fallback_total")[0] > f0
+    _rows_eq(got, _cpu(sess, q), "grouped-chaos")
+    # the next run (failpoint disarmed) pushes down again
+    got2 = _run_mpp(sess, q, want_mode="shuffle+grouped")
+    _rows_eq(got2, _cpu(sess, q), "grouped-chaos-recovered")
+
+
+def test_grouped_skewed_keys_stay_grouped(sess):
+    """Skewed group-key distribution (one dominant group) must not blow
+    the budget: G is what matters, not per-group row counts."""
+    d = sess.domain
+    s = d.new_session()
+    s.execute("create table skg (k bigint, grp bigint, v double)")
+    t = d.catalog.info_schema().table("test", "skg")
+    n = 20000
+    rng = np.random.default_rng(23)
+    grp = np.where(rng.random(n) < 0.9, 3, rng.integers(0, 40, n))
+    d.storage.table(t.id).bulk_load_arrays(
+        [rng.integers(0, N_ORDERS, n), grp, rng.uniform(0, 10, n)],
+        ts=d.storage.current_ts())
+    s.execute("analyze table skg")
+    s.execute("set tidb_enforce_mpp = 1")
+    q = ("select grp, count(*), sum(v) from skg join orders"
+         " on k = o_orderkey group by grp")
+    p0 = _snap("mpp_grouped_agg_pushed_total")[0]
+    got = s.query(q)
+    assert _snap("mpp_grouped_agg_pushed_total")[0] > p0
+    s.execute("set tidb_use_tpu = 0")
+    want = s.query(q)
+    s.execute("set tidb_use_tpu = 1")
+    _rows_eq(got, want, "skewed-grouped")
+
+
+def test_grouped_delta_rows_fall_back_to_host_with_parity(sess):
+    """Committed delta rows keep the grouped plan OFF the device; the
+    host rung emits the same grouped-partial layout the final HashAgg
+    merges."""
+    d = sess.domain
+    s = d.new_session()
+    s.execute("create table gdlt (k bigint primary key, g bigint,"
+              " v double)")
+    t = d.catalog.info_schema().table("test", "gdlt")
+    d.storage.table(t.id).bulk_load_arrays(
+        [np.arange(3000, dtype=np.int64),
+         np.arange(3000, dtype=np.int64) % 7,
+         np.arange(3000, dtype=np.float64)],
+        ts=d.storage.current_ts())
+    s.execute("analyze table gdlt")
+    s.execute("set tidb_enforce_mpp = 1")
+    s.execute("insert into gdlt values (90001, 3, 1.5)")
+    q = ("select g, count(*), sum(l_qty), min(v) from li join gdlt"
+         " on l_orderkey = k group by g")
+    f0 = _snap("mpp_fallback_total")[0]
+    got = s.query(q)
+    assert _snap("mpp_fallback_total")[0] > f0
+    s.execute("set tidb_use_tpu = 0")
+    want = s.query(q)
+    s.execute("set tidb_use_tpu = 1")
+    _rows_eq(got, want, "grouped-delta-fallback")
+
+
+# ---------------------------------------------------------------------------
+# multi-column and non-unique build join keys (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dup_sess():
+    """Build side with DUPLICATE join keys (and NULLs) plus a
+    two-column-key pair — the two-pass count+emit shapes."""
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table dup (k bigint, g bigint, v double)")
+    s.execute("create table probe (pk bigint, q bigint)")
+    s.execute("create table a2 (k1 bigint, k2 bigint, x bigint)")
+    s.execute("create table b2 (m1 bigint, m2 bigint, y double)")
+    rng = np.random.default_rng(7)
+    t = d.catalog.info_schema()
+    n_d, n_p = 12000, 20000
+    dvalid = [np.ones(n_d, np.bool_), None, None]
+    dvalid[0][rng.integers(0, n_d, 300)] = False
+    d.storage.table(t.table("test", "dup").id).bulk_load_arrays(
+        [rng.integers(0, 4000, n_d), rng.integers(0, 7, n_d),
+         rng.uniform(0, 100, n_d)], dvalid, ts=d.storage.current_ts())
+    d.storage.table(t.table("test", "probe").id).bulk_load_arrays(
+        [rng.integers(0, 12000, n_p), rng.integers(0, 50, n_p)],
+        ts=d.storage.current_ts())
+    n_a, n_b = 16000, 6000
+    d.storage.table(t.table("test", "a2").id).bulk_load_arrays(
+        [rng.integers(0, 50, n_a), rng.integers(0, 40, n_a),
+         rng.integers(0, 9, n_a)], ts=d.storage.current_ts())
+    d.storage.table(t.table("test", "b2").id).bulk_load_arrays(
+        [rng.integers(0, 50, n_b), rng.integers(0, 40, n_b),
+         rng.uniform(0, 10, n_b)], ts=d.storage.current_ts())
+    for name in ("dup", "probe", "a2", "b2"):
+        s.execute(f"analyze table {name}")
+    s.execute("set tidb_enforce_mpp = 1")
+    return s
+
+
+def _dup_par(s, q, label, want_mode=None):
+    if want_mode is not None:
+        got = _run_mpp(s, q, want_mode=want_mode)
+    else:
+        got = s.query(q)
+    _rows_eq(got, _cpu(s, q), label)
+    return got
+
+
+def test_nonunique_build_keys_inner_expansion(dup_sess):
+    """Duplicate build keys expand via the two-pass count+emit: every
+    (probe, match) pair emits — no more dup demotion to the host
+    (_run_mpp already asserts the MPP run itself took no fallback)."""
+    _dup_par(dup_sess,
+             "select pk, q, g, v from probe join dup on pk = k"
+             " where q < 25", "nonunique-inner", want_mode="shuffle")
+
+
+def test_nonunique_build_keys_left_outer(dup_sess):
+    got = _dup_par(dup_sess,
+                   "select pk, q, v from probe left join dup on pk = k",
+                   "nonunique-louter", want_mode="shuffle")
+    assert any(r[2] is None for r in got)  # unmatched rows NULL-extend
+
+
+def test_nonunique_build_grouped_agg(dup_sess):
+    _dup_par(dup_sess,
+             "select g, count(*), sum(v), avg(q) from probe join dup"
+             " on pk = k group by g", "nonunique-grouped",
+             want_mode="shuffle+grouped")
+
+
+def test_multicolumn_join_keys_rows_and_grouped(dup_sess):
+    """Two-column equi-join exchanges a mix-hash and re-verifies true
+    per-column equality on device."""
+    _dup_par(dup_sess,
+             "select x, y from a2 join b2 on k1 = m1 and k2 = m2"
+             " where x < 5", "multicol-rows", want_mode="shuffle")
+    _dup_par(dup_sess,
+             "select x, count(*), sum(y) from a2 join b2"
+             " on k1 = m1 and k2 = m2 group by x", "multicol-grouped",
+             want_mode="shuffle+grouped")
+
+
+def test_multicolumn_left_outer_stays_on_host(dup_sess):
+    """Mix-hash collisions could drop a left-outer probe row's
+    NULL-extension slot, so multi-key louter never plans as MPP."""
+    plan = "\n".join(
+        " | ".join(str(x) for x in r)
+        for r in dup_sess.execute(
+            "explain select x, y from a2 left join b2"
+            " on k1 = m1 and k2 = m2")[0].rows)
+    assert "ExchangeSender" not in plan, plan

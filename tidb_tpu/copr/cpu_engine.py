@@ -93,6 +93,22 @@ def run_dag_on_chunk(dag: DAG, chunk: Chunk, aux: Optional[dict] = None) -> Chun
     return chunk
 
 
+def grouped_partial_chunks(group_by, aggs, chunks) -> List[Chunk]:
+    """Grouped PARTIAL aggregation over row chunks, one partial chunk
+    ([keys..., states...] layout) per non-empty input chunk — the shared
+    host-tail recipe of the MPP agg-peel rung and the MPP host fallback
+    (a FINAL HashAgg upstream merges groups across chunks)."""
+    agg_ir = AggregationIR(list(group_by), list(aggs), mode="partial")
+    out: List[Chunk] = []
+    for c in chunks:
+        if not c.num_rows:
+            continue
+        r = _run_agg(agg_ir, c)
+        if r.num_rows:
+            out.append(r)
+    return out
+
+
 def _run_agg(agg_ir: AggregationIR, chunk: Chunk) -> Chunk:
     gcols = [g.eval(chunk).to_column() for g in agg_ir.group_by]
     if gcols:

@@ -191,6 +191,139 @@ def projection_outputs(ctx: RegionContext):
 
 
 # ---------------------------------------------------------------------------
+# grouped sort-agg emitters: shared by the mesh sort-agg program
+# (parallel._build_sort_agg_core) and the MPP grouped partial-agg phase
+# (mpp/engine.py) — the "partial partial aggregates" machinery
+# ---------------------------------------------------------------------------
+
+
+def sort_group_segments(key_bits, key_flags, mask, cap, order=None,
+                        diff=None):
+    """Sort-based grouping into a static `cap`-slot budget.
+
+    lexsorts rows by (key bits..., null flags..., selected-last), marks
+    group boundaries, and clips segment ids to [0, cap).  Callers with a
+    cheaper total order (e.g. the fd-lookup single-int sort) pass their
+    own `order` + boundary `diff` and reuse only the segment layout.
+
+    Returns (order, sm, skeys, seg, pos, n_uniq): the sort permutation,
+    sorted selection mask, sorted key arrays, per-row segment ids, the
+    compacted first-row-per-group positions, and the TRUE distinct-group
+    count — n_uniq > cap means the budget blew and slots past cap-1 hold
+    merged garbage; the caller must treat the result as overflowed.
+    """
+    n = mask.shape[0]
+    ar = jnp.arange(n, dtype=jnp.int64)
+    if order is None:
+        # lexsort: LAST key is primary -> selected rows first, grouped
+        # by key
+        order = jnp.lexsort(
+            tuple(key_bits + key_flags + [(~mask).astype(jnp.int64)])
+        )
+    sm = mask[order]
+    skeys = [k[order] for k in key_bits + key_flags]
+    if diff is None:
+        diff = ar == 0
+        for k in skeys:
+            diff = diff | (k != jnp.roll(k, 1))
+    boundary = sm & diff
+    n_uniq = boundary.sum().astype(jnp.int64)
+    seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0, cap - 1)
+    pos = jnp.nonzero(boundary, size=cap, fill_value=n - 1)[0]
+    return order, sm, skeys, seg, pos, n_uniq
+
+
+def grouped_partial_states(aggs, arg_fn, order, sm, seg, cap,
+                           sgofs=None, n_global=0):
+    """Segment-reduce per-group partial states for every aggregate over
+    sort-grouped rows (the layouts `_agg_tags` names: count -> [cap],
+    sum/avg/min/max -> ([cap], [cap]) value+count, first_row -> [cap]
+    global row indices when `sgofs` is given).
+
+    `arg_fn(expr)` evaluates an aggregate argument in the UNSORTED row
+    layout; this emitter applies the sort permutation.
+    """
+    from .jax_engine import _to_state_dtype
+
+    results = []
+    for a in aggs:
+        if a.name == "count":
+            if a.args:
+                d, v = arg_fn(a.args[0])
+                results.append(
+                    ops.masked_segment_count(seg, sm & v[order], cap))
+            else:
+                results.append(ops.masked_segment_count(seg, sm, cap))
+            continue
+        d, v = arg_fn(a.args[0])
+        d, mv = d[order], sm & v[order]
+        if a.name in ("sum", "avg"):
+            st = a.partial_types()[0]
+            dd = _to_state_dtype(d, a.args[0].ftype, st)
+            results.append((
+                ops.masked_segment_sum(dd, seg, mv, cap),
+                ops.masked_segment_count(seg, mv, cap),
+            ))
+        elif a.name == "min":
+            results.append((
+                ops.masked_segment_min(d, seg, mv, cap),
+                ops.masked_segment_count(seg, mv, cap),
+            ))
+        elif a.name == "max":
+            results.append((
+                ops.masked_segment_max(d, seg, mv, cap),
+                ops.masked_segment_count(seg, mv, cap),
+            ))
+        elif a.name == "first_row":
+            contrib = jnp.where(mv, sgofs, jnp.int64(n_global))
+            results.append(
+                jax.ops.segment_min(contrib, seg, num_segments=cap)
+            )
+    return results
+
+
+def merge_grouped_partials(aggs, key_bits, key_flags, row_valid, states,
+                           cap):
+    """Merge compacted (key, partial-state) rows — e.g. the all_gathered
+    per-shard groups of an MPP grouped aggregation — into <= cap merged
+    groups: a second sort-group over the partial rows, then state-MERGE
+    reductions (counts/sums add, min/min max/max, first_row keeps the
+    global minimum row index).
+
+    `states` uses grouped_partial_states' layout per agg.  Returns
+    (n_uniq, out_keys, merged_states); n_uniq > cap means the merged
+    group count blew the budget.
+    """
+    order, sm, skeys, seg, pos, n_uniq = sort_group_segments(
+        key_bits, key_flags, row_valid, cap)
+    merged = []
+    for a, st in zip(aggs, states):
+        if a.name == "count":
+            merged.append(
+                ops.masked_segment_sum(st[order], seg, sm, cap))
+        elif a.name in ("sum", "avg"):
+            s, c = st
+            merged.append((
+                ops.masked_segment_sum(s[order], seg, sm, cap),
+                ops.masked_segment_sum(c[order], seg, sm, cap),
+            ))
+        elif a.name in ("min", "max"):
+            v, c = st
+            mv = sm & (c[order] > 0)  # empty partials carry sentinels
+            red = (ops.masked_segment_min if a.name == "min"
+                   else ops.masked_segment_max)
+            merged.append((
+                red(v[order], seg, mv, cap),
+                ops.masked_segment_sum(c[order], seg, sm, cap),
+            ))
+        else:  # first_row: the smallest global row index wins
+            merged.append(
+                ops.masked_segment_min(st[order], seg, sm, cap))
+    out_keys = tuple(k[pos] for k in skeys)
+    return n_uniq, out_keys, merged
+
+
+# ---------------------------------------------------------------------------
 # fusion regions: split a fragment at unfusable boundaries
 # ---------------------------------------------------------------------------
 

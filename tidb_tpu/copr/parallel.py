@@ -872,11 +872,12 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
             zero = jnp.float64(0.0) if k.dtype == jnp.float64 else jnp.int64(0)
             key_bits.append(jnp.where(v, k, zero))
             key_flags.append(v.astype(jnp.int64))
-        ar = jnp.arange(n_local, dtype=jnp.int64)
+        order = diff = None
         if fd_lookup:
             # every group key is determined by the matched build row: one
             # int argsort on the build-row index replaces the full lexsort
             # (XLA CSE folds this searchsorted into _apply_probes' one)
+            ar = jnp.arange(n_local, dtype=jnp.int64)
             lk = an.lookups[0]
             bkeys = pargs[2 * len(an.probes)]
             dk, _vk = compile_expr(lk.key, cols, n_local)
@@ -886,61 +887,12 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
             order = jnp.argsort(sortk)
             ssort = sortk[order]
             diff = (ar == 0) | (ssort != jnp.roll(ssort, 1))
-            sm = m[order]
-            sgofs = gofs[order]
-            skeys = [k[order] for k in key_bits + key_flags]
-        else:
-            # lexsort: LAST key is primary -> selected rows first, grouped
-            # by key
-            order = jnp.lexsort(
-                tuple(key_bits + key_flags + [(~m).astype(jnp.int64)])
-            )
-            sm = m[order]
-            sgofs = gofs[order]
-            skeys = [k[order] for k in key_bits + key_flags]
-            diff = ar == 0
-            for k in skeys:
-                diff = diff | (k != jnp.roll(k, 1))
-        boundary = sm & diff
-        n_uniq = boundary.sum().astype(jnp.int64)
-        seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0, OUT - 1)
-        pos = jnp.nonzero(boundary, size=OUT, fill_value=n_local - 1)[0]
+        order, sm, skeys, seg, pos, n_uniq = fusion.sort_group_segments(
+            key_bits, key_flags, m, OUT, order=order, diff=diff)
         out_keys = tuple(k[pos] for k in skeys)
-        results = []
-        for ai, a in enumerate(agg_ir.aggs):
-            if a.name == "count":
-                if a.args:
-                    d, v = compile_expr(a.args[0], cols, n_local)
-                    results.append(
-                        ops.masked_segment_count(seg, sm & v[order], OUT)
-                    )
-                else:
-                    results.append(ops.masked_segment_count(seg, sm, OUT))
-                continue
-            d, v = compile_expr(a.args[0], cols, n_local)
-            d, mv = d[order], sm & v[order]
-            if a.name in ("sum", "avg"):
-                st = a.partial_types()[0]
-                dd = _to_state_dtype(d, a.args[0].ftype, st)
-                results.append((
-                    ops.masked_segment_sum(dd, seg, mv, OUT),
-                    ops.masked_segment_count(seg, mv, OUT),
-                ))
-            elif a.name == "min":
-                results.append((
-                    ops.masked_segment_min(d, seg, mv, OUT),
-                    ops.masked_segment_count(seg, mv, OUT),
-                ))
-            elif a.name == "max":
-                results.append((
-                    ops.masked_segment_max(d, seg, mv, OUT),
-                    ops.masked_segment_count(seg, mv, OUT),
-                ))
-            elif a.name == "first_row":
-                contrib = jnp.where(mv, sgofs, jnp.int64(n_global))
-                results.append(
-                    jax.ops.segment_min(contrib, seg, num_segments=OUT)
-                )
+        results = fusion.grouped_partial_states(
+            agg_ir.aggs, lambda e: compile_expr(e, cols, n_local),
+            order, sm, seg, OUT, sgofs=gofs[order], n_global=n_global)
         return n_uniq.reshape(1), out_keys, tuple(results)
 
     return shard_map(shard_fn, mesh=mesh,
@@ -1041,6 +993,27 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
                 cols.append(Column(pts[0], vals, valid))
         chunks.append(Chunk(cols))
     return chunks
+
+
+def _peel_agg_rerun(storage, req, tid: int, dag: DAG, reason: str):
+    """MeshAggOverflow fallback rung: re-run the SAME fragment with the
+    fused region cut just before the aggregation — the scan+selection
+    head streams from the mesh and the agg runs as a host tail over the
+    still-partial chunks (ROADMAP fusion follow-up (c)).  Returns the
+    filter-stream generator, or None when no device head remains (the
+    caller then demotes to the host hash agg as before)."""
+    from .ir import AggregationIR
+
+    cut = next((i for i, x in enumerate(dag.executors)
+                if isinstance(x, AggregationIR)), 0)
+    if cut <= 1:
+        return None  # scan-only head: a device pass reduces nothing
+    from ..metrics import REGISTRY
+    from ..trace import annotate
+
+    REGISTRY.inc("mesh_agg_peel_total")
+    annotate(mesh_agg_peel=reason[:80])
+    return _run_mesh_once(storage, req, tid, max_cut=cut)
 
 
 # ---------------------------------------------------------------------------
@@ -1211,9 +1184,15 @@ def _guarded_stream(storage, req: CopRequest, tid: int, gen, attempts: int):
             gen = None
 
 
-def _run_mesh_once(storage, req: CopRequest, tid: int):
+def _run_mesh_once(storage, req: CopRequest, tid: int,
+                   max_cut: Optional[int] = None):
     """One attempt at running the request over the current mesh; None if
-    ineligible.  Raises on runtime failures — try_run_mesh owns failover."""
+    ineligible.  Raises on runtime failures — try_run_mesh owns failover.
+
+    `max_cut` caps the fused region at an executor boundary — the
+    MeshAggOverflow peel re-enters here with the cut placed just before
+    the aggregation, so the scan+selection head stays on device and only
+    the blown-budget agg moves to the host tail."""
     dag = DAG.from_dict(req.dag)
     table = storage.table(tid)
     if table.base_rows == 0 or table.base_ts > req.ts:
@@ -1232,7 +1211,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
     # unfusable suffix runs as a host tail over the region's output
     # instead of rejecting the whole fragment off the mesh path
     try:
-        plan = plan_regions(dag, table)
+        plan = plan_regions(dag, table, max_cut=max_cut)
     except JaxUnsupported as e:
         req.mesh_reject_reason = str(e)
         return None
@@ -1339,6 +1318,12 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
           + f"kpads={kpads} wire={wire_sig}"
           + (f"|hp={len(hoisted[0])},{len(hoisted[1])}"
              if hoisted is not None else ""))
+    if kind == "agg" and an.agg_mode == "sort":
+        # the static OUT budget shapes the compiled program: a re-tuned
+        # TIDB_TPU_AGG_OUT must not reuse a program with the old budget
+        import os as _os
+
+        fp += "|aggout=" + _os.environ.get("TIDB_TPU_AGG_OUT", "")
     from ..trace import annotate, span
 
     annotate(device_ids=list(mesh_ids))
@@ -1415,7 +1400,14 @@ def _run_mesh_once(storage, req: CopRequest, tid: int):
                 chunks.extend(_sort_agg_chunks(out, table, an))
             except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
-                # shard — hand the whole request to the host hash agg
+                # shard.  Re-enter the fused mesh with the AGG PEELED to
+                # the host tail (scan+selection stays device-resident and
+                # streamed) instead of dropping the whole fragment to the
+                # per-tile fan-out rung; fragments with no device-worthy
+                # head still take the old host-hash-agg demotion.
+                peeled = _peel_agg_rerun(storage, req, tid, dag, str(e))
+                if peeled is not None:
+                    return peeled
                 req.mesh_reject_reason = str(e)
                 return None
         elif kind == "agg":

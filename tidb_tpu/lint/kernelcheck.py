@@ -55,8 +55,16 @@ CANONICAL_KERNEL_QUERIES = [
 #: MPP exchange kernels (mpp/exchange.py): traced over a 1-device mesh so
 #: the jaxpr stats are deterministic regardless of how many virtual
 #: devices the harness exposes; covers the partition/all_to_all shuffle
-#: and the all_gather broadcast rung of the partitioned join.
+#: and the all_gather broadcast rung of the partitioned join (both with
+#: the two-pass count+emit expansion).
 MPP_EXCHANGE_KERNELS = ("mpp-shuffle-join", "mpp-broadcast-join")
+
+#: the grouped-partial + on-device-merge kernel (mpp/exchange.py
+#: trace_grouped_agg_kernel): per-shard sort-group, all_gather of
+#: compacted (key, state) rows, second sort-merge, sliced emission.  The
+#: group BUDGET rides a runtime scalar slot; the checker traces two
+#: budget values and fails on any jaxpr divergence.
+MPP_GROUPED_KERNEL = "mpp-grouped-agg-merge"
 
 #: the micro-batcher's vmapped padded-batch kernel (serving/batcher.py):
 #: the q6-scalar-agg shape with predicate constants hoisted to parameter
@@ -329,6 +337,39 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"int64 equation count grew {base.get('i64_eqns')} -> "
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
+
+    # -- MPP grouped-partial + on-device-merge kernel -------------------
+    name = MPP_GROUPED_KERNEL
+    try:
+        from ..mpp.exchange import trace_grouped_agg_kernel
+
+        closed = trace_grouped_agg_kernel(budget=5)
+        stats = _jaxpr_stats(closed)
+        # the budget is a runtime slot: tracing under a DIFFERENT budget
+        # must produce the identical program (a budget baked into the
+        # jaxpr would recompile per budget value — the range-slot rule
+        # applied to the group capacity)
+        other = trace_grouped_agg_kernel(budget=9)
+        if str(closed) != str(other):
+            emit(name,
+                 "group-budget value changed the grouped kernel's jaxpr "
+                 "— the budget must stay a runtime scalar slot, never a "
+                 "compiled constant")
+        elif collect_stats is not None:
+            collect_stats[name] = stats
+        else:
+            base = baseline_kernels.get(name)
+            if base is None:
+                emit(name, f"kernel not in baseline (measured {stats}); "
+                           "run python -m tidb_tpu.lint --update-baseline")
+            elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+                emit(name,
+                     f"int64 equation count grew {base.get('i64_eqns')} "
+                     f"-> {stats['i64_eqns']}: an int64-emulation chain "
+                     "was reintroduced into the grouped merge kernel")
+    except Exception as e:  # noqa: BLE001 — contract break
+        emit(name, f"grouped agg kernel trace failed: "
+                   f"{type(e).__name__}: {e}")
 
     # -- whole-fragment fused mesh programs -----------------------------
     from ..copr.fusion import trace_fused_fragment

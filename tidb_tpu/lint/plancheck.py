@@ -235,21 +235,32 @@ class _Checker:
         rfts = self._child_fts(p, 1)
         probe = p.probe_sender
         build = p.build_sender
-        if not (0 <= probe.key_pos < len(probe.schema)):
-            self.fail(p, f"probe key pos {probe.key_pos} out of range")
-        if not (0 <= build.key_pos < len(build.schema)):
-            self.fail(p, f"build key pos {build.key_pos} out of range")
-        pkft = probe.schema.col(probe.key_pos).ftype
-        bkft = build.schema.col(build.key_pos).ftype
-        if pkft.kind != bkft.kind or pkft.scale != bkft.scale:
-            self.fail(p, f"join key domains differ: {pkft.kind.name}"
-                         f"(s{pkft.scale}) vs {bkft.kind.name}"
-                         f"(s{bkft.scale})")
+        if len(probe.key_pos) != len(build.key_pos) or not probe.key_pos:
+            self.fail(p, f"join key count mismatch: {len(probe.key_pos)} "
+                         f"probe vs {len(build.key_pos)} build")
+            return
+        for kp, kb in zip(probe.key_pos, build.key_pos):
+            if not (0 <= kp < len(probe.schema)):
+                self.fail(p, f"probe key pos {kp} out of range")
+                continue
+            if not (0 <= kb < len(build.schema)):
+                self.fail(p, f"build key pos {kb} out of range")
+                continue
+            pkft = probe.schema.col(kp).ftype
+            bkft = build.schema.col(kb).ftype
+            if pkft.kind != bkft.kind or pkft.scale != bkft.scale:
+                self.fail(p, f"join key domains differ: {pkft.kind.name}"
+                             f"(s{pkft.scale}) vs {bkft.kind.name}"
+                             f"(s{bkft.scale})")
         if p.aggs is not None:
-            width = sum(len(a.partial_types()) for a in p.aggs)
+            joined = list(probe.schema.ftypes()) + list(build.schema.ftypes())
+            for i, g in enumerate(p.group_by or ()):
+                self.check_expr(p, g, joined, f"mpp group key #{i}")
+            width = sum(len(a.partial_types()) for a in p.aggs) \
+                + len(p.group_by or ())
             if len(p.schema) != width:
                 self.fail(p, f"partial-agg schema width {len(p.schema)} "
-                             f"!= {width} partial state cols")
+                             f"!= {width} group key + partial state cols")
             return
         if len(p.schema) != len(lfts) + len(rfts):
             self.fail(p, f"join schema width {len(p.schema)} != "

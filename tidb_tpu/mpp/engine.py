@@ -78,9 +78,18 @@ class MPPIneligible(Exception):
 
 
 class MPPPartitionOverflow(Exception):
-    """A (source, destination) exchange bucket exceeded its static
-    capacity: the compiled program dropped rows, so the result is
-    incomplete and the run must step down the ladder."""
+    """A (source, destination) exchange bucket — or the two-pass join's
+    emission buffer — exceeded its static capacity: the compiled program
+    dropped rows, so the result is incomplete and the run must step down
+    the ladder."""
+
+
+class MPPGroupedAggOverflow(Exception):
+    """The per-shard or merged distinct-group count exceeded the runtime
+    group budget: the compacted group slots hold merged garbage beyond
+    the cap, so the grouped pushdown is invalid for this data.  The run
+    retries with the AGG PEELED to a host tail over the still-device-
+    resident join output (not a full host-join demotion)."""
 
 
 @dataclass
@@ -90,7 +99,7 @@ class MPPJoinSide:
     table_id: int
     dag: dict                   # serialized DAG (TableScanIR + SelectionIR*)
     ranges: List[KeyRange]
-    key_pos: int                # scan-output position of the join key
+    key_pos: List[int]          # scan-output positions of the join key(s)
     out_ftypes: list = field(default_factory=list)  # schema ftypes by pos
 
 
@@ -101,10 +110,18 @@ class MPPJoinSpec:
     kind: str                   # "inner" | "left_outer"
     probe_is_left: bool
     ts: int = 0
-    # scalar partial-agg pushdown: AggDescs over the JOINED layout
-    # (probe scan positions, then build positions at probe_width+j);
-    # only set for inner joins with probe_is_left
+    # partial-agg pushdown: AggDescs over the JOINED layout (probe scan
+    # positions, then build positions at probe_width+j); only set for
+    # inner joins with probe_is_left
     aggs: Optional[list] = None
+    # grouped partial-agg pushdown: GROUP BY expressions over the joined
+    # layout; None = scalar aggregation (G=1) when aggs is set
+    group_by: Optional[list] = None
+    # planner's group-cardinality budget: the device detects budget
+    # overflow and the run falls back to the agg-peel rung.  The STATIC
+    # group capacity pow2-buckets this value; the budget itself rides a
+    # runtime scalar slot (never enters the compiled fingerprint)
+    group_budget: int = 0
     # co-partitioned elision (PhysMPPJoin.elided): ordinal-aligned
     # (probe partition id, build partition id) pairs — the join runs per
     # pair with NO exchange between partitions (inner joins only)
@@ -161,9 +178,10 @@ class _SideState:
         an = self.an
         if an.agg or an.topn or an.probes or an.lookups or an.projection:
             raise MPPIneligible("side DAG is not scan+selection")
-        kft = an.scan.ftypes[side.key_pos]
-        if kft.kind in (TypeKind.FLOAT, TypeKind.STRING):
-            raise MPPIneligible(f"non-int join key {kft.kind.name}")
+        for kp in side.key_pos:
+            kft = an.scan.ftypes[kp]
+            if kft.kind in (TypeKind.FLOAT, TypeKind.STRING):
+                raise MPPIneligible(f"non-int join key {kft.kind.name}")
         for ft in an.scan.ftypes:
             if ft.kind == TypeKind.DECIMAL and ft.is_wide_decimal:
                 raise MPPIneligible("wide-decimal column")
@@ -227,10 +245,14 @@ def _shard_side(an: _Analyzed, col_order, n_local: int, n_ranges: int):
 
 
 def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
-                  mode: str, mesh, cap_p: int, cap_b: int):
+                  mode: str, mesh, cap_p: int, cap_b: int, cap_out: int,
+                  cap_g: int):
     """One shard_map program: per-shard scan+filter on both sides,
-    partition exchange (or build broadcast), co-partitioned local join,
-    then row emission or scalar partial aggregation."""
+    partition exchange (or build broadcast), two-pass count+emit local
+    join (non-unique and multi-column keys), then row emission, scalar
+    partial aggregation, or grouped partial aggregation with the
+    cross-shard merge ON DEVICE (all_gather of compacted (key, state)
+    rows + a second sort-merge), so only O(G) group rows leave."""
     S = len(mesh.devices.ravel())
     p_an, b_an = ps.an, bs.an
     # capture ONLY scalars/analysis objects in the shard closure: the
@@ -238,7 +260,8 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
     # closing over the _SideState objects would pin both sides' sharded
     # device arrays (and their table stores) against any cache eviction
     p_order, b_order = list(ps.col_order), list(bs.col_order)
-    p_key_pos, b_key_pos = ps.side.key_pos, bs.side.key_pos
+    p_key_pos = list(ps.side.key_pos)
+    b_key_pos = list(bs.side.key_pos)
     # range bounds ride in MESH_RANGE_SLOTS runtime scalar slots per
     # side (pad slots are empty ranges), so the range COUNT never enters
     # the fused program's fingerprint — same policy as the mesh scan
@@ -246,15 +269,26 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
     b_prep = _shard_side(b_an, b_order, bs.n_local, MESH_RANGE_SLOTS)
     n_pb = n_bb = MESH_RANGE_SLOTS
     louter = spec.kind == "left_outer"
-    n_out = S * cap_p if mode == "shuffle" else ps.n_local
     aggs = spec.aggs
+    group_by = spec.group_by
+    grouped = aggs is not None and group_by is not None
+    nk = len(group_by) if grouped else 0
+    gchunk = cap_g // S if grouped else 0
 
     def shard_fn(p_datas, p_valids, p_del, p_bounds,
-                 b_datas, b_valids, b_del, b_bounds):
+                 b_datas, b_valids, b_del, b_bounds, gbudget=None):
+        from ..copr.fusion import (grouped_partial_states,
+                                   merge_grouped_partials,
+                                   sort_group_segments)
+        from ..copr.parallel import _key_device
+
         # ---- build side: filter, partition, exchange ------------------
         b_cols, bm = b_prep(b_datas, b_valids, b_del, b_bounds)
-        bk_d, bk_v = b_cols[b_key_pos]
-        bk = bk_d.astype(jnp.int64)
+        bk = ex.combine_keys(
+            [b_cols[kp][0].astype(jnp.int64) for kp in b_key_pos])
+        bk_v = b_cols[b_key_pos[0]][1]
+        for kp in b_key_pos[1:]:
+            bk_v = bk_v & b_cols[kp][1]
         bsel = bm & bk_v  # NULL build keys never match: drop pre-exchange
         b_arrays = [bk]
         for ci in b_order:
@@ -273,12 +307,14 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             b_over = jnp.int64(0)
         rbk = recv_b[0]
         sbk, bord, nb = ex.sorted_build(rbk, b_ok)
-        dups = jax.lax.psum(ex.duplicate_keys(sbk, nb), "dp")
 
         # ---- probe side ----------------------------------------------
         p_cols, pm = p_prep(p_datas, p_valids, p_del, p_bounds)
-        pk_d, pk_v = p_cols[p_key_pos]
-        pk = pk_d.astype(jnp.int64)
+        pk = ex.combine_keys(
+            [p_cols[kp][0].astype(jnp.int64) for kp in p_key_pos])
+        pk_v = p_cols[p_key_pos[0]][1]
+        for kp in p_key_pos[1:]:
+            pk_v = pk_v & p_cols[kp][1]
         # left outer keeps NULL-key probe rows (they emit with NULL build
         # cols); inner drops them pre-exchange
         psel = pm & (pk_v if not louter else jnp.bool_(True))
@@ -299,13 +335,27 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             p_over = jnp.int64(0)
         rpk, rpk_v = recv_p[0], recv_p[1]
 
-        # ---- co-partitioned local join -------------------------------
-        hit, bidx = ex.probe_sorted(sbk, bord, nb, rpk, rpk_v & p_ok)
+        # ---- two-pass count+emit local join --------------------------
+        src, bidx, out_valid, matched, j_over = ex.expand_matches(
+            sbk, bord, nb, rpk, p_ok, rpk_v & p_ok, cap_out, louter)
         overflow = jax.lax.psum(b_over + p_over, "dp")
+        jover = jax.lax.psum(j_over, "dp")
 
         probe_out = []
         for j, ci in enumerate(p_order):
-            probe_out.append((recv_p[2 + 2 * j], recv_p[3 + 2 * j]))
+            probe_out.append(
+                (recv_p[2 + 2 * j][src], recv_p[3 + 2 * j][src]))
+        hit = matched
+        if len(p_key_pos) > 1:
+            # multi-column keys exchange/sort on a MIX-HASH: candidate
+            # spans can hold colliding unequal keys, so re-verify TRUE
+            # per-column equality on device before any row counts
+            for kp, kb in zip(p_key_pos, b_key_pos):
+                jp = p_order.index(kp)
+                jb = b_order.index(kb)
+                hit = hit & (
+                    probe_out[jp][0].astype(jnp.int64)
+                    == recv_b[1 + 2 * jb][bidx].astype(jnp.int64))
         build_out = []
         for j, ci in enumerate(b_order):
             d = recv_b[1 + 2 * j][bidx]
@@ -313,30 +363,77 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             build_out.append((d, v))
 
         if aggs is None:
+            keep = out_valid if louter else out_valid & hit
             flat = []
             for d, v in probe_out + build_out:
                 flat.append(d)
                 flat.append(v)
-            return (overflow, dups, p_ok, hit, tuple(flat))
+            return (overflow, jover, keep, tuple(flat))
 
-        # ---- scalar partial aggregation (inner join only) ------------
+        # ---- partial aggregation (inner join only) -------------------
         wp = len(p_order)
         env = {ci: probe_out[j] for j, ci in enumerate(p_order)}
         for j in range(len(b_order)):
             env[wp + j] = build_out[j]
-        row_mask = p_ok & hit
+        row_mask = out_valid & hit
+
+        if grouped:
+            # -- grouped partial aggregation below the exchange --------
+            # per-shard sort-group into the static cap_g budget, then
+            # merge partials ACROSS shards on device: all_gather the
+            # compacted (key, state) rows, second sort-merge (identical
+            # on every shard), and each shard emits its 1/S slice — the
+            # readback is O(cap_g), never O(joined rows)
+            key_bits, key_flags = [], []
+            for g in group_by:
+                d, v = compile_expr(g, env, cap_out)
+                k = _key_device(d)
+                zero = (jnp.float64(0.0) if k.dtype == jnp.float64
+                        else jnp.int64(0))
+                key_bits.append(jnp.where(v, k, zero))
+                key_flags.append(v.astype(jnp.int64))
+            order, sm, skeys, seg, pos, n_uniq = sort_group_segments(
+                key_bits, key_flags, row_mask, cap_g)
+            states = grouped_partial_states(
+                aggs, lambda e: compile_expr(e, env, cap_out),
+                order, sm, seg, cap_g)
+            out_keys = [k[pos] for k in skeys]
+            # the BUDGET is a runtime scalar slot: overflow is detected
+            # on device against it, but only the pow2 capacity shapes
+            # the compiled program
+            over_l = jax.lax.psum(
+                jnp.maximum(n_uniq - gbudget, 0), "dp")
+            slot_ok = jnp.arange(cap_g, dtype=jnp.int64) \
+                < jnp.minimum(n_uniq, cap_g)
+            g_keys = [ex.replicate(k) for k in out_keys]
+            g_ok = ex.replicate(slot_ok)
+            g_states = jax.tree_util.tree_map(ex.replicate, states)
+            mn_uniq, m_keys, m_states = merge_grouped_partials(
+                aggs, g_keys[:nk], g_keys[nk:], g_ok, g_states, cap_g)
+            over_m = jnp.maximum(mn_uniq - gbudget, 0)
+            shard = jax.lax.axis_index("dp")
+
+            def slc(y):
+                return jax.lax.dynamic_slice(y, (shard * gchunk,),
+                                             (gchunk,))
+
+            return (overflow, jover, over_l, over_m.reshape(1),
+                    mn_uniq.reshape(1), tuple(slc(k) for k in m_keys),
+                    tuple(jax.tree_util.tree_map(slc, m_states)))
+
+        # -- scalar partial aggregation --------------------------------
         states = []
         for a in aggs:
             if a.name == "count":
                 if a.args:
-                    d, v = compile_expr(a.args[0], env, n_out)
+                    d, v = compile_expr(a.args[0], env, cap_out)
                     states.append(jax.lax.psum(
                         (row_mask & v).sum().astype(jnp.int64), "dp"))
                 else:
                     states.append(jax.lax.psum(
                         row_mask.sum().astype(jnp.int64), "dp"))
                 continue
-            d, v = compile_expr(a.args[0], env, n_out)
+            d, v = compile_expr(a.args[0], env, cap_out)
             mv = row_mask & v
             if a.name in ("sum", "avg"):
                 st = a.partial_types()[0]
@@ -359,11 +456,21 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
                     part.reshape(1),
                     jax.lax.psum(mv.sum().astype(jnp.int64), "dp"),
                 ))
-        return (overflow, dups, tuple(states))
+        return (overflow, jover, tuple(states))
 
     if aggs is None:
-        out_specs = (P(), P(), P("dp"), P("dp"), tuple(
+        out_specs = (P(), P(), P("dp"), tuple(
             P("dp") for _ in range(2 * (len(p_order) + len(b_order)))))
+    elif grouped:
+        out_states = []
+        for a in aggs:
+            if a.name == "count":
+                out_states.append(P("dp"))
+            else:
+                out_states.append((P("dp"), P("dp")))
+        out_specs = (P(), P(), P(), P("dp"), P("dp"),
+                     tuple(P("dp") for _ in range(2 * nk)),
+                     tuple(out_states))
     else:
         out_states = []
         for a in aggs:
@@ -375,14 +482,14 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
                 out_states.append((P("dp"), P()))
         out_specs = (P(), P(), tuple(out_states))
 
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), tuple(P() for _ in
-                                                   range(2 * n_pb)),
-                  P("dp"), P("dp"), P("dp"), tuple(P() for _ in
-                                                   range(2 * n_bb))),
-        out_specs=out_specs,
-    )
+    in_specs = (P("dp"), P("dp"), P("dp"), tuple(P() for _ in
+                                                 range(2 * n_pb)),
+                P("dp"), P("dp"), P("dp"), tuple(P() for _ in
+                                                 range(2 * n_bb)))
+    if grouped:
+        in_specs = in_specs + (P(),)  # the runtime group-budget slot
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
     return _packed_jit(fn)
 
 
@@ -406,9 +513,9 @@ def _to_column(table, an: _Analyzed, pos: int, ft, data: np.ndarray,
 
 
 def _assemble_rows(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
-                   p_ok, hit, flat) -> List[Chunk]:
+                   keep, flat) -> List[Chunk]:
     louter = spec.kind == "left_outer"
-    sel = np.flatnonzero(p_ok & hit) if not louter else np.flatnonzero(p_ok)
+    sel = np.flatnonzero(keep)
     wp = len(ps.col_order)
     probe_cols, build_cols = [], []
     for j, ci in enumerate(ps.col_order):
@@ -457,6 +564,71 @@ def _assemble_partials(spec: MPPJoinSpec, states, S: int) -> List[Chunk]:
     return [Chunk(cols)]
 
 
+def _assemble_grouped(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
+                      n_uniq, keys, states) -> List[Chunk]:
+    """Device-merged grouped partials -> ONE partial chunk in the
+    [keys..., states...] layout the root final HashAgg merges.  String
+    group keys come back as dictionary codes and decode through the
+    OWNING side's store (probe scan positions < probe width, build
+    positions above)."""
+    from ..types import TypeKind as TK
+
+    nk = len(spec.group_by)
+    k = int(n_uniq[0])
+    wp = len(ps.col_order)
+    cols: List[Column] = []
+    for i, g in enumerate(spec.group_by):
+        bits = keys[i][:k]
+        flags = keys[nk + i][:k].astype(np.bool_)
+        ft = g.ftype
+        if ft.kind == TK.FLOAT:
+            data = bits.astype(np.float64, copy=False)
+        elif ft.kind == TK.STRING:
+            from ..store.blockstore import _decode_dict
+
+            st, ci = (ps, g.index) if g.index < wp else (bs, g.index - wp)
+            store_ci = st.an.scan.columns[ci]
+            data = _decode_dict(bits.astype(np.int64),
+                                st.table.cols[store_ci].dictionary)
+        else:
+            data = bits.astype(ft.np_dtype)
+        cols.append(Column(ft, data, flags if not flags.all() else None))
+    for a, st in zip(spec.aggs, states):
+        pts = a.partial_types()
+        if a.name == "count":
+            cols.append(Column(pts[0], st[:k].astype(np.int64)))
+        elif a.name in ("sum", "avg"):
+            s, c = st[0][:k], st[1][:k]
+            cols.append(Column(pts[0], s.astype(pts[0].np_dtype), c > 0))
+            if a.name == "avg":
+                cols.append(Column(pts[1], c.astype(np.int64)))
+        else:  # min / max (value, count) — already merged across shards
+            v, c = st[0][:k], st[1][:k]
+            cols.append(Column(pts[0], v.astype(pts[0].np_dtype), c > 0))
+    chunk = Chunk(cols)
+    return [chunk] if chunk.num_rows else []
+
+
+def grouped_pushdown_enabled() -> bool:
+    """The one home of the TIDB_TPU_MPP_GROUPED knob (the planner's
+    pushdown gate and the engine's force-peel comparator both read it):
+    default on, "0" disables."""
+    import os
+
+    return os.environ.get("TIDB_TPU_MPP_GROUPED", "1") != "0"
+
+
+def _host_grouped_partials(spec: MPPJoinSpec,
+                           chunks: List[Chunk]) -> List[Chunk]:
+    """The agg-peel rung's host tail: grouped PARTIAL aggregation over
+    the device-joined row chunks (the join stayed on device; only the
+    blown-budget agg moved to the host).  Per-chunk partials are fine —
+    the parent is a FINAL HashAgg and merges groups across chunks."""
+    from ..copr.cpu_engine import grouped_partial_chunks
+
+    return grouped_partial_chunks(spec.group_by, spec.aggs, chunks)
+
+
 # ---------------------------------------------------------------------------
 # entry
 # ---------------------------------------------------------------------------
@@ -474,6 +646,25 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     slack = _slack()
     cap_p = min(_pow2ceil(int(slack * ps.n_local / S) + 1), ps.n_local)
     cap_b = min(_pow2ceil(int(slack * bs.n_local / S) + 1), bs.n_local)
+    # two-pass join emission buffer: sized to the received probe rows
+    # times TIDB_TPU_MPP_JOIN_SLACK (>1 buys headroom for duplicate-key
+    # expansion; emission overflow steps down the ladder)
+    import os as _os
+
+    n_recv = S * cap_p if mode == "shuffle" else ps.n_local
+    cap_out = max(
+        int(float(_os.environ.get("TIDB_TPU_MPP_JOIN_SLACK", "1.0"))
+            * n_recv), 16)
+    grouped = spec.aggs is not None and spec.group_by is not None
+    budget, cap_g = 0, 0
+    if grouped:
+        budget = (int(_os.environ.get("TIDB_TPU_MPP_GROUP_BUDGET", "0"))
+                  or spec.group_budget or 4096)
+        # pow2-bucketed STATIC capacity, padded to a multiple of S so
+        # every shard emits an equal slice of the merged groups; the
+        # budget itself stays a runtime scalar slot
+        cap_g0 = _pow2ceil(budget)
+        cap_g = S * (-(-cap_g0 // S))
 
     # column arrays load before the program lookup (compiled programs are
     # specialized on wire dtypes / null patterns, like the mesh scan)
@@ -489,22 +680,33 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
         agg_sig = _json.dumps(
             [[a.name] + [serialize_expr(x) for x in a.args]
              for a in spec.aggs], sort_keys=True)
+    group_sig = ""
+    if grouped:
+        group_sig = _json.dumps(
+            [serialize_expr(g) for g in spec.group_by], sort_keys=True)
     fp = (f"mpp|{mode}|{spec.kind}|pil={spec.probe_is_left}"
-          f"|S={S} devs={mesh_ids} caps={cap_p},{cap_b}"
+          f"|S={S} devs={mesh_ids} caps={cap_p},{cap_b},{cap_out}"
           f"|p:{_fingerprint(ps.an, 'filter')}|Tl={ps.Tl}"
           f"|k={spec.probe.key_pos}|wire={ps.wire_sig}"
           f"|b:{_fingerprint(bs.an, 'filter')}|Tl={bs.Tl}"
           f"|k={spec.build.key_pos}|wire={bs.wire_sig}"
-          f"|aggs={agg_sig}")
+          f"|aggs={agg_sig}|gb={group_sig}|capg={cap_g}")
     fn = _COMPILED.get(fp)
     if fn is None:
-        fn = _build_mpp_fn(spec, ps, bs, mode, mesh, cap_p, cap_b)
+        fn = _build_mpp_fn(spec, ps, bs, mode, mesh, cap_p, cap_b,
+                           cap_out, cap_g)
         _COMPILED.put(fp, fn)
 
     # deterministic mid-shuffle fault injection (chaos harness): fires
     # after both sides are device-resident, before the exchange program
     FAILPOINTS.hit("mpp/exchange", mode=mode, device_ids=mesh_ids,
                    kind=spec.kind)
+    if grouped:
+        # chaos site for the grouped-agg overflow rung: an armed action
+        # raises MPPGroupedAggOverflow, driving the same agg-peel path a
+        # genuine on-device budget overflow takes
+        FAILPOINTS.hit("mpp/grouped_agg_overflow", mode=mode,
+                       budget=budget, cap_g=cap_g)
 
     def bounds_args(st: _SideState):
         # the mesh scan's slot padding, verbatim (one pad policy)
@@ -512,26 +714,32 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
 
     from ..copr.parallel import DISPATCH_LOCK
 
+    args = (tuple(ps.datas), tuple(ps.valids), ps.del_mask,
+            bounds_args(ps),
+            tuple(bs.datas), tuple(bs.valids), bs.del_mask,
+            bounds_args(bs))
+    if grouped:
+        args = args + (jnp.int64(budget),)
     with DISPATCH_LOCK:
         # collective programs serialize per process (see parallel.py:
         # concurrent shard_map launches deadlock at the rendezvous)
-        out = fn(tuple(ps.datas), tuple(ps.valids), ps.del_mask,
-                 bounds_args(ps),
-                 tuple(bs.datas), tuple(bs.valids), bs.del_mask,
-                 bounds_args(bs))
-    overflow, dups = int(out[0]), int(out[1])
-    if dups:
-        # the planner's uniqueness inference was wrong: the device picks
-        # one arbitrary match per probe row, so its output cannot be
-        # trusted — demote to the host join, which expands duplicates
-        REGISTRY.inc("mpp_build_dup_fallback_total")
-        raise MPPIneligible(
-            "build keys not unique (planner uniqueness inference "
-            "violated); host join handles duplicates")
+        out = fn(*args)
+    overflow, jover = int(out[0]), int(out[1])
     if overflow:
         raise MPPPartitionOverflow(
             f"{overflow} rows over per-partition capacity "
             f"(cap_p={cap_p}, cap_b={cap_b}, mode={mode})")
+    if jover:
+        raise MPPPartitionOverflow(
+            f"{jover} joined rows over the emission buffer "
+            f"(cap_out={cap_out}, mode={mode}): duplicate-key expansion "
+            "outgrew the two-pass emit budget")
+    if grouped:
+        over_l, over_m = int(out[2]), int(np.max(out[3]))
+        if over_l or over_m:
+            raise MPPGroupedAggOverflow(
+                f"distinct groups over budget {budget} "
+                f"(per-shard over {over_l}, merged over {over_m})")
 
     # exchange traffic accounting (static shapes: what the program moved)
     if mode == "shuffle":
@@ -556,19 +764,35 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     from ..copr.device_health import DEVICE_HEALTH
 
     DEVICE_HEALTH.record_success(mesh_ids)
+    if grouped:
+        REGISTRY.inc("mpp_grouped_agg_pushed_total")
+        annotate(groups=int(out[4][0]), group_budget=budget)
+        return _assemble_grouped(spec, ps, bs, out[4], out[5], out[6])
     if spec.aggs is not None:
         return _assemble_partials(spec, out[2], S)
-    return _assemble_rows(spec, ps, bs, out[2], out[3], out[4])
+    return _assemble_rows(spec, ps, bs, out[2], out[3])
 
 
 def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
     """Run the join over the mesh; (chunks, mode) on success, raises
     MPPIneligible when the host rung must serve it.  Overflow and device
-    failures step down the ladder internally."""
+    failures step down the ladder internally.
+
+    Grouped pushdown has its own fallback rung: a group-budget overflow
+    retries the SAME join rung with the aggregation PEELED to a host
+    tail over the device-joined rows (mode suffix "+agg-peel"); a
+    successful grouped pushdown reports mode suffix "+grouped"."""
+    import dataclasses
+
     from ..trace import span
 
     mode = "shuffle"
     attempts = 0
+    # TIDB_TPU_MPP_GROUPED=0 forces the agg-peel rung from the start:
+    # the join still runs on device, every joined row ships to the host
+    # and aggregates there — the bench's host-merge comparator
+    peel = (spec.group_by is not None and spec.aggs is not None
+            and not grouped_pushdown_enabled())
     while True:
         # cancellation seam at every rung transition/retry: a cancelled
         # statement must not start the next exchange program (the typed
@@ -580,12 +804,36 @@ def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
         current_scope().check()
         if _no_eligible_devices():
             raise MPPIneligible("all device breakers open")
+        run_spec = spec
+        if peel:
+            # the join stays on device; only the agg leaves for the host
+            run_spec = dataclasses.replace(spec, aggs=None, group_by=None)
         try:
-            with span("mpp.exchange", rung=mode, kind=spec.kind):
-                chunks = _run_once(storage, spec, mode)
+            with span("mpp.exchange", rung=mode, kind=spec.kind,
+                      grouped=bool(spec.group_by), peel=peel):
+                chunks = _run_once(storage, run_spec, mode)
+            if peel:
+                with span("mpp.agg_peel", rung=mode):
+                    chunks = _host_grouped_partials(spec, chunks)
+                mode = mode + "+agg-peel"
+            elif spec.group_by is not None and spec.aggs is not None:
+                mode = mode + "+grouped"
             REGISTRY.inc("mpp_joins_total")
-            REGISTRY.inc(f"mpp_joins_{mode}_total")
+            # rung suffixes use '+'/'-' for human surfaces (EXPLAIN
+            # ANALYZE); metric names must stay in the Prometheus
+            # grammar [a-zA-Z0-9_:] or the whole /metrics scrape fails
+            REGISTRY.inc("mpp_joins_"
+                         + mode.replace("+", "_").replace("-", "_")
+                         + "_total")
             return chunks, mode
+        except MPPGroupedAggOverflow as e:
+            REGISTRY.inc("mpp_grouped_agg_overflow_total")
+            REGISTRY.inc("mpp_grouped_agg_fallback_total")
+            from ..trace import annotate
+
+            annotate(grouped_agg_overflow=str(e)[:120])
+            peel = True
+            continue
         except MPPPartitionOverflow as e:
             REGISTRY.inc("mpp_partition_overflow_total")
             if mode == "shuffle":

@@ -86,30 +86,63 @@ def replicate(local, axis_name: str = "dp"):
     return jax.lax.all_gather(local, axis_name).reshape(-1)
 
 
+def combine_keys(keys):
+    """Fold multiple int64 join-key columns into ONE int64 sort/partition
+    key (identity for a single column, so single-key joins keep exact
+    equality).  Multi-column combination is a mix-hash: colliding unequal
+    keys land in the same sorted span, so callers must re-verify TRUE
+    per-column equality on candidate matches (expand_matches emits the
+    candidates; the engine filters)."""
+    h = keys[0]
+    for k in keys[1:]:
+        h = (h * _MIX) ^ k ^ ((h >> 29) & 0x7FFFFFFF)
+    return h
+
+
 def sorted_build(keys, valid):
     """(sorted keys with invalid rows pushed to +inf, source order,
     valid count) — the device hash table: searchsorted probes against
-    the sorted unique build keys."""
+    the sorted build keys (duplicates stay adjacent)."""
     sortk = jnp.where(valid, keys, I64_MAX)
     order = jnp.argsort(sortk)
     return sortk[order], order, valid.sum()
 
 
-def probe_sorted(sbk, bord, nb, probe_keys, probe_ok):
-    """(hit mask, matched build source index) for each probe row against
-    a sorted unique build key set."""
-    pos = jnp.searchsorted(sbk, probe_keys)
-    posc = jnp.clip(pos, 0, sbk.shape[0] - 1)
-    hit = (pos < nb) & (sbk[posc] == probe_keys) & probe_ok
-    return hit, bord[posc]
+def expand_matches(sbk, bord, nb, probe_keys, probe_emit, probe_match_ok,
+                   cap_out: int, louter: bool):
+    """Two-pass count+emit join expansion over NON-UNIQUE build keys.
 
+    Pass 1 (count): each probe row's match span in the sorted build keys
+    is [lo, hi) via two searchsorteds; cnt = hi - lo candidate matches.
+    Pass 2 (emit): output slot t maps back to its source probe row via
+    searchsorted on the exclusive prefix sums — every (probe row, match
+    ordinal) pair lands in one of `cap_out` static output slots.
 
-def duplicate_keys(sbk, nb):
-    """Count adjacent equal VALID keys in a sorted build key array — the
-    planner's uniqueness inference is re-verified on device; a nonzero
-    count demotes the join to the host (which handles duplicates)."""
-    ar = jnp.arange(sbk.shape[0])
-    return ((sbk == jnp.roll(sbk, 1)) & (ar > 0) & (ar < nb)).sum()
+    Left-outer probe rows with no match still emit ONE row (`matched`
+    False there — the engine NULL-extends the build columns).  Total
+    emissions beyond cap_out are DROPPED on device; the returned
+    overflow scalar is how the host learns the result is incomplete.
+
+    Returns (src, bidx, out_valid, matched, overflow): per-slot source
+    probe row, matched build source row, slot-live mask, true-match-span
+    mask, and the clamped overflow count.
+    """
+    n = probe_keys.shape[0]
+    lo = jnp.searchsorted(sbk, probe_keys, side="left")
+    hi = jnp.minimum(jnp.searchsorted(sbk, probe_keys, side="right"), nb)
+    cnt = jnp.where(probe_match_ok, jnp.maximum(hi - lo, 0), 0)
+    emit_cnt = (jnp.where(probe_emit, jnp.maximum(cnt, 1), 0)
+                if louter else cnt)
+    total = emit_cnt.sum().astype(jnp.int64)
+    overflow = jnp.maximum(total - cap_out, 0)
+    starts = jnp.cumsum(emit_cnt) - emit_cnt
+    t = jnp.arange(cap_out, dtype=starts.dtype)
+    src = jnp.clip(jnp.searchsorted(starts, t, side="right") - 1, 0, n - 1)
+    j = t - starts[src]
+    matched = j < cnt[src]
+    bpos = jnp.clip(lo[src] + j, 0, sbk.shape[0] - 1)
+    out_valid = t < total
+    return src, bord[bpos], out_valid, matched & out_valid, overflow
 
 
 # ---------------------------------------------------------------------------
@@ -120,7 +153,9 @@ def duplicate_keys(sbk, nb):
 def _canonical_join_fn(S: int, cap: int, n_local: int, mode: str):
     """The canonical partition -> exchange -> local-join program shape
     the lint kernelcheck traces (no tables, no engine state): one int64
-    key + one f64 payload per side, inner-join semantics."""
+    key + one f64 payload per side, inner-join semantics with the
+    production two-pass count+emit expansion (non-unique build keys)."""
+    cap_out = S * cap if mode == "shuffle" else n_local
 
     def shard_fn(pk, pm, bk, bm, pv):
         if mode == "shuffle":
@@ -141,10 +176,12 @@ def _canonical_join_fn(S: int, cap: int, n_local: int, mode: str):
             rpk, p_ok = pk, pm
             b_over = p_over = jnp.int64(0)
         sbk, bord, nb = sorted_build(rbk, b_ok)
-        hit, bidx = probe_sorted(sbk, bord, nb, rpk, p_ok)
-        payload = jnp.where(hit, rbv[bidx], 0.0)
+        src, bidx, out_valid, matched, j_over = expand_matches(
+            sbk, bord, nb, rpk, p_ok, p_ok, cap_out, False)
+        payload = jnp.where(matched, rbv[bidx], 0.0)
         overflow = jax.lax.psum(b_over + p_over, "dp")
-        return overflow, hit, payload
+        jover = jax.lax.psum(j_over, "dp")
+        return overflow, jover, matched, payload
 
     return shard_fn
 
@@ -164,11 +201,94 @@ def trace_exchange_kernel(mode: str = "shuffle"):
     fn = shard_map(
         _canonical_join_fn(S, cap, n_local, mode), mesh=mesh,
         in_specs=(P("dp"),) * 5,
-        out_specs=(P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P("dp"), P("dp")),
     )
     args = (
         jnp.zeros(n_local, jnp.int64), jnp.ones(n_local, jnp.bool_),
         jnp.zeros(n_local, jnp.int64), jnp.ones(n_local, jnp.bool_),
         jnp.zeros(n_local, jnp.float64),
+    )
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _canonical_grouped_fn(S: int, cap_out: int, cap_g: int):
+    """Canonical grouped-partial + on-device-merge program: one int64
+    group key + one f64 measure over cap_out joined rows — per-shard
+    sort-group into cap_g slots, all_gather of the compacted
+    (key, state) rows, second sort-merge, per-shard slice emission.
+    The group BUDGET is the runtime scalar argument: kernelcheck
+    asserts the traced jaxpr is IDENTICAL across budget values."""
+    from ..copr.fusion import (grouped_partial_states,
+                               merge_grouped_partials,
+                               sort_group_segments)
+    from ..expr.aggregation import AggDesc
+    from ..types import FieldType, TypeKind
+
+    f64 = FieldType(TypeKind.FLOAT)
+    aggs = [AggDesc("count", [], False, FieldType(TypeKind.INT)),
+            AggDesc("sum", [_CanonArg(f64)], False, f64)]
+    gchunk = cap_g // S
+
+    def shard_fn(gk, gv, meas, mm, gbudget):
+        key_bits = [jnp.where(gv, gk, 0)]
+        key_flags = [gv.astype(jnp.int64)]
+        order, sm, skeys, seg, pos, n_uniq = sort_group_segments(
+            key_bits, key_flags, mm, cap_g)
+        states = grouped_partial_states(
+            aggs, lambda e: (meas, mm), order, sm, seg, cap_g)
+        out_keys = [k[pos] for k in skeys]
+        over_l = jax.lax.psum(jnp.maximum(n_uniq - gbudget, 0), "dp")
+        slot_ok = jnp.arange(cap_g, dtype=jnp.int64) \
+            < jnp.minimum(n_uniq, cap_g)
+        g_keys = [replicate(k) for k in out_keys]
+        g_ok = replicate(slot_ok)
+        g_states = jax.tree_util.tree_map(replicate, states)
+        mn_uniq, m_keys, m_states = merge_grouped_partials(
+            aggs, g_keys[:1], g_keys[1:], g_ok, g_states, cap_g)
+        over_m = jnp.maximum(mn_uniq - gbudget, 0)
+        shard = jax.lax.axis_index("dp")
+
+        def slc(y):
+            return jax.lax.dynamic_slice(y, (shard * gchunk,), (gchunk,))
+
+        return (over_l, over_m.reshape(1), mn_uniq.reshape(1),
+                tuple(slc(k) for k in m_keys),
+                tuple(jax.tree_util.tree_map(slc, m_states)))
+
+    return shard_fn
+
+
+class _CanonArg:
+    """Minimal expression stand-in for the canonical grouped kernel:
+    grouped_partial_states only reads `.args[0].ftype` and calls the
+    arg_fn closure, which ignores the expression object."""
+
+    def __init__(self, ftype):
+        self.ftype = ftype
+
+
+def trace_grouped_agg_kernel(budget: int = 7):
+    """make_jaxpr stats for the canonical grouped-partial + merge
+    program over a 1-device mesh; `budget` rides the runtime scalar
+    slot — lint.kernelcheck traces two budgets and requires identical
+    jaxprs (the budget must never become a compiled constant)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    S, cap_out, cap_g = 1, 256, 32
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    fn = shard_map(
+        _canonical_grouped_fn(S, cap_out, cap_g), mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P()),
+        out_specs=(P(), P("dp"), P("dp"), (P("dp"),) * 2,
+                   (P("dp"), (P("dp"), P("dp")))),
+    )
+    args = (
+        jnp.zeros(cap_out, jnp.int64), jnp.ones(cap_out, jnp.bool_),
+        jnp.zeros(cap_out, jnp.float64), jnp.ones(cap_out, jnp.bool_),
+        jnp.int64(budget),
     )
     return jax.make_jaxpr(fn)(*args)

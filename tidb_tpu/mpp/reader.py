@@ -116,23 +116,24 @@ class MPPReaderExec(Executor):
     def _build_host_join(self, spec):
         """Root hash join over a spec's two cop DAGs (always correct:
         handles deltas, duplicates, overflow shapes).  Inner joins keep
-        the MPP plan's selectivity win: the build side's distinct keys
-        ship to the probe scan as a runtime semi-join filter
-        (JoinProbeIR), so non-matching probe rows die in the coprocessor
-        instead of streaming to the host join."""
+        the MPP plan's selectivity win: the FIRST key's build-side
+        distinct values ship to the probe scan as a runtime semi-join
+        filter (JoinProbeIR — a superset filter under multi-column keys,
+        the join re-checks full equality), so non-matching probe rows
+        die in the coprocessor instead of streaming to the host join."""
         from ..copr.ir import JoinProbeIR
         from ..executor.join import HashJoinExec
 
-        pk = ColumnExpr(spec.probe.key_pos,
-                        spec.probe.out_ftypes[spec.probe.key_pos], "pk", -1)
-        bk = ColumnExpr(spec.build.key_pos,
-                        spec.build.out_ftypes[spec.build.key_pos], "bk", -1)
-        probe_ir = JoinProbeIR(pk, filter_id=0) \
+        pks = [ColumnExpr(kp, spec.probe.out_ftypes[kp], "pk", -1)
+               for kp in spec.probe.key_pos]
+        bks = [ColumnExpr(kb, spec.build.out_ftypes[kb], "bk", -1)
+               for kb in spec.build.key_pos]
+        probe_ir = JoinProbeIR(pks[0], filter_id=0) \
             if spec.kind == "inner" else None
         probe = self._side_reader(spec.probe, probe_ir)
         build = self._side_reader(spec.build)
         return HashJoinExec(
-            self.ctx, build, probe, spec.kind, [bk], [pk], [],
+            self.ctx, build, probe, spec.kind, bks, pks, [],
             probe_is_left=spec.probe_is_left, plan_id=-1,
             rf_reader=probe if probe_ir is not None else None,
             rf_key_idx=0, rf_filter_id=0)
@@ -145,8 +146,9 @@ class MPPReaderExec(Executor):
 
         with span("mpp.host_join", reason=reason[:80]):
             join = self._build_host_join(pair)
+            grouped = pair.aggs is not None and pair.group_by is not None
             folds = ([_AggFold(a) for a in pair.aggs]
-                     if pair.aggs is not None else None)
+                     if pair.aggs is not None and not grouped else None)
             out: List[Chunk] = []
             join.open()
             try:
@@ -156,7 +158,9 @@ class MPPReaderExec(Executor):
                         break
                     if not c.num_rows:
                         continue
-                    if folds is None:
+                    if grouped:
+                        out.extend(_grouped_fold(pair, c))
+                    elif folds is None:
                         out.append(c)
                     else:
                         for f in folds:
@@ -177,22 +181,34 @@ class MPPReaderExec(Executor):
             self._fallback.open()
             return
         # partial-agg pushdown plan: the parent is a FINAL HashAgg, so
-        # the host rung must emit the same [states...] partial layout.
-        # Fold per chunk — an MPP-eligible join is big by construction,
-        # so the joined rows must never be materialized whole
-        folds = [_AggFold(a) for a in spec.aggs]
+        # the host rung must emit the same [keys..., states...] partial
+        # layout.  Fold per chunk — an MPP-eligible join is big by
+        # construction, so the joined rows must never materialize whole;
+        # grouped plans emit per-chunk grouped partials (the final
+        # HashAgg merges groups across chunks)
+        grouped = spec.group_by is not None
+        folds = [_AggFold(a) for a in spec.aggs] if not grouped else None
+        chunks: List[Chunk] = []
         join.open()
         try:
             while True:
                 c = join.next()
                 if c is None:
                     break
-                if c.num_rows:
+                if not c.num_rows:
+                    continue
+                if grouped:
+                    chunks.extend(_grouped_fold(spec, c))
+                else:
                     for f in folds:
                         f.consume(c)
         finally:
             join.close()
-        self._chunks = [Chunk([col for f in folds for col in f.partials()])]
+        if grouped:
+            self._chunks = chunks
+        else:
+            self._chunks = [
+                Chunk([col for f in folds for col in f.partials()])]
 
     def _next(self) -> Optional[Chunk]:
         if self._fallback is not None:
@@ -211,6 +227,14 @@ class MPPReaderExec(Executor):
         if self._fallback is not None:
             self._fallback.close()
             self._fallback = None
+
+
+def _grouped_fold(spec, chunk: Chunk) -> List[Chunk]:
+    """Host-rung grouped partials for one joined chunk (the shared
+    copr recipe; the parent FINAL HashAgg merges across chunks)."""
+    from ..copr.cpu_engine import grouped_partial_chunks
+
+    return grouped_partial_chunks(spec.group_by, spec.aggs, [chunk])
 
 
 class _AggFold:

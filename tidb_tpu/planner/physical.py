@@ -235,11 +235,11 @@ class PhysExchangeSender(PhysTableReader):
     mpp.ExchangeSenderBlockInputStream role, realized as a
     `jax.lax.all_to_all` inside the shard_map program)."""
 
-    def __init__(self, schema: Schema, task: CopTask, key_pos: int,
+    def __init__(self, schema: Schema, task: CopTask, key_pos: List[int],
                  ranges: Optional[List[KeyRange]] = None,
                  elided: bool = False):
         super().__init__(schema, task, keep_order=False, ranges=ranges)
-        self.key_pos = key_pos
+        self.key_pos = list(key_pos)  # scan positions of the join key(s)
         # co-partitioned elision: this fragment IS already partitioned on
         # the join key (hash-partitioned table), so no exchange runs —
         # the node renders as a plain MPP scan
@@ -253,7 +253,7 @@ class PhysExchangeSender(PhysTableReader):
         return "mpp[tpu]"
 
     def info(self) -> str:
-        key = self.cop.scan_cols[self.key_pos].name
+        key = ", ".join(self.cop.scan_cols[k].name for k in self.key_pos)
         if self.elided:
             return (f"co-partitioned on {key} "
                     f"(hash, {len(self.cop.table.partition_info.defs)} "
@@ -288,13 +288,18 @@ class PhysMPPJoin(PhysicalPlan):
     def __init__(self, left_recv, right_recv, kind: str,
                  probe_is_left: bool, schema: Schema,
                  left_keys: List[Expression], right_keys: List[Expression],
-                 aggs=None, reason: str = "", elided: bool = False):
+                 aggs=None, group_by=None, group_budget: int = 0,
+                 reason: str = "", elided: bool = False):
         super().__init__(schema, [left_recv, right_recv])
         self.kind = kind
         self.probe_is_left = probe_is_left
         self.left_keys = left_keys
         self.right_keys = right_keys
-        self.aggs = aggs  # scalar partial-agg pushdown (joined layout)
+        self.aggs = aggs  # partial-agg pushdown (joined layout)
+        # grouped partial-agg pushdown: GROUP BY exprs (joined layout) +
+        # the cost-model group budget the device checks at runtime
+        self.group_by = group_by
+        self.group_budget = group_budget
         self.reason = reason  # cost-choice note surfaced in EXPLAIN
         # co-partitioned elision: children are bare MPPScan fragments
         # (no sender/receiver pair); the join runs per partition pair
@@ -320,6 +325,9 @@ class PhysMPPJoin(PhysicalPlan):
         s += ", build:" + ("right" if self.probe_is_left else "left")
         if self.aggs is not None:
             s += f", partial aggs:[{', '.join(map(str, self.aggs))}]"
+        if self.group_by:
+            s += (f", group by:[{', '.join(map(str, self.group_by))}]"
+                  f" budget:{self.group_budget}")
         if self.reason:
             s += f" ({self.reason})"
         return s
@@ -332,14 +340,15 @@ class PhysMPPJoin(PhysicalPlan):
                 table_id=sender.cop.table.id,
                 dag=sender.dag.to_dict(),
                 ranges=list(sender.ranges),
-                key_pos=sender.key_pos,
+                key_pos=list(sender.key_pos),
                 out_ftypes=sender.dag.output_ftypes(),
             )
 
         spec = MPPJoinSpec(
             probe=side(self.probe_sender), build=side(self.build_sender),
             kind=self.kind, probe_is_left=self.probe_is_left,
-            aggs=self.aggs)
+            aggs=self.aggs, group_by=self.group_by,
+            group_budget=self.group_budget)
         if self.elided:
             # partition pairs aligned by ordinal: partition i of the
             # probe table joins ONLY partition i of the build table
@@ -1685,23 +1694,34 @@ _MPP_OUT_KINDS = _DJ_PAYLOAD_KINDS + (TypeKind.STRING,)
 def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
     """Structural + cost gates for the MPP shuffle join; returns
     (probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
-    build_est) or None.  Mirrors TiFlash's MPP eligibility:
-    single int-domain equi-key, unique build key (device joins are
-    lookup joins), plain scan[+selection] fragments on both sides."""
-    if join.kind not in ("inner", "left_outer") or len(join.eq_conds) != 1 \
+    build_est, copart) with pk_pos/bk_pos as scan-position LISTS, or
+    None.  Mirrors TiFlash's MPP eligibility: int-domain equi-keys
+    (multi-column inner joins exchange a mix-hash and re-verify true
+    equality on device; build keys may be NON-unique — the local join
+    is a two-pass count+emit expansion), plain scan[+selection]
+    fragments on both sides."""
+    if join.kind not in ("inner", "left_outer") or not join.eq_conds \
             or join.other_conds:
+        return None
+    # multi-column keys exchange on a mix-hash whose collisions are
+    # filtered per-candidate on device — that drops candidate rows,
+    # which is only sound for inner joins (a left-outer probe row could
+    # lose its NULL-extension slot to a collision)
+    if len(join.eq_conds) > 1 and join.kind != "inner":
         return None
     if not pctx.allow_mpp or not pctx.enable_pushdown \
             or pctx.prefer_merge_join:
         return None
-    le, re_ = join.eq_conds[0]
-    if not isinstance(le, ColumnExpr) or not isinstance(re_, ColumnExpr):
+    if any(not isinstance(le, ColumnExpr) or not isinstance(re_, ColumnExpr)
+           for le, re_ in join.eq_conds):
         return None
     left, right = join.children
-    orders = [(left, right, le, re_, True)]
+    les = [le for le, _ in join.eq_conds]
+    res = [re_ for _, re_ in join.eq_conds]
+    orders = [(left, right, les, res, True)]
     if join.kind == "inner":
-        orders.append((right, left, re_, le, False))
-    for probe_l, build_l, pk, bk, probe_is_left in orders:
+        orders.append((right, left, res, les, False))
+    for probe_l, build_l, pks, bks, probe_is_left in orders:
         if not isinstance(probe_l, LogicalDataSource) \
                 or not isinstance(build_l, LogicalDataSource):
             continue
@@ -1712,21 +1732,22 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
             # partition counts means partition i of one side can only
             # match partition i of the other — the join runs per
             # partition pair with NO exchange operators.  Inner joins
-            # only: a pruned build partition then simply contributes
-            # nothing.  Anything else stays per-partition-store sharded
-            # and takes the host lanes (ROADMAP PR-3 follow-up (d)).
-            copart = (join.kind == "inner"
-                      and _co_partitioned(probe_l, pk, build_l, bk))
+            # with a single key only: a pruned build partition then
+            # simply contributes nothing.  Anything else stays
+            # per-partition-store sharded and takes the host lanes.
+            copart = (join.kind == "inner" and len(pks) == 1
+                      and _co_partitioned(probe_l, pks[0], build_l,
+                                          bks[0]))
             if not copart:
                 continue
-        if pk.ftype.kind not in _DJ_KEY_KINDS \
-                or bk.ftype.kind != pk.ftype.kind:
+        if any(pk.ftype.kind not in _DJ_KEY_KINDS
+               or bk.ftype.kind != pk.ftype.kind
+               for pk, bk in zip(pks, bks)):
             continue
-        if pk.ftype.kind == TypeKind.DECIMAL \
-                and bk.ftype.scale != pk.ftype.scale:
+        if any(pk.ftype.kind == TypeKind.DECIMAL
+               and bk.ftype.scale != pk.ftype.scale
+               for pk, bk in zip(pks, bks)):
             continue
-        if bk.unique_id < 0 or not _build_key_unique(build_l, bk.unique_id):
-            continue  # device join is a lookup join: <=1 match per probe
         if any(c.ftype.kind not in _MPP_OUT_KINDS
                or (c.ftype.kind == TypeKind.DECIMAL
                    and c.ftype.is_wide_decimal)
@@ -1741,9 +1762,10 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
         if any(not isinstance(x, SelectionIR)
                for x in p_task.dag_execs + b_task.dag_execs):
             continue
-        pk_pos = p_task.scan_pos_map().get(pk.unique_id)
-        bk_pos = b_task.scan_pos_map().get(bk.unique_id)
-        if pk_pos is None or bk_pos is None:
+        pk_pos = [p_task.scan_pos_map().get(pk.unique_id) for pk in pks]
+        bk_pos = [b_task.scan_pos_map().get(bk.unique_id) for bk in bks]
+        if any(p is None for p in pk_pos) or any(b is None
+                                                 for b in bk_pos):
             continue
         # cost gate: small build sides are served better by the
         # broadcast lookup / host lanes (no exchange); the shuffle wins
@@ -1751,6 +1773,15 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
         build_est = _est_rows(
             PhysTableReader(Schema(b_task.scan_cols), b_task, False,
                             build_l.ranges), pctx)
+        if not probe_is_left:
+            # the reversed order exists so the SMALLER side builds; now
+            # that non-unique build keys are legal, never reverse just
+            # to get a bigger build side past the broadcast threshold
+            probe_est = _est_rows(
+                PhysTableReader(Schema(p_task.scan_cols), p_task, False,
+                                probe_l.ranges), pctx)
+            if build_est > probe_est:
+                continue
         if not pctx.enforce_mpp and build_est <= pctx.mpp_threshold:
             continue
         return (probe_l, build_l, p_task, b_task, pk_pos, bk_pos,
@@ -1823,23 +1854,66 @@ def _try_mpp_join(plan: LogicalJoin,
     left_recv, right_recv = _mpp_exchange_pair(
         probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
         elided=copart)
-    le, re_ = plan.eq_conds[0]
     lmap = {c.uid: i for i, c in enumerate(left_l.schema.cols)}
     rmap = {c.uid: i for i, c in enumerate(right_l.schema.cols)}
     return PhysMPPJoin(
         left_recv, right_recv, plan.kind, probe_is_left, plan.schema,
-        [le.remap_columns(lmap)], [re_.remap_columns(rmap)],
+        [le.remap_columns(lmap) for le, _ in plan.eq_conds],
+        [re_.remap_columns(rmap) for _, re_ in plan.eq_conds],
         reason=_mpp_reason(pctx, build_est), elided=copart)
+
+
+#: grouped-pushdown budget ceiling: above this estimated group count the
+#: compacted (key, state) all_gather stops paying for itself and the
+#: generic plan (device join + host agg over joined rows) serves better
+MPP_GROUP_BUDGET_MAX = 1 << 15
+MPP_GROUP_BUDGET_MIN = 1 << 10
+
+
+def _mpp_grouped_enabled() -> bool:
+    from ..mpp.engine import grouped_pushdown_enabled
+
+    return grouped_pushdown_enabled()
+
+
+def _mpp_group_ndv(p_task, b_task, group_by, pctx) -> float:
+    """Estimated distinct-group count of a GROUP BY over the join:
+    product of per-key ANALYZEd NDVs (plain columns resolve against the
+    owning side's stats; computed keys guess 100, the _group_ndv
+    default)."""
+    ndv = 1.0
+    for g in group_by:
+        got = None
+        if isinstance(g, ColumnExpr) and g.unique_id >= 0 \
+                and pctx.stats is not None:
+            for task in (p_task, b_task):
+                sc = next((c for c in task.scan_cols
+                           if c.uid == g.unique_id), None)
+                if sc is None:
+                    continue
+                st = pctx.stats.get(task.table.id)
+                cs = st.columns.get(sc.store_offset) if st else None
+                if cs is not None and cs.ndv > 0:
+                    got = float(cs.ndv)
+                break
+        ndv *= got if got is not None else 100.0
+    return ndv
 
 
 def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
                       pctx: PhysicalContext) -> Optional[PhysicalPlan]:
-    """Scalar agg over an MPP-eligible inner join -> the partial
-    aggregation runs inside the exchange program (psum-merged sums and
-    counts; min/max partials merge on host) and only G=1 partials leave
-    the device; a FINAL HashAgg merges.  The multi-stage MPP aggregation
-    shape (TiFlash's partial agg above the exchange join)."""
-    if plan.group_by or not plan.aggs or join.kind != "inner":
+    """Aggregation over an MPP-eligible inner join -> the partial
+    aggregation runs inside the exchange program and a FINAL HashAgg
+    merges.  Scalar aggs psum-merge on device (G=1 partials leave);
+    GROUP BY sort-groups per shard inside a planner-budgeted group
+    capacity and merges partials ACROSS shards on device, so only O(G)
+    group rows leave — the "partial partial aggregates" regime.  The
+    group-cardinality gate keeps exploding GROUP BYs on the generic
+    plan; runtime overflow falls back through the agg-peel rung."""
+    if not plan.aggs or join.kind != "inner":
+        return None
+    grouped = bool(plan.group_by)
+    if grouped and not _mpp_grouped_enabled():
         return None
     parts = _mpp_join_parts(join, pctx)
     if parts is None:
@@ -1848,7 +1922,20 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
      build_est, copart) = parts
     if not probe_is_left:
         return None  # host-rung partial layout assumes probe==left
-    from ..expr.pushdown import can_push_agg
+    budget = 0
+    if grouped:
+        est_g = _mpp_group_ndv(p_task, b_task, plan.group_by, pctx)
+        if est_g > MPP_GROUP_BUDGET_MAX:
+            return None  # group cardinality too large to pay for itself
+        budget = int(min(max(2.0 * est_g, MPP_GROUP_BUDGET_MIN),
+                         MPP_GROUP_BUDGET_MAX))
+    if grouped and copart:
+        # per-pair grouped partials merge at the final HashAgg anyway,
+        # but each pair would budget G independently; keep the elided
+        # path on the scalar/row shapes it is tested for and let the
+        # grouped plan ride the generic per-pair host merge
+        return None
+    from ..expr.pushdown import can_push_agg, can_push_expr
 
     dict_uids = _dict_uids(probe_l, pctx) | _dict_uids(build_l, pctx)
     probe_uids = {c.uid for c in probe_l.schema.cols}
@@ -1857,6 +1944,18 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
     mapping = dict(p_task.scan_pos_map())
     for u, i in build_pos.items():
         mapping[u] = wp + i
+    group_by = []
+    for g in plan.group_by:
+        refs: set = set()
+        g.collect_columns(refs)
+        if any(u not in probe_uids and u not in build_pos for u in refs):
+            return None
+        if not (can_push_expr(g, pctx.pushdown_blacklist, dict_uids)
+                or _is_plain_col(g)):
+            return None
+        if g.ftype.kind == TypeKind.STRING and not isinstance(g, ColumnExpr):
+            return None  # dict decode needs a store column, not an expr
+        group_by.append(g.remap_columns(mapping))
     aggs = []
     for a in plan.aggs:
         if a.name not in ("count", "sum", "avg", "min", "max") \
@@ -1864,7 +1963,7 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
             return None
         if not can_push_agg(a, pctx.pushdown_blacklist, dict_uids):
             return None
-        refs: set = set()
+        refs = set()
         for x in a.args:
             x.collect_columns(refs)
         if any(u not in probe_uids and u not in build_pos for u in refs):
@@ -1875,14 +1974,17 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
     left_recv, right_recv = _mpp_exchange_pair(
         probe_l, build_l, p_task, b_task, pk_pos, bk_pos, probe_is_left,
         elided=copart)
-    le, re_ = join.eq_conds[0]
     lmap = {c.uid: i for i, c in enumerate(probe_l.schema.cols)}
     rmap = {c.uid: i for i, c in enumerate(build_l.schema.cols)}
     mpp = PhysMPPJoin(
         left_recv, right_recv, "inner", True, _partial_schema(plan),
-        [le.remap_columns(lmap)], [re_.remap_columns(rmap)], aggs=aggs,
+        [le.remap_columns(lmap) for le, _ in join.eq_conds],
+        [re_.remap_columns(rmap) for _, re_ in join.eq_conds],
+        aggs=aggs, group_by=group_by or None, group_budget=budget,
         reason=_mpp_reason(pctx, build_est), elided=copart)
-    return PhysHashAgg(mpp, [], plan.aggs, True, plan.schema)
+    fin_gb = [ColumnExpr(i, g.ftype, str(g), -1)
+              for i, g in enumerate(plan.group_by)]
+    return PhysHashAgg(mpp, fin_gb, plan.aggs, True, plan.schema)
 
 
 def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
@@ -2076,6 +2178,9 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
         return max(_est_rows(p.children[0], pctx) * 0.1, 1)
     if isinstance(p, PhysMPPJoin):
         if p.aggs is not None:
+            if p.group_by:
+                # grouped partials: at most the planner's group budget
+                return float(max(p.group_budget, 1))
             return 1.0  # scalar partial: one G=1 partial row
         l = _est_rows(p.children[0], pctx)
         r = _est_rows(p.children[1], pctx)
