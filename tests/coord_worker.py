@@ -1,0 +1,107 @@
+"""Coordination-plane chaos worker: ONE process of the 2-process
+failover / rolling-restart acceptance test (tests/test_coord.py).
+
+Unlike tests/multihost_worker.py this does NOT join jax.distributed —
+each worker owns a private 4-virtual-device CPU mesh while the TEST
+process runs the Coordinator, so the test exercises exactly what the
+control plane owns across real OS processes: epoch-numbered membership
+(lease expiry when a worker is SIGKILLed mid-query), cross-host span
+forwarding, and session-state handoff across a restart.  The worker
+checkpoints its prepared session EAGERLY (not only at drain), so even a
+hard-killed incarnation's sessions replay when the pid rejoins.
+
+argv: [process_id, coordinator_port].  Env knobs: COORD_LEASE_S,
+COORD_WORKER_MAX_S (self-terminate budget).
+"""
+
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("TIDB_TPU_TILE", "1024")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tidb_tpu.coord import activate_worker
+    from tidb_tpu.lifecycle import (
+        collect_session_states,
+        replay_session_states,
+    )
+    from tidb_tpu.metrics import REGISTRY
+    from tidb_tpu.tpch_data import build_lineitem
+
+    lease_s = float(os.environ.get("COORD_LEASE_S", "1.5"))
+    max_s = float(os.environ.get("COORD_WORKER_MAX_S", "120"))
+    t0 = time.monotonic()
+
+    sess = build_lineitem(8192, regions=4)
+    dom = sess.domain
+    plane = activate_worker(("127.0.0.1", port), pid=pid,
+                            devices=[d.id for d in jax.devices()],
+                            lease_s=lease_s)
+
+    # a previous incarnation of this pid parked sessions? replay them and
+    # prove the prepared statement still executes (rolling restart)
+    states = plane.take_handoff()
+    n = replay_session_states(dom, states)
+    if n:
+        rsess = next(s for s in dom.sessions.values()
+                     if getattr(s, "handoff_origin", None) is not None)
+        rows = rsess.query("execute p_cnt")
+        print(f"HANDOFF_REPLAYED pid={pid} n={n} rows={rows[0][0]} "
+              f"sysvar={rsess.vars.get_int('tidb_slow_log_threshold')}",
+              flush=True)
+
+    # prepare a session and checkpoint it eagerly: SIGKILL must not lose it
+    psess = dom.new_session()
+    psess.execute("set tidb_slow_log_threshold = 4321")
+    psess.execute("prepare p_cnt from 'select count(*) from lineitem'")
+    plane.handoff_put(collect_session_states(dom))
+
+    # one traced statement: its span tree rejoins the coordinator's ring
+    sess.execute("trace format='row' select count(*) from lineitem")
+
+    print(f"READY pid={pid}", flush=True)
+
+    q6 = ("select sum(l_extendedprice * l_discount) from lineitem"
+          " where l_discount between 0.05 and 0.07 and l_quantity < 24")
+    sess.execute("set tidb_use_tpu = 0")
+    want = sess.query(q6)[0][0]
+    sess.execute("set tidb_use_tpu = 1")
+
+    stop = [False]
+    signal.signal(signal.SIGTERM, lambda *_a: stop.__setitem__(0, True))
+
+    rounds = 0
+    while not stop[0] and time.monotonic() - t0 < max_s:
+        m0 = REGISTRY.get("mesh_scans_total")
+        got = sess.query(q6)[0][0]
+        ok = abs(got - want) <= 1e-9 * max(1.0, abs(want))
+        mesh = int(REGISTRY.get("mesh_scans_total") > m0)
+        print(f"ROUND pid={pid} n={rounds} epoch={plane.current_epoch()} "
+              f"ok={int(ok)} mesh={mesh}", flush=True)
+        rounds += 1
+        time.sleep(0.05)
+
+    # graceful drain: final handoff + immediate leave (epoch bumps NOW)
+    plane.handoff_put(collect_session_states(dom))
+    plane.leave()
+    plane.stop()
+    print(f"DRAINED pid={pid} rounds={rounds}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,34 @@ def main() -> int:
     assert all_procs == set(range(nproc)), all_procs
     assert local_procs == {pid}, (local_procs, pid)
 
+    # coordination plane (ISSUE 9): when the test wired a coord address,
+    # the SAME two processes also form the control plane — assert the
+    # formed membership broadcast spans both processes' device sets and
+    # that a worker-side trace rejoined the coordinator's ring
+    if os.environ.get("TIDB_TPU_COORD_ADDR"):
+        import time as _time
+
+        from tidb_tpu.coord import get_plane
+
+        plane = get_plane()
+        view = plane.view()
+        assert set(view.members) == set(range(nproc)), view.members
+        assert len(view.device_ids()) == 4 * nproc, view
+        assert view.formed, view
+        sess.execute("trace format='row' select count(*) from lineitem")
+        if pid == 0:
+            deadline = _time.time() + 20
+            while (_time.time() < deadline
+                   and REGISTRY.snapshot().get(
+                       "coord_spans_ingested_total", 0) < 1):
+                _time.sleep(0.2)
+            assert REGISTRY.snapshot().get(
+                "coord_spans_ingested_total", 0) >= 1
+        else:
+            assert REGISTRY.snapshot().get(
+                "coord_spans_forwarded_total", 0) >= 1
+        print(f"COORD_OK pid={pid} epoch={view.epoch}", flush=True)
+
     print(f"MULTIHOST_OK pid={pid} devices={len(devs)} "
           f"q1_rows={len(results['q1'])} q6={results['q6'][0][0]:.4f}",
           flush=True)

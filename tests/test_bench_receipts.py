@@ -85,3 +85,52 @@ def test_probe_error_classes():
     assert bench.classify_probe_error("deadline exceeded") == "probe-timeout"
     assert bench.classify_probe_error("No module named jax") == "environment"
     assert bench.classify_probe_error("???") == "unknown"
+
+
+def test_parallel_fallback_commits_receipt_before_preflight_ends(
+        monkeypatch, tmp_path):
+    """ISSUE 9 satellite: the host-side fallback runs IN PARALLEL with
+    the device preflight — its receipt lands in state AND on disk as
+    soon as the child finishes, so a tunnel-wedged run harvested by the
+    driver's timeout still carries a nonzero receipt."""
+    monkeypatch.setenv("BENCH_PARTIAL_PATH", str(tmp_path / "p.json"))
+    monkeypatch.setattr(bench, "T0", time.perf_counter())
+    monkeypatch.setattr(bench, "WALL_LIMIT", 120.0)
+    monkeypatch.setattr(bench, "_fallback_cmd", lambda: [
+        sys.executable, "-c",
+        "print('FALLBACK_JSON {\"q1_cpu_rows_per_sec\": 123.0}')"])
+    import os
+
+    monkeypatch.setattr(bench, "_fallback_env", lambda: dict(os.environ))
+    state: dict = {}
+    h = bench.start_parallel_fallback(state)
+    assert h is not None
+    assert h["done"].wait(30)
+    # committed to state + persisted WITHOUT host_side_fallback running
+    assert state["host_fallback"]["q1_cpu_rows_per_sec"] == 123.0
+    data = json.loads((tmp_path / "p.json").read_text())
+    assert data["host_fallback"]["q1_cpu_rows_per_sec"] == 123.0
+    # the failure path harvests the already-running worker (no respawn)
+    bench.host_side_fallback(state, parallel=h)
+    assert state["host_fallback"]["q1_cpu_rows_per_sec"] == 123.0
+
+
+def test_parallel_fallback_cancelled_on_preflight_success(monkeypatch):
+    monkeypatch.setattr(bench, "T0", time.perf_counter())
+    monkeypatch.setattr(bench, "WALL_LIMIT", 120.0)
+    monkeypatch.setattr(bench, "_fallback_cmd", lambda: [
+        sys.executable, "-c", "import time; time.sleep(60)"])
+    import os
+
+    monkeypatch.setattr(bench, "_fallback_env", lambda: dict(os.environ))
+    state: dict = {}
+    h = bench.start_parallel_fallback(state)
+    assert h is not None
+    bench.cancel_parallel_fallback(h, state)
+    assert state["parallel_fallback"].startswith("cancelled")
+    assert h["done"].wait(30)  # the collector unwinds after the kill
+
+
+def test_parallel_fallback_skipped_when_forced_cpu(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    assert bench.start_parallel_fallback({}) is None

@@ -1,0 +1,633 @@
+"""Multi-host coordination plane (ISSUE 9): epoch-numbered membership,
+cross-host failover, span forwarding, and rolling-restart handoff.
+
+Everything here runs WITHOUT jax.distributed — the control plane is
+plain TCP plus the process-local loopback — so the tier-1 CPU suite
+exercises the whole plane: protocol tests against a real Coordinator
+socket, single-process degenerate loops (LocalPlane, satellite: the
+plane works with one process), seeded chaos sweeps over the new
+coord/member_lost and coord/handoff sites, server drain/restart handoff
+over the wire, and a 2-OS-process failover + rolling-restart acceptance
+test whose workers own private CPU meshes while sharing the
+coordination plane (tests/coord_worker.py).
+"""
+
+import asyncio
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tidb_tpu.coord import (
+    CoordEpochMismatch,
+    Coordinator,
+    WorkerPlane,
+    get_plane,
+    reset_plane,
+)
+from tidb_tpu.copr.device_health import DEVICE_HEALTH, DeviceFailure
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.session import Domain
+from tidb_tpu.store.fault import FAILPOINTS, always, failpoint
+from tidb_tpu.trace import TRACE_RING, finish_trace, span, start_trace
+from tidb_tpu.trace import recorder
+
+Q1 = ("select g, sum(x), count(*), min(x), max(x), avg(x) from t "
+      "group by g order by g")
+Q6 = "select sum(x) from t where k < 15000 and x < 50"
+TOPN = "select k, x from t order by x desc limit 7"
+FILTER = "select k from t where x < 2.5"
+
+SWEEP_QUERIES = (Q1, Q6, TOPN, FILTER)
+
+
+@pytest.fixture(scope="module")
+def sess():
+    d = Domain()
+    s = d.new_session()
+    s.execute("create table t (k bigint, g bigint, x double)")
+    t = d.catalog.info_schema().table("test", "t")
+    store = d.storage.table(t.id)
+    rng = np.random.default_rng(7)
+    n = 20_000
+    store.bulk_load_arrays(
+        [np.arange(n, dtype=np.int64),
+         rng.integers(0, 5, n, dtype=np.int64),
+         rng.uniform(0, 100, n)],
+        ts=d.storage.current_ts(),
+    )
+    d.storage.regions.split_even(t.id, 4, store.base_rows)
+    return s
+
+
+@pytest.fixture(autouse=True)
+def _plane_isolation():
+    """The plane and device health are process-global: every test starts
+    AND ends on the lazy local default with closed breakers."""
+    reset_plane()
+    DEVICE_HEALTH.reset()
+    yield
+    reset_plane()
+    DEVICE_HEALTH.reset()
+
+
+def _approx_eq(a, b):
+    if isinstance(a, float) or isinstance(b, float):
+        return a == pytest.approx(b, rel=1e-9, abs=1e-9)
+    return a == b
+
+
+def _rows_eq(got, want, ctx=""):
+    assert len(got) == len(want), (ctx, got, want)
+    for ra, rb in zip(sorted(got), sorted(want)):
+        assert all(_approx_eq(x, y) for x, y in zip(ra, rb)), (ctx, ra, rb)
+
+
+def _cpu_rows(sess, sql):
+    sess.execute("set tidb_use_tpu = 0")
+    try:
+        return sess.query(sql)
+    finally:
+        sess.execute("set tidb_use_tpu = 1")
+
+
+def _wait(pred, timeout_s=5.0, tick=0.05):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# protocol: membership, broadcast, lease expiry
+# ---------------------------------------------------------------------------
+
+def test_membership_register_report_broadcast():
+    """Two workers join over real sockets; an unhealthy-device report on
+    one host bumps the epoch and every member converges on the same
+    shrunken broadcast."""
+    c = Coordinator(lease_s=30.0, expect=2)
+    c.start()
+    w1 = w2 = None
+    try:
+        w1 = WorkerPlane(("127.0.0.1", c.port), pid=1, lease_s=30.0,
+                         heartbeat_s=0.2).start([0, 1, 2, 3])
+        w2 = WorkerPlane(("127.0.0.1", c.port), pid=2, lease_s=30.0,
+                         heartbeat_s=0.2).start([4, 5, 6, 7])
+        v = c.view()
+        assert set(v.members) == {1, 2} and v.formed
+        assert v.members[1] == (0, 1, 2, 3)
+        # breaker trip on host 2 (the DeviceHealthRegistry hook shape)
+        w2.on_health_change((5,), "trip")
+        v2 = c.view()
+        assert v2.epoch == v.epoch + 1
+        assert v2.members[2] == (4, 6, 7)
+        assert v2.device_ids() == frozenset({0, 1, 2, 3, 4, 6, 7})
+        # the OTHER worker's cached view converges via its heartbeat
+        assert _wait(lambda: w1.current_epoch() == v2.epoch)
+        assert w1.view().members[2] == (4, 6, 7)
+        # recovery regrows the set and renumbers again
+        w2.on_health_change((), "recover")
+        v3 = c.view()
+        assert v3.epoch == v2.epoch + 1
+        assert v3.members[2] == (4, 5, 6, 7)
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.stop()
+        c.stop()
+
+
+def test_member_lease_expiry_bumps_epoch():
+    """A worker that stops heartbeating (SIGKILL stand-in) is expired by
+    the coordinator within ~one lease, the epoch bumps, and the
+    survivor observes the new broadcast; formation stays latched so the
+    survivor view remains authoritative."""
+    c = Coordinator(lease_s=0.5, expect=2)
+    c.start()
+    w1 = w2 = None
+    try:
+        w1 = WorkerPlane(("127.0.0.1", c.port), pid=1,
+                         lease_s=0.5).start([0])
+        w2 = WorkerPlane(("127.0.0.1", c.port), pid=2,
+                         lease_s=0.5).start([1])
+        assert c.view().formed
+        e0 = c.view().epoch
+        x0 = REGISTRY.get("coord_members_expired_total")
+        w2.stop()  # heartbeats cease
+        assert _wait(lambda: 2 not in c.view().members, 5.0)
+        v = c.view()
+        assert v.epoch > e0 and v.formed
+        assert REGISTRY.get("coord_members_expired_total") == x0 + 1
+        assert _wait(lambda: 2 not in w1.view().members, 5.0)
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.stop()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# single-process degenerate loops (satellite: the tier-1 suite exercises
+# the plane with one process, no workers spawned)
+# ---------------------------------------------------------------------------
+
+def test_local_plane_epoch_bumps_on_breaker_transitions():
+    plane = get_plane()
+    assert plane.kind == "local"
+    e0 = plane.current_epoch()
+    DEVICE_HEALTH.record_error(3, DeviceFailure("chip 3 died",
+                                                device_ids=(3,)))
+    assert plane.current_epoch() == e0 + 1  # trip renumbers
+    import jax
+
+    DEVICE_HEALTH.expire_cooldowns()
+    DEVICE_HEALTH.select_devices(list(jax.devices()))  # half-open probe
+    assert plane.current_epoch() == e0 + 2
+    DEVICE_HEALTH.record_success([3])  # probe closes: recovery
+    assert plane.current_epoch() == e0 + 3
+
+
+def test_local_membership_published_on_mesh_build(sess):
+    """The mesh builder publishes its healthy device set to the plane,
+    so the degenerate single-process membership broadcast is truthful."""
+    sess.execute("set tidb_use_tpu = 1")
+    sess.query(Q6)
+    view = get_plane().view()
+    assert set(view.members) == {0}
+    assert len(view.device_ids()) == 8  # the 8-virtual-device harness
+    assert view.formed
+
+
+def test_local_handoff_replay_loop():
+    """Single-process handoff loop: collect -> park -> take -> replay."""
+    from tidb_tpu.lifecycle import (
+        collect_session_states,
+        replay_session_states,
+    )
+
+    d = Domain()
+    try:
+        s = d.new_session()
+        s.execute("set tidb_slow_log_threshold = 777")
+        s.execute("prepare px from 'select 6 * 7'")
+        states = collect_session_states(d)
+        assert len(states) == 1 and states[0]["prepared"]
+        json.dumps(states)  # strictly JSON-portable
+        plane = get_plane()
+        plane.handoff_put(states)
+        d2 = Domain()
+        try:
+            n = replay_session_states(d2, plane.take_handoff())
+            assert n == 1
+            sess2 = next(s2 for s2 in d2.sessions.values()
+                         if getattr(s2, "handoff_origin", None) is not None)
+            assert sess2.query("execute px") == [(42,)]
+            assert sess2.vars.get_int("tidb_slow_log_threshold") == 777
+            assert plane.take_handoff() == []  # consumed exactly once
+        finally:
+            d2.maintenance.stop()
+    finally:
+        d.maintenance.stop()
+
+
+# ---------------------------------------------------------------------------
+# dispatch-seam chaos: coord/member_lost
+# ---------------------------------------------------------------------------
+
+def test_chaos_member_lost_mid_query_rebuilds_with_parity(sess):
+    """Seeded sweep over the new coord/member_lost site: a membership
+    epoch bump lands exactly between mesh build and dispatch for every
+    query shape — the typed CoordEpochMismatch retries on the rebuilt
+    mesh with CPU parity, trips no breakers, leaks nothing."""
+    plane = get_plane()
+    for sql in SWEEP_QUERIES:
+        want = _cpu_rows(sess, sql)
+        fired = {"n": 0}
+
+        def bump_once(**_ctx):
+            if fired["n"] == 0:
+                plane.bump("chaos: member lost")
+            fired["n"] += 1
+
+        m0 = REGISTRY.get("coord_epoch_mismatch_total")
+        with failpoint("coord/member_lost", bump_once):
+            sess.execute("set tidb_use_tpu = 1")
+            got = sess.query(sql)
+        _rows_eq(got, want, sql)
+        assert fired["n"] >= 2, (sql, fired)  # the retry re-hit the seam
+        assert REGISTRY.get("coord_epoch_mismatch_total") == m0 + 1, sql
+        assert DEVICE_HEALTH.tripped_ids() == ()  # never a chip fault
+    assert FAILPOINTS.armed() == []
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("tidb-tpu-select")]
+    assert not alive, alive
+
+
+def test_epoch_flapping_exhausts_retries_and_steps_down(sess):
+    """A plane whose epoch moves on EVERY dispatch exhausts the mesh
+    retry budget: the typed error surfaces to distsql, which steps down
+    to the per-region rung — still correct, never a hang."""
+    plane = get_plane()
+    want = _cpu_rows(sess, Q6)
+    c0 = REGISTRY.get("cop_tasks_total")
+    e0 = REGISTRY.get("mesh_scan_errors_total")
+    with failpoint("coord/member_lost",
+                   lambda **_c: plane.bump("chaos: flapping")):
+        sess.execute("set tidb_use_tpu = 1")
+        got = sess.query(Q6)
+    _rows_eq(got, want)
+    assert REGISTRY.get("mesh_scan_errors_total") > e0
+    assert REGISTRY.get("cop_tasks_total") > c0
+
+
+def test_epoch_mismatch_error_is_typed_and_retriable(sess):
+    """The raw dispatcher raises CoordEpochMismatch (not a hang, not a
+    device fault) when the epoch moved under it."""
+    from tidb_tpu.copr import parallel as pl
+    from tidb_tpu.copr.device_health import classify_failure
+
+    exc = CoordEpochMismatch(3, 4)
+    assert classify_failure(exc) is None  # never trips breakers
+    plane = get_plane()
+    sess.execute("set tidb_use_tpu = 1")
+    sess.query(Q6)  # mesh built + stamped
+    stamped = pl.mesh_epoch()
+    assert stamped == plane.current_epoch()
+    plane.bump("out-of-band member change")
+    with failpoint("coord/member_lost", lambda **_c: None):
+        with pytest.raises(CoordEpochMismatch):
+            pl._check_membership_epoch()
+
+
+# ---------------------------------------------------------------------------
+# span forwarding: one tree spanning hosts
+# ---------------------------------------------------------------------------
+
+def test_span_forwarding_grafts_one_tree():
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        # the coordinator-side trace exists first (hook must not fire
+        # for it: the worker plane installs the hook on start)
+        tr_local, tok = start_trace("select 1", 1)
+        finish_trace(tr_local, tok)
+        w = WorkerPlane(("127.0.0.1", c.port), pid=7,
+                        lease_s=30.0).start([0])
+        assert recorder.TRACE_EXPORT_HOOK is not None
+        tr_w, tok_w = start_trace("select 1", 9)
+        with span("copr.device.execute"):
+            pass
+        tr_w.qid = tr_local.qid  # the SPMD statement-seq correlation
+        f0 = REGISTRY.get("coord_spans_forwarded_total")
+        g0 = REGISTRY.get("coord_spans_grafted_total")
+        finish_trace(tr_w, tok_w)
+        assert REGISTRY.get("coord_spans_forwarded_total") == f0 + 1
+        assert REGISTRY.get("coord_spans_grafted_total") == g0 + 1
+        # ONE tree: the worker's root hangs under the coordinator's,
+        # host-tagged, with its spans intact and renderable
+        remote = [s for s in tr_local.root.children
+                  if (s.attrs or {}).get("host") == 7]
+        assert len(remote) == 1
+        assert any(ch.name == "copr.device.execute"
+                   for ch in remote[0].children)
+        rendered = "\n".join(r[0] for r in tr_local.rows())
+        assert "host: 7" in rendered and "copr.device.execute" in rendered
+    finally:
+        if w is not None:
+            w.stop()
+        c.stop()
+
+
+def test_span_forwarding_respects_byte_cap(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_COORD_SPAN_CAP", "64")
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        w = WorkerPlane(("127.0.0.1", c.port), pid=3,
+                        lease_s=30.0).start([0])
+        d0 = REGISTRY.get("coord_spans_dropped_total")
+        f0 = REGISTRY.get("coord_spans_forwarded_total")
+        tr, tok = start_trace("select 'oversized payload'", 3)
+        finish_trace(tr, tok)
+        assert REGISTRY.get("coord_spans_dropped_total") == d0 + 1
+        assert REGISTRY.get("coord_spans_forwarded_total") == f0
+    finally:
+        if w is not None:
+            w.stop()
+        c.stop()
+
+
+def test_import_does_not_consume_trace_seq():
+    """Ingesting a forwarded trace must not advance the local statement
+    sequence: SPMD qid correlation relies on every process assigning the
+    same seq to the same statement, so a coordinator that consumed seqs
+    on ingest would stop grafting after the first forwarded trace."""
+    from tidb_tpu.trace import import_trace, trace_payload
+
+    tr, tok = start_trace("select 1", 1)
+    finish_trace(tr, tok)
+    imported = import_trace(trace_payload(tr), host=5)
+    assert imported.seq == -1 and imported.imported_from == 5
+    tr2, tok2 = start_trace("select 1", 1)
+    finish_trace(tr2, tok2)
+    assert tr2.seq == tr.seq + 1  # the import consumed nothing
+
+
+def test_coordinator_plane_take_handoff_reads_live_store():
+    """A server drain ON the coordinator host parks straight into the
+    live store; the restarted server's take_handoff must see it (not
+    just the registration-time snapshot)."""
+    from tidb_tpu.coord import activate_coordinator
+
+    plane = activate_coordinator(port=0, pid=0, devices=[0])
+    plane.handoff_put([{"conn_id": 1, "prepared": {"p": "select 1"}}])
+    out = plane.take_handoff()
+    assert out and out[0]["prepared"] == {"p": "select 1"}
+    assert plane.take_handoff() == []  # consumed exactly once
+
+
+def test_forwarding_survives_dead_coordinator():
+    """A dead coordinator costs a counted RPC error, never a query
+    failure."""
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        w = WorkerPlane(("127.0.0.1", c.port), pid=4, lease_s=30.0,
+                        rpc_timeout_s=0.5).start([0])
+        c.stop()
+        r0 = REGISTRY.get("coord_rpc_errors_total")
+        tr, tok = start_trace("select 1", 4)
+        finish_trace(tr, tok)  # must not raise
+        assert REGISTRY.get("coord_rpc_errors_total") > r0
+    finally:
+        if w is not None:
+            w.stop()
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# server drain handoff (rolling restart in one process) + chaos
+# ---------------------------------------------------------------------------
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_server_drain_hands_off_and_restart_replays():
+    """Rolling restart over the wire: server A drains with a prepared
+    session; server B (fresh domain, same process — the LocalPlane
+    loop) starts and replays it, losing no prepared sessions."""
+    from tidb_tpu.server.server import MySQLServer
+    from test_lifecycle import WireClient
+
+    async def body():
+        dom_a = Domain()
+        srv_a = MySQLServer(dom_a, port=0)
+        await srv_a.start()
+        cl = WireClient(srv_a.host, srv_a.port)
+        await cl.connect()
+        await cl.query("prepare ps1 from 'select 21 * 2'")
+        await cl.query("set tidb_slow_log_threshold = 4321")
+        p0 = REGISTRY.get("coord_handoff_put_total")
+        r0 = REGISTRY.get("coord_handoff_replayed_total")
+        await srv_a.shutdown(drain_s=2.0)
+        dom_a.maintenance.stop()
+        assert REGISTRY.get("coord_handoff_put_total") == p0 + 1
+        dom_b = Domain()
+        srv_b = MySQLServer(dom_b, port=0)
+        await srv_b.start()
+        try:
+            assert REGISTRY.get("coord_handoff_replayed_total") == r0 + 1
+            replayed = [s for s in dom_b.sessions.values()
+                        if getattr(s, "handoff_origin", None) is not None]
+            assert len(replayed) == 1
+            assert replayed[0].query("execute ps1") == [(42,)]
+            assert replayed[0].vars.get_int(
+                "tidb_slow_log_threshold") == 4321
+        finally:
+            await srv_b.stop()
+            dom_b.maintenance.stop()
+
+    _run(body())
+
+
+def test_chaos_handoff_site_fails_safe():
+    """The coord/handoff chaos site: a handoff lost mid-drain (raised
+    action) is counted and the drain still completes; the replacement
+    starts empty instead of crashing."""
+    from tidb_tpu.server.server import MySQLServer
+    from test_lifecycle import WireClient
+
+    async def body():
+        dom_a = Domain()
+        srv_a = MySQLServer(dom_a, port=0)
+        await srv_a.start()
+        cl = WireClient(srv_a.host, srv_a.port)
+        await cl.connect()
+        await cl.query("prepare ps1 from 'select 1'")
+        f0 = REGISTRY.get("coord_handoff_failed_total")
+        with failpoint("coord/handoff",
+                       always(RuntimeError("injected: handoff lost"))):
+            await srv_a.shutdown(drain_s=1.0)
+        dom_a.maintenance.stop()
+        assert REGISTRY.get("coord_handoff_failed_total") == f0 + 1
+        assert get_plane().take_handoff() == []
+        dom_b = Domain()
+        srv_b = MySQLServer(dom_b, port=0)
+        await srv_b.start()
+        try:
+            assert not any(
+                getattr(s, "handoff_origin", None) is not None
+                for s in dom_b.sessions.values())
+        finally:
+            await srv_b.stop()
+            dom_b.maintenance.stop()
+
+    _run(body())
+
+
+def test_status_endpoint_reports_coord_section():
+    from tidb_tpu.server.http_status import StatusServer
+
+    d = Domain()
+    ss = StatusServer(d, port=0)
+    host, port = ss.start()
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/status", timeout=5).read())
+        coord = body["coord"]
+        assert coord["kind"] == "local"
+        assert coord["epoch"] >= 1
+        assert "coord_epoch_bumps_total" in coord["metrics"]
+        assert "coord_handoff_replayed_total" in coord["metrics"]
+    finally:
+        ss.stop()
+        d.maintenance.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2 worker processes, kill mid-query, rolling restart
+# ---------------------------------------------------------------------------
+
+def _spawn_worker(pid, port):
+    import os
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["COORD_LEASE_S"] = "1.5"
+    env["COORD_WORKER_MAX_S"] = "150"
+    worker = os.path.join(os.path.dirname(__file__), "coord_worker.py")
+    p = subprocess.Popen(
+        [sys.executable, worker, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, bufsize=1)
+    lines = []
+
+    def pump():
+        for line in p.stdout:
+            lines.append(line.strip())
+
+    threading.Thread(target=pump, daemon=True).start()
+    return p, lines
+
+
+def _wait_line(lines, pred, timeout_s, procs=()):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if any(pred(ln) for ln in list(lines)):
+            return True
+        if procs and all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.1)
+    return any(pred(ln) for ln in list(lines))
+
+
+def test_two_process_failover_and_rolling_restart():
+    """Acceptance (ISSUE 9): 2 worker processes under load sharing the
+    coordination plane.  SIGKILL one mid-query -> lease expiry bumps the
+    epoch and the survivor keeps answering with parity on its rebuilt
+    mesh at the new epoch (no hang, every round ok=1 mesh=1).  Restart
+    the victim -> it rejoins at a newer epoch with its prepared session
+    replayed from the eager handoff checkpoint.  Span trees from both
+    hosts landed in the coordinator-side ring."""
+    threads_before = {t.name for t in threading.enumerate()}
+    c = Coordinator(lease_s=1.5, expect=2)
+    c.start()
+    procs = []
+    try:
+        w0, l0 = _spawn_worker(0, c.port)
+        procs.append(w0)
+        w1, l1 = _spawn_worker(1, c.port)
+        procs.append(w1)
+        assert _wait_line(l0, lambda s: s.startswith("READY"), 90,
+                          (w0,)), (l0[-10:], l1[-10:])
+        assert _wait_line(l1, lambda s: s.startswith("READY"), 90,
+                          (w1,)), (l0[-10:], l1[-10:])
+        v = c.view()
+        assert set(v.members) == {0, 1} and v.formed
+        # both under load on their meshes
+        ok_round = lambda s: (s.startswith("ROUND") and "ok=1" in s
+                              and "mesh=1" in s)  # noqa: E731
+        assert _wait_line(l0, ok_round, 30, (w0,)), l0[-5:]
+        assert _wait_line(l1, ok_round, 30, (w1,)), l1[-5:]
+
+        # ---- hard kill mid-query ------------------------------------
+        e_before = c.view().epoch
+        w1.kill()
+        assert _wait(lambda: 1 not in c.view().members, 15.0), \
+            "lease expiry did not evict the killed worker"
+        v_after = c.view()
+        assert v_after.epoch > e_before and v_after.formed
+        # the survivor observes the bumped epoch and keeps serving with
+        # parity — a completed query at the new epoch, not a hang
+        assert _wait_line(
+            l0, lambda s: ok_round(s) and f"epoch={v_after.epoch}" in s,
+            30, (w0,)), l0[-5:]
+        assert not any("ok=0" in s for s in list(l0)), \
+            [s for s in l0 if "ok=0" in s]
+
+        # ---- rolling restart of the victim --------------------------
+        w1b, l1b = _spawn_worker(1, c.port)
+        procs.append(w1b)
+        assert _wait_line(l1b, lambda s: s.startswith("HANDOFF_REPLAYED"),
+                          90, (w1b,)), l1b[-10:]
+        line = next(s for s in list(l1b)
+                    if s.startswith("HANDOFF_REPLAYED"))
+        assert "n=1" in line and "rows=8192" in line \
+            and "sysvar=4321" in line, line
+        assert _wait(lambda: 1 in c.view().members, 10.0)
+        assert c.view().epoch > v_after.epoch  # rejoined at a NEW epoch
+
+        # ---- cross-host spans rejoined the coordinator's ring -------
+        assert any(getattr(tr, "imported_from", None) in (0, 1)
+                   for tr in list(TRACE_RING))
+
+        # ---- graceful drains ----------------------------------------
+        w0.send_signal(signal.SIGTERM)
+        assert _wait_line(l0, lambda s: s.startswith("DRAINED"), 30, (w0,))
+        w1b.send_signal(signal.SIGTERM)
+        assert _wait_line(l1b, lambda s: s.startswith("DRAINED"), 30,
+                          (w1b,))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        c.stop()
+    # no leaked coordinator threads or armed failpoints in this process
+    time.sleep(0.3)
+    leaked = {t.name for t in threading.enumerate()} - threads_before
+    leaked = {n for n in leaked if n.startswith("tidb-tpu-coord")}
+    assert not leaked, leaked
+    assert FAILPOINTS.armed() == []

@@ -1,0 +1,134 @@
+"""Multi-host coordination plane (ISSUE 9, ROADMAP "True multi-host
+production mesh").
+
+DrJAX (PAPERS.md) scales MapReduce-style primitives across JAX hosts by
+letting the collective runtime carry the DATA plane while a thin
+coordination layer owns membership; "Query Processing on Tensor
+Computation Runtimes" is the same bet from the database side.  This
+package is that thin layer for the TPU query engine — three
+capabilities, all chaos-tested without real hardware:
+
+1. **Epoch-numbered mesh membership** — the coordinator broadcasts the
+   participating process ids and each process's healthy device set (fed
+   by its DeviceHealthRegistry).  A breaker trip on ANY host bumps the
+   epoch; every process rebuilds the same survivor mesh from the
+   broadcast, and an epoch mismatch detected at dispatch time raises
+   the typed retriable `CoordEpochMismatch` instead of desyncing an XLA
+   collective (copr/parallel.py).
+2. **Span forwarding** — workers ship each finished QueryTrace to the
+   coordinator at query end (per-host byte cap + drop counter), so
+   EXPLAIN ANALYZE / SLOW_QUERY / /status show ONE tree spanning hosts
+   (trace/export.py).
+3. **Session-state handoff** — `shutdown(drain_s)` parks prepared
+   statements + session sysvars on the coordinator; the replacement
+   process replays them when it rejoins at a new epoch, so a rolling
+   restart loses no prepared sessions (lifecycle/handoff.py).
+
+The plane is jax-free by contract (purity lint covers this package):
+it moves plain ints and JSON, never device arrays, and the membership
+epoch is host-side control state that must never capture into compiled
+code (lint.kernelcheck traces the fused mesh corpus across epoch bumps
+and requires identical jaxprs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .membership import CoordEpochMismatch, MembershipView  # noqa: F401
+from .plane import (  # noqa: F401
+    Coordinator,
+    CoordinatorPlane,
+    LocalPlane,
+    WorkerPlane,
+)
+
+_PLANE = None
+_PLANE_LOCK = threading.Lock()
+
+
+def get_plane():
+    """The process's active coordination plane — the degenerate
+    LocalPlane until a multi-host activation swaps in a TCP plane.
+    First use installs the DeviceHealthRegistry epoch hook, so breaker
+    transitions renumber the membership epoch from then on."""
+    global _PLANE
+    if _PLANE is None:
+        with _PLANE_LOCK:
+            if _PLANE is None:
+                plane = LocalPlane()
+                _install(plane)
+                _PLANE = plane
+    return _PLANE
+
+
+def _install(plane):
+    from ..copr.device_health import DEVICE_HEALTH
+
+    DEVICE_HEALTH.set_epoch_hook(plane.on_health_change)
+
+
+def _swap(plane):
+    global _PLANE
+    with _PLANE_LOCK:
+        _PLANE = plane
+        _install(plane)
+    return plane
+
+
+def activate_coordinator(host: str = "127.0.0.1", port: int = 0,
+                         pid: int = 0, devices=(), lease_s: float = 5.0,
+                         expect: Optional[int] = None) -> CoordinatorPlane:
+    """Bind the coordination endpoint in THIS process and join it as
+    member `pid` (the coordinator runs queries too — SPMD)."""
+    coord = Coordinator(host=host, port=port, lease_s=lease_s,
+                        expect=expect, self_pid=pid)
+    return _swap(CoordinatorPlane(coord, pid=pid).start(devices))
+
+
+def activate_worker(addr, pid: int, devices=(),
+                    lease_s: float = 5.0) -> WorkerPlane:
+    """Join an existing coordinator as member `pid` (retries while the
+    coordinator is still binding)."""
+    return _swap(WorkerPlane(addr, pid, lease_s=lease_s).start(devices))
+
+
+def activate_env_plane(addr: str, pid: int, devices,
+                       expect: Optional[int] = None,
+                       form_timeout_s: float = 45.0):
+    """jax.distributed bring-up seam (copr/parallel._maybe_init_multihost
+    when TIDB_TPU_COORD_ADDR is set): process 0 binds, everyone else
+    joins, and ALL processes block until the cluster FORMS (every
+    expected member registered) so the first mesh every process builds
+    derives from the same broadcast.  A formation timeout degrades to
+    the unfiltered full-device mesh on every process identically (the
+    view stays un-formed everywhere until the last member registers)."""
+    host, _, port = addr.rpartition(":")
+    if pid == 0:
+        plane = activate_coordinator(host=host, port=int(port), pid=0,
+                                     devices=devices, expect=expect)
+    else:
+        plane = activate_worker((host, int(port)), pid=pid,
+                                devices=devices)
+    plane.wait_formed(form_timeout_s)
+    return plane
+
+
+def reset_plane():
+    """Tear down the active plane and restore the lazy local default
+    (tests; also clears the span-forwarding and epoch hooks)."""
+    global _PLANE
+    with _PLANE_LOCK:
+        plane, _PLANE = _PLANE, None
+    if plane is not None:
+        try:
+            plane.stop()
+        except Exception:
+            pass
+    from ..trace import recorder
+
+    recorder.TRACE_EXPORT_HOOK = None
+    from ..copr.device_health import DEVICE_HEALTH
+
+    DEVICE_HEALTH.set_epoch_hook(None)

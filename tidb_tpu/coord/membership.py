@@ -1,0 +1,60 @@
+"""Epoch-numbered mesh membership: the broadcast value every process
+builds its survivor mesh from.
+
+Reference: the reference's PD keeps an epoch-versioned region/store
+topology that every TiKV client caches and re-fetches on a stale-epoch
+error (region_cache.go).  Here the "topology" is the set of live
+processes and their healthy device sets; the epoch renumbers on EVERY
+membership change (join, leave, lease expiry, per-device breaker trip),
+so two processes can cheaply agree whether they derived their mesh from
+the same broadcast — and a mismatch detected at dispatch time becomes a
+typed retriable error instead of an XLA collective desync (DrJAX's
+thin-control-plane bet, PAPERS.md).
+
+This module is jax-free by contract: the control plane carries plain
+ints (process ids, device ids) and never holds device-array provenance
+(enforced by the purity lint over tidb_tpu/coord).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Tuple
+
+
+@dataclass(frozen=True)
+class MembershipView:
+    """One broadcast: the epoch plus every live process's healthy device
+    ids.  `formed` latches once the expected process count has joined —
+    before formation the view is advisory (mesh builds keep the full
+    device set, the pre-coordination behavior) and after it the view is
+    authoritative (survivor meshes exclude lost members' devices)."""
+
+    epoch: int
+    members: Dict[int, Tuple[int, ...]] = field(default_factory=dict)
+    formed: bool = True
+
+    def device_ids(self) -> FrozenSet[int]:
+        out = set()
+        for ids in self.members.values():
+            out.update(ids)
+        return frozenset(out)
+
+
+class CoordEpochMismatch(RuntimeError):
+    """The membership epoch advanced between mesh build and dispatch (a
+    member was lost, rejoined, or reported a device unhealthy on some
+    host).  Typed and retriable BY DESIGN: the dispatcher rebuilds the
+    mesh from the current broadcast and re-runs, instead of launching an
+    XLA collective whose participant set no longer matches what the
+    other hosts will launch — the desync that otherwise presents as a
+    hang.  The message deliberately avoids device-failure vocabulary so
+    device_health.classify_failure can never mistake it for a chip
+    fault (no breaker trips, no cache evictions)."""
+
+    def __init__(self, built_at, current):
+        super().__init__(
+            f"mesh membership epoch advanced {built_at} -> {current}; "
+            "rebuilding over the current member set")
+        self.built_at = built_at
+        self.current = current

@@ -1,0 +1,636 @@
+"""Coordination-plane transports: local loopback, TCP coordinator, TCP
+worker.
+
+The data plane (scans, exchanges, aggregation merges) rides XLA's
+collective runtime; this module is the deliberately small control plane
+that owns what collectives cannot: WHO is in the mesh (epoch-numbered
+membership with lease-based liveness), getting worker span trees back
+into the coordinator's trace ring, and parking drained sessions for a
+rolling restart.  The wire format is one JSON line per request over a
+short-lived localhost/DCN TCP connection — no new dependencies, and
+deliberately not jax.distributed's KV store so the plane keeps working
+(and keeps being testable) in environments where the gRPC coordination
+service cannot form.
+
+Three interchangeable planes share one duck-typed surface (view /
+current_epoch / bump / publish_local / on_health_change / forward_trace
+/ handoff_put / take_handoff / wait_formed / leave / stop):
+
+- ``LocalPlane``: single-process degenerate loops — epoch bumps ride the
+  DeviceHealthRegistry hook, membership is this process's healthy device
+  set, handoff is an in-memory parking lot that survives server
+  restarts within the process.  The tier-1 CPU suite exercises the
+  whole plane through it without spawning workers.
+- ``Coordinator`` + ``CoordinatorPlane``: process 0 binds the TCP
+  endpoint and is also member 0 (multi-controller SPMD: the coordinator
+  runs queries too).
+- ``WorkerPlane``: every other process; registers, heartbeats a lease,
+  reports breaker trips, forwards finished traces, parks handoff state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..metrics import REGISTRY
+from ..store.fault import FAILPOINTS
+from .membership import MembershipView
+
+
+def _span_cap_bytes() -> int:
+    """Per-host byte cap on one forwarded span payload (worker AND
+    coordinator enforce it); oversize trees drop with a counter instead
+    of bloating the control plane."""
+    try:
+        return int(os.environ.get("TIDB_TPU_COORD_SPAN_CAP",
+                                  str(256 * 1024)))
+    except ValueError:
+        return 256 * 1024
+
+
+def _hit_handoff(pid: int, n: int):
+    # chaos site: a raised action simulates a handoff lost mid-drain
+    # (coordinator unreachable, payload refused); callers must degrade
+    # to "sessions lost, drain still completes"
+    FAILPOINTS.hit("coord/handoff", pid=pid, sessions=n)
+
+
+def _view_from_resp(resp: dict) -> MembershipView:
+    return MembershipView(
+        epoch=int(resp.get("epoch", 0)),
+        members={int(p): tuple(int(d) for d in ids)
+                 for p, ids in (resp.get("members") or {}).items()},
+        formed=bool(resp.get("formed", True)),
+    )
+
+
+class Coordinator:
+    """Membership/handoff/span state + the TCP endpoint serving it.
+
+    Liveness is lease-based and LAZILY swept: every state operation
+    first expires members whose lease lapsed (any live worker's
+    heartbeat therefore evicts a dead peer within ~one lease).  Every
+    membership change bumps the epoch; `formed` latches once `expect`
+    members have joined and stays latched, so survivor views remain
+    authoritative after a loss."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 lease_s: float = 5.0, expect: Optional[int] = None,
+                 self_pid: Optional[int] = None, clock=time.monotonic):
+        self.host = host
+        self.port = port
+        self.lease_s = lease_s
+        self.expect = expect
+        self.self_pid = self_pid  # exempt from lease expiry (no heartbeat)
+        self._clock = clock
+        self._mu = threading.RLock()
+        self._epoch = 0
+        self._formed = expect is None
+        self._members: Dict[int, dict] = {}
+        self._handoff: Dict[int, List[dict]] = {}
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> Tuple[str, int]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(32)
+        s.settimeout(0.2)
+        self.port = s.getsockname()[1]
+        self._sock = s
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="tidb-tpu-coord")
+        self._thread.start()
+        return self.host, self.port
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # ---- membership state ops ------------------------------------------
+    def _bump_locked(self, reason: str):
+        self._epoch += 1
+        REGISTRY.inc("coord_epoch_bumps_total")
+        REGISTRY.set("coord_epoch", self._epoch)
+        REGISTRY.set("coord_members", len(self._members))
+
+    def bump(self, reason: str = ""):
+        with self._mu:
+            self._bump_locked(reason)
+
+    def _expire_locked(self):
+        now = self._clock()
+        dead = [pid for pid, m in self._members.items()
+                if pid != self.self_pid
+                and now - m["last_seen"] > m.get("lease_s", self.lease_s)]
+        for pid in dead:
+            del self._members[pid]
+            REGISTRY.inc("coord_members_expired_total")
+            self._bump_locked(f"member {pid} lease expired")
+
+    def _touch_locked(self, pid: int):
+        m = self._members.get(pid)
+        if m is not None:
+            m["last_seen"] = self._clock()
+
+    def register(self, pid: int, devices,
+                 lease_s: Optional[float] = None) -> dict:
+        """A process joins (or REJOINS after a restart) with its healthy
+        local device ids; any parked handoff state for this pid rides
+        back in the response, consumed exactly once."""
+        devices = tuple(int(d) for d in devices)
+        with self._mu:
+            self._expire_locked()
+            prev = self._members.get(pid)
+            self._members[pid] = {
+                "devices": devices,
+                "last_seen": self._clock(),
+                "lease_s": float(lease_s or self.lease_s),
+            }
+            if prev is None or prev["devices"] != devices:
+                self._bump_locked(f"member {pid} joined")
+            if self.expect is not None \
+                    and len(self._members) >= self.expect:
+                self._formed = True
+            handoff = self._handoff.pop(pid, [])
+            return {"view": self._view_locked(), "handoff": handoff}
+
+    def poll(self, pid: int) -> MembershipView:
+        with self._mu:
+            self._touch_locked(pid)
+            self._expire_locked()
+            return self._view_locked()
+
+    def report(self, pid: int, healthy_devices) -> MembershipView:
+        """A member publishes its CURRENT healthy device set (fed by its
+        DeviceHealthRegistry): shrink on a breaker trip, regrow on a
+        half-open recovery — either way the epoch renumbers."""
+        devices = tuple(int(d) for d in healthy_devices)
+        with self._mu:
+            m = self._members.get(pid)
+            if m is not None:
+                m["last_seen"] = self._clock()
+                if m["devices"] != devices:
+                    m["devices"] = devices
+                    self._bump_locked(f"member {pid} health changed")
+            self._expire_locked()
+            return self._view_locked()
+
+    def leave(self, pid: int) -> MembershipView:
+        with self._mu:
+            if self._members.pop(pid, None) is not None:
+                self._bump_locked(f"member {pid} left")
+            self._expire_locked()
+            return self._view_locked()
+
+    def put_handoff(self, pid: int, states: List[dict]):
+        with self._mu:
+            self._handoff[pid] = list(states)
+            self._touch_locked(pid)
+        REGISTRY.inc("coord_handoff_put_total", len(states))
+
+    def pop_handoff(self, pid: int) -> List[dict]:
+        with self._mu:
+            return self._handoff.pop(pid, [])
+
+    def ingest_spans(self, pid: int, payload: dict, nbytes: int) -> str:
+        """Rebuild a worker's forwarded span tree into this process's
+        trace ring — grafted under the matching local trace when the
+        qid correlates (ONE tree spanning hosts), standalone otherwise."""
+        if nbytes > _span_cap_bytes():
+            REGISTRY.inc("coord_spans_dropped_total")
+            return "dropped"
+        from ..trace.export import graft_or_append
+
+        outcome = graft_or_append(payload, host=pid)
+        REGISTRY.inc("coord_spans_ingested_total")
+        REGISTRY.inc("coord_span_bytes_total", nbytes)
+        if outcome == "grafted":
+            REGISTRY.inc("coord_spans_grafted_total")
+        with self._mu:
+            self._touch_locked(pid)
+        return outcome
+
+    def _view_locked(self) -> MembershipView:
+        return MembershipView(
+            epoch=self._epoch,
+            members={p: m["devices"] for p, m in self._members.items()},
+            formed=self._formed,
+        )
+
+    def view(self) -> MembershipView:
+        with self._mu:
+            self._expire_locked()
+            return self._view_locked()
+
+    # ---- wire -----------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True,
+                             name="tidb-tpu-coord-conn").start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            conn.settimeout(3.0)
+            f = conn.makefile("rwb")
+            line = f.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                resp = self._dispatch(req, len(line))
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            f.write(json.dumps(resp).encode() + b"\n")
+            f.flush()
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict, nbytes: int) -> dict:
+        cmd = req.get("cmd")
+        pid = int(req.get("pid", -1))
+        if cmd == "register":
+            out = self.register(pid, req.get("devices") or (),
+                                req.get("lease_s"))
+            return self._resp(out["view"], handoff=out["handoff"])
+        if cmd == "poll":
+            return self._resp(self.poll(pid))
+        if cmd == "report":
+            return self._resp(self.report(pid, req.get("devices") or ()))
+        if cmd == "leave":
+            return self._resp(self.leave(pid))
+        if cmd == "handoff":
+            self.put_handoff(pid, req.get("sessions") or [])
+            return self._resp(self.view())
+        if cmd == "spans":
+            outcome = self.ingest_spans(pid, req.get("payload") or {},
+                                        nbytes)
+            return self._resp(self.view(), outcome=outcome)
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    @staticmethod
+    def _resp(view: MembershipView, **extra) -> dict:
+        d = {"ok": True, "epoch": view.epoch, "formed": view.formed,
+             "members": {str(p): list(ids)
+                         for p, ids in view.members.items()}}
+        d.update(extra)
+        return d
+
+
+class LocalPlane:
+    """Single-process degenerate plane: every coordination primitive
+    works as a local loop so the tier-1 suite exercises the plane
+    without worker processes.  Epoch bumps arrive through the
+    DeviceHealthRegistry hook; membership is the healthy device set the
+    mesh builder last published; handoff parks in memory and survives
+    server restarts within the process (the single-host rolling-restart
+    story)."""
+
+    kind = "local"
+    pid = 0
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._epoch = 1
+        self._devices: Tuple[int, ...] = ()
+        self._handoff: List[dict] = []
+
+    def view(self) -> MembershipView:
+        with self._mu:
+            members = {0: self._devices} if self._devices else {}
+            return MembershipView(self._epoch, members, formed=True)
+
+    def current_epoch(self) -> int:
+        with self._mu:
+            return self._epoch
+
+    def bump(self, reason: str = ""):
+        with self._mu:
+            self._epoch += 1
+            REGISTRY.inc("coord_epoch_bumps_total")
+            REGISTRY.set("coord_epoch", self._epoch)
+
+    def publish_local(self, device_ids):
+        # no bump: publishing the same healthy set is not a membership
+        # change (trips/recoveries bump through on_health_change)
+        with self._mu:
+            self._devices = tuple(int(d) for d in device_ids)
+
+    def on_health_change(self, tripped_ids, reason: str):
+        self.bump(reason)
+
+    def wait_formed(self, timeout_s: float = 0.0) -> bool:
+        return True
+
+    def forward_trace(self, tr):  # local traces are already in the ring
+        pass
+
+    def handoff_put(self, states):
+        states = list(states or ())
+        if not states:
+            return
+        _hit_handoff(self.pid, len(states))
+        with self._mu:
+            self._handoff = states
+        REGISTRY.inc("coord_handoff_put_total", len(states))
+
+    def take_handoff(self) -> List[dict]:
+        with self._mu:
+            out, self._handoff = self._handoff, []
+            return out
+
+    def leave(self):
+        pass
+
+    def stop(self, leave: bool = False):
+        pass
+
+
+class CoordinatorPlane:
+    """Process 0's plane: owns the Coordinator state in-process (no TCP
+    round trip to itself) and participates as member `pid`."""
+
+    kind = "coordinator"
+
+    def __init__(self, coordinator: Coordinator, pid: int = 0):
+        self.coord = coordinator
+        self.pid = pid
+        self._devices: Tuple[int, ...] = ()
+        self._handoff_in: List[dict] = []
+
+    def start(self, devices=()):
+        self._devices = tuple(int(d) for d in devices)
+        if self.coord._thread is None:
+            self.coord.start()
+        out = self.coord.register(self.pid, self._devices)
+        self._handoff_in = list(out["handoff"])
+        return self
+
+    def view(self) -> MembershipView:
+        return self.coord.view()
+
+    def current_epoch(self) -> int:
+        return self.view().epoch
+
+    def bump(self, reason: str = ""):
+        self.coord.bump(reason)
+
+    def publish_local(self, device_ids):
+        pass  # membership truth flows through register/report
+
+    def on_health_change(self, tripped_ids, reason: str):
+        tripped = set(int(d) for d in tripped_ids)
+        healthy = tuple(d for d in self._devices if d not in tripped)
+        self.coord.report(self.pid, healthy)
+
+    def wait_formed(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.coord.view().formed:
+                return True
+            time.sleep(0.1)
+        return self.coord.view().formed
+
+    def forward_trace(self, tr):  # the coordinator's traces are local
+        pass
+
+    def handoff_put(self, states):
+        states = list(states or ())
+        if not states:
+            return
+        _hit_handoff(self.pid, len(states))
+        self.coord.put_handoff(self.pid, states)
+
+    def take_handoff(self) -> List[dict]:
+        # registration snapshot PLUS anything parked since (an in-process
+        # server drain on the coordinator host puts straight into the
+        # live store — LocalPlane and WorkerPlane rejoin both read live
+        # state, and this path must match)
+        out, self._handoff_in = self._handoff_in, []
+        return out + self.coord.pop_handoff(self.pid)
+
+    def leave(self):
+        pass  # the coordinator leaving takes the plane down with it
+
+    def stop(self, leave: bool = False):
+        self.coord.stop()
+
+
+class WorkerPlane:
+    """A non-coordinator process's plane: registers with the
+    coordinator, heartbeats its lease (caching each membership
+    broadcast), reports breaker trips, forwards finished traces, and
+    parks/retrieves handoff state.  Every RPC is a short-lived
+    connection with a small timeout; a dead coordinator degrades the
+    worker to its last cached view (counted, never blocking a query)."""
+
+    kind = "worker"
+
+    def __init__(self, addr, pid: int, lease_s: float = 5.0,
+                 heartbeat_s: Optional[float] = None,
+                 rpc_timeout_s: float = 2.0):
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host, int(port))
+        self.addr = (addr[0], int(addr[1]))
+        self.pid = int(pid)
+        self.lease_s = float(lease_s)
+        self.heartbeat_s = heartbeat_s or max(self.lease_s / 3.0, 0.05)
+        self.rpc_timeout_s = rpc_timeout_s
+        self._mu = threading.Lock()
+        self._view = MembershipView(0, {}, formed=False)
+        self._devices: Tuple[int, ...] = ()
+        self._handoff_in: List[dict] = []
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self, devices=()):
+        self._devices = tuple(int(d) for d in devices)
+        resp = self._rpc({"cmd": "register", "pid": self.pid,
+                          "devices": list(self._devices),
+                          "lease_s": self.lease_s},
+                         retries=40, retry_sleep=0.25)
+        self._apply(resp)
+        with self._mu:
+            self._handoff_in = list(resp.get("handoff") or [])
+        self._stop.clear()
+        self._hb = threading.Thread(target=self._heartbeat, daemon=True,
+                                    name="tidb-tpu-coord-hb")
+        self._hb.start()
+        # worker span trees rejoin the coordinator's trace ring
+        from ..trace import recorder
+
+        recorder.TRACE_EXPORT_HOOK = self.forward_trace
+        return self
+
+    def stop(self, leave: bool = False):
+        if leave:
+            self.leave()
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=2.0)
+            self._hb = None
+        from ..trace import recorder
+
+        if recorder.TRACE_EXPORT_HOOK == self.forward_trace:
+            recorder.TRACE_EXPORT_HOOK = None
+
+    def leave(self):
+        try:
+            self._apply(self._rpc({"cmd": "leave", "pid": self.pid}))
+        except Exception:
+            REGISTRY.inc("coord_rpc_errors_total")
+
+    # ---- views ----------------------------------------------------------
+    def view(self) -> MembershipView:
+        with self._mu:
+            return self._view
+
+    def current_epoch(self) -> int:
+        return self.view().epoch
+
+    def bump(self, reason: str = ""):
+        """Local-cache bump (tests/diagnostics): makes the next dispatch
+        observe an epoch ahead of its mesh stamp."""
+        with self._mu:
+            self._view = MembershipView(self._view.epoch + 1,
+                                        self._view.members,
+                                        self._view.formed)
+
+    def publish_local(self, device_ids):
+        pass  # membership truth flows through register/report
+
+    def wait_formed(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.view().formed:
+                return True
+            try:
+                self._apply(self._rpc({"cmd": "poll", "pid": self.pid}))
+            except Exception:
+                REGISTRY.inc("coord_rpc_errors_total")
+            time.sleep(0.1)
+        return self.view().formed
+
+    # ---- plane surface --------------------------------------------------
+    def on_health_change(self, tripped_ids, reason: str):
+        tripped = set(int(d) for d in tripped_ids)
+        healthy = tuple(d for d in self._devices if d not in tripped)
+        try:
+            self._apply(self._rpc({"cmd": "report", "pid": self.pid,
+                                   "devices": list(healthy)}))
+        except Exception:
+            REGISTRY.inc("coord_rpc_errors_total")
+
+    def forward_trace(self, tr):
+        """finish_trace hook: ship the finished span tree to the
+        coordinator.  Oversize payloads (per-host byte cap) drop with a
+        counter; a dead coordinator costs one short timeout, never a
+        query failure."""
+        try:
+            from ..trace.export import trace_payload
+
+            data = json.dumps({"cmd": "spans", "pid": self.pid,
+                               "payload": trace_payload(tr)})
+            if len(data) > _span_cap_bytes():
+                REGISTRY.inc("coord_spans_dropped_total")
+                return
+            self._rpc_line(data)
+            REGISTRY.inc("coord_spans_forwarded_total")
+            REGISTRY.inc("coord_span_bytes_total", len(data))
+        except Exception:
+            REGISTRY.inc("coord_rpc_errors_total")
+
+    def handoff_put(self, states):
+        states = list(states or ())
+        if not states:
+            return
+        _hit_handoff(self.pid, len(states))
+        self._rpc({"cmd": "handoff", "pid": self.pid, "sessions": states})
+
+    def take_handoff(self) -> List[dict]:
+        with self._mu:
+            out, self._handoff_in = self._handoff_in, []
+            return out
+
+    # ---- internals ------------------------------------------------------
+    def _apply(self, resp: dict):
+        view = _view_from_resp(resp)
+        with self._mu:
+            if view.epoch >= self._view.epoch:
+                self._view = view
+        REGISTRY.set("coord_epoch", view.epoch)
+
+    def _heartbeat(self):
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                resp = self._rpc({"cmd": "poll", "pid": self.pid})
+                view = _view_from_resp(resp)
+                if self.pid not in view.members:
+                    # expired while alive (paused/partitioned): rejoin at
+                    # the new epoch; any parked handoff rides back
+                    resp = self._rpc({"cmd": "register", "pid": self.pid,
+                                      "devices": list(self._devices),
+                                      "lease_s": self.lease_s})
+                    with self._mu:
+                        self._handoff_in += list(resp.get("handoff") or [])
+                self._apply(resp)
+            except Exception:
+                REGISTRY.inc("coord_rpc_errors_total")
+
+    def _rpc(self, obj: dict, retries: int = 1,
+             retry_sleep: float = 0.2) -> dict:
+        data = json.dumps(obj)
+        last: Optional[Exception] = None
+        for _i in range(max(retries, 1)):
+            try:
+                return self._rpc_line(data)
+            except Exception as e:  # noqa: BLE001 — transport boundary
+                last = e
+                time.sleep(retry_sleep)
+        raise last
+
+    def _rpc_line(self, data: str) -> dict:
+        with socket.create_connection(
+                self.addr, timeout=self.rpc_timeout_s) as s:
+            s.settimeout(self.rpc_timeout_s)
+            f = s.makefile("rwb")
+            f.write(data.encode() + b"\n")
+            f.flush()
+            line = f.readline()
+        if not line:
+            raise ConnectionError("coordinator closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(str(resp.get("error")))
+        return resp

@@ -73,6 +73,10 @@ def classify_failure(exc: BaseException) -> Optional[str]:
         return "device"
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
         return None
+    from ..coord import CoordEpochMismatch
+
+    if isinstance(exc, CoordEpochMismatch):
+        return None  # membership move: retried upstream, never a chip fault
     from ..errors import TiDBTPUError
 
     if isinstance(exc, TiDBTPUError):
@@ -140,9 +144,30 @@ class DeviceHealthRegistry:
         self._clock = clock
         self._mu = threading.Lock()
         self._devices: Dict[int, DeviceState] = {}
+        # coordination-plane epoch publication hook (tidb_tpu/coord):
+        # invoked OUTSIDE the lock after any transition that changes the
+        # mesh-eligible set, so a breaker trip on this host renumbers
+        # the cluster's membership epoch
+        self._epoch_hook = None
+
+    def set_epoch_hook(self, cb):
+        """cb(tripped_ids, reason) or None.  Called after trips, probe
+        admissions, half-open recoveries and resets — every event that
+        changes which devices the mesh may span."""
+        self._epoch_hook = cb
+
+    def _notify(self, reason: str):
+        cb = self._epoch_hook
+        if cb is None:
+            return
+        try:
+            cb(self.tripped_ids(), reason)
+        except Exception:
+            pass  # the plane must never break health bookkeeping
 
     # ---- state transitions ---------------------------------------------
     def record_error(self, device_id: int, exc: BaseException):
+        tripped = False
         with self._mu:
             st = self._devices.setdefault(device_id, DeviceState(device_id))
             st.error_count += 1
@@ -152,7 +177,10 @@ class DeviceHealthRegistry:
             if (st.state == PROBING
                     or st.consecutive_errors >= self.trip_threshold):
                 self._trip(st)
+                tripped = True
             self._publish()
+        if tripped:
+            self._notify("trip")
 
     def _trip(self, st: DeviceState):
         st.state = TRIPPED
@@ -166,6 +194,7 @@ class DeviceHealthRegistry:
     def record_success(self, device_ids):
         """A mesh program completed over these devices: close half-open
         breakers and reset consecutive-error counters."""
+        recovered = False
         with self._mu:
             for did in device_ids:
                 st = self._devices.get(did)
@@ -175,7 +204,10 @@ class DeviceHealthRegistry:
                 if st.state == PROBING:
                     st.state = HEALTHY
                     REGISTRY.inc("device_breaker_recoveries_total")
+                    recovered = True
             self._publish()
+        if recovered:
+            self._notify("recover")
 
     def select_devices(self, devices: List) -> List:
         """Filter a device list down to mesh-eligible devices: healthy ones
@@ -183,6 +215,7 @@ class DeviceHealthRegistry:
         probes).  Order is preserved (shard placement stays deterministic)."""
         now = self._clock()
         out = []
+        probed = False
         with self._mu:
             for d in devices:
                 st = self._devices.get(d.id)
@@ -194,7 +227,10 @@ class DeviceHealthRegistry:
                     st.state = PROBING
                     REGISTRY.inc("device_breaker_probes_total")
                     out.append(d)
+                    probed = True
             self._publish()
+        if probed:
+            self._notify("probe")
         return out
 
     def expire_cooldowns(self):
@@ -231,6 +267,7 @@ class DeviceHealthRegistry:
         with self._mu:
             self._devices.clear()
             self._publish()
+        self._notify("reset")
 
     def _publish(self):
         # gauge, not counter: reflects the CURRENT quarantine set

@@ -42,6 +42,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..chunk import Chunk, Column
+from ..coord import CoordEpochMismatch
 from ..store.fault import FAILPOINTS
 from ..store.kv import CopRequest
 from ..types import TypeKind
@@ -61,6 +62,11 @@ from .jax_engine import _Analyzed, _fingerprint, _gather_tile, _to_state_dtype
 # ---------------------------------------------------------------------------
 
 _MESH: Optional[Mesh] = None
+#: membership epoch the current _MESH was derived from (coord plane):
+#: stamped under _MESH_LOCK by get_mesh, compared at every dispatch —
+#: a mismatch means some host changed the survivor set after we built,
+#: and dispatching anyway risks an XLA collective desync/hang
+_MESH_EPOCH: Optional[int] = None
 _MESH_LOCK = threading.Lock()
 _DIST_INIT = False
 
@@ -100,18 +106,60 @@ def _maybe_init_multihost():
         process_id=int(os.environ.get("TIDB_TPU_PROCESS_ID", "0")),
     )
     _DIST_INIT = True  # only latched on success (a raise retries next call)
+    # coordination plane (ISSUE 9): when TIDB_TPU_COORD_ADDR is also
+    # set, the SAME processes form the control plane — process 0 binds,
+    # everyone registers its local device ids, and all block until the
+    # cluster FORMS so the first mesh derives from one broadcast
+    addr = os.environ.get("TIDB_TPU_COORD_ADDR")
+    if addr:
+        from ..coord import activate_env_plane
+
+        activate_env_plane(
+            addr,
+            pid=int(os.environ.get("TIDB_TPU_PROCESS_ID", "0")),
+            devices=[d.id for d in jax.local_devices()],
+            expect=int(os.environ.get("TIDB_TPU_NUM_PROCESSES", "1")),
+        )
 
 
 def _eligible_devices():
-    """Mesh-eligible devices: the full visible set minus tripped breakers
-    (plus half-open probe admissions).  Multi-process meshes never filter —
-    every process must build the identical mesh or the collective fabric
-    desyncs; cross-host failover is the coordinator's job there."""
+    """(mesh-eligible devices, membership epoch they derive from).
+
+    Single-process: the full visible set minus tripped breakers (plus
+    half-open probe admissions), published to the coordination plane so
+    /status membership stays truthful.  Multi-process: the plane's
+    epoch-numbered membership broadcast — every process filters from
+    the SAME broadcast, so survivor meshes stay identical across hosts
+    (this closes the "health filtering skipped on multi-host" hole: a
+    breaker trip on ANY host shrinks everyone's mesh).  Before the
+    cluster has formed the full device set is used on every process
+    identically, which is the pre-coordination behavior."""
+    from ..coord import get_plane
+
+    plane = get_plane()
     devs = list(jax.devices())
     if jax.process_count() > 1:
-        return devs
+        # drive the LOCAL breaker state machine even though filtering is
+        # membership-driven here: select_devices is what transitions
+        # TRIPPED -> PROBING once a cooldown lapses, and that transition
+        # publishes through the epoch hook (report -> regrown broadcast
+        # -> epoch bump), so a probe-eligible chip rejoins every host's
+        # mesh for its half-open trial instead of staying excluded until
+        # a process restart
+        DEVICE_HEALTH.select_devices(
+            [d for d in devs
+             if d.process_index == jax.process_index()])
+        view = plane.view()
+        if view.formed and view.members:
+            allowed = view.device_ids()
+            sel = [d for d in devs if d.id in allowed]
+            if sel:
+                return sel, view.epoch
+        return devs, view.epoch
     healthy = DEVICE_HEALTH.select_devices(devs)
-    return healthy if healthy else devs  # all tripped: callers gate
+    chosen = healthy if healthy else devs  # all tripped: callers gate
+    plane.publish_local(tuple(d.id for d in chosen))
+    return chosen, plane.current_epoch()
 
 
 def _no_eligible_devices() -> bool:
@@ -128,14 +176,14 @@ def get_mesh() -> Mesh:
     mesh REBUILDS whenever the eligible set changes — a tripped breaker
     shrinks it to the survivors, a successful half-open probe restores it
     (region_cache.go invalidateStore -> reload, on devices)."""
-    global _MESH
+    global _MESH, _MESH_EPOCH
     _maybe_init_multihost()
     # serialize check-and-rebuild AND snapshot eligibility under the
     # lock: with breakers changing the eligible set at runtime, a racing
     # producer thread holding a pre-trip snapshot could otherwise
     # reinstate a mesh containing the just-quarantined device
     with _MESH_LOCK:
-        devs = _eligible_devices()
+        devs, epoch = _eligible_devices()
         ids = tuple(d.id for d in devs)
         if _MESH is None or tuple(d.id for d in _MESH.devices.ravel()) != ids:
             if _MESH is not None:
@@ -144,7 +192,38 @@ def get_mesh() -> Mesh:
                 REGISTRY.inc("mesh_rebuilds_total")
             FAILPOINTS.hit("mesh/rebuild", device_ids=ids)
             _MESH = Mesh(np.array(devs), ("dp",))
+        # restamp even when the device set is unchanged: an epoch bump
+        # without a visible device change (a lost member whose devices
+        # we never saw, a chaos bump) must not leave a stale stamp that
+        # fails every later dispatch check
+        _MESH_EPOCH = epoch
         return _MESH
+
+
+def mesh_epoch() -> Optional[int]:
+    """Membership epoch the current mesh was built from (tests,
+    /status)."""
+    return _MESH_EPOCH
+
+
+def _check_membership_epoch():
+    """Dispatch-time epoch guard (coord plane): the chaos site
+    coord/member_lost lands a membership change exactly here, and a
+    real cross-host change (breaker trip, lease expiry, rejoin) between
+    mesh build and dispatch is detected the same way.  Raises the typed
+    retriable CoordEpochMismatch — try_run_mesh rebuilds from the new
+    broadcast and re-runs — instead of launching into an XLA collective
+    whose participant set no longer matches other hosts (a desync that
+    presents as a hang)."""
+    from ..coord import get_plane
+
+    FAILPOINTS.hit("coord/member_lost", epoch=_MESH_EPOCH)
+    ep = get_plane().current_epoch()
+    if _MESH_EPOCH is not None and ep != _MESH_EPOCH:
+        from ..metrics import REGISTRY
+
+        REGISTRY.inc("coord_epoch_mismatch_total")
+        raise CoordEpochMismatch(_MESH_EPOCH, ep)
 
 
 def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
@@ -1127,6 +1206,15 @@ def try_run_mesh(storage, req: CopRequest, table_id=None):
     while True:
         try:
             out = _run_mesh_once(storage, req, tid)
+        except CoordEpochMismatch:
+            # membership moved between mesh build and dispatch (a member
+            # lost, rejoined, or health-shrunk on some host): rebuild
+            # from the new broadcast and retry — typed and retriable by
+            # design, no breaker trips, never a collective desync
+            if attempts + 1 >= MAX_MESH_ATTEMPTS:
+                raise
+            attempts += 1
+            continue
         except BaseException as e:
             if not _handle_mesh_failure(req, e, attempts):
                 raise
@@ -1169,6 +1257,15 @@ def _guarded_stream(storage, req: CopRequest, tid: int, gen, attempts: int):
                 emitted = True
                 yield c
             return
+        except CoordEpochMismatch:
+            # pre-first-chunk membership move: restart the stream on the
+            # rebuilt mesh (same rule as device failures — after rows
+            # were emitted a retry would duplicate them)
+            if emitted or attempts + 1 >= MAX_MESH_ATTEMPTS:
+                raise
+            attempts += 1
+            gen = None
+            continue
         except BaseException as e:
             # trip/evict side effects run even when the error must
             # surface (mid-stream failures after emitted rows): the NEXT
@@ -1393,6 +1490,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
                        end=bounds[-1][1])
         FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=bounds[0][0],
                        end=bounds[-1][1])
+        _check_membership_epoch()
         if kind == "agg" and an.agg_mode == "sort":
             try:
                 with DISPATCH_LOCK:
@@ -1481,6 +1579,7 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
                        end=bounds[-1][1])
         FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=bounds[0][0],
                        end=bounds[-1][1])
+        _check_membership_epoch()
         with DISPATCH_LOCK:
             mask = fn(datas, valids, del_mask, bounds, pargs)
         handles = np.flatnonzero(mask)

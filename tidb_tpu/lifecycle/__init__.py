@@ -2,9 +2,16 @@
 
 One QueryScope per top-level statement, threaded through every blocking
 host-side seam (see scope.py).  The server layers admission control and
-graceful drain on top of the same scope plane (server/server.py).
+graceful drain on top of the same scope plane (server/server.py); drain
+additionally parks prepared-session state on the coordination plane for
+rolling restarts (see handoff.py).
 """
 
+from .handoff import (  # noqa: F401
+    collect_session_states,
+    replay_session_states,
+    session_state,
+)
 from .scope import (  # noqa: F401
     NULL_SCOPE,
     REASONS,

@@ -1,0 +1,80 @@
+"""Session-state handoff for rolling restarts (ROADMAP lifecycle
+follow-up (c)).
+
+The reference's rolling-restart story leans on clients reconnecting and
+re-preparing; on a TPU mesh a restart is routine (driver upgrades, host
+kernel patches) and re-preparing a fleet's statements is real lost work.
+Here a draining server serializes every session that holds prepared
+statements — name->sql map, session-scoped sysvars, simple user @vars —
+and parks the bundle on the coordination plane (coord/plane.py); the
+replacement process replays it at startup, at its NEW membership epoch,
+so a rolling restart loses no prepared sessions.
+
+The payload is strictly JSON (the plane is jax-free and wire-portable):
+anything that cannot travel as a scalar is dropped, never pickled.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..metrics import REGISTRY
+
+_JSONABLE = (str, int, float, bool, type(None))
+
+
+def session_state(sess) -> Optional[dict]:
+    """One session's restart-surviving state, or None when it holds no
+    prepared statements (prepared statements are WHAT the handoff
+    preserves; sysvars and user vars ride along so the replayed session
+    behaves identically)."""
+    prepared = dict(getattr(sess, "_prepared", None) or {})
+    if not prepared:
+        return None
+    return {
+        "conn_id": sess.conn_id,
+        "db": sess.current_db,
+        "user": sess.user,
+        "prepared": {str(k): str(v) for k, v in prepared.items()},
+        "sysvars": dict(sess.vars._session),
+        "user_vars": {k: v for k, v in sess.vars.user_vars.items()
+                      if isinstance(v, _JSONABLE)},
+    }
+
+
+def collect_session_states(domain) -> List[dict]:
+    """Every live session's handoff state (drain-time collection; also
+    usable as an eager checkpoint so even a hard-killed worker's last
+    known sessions replay on rejoin)."""
+    out = []
+    for _cid, sess in sorted(domain.sessions.items()):
+        st = session_state(sess)
+        if st is not None:
+            out.append(st)
+    return out
+
+
+def replay_session_states(domain, states) -> int:
+    """Recreate parked sessions in `domain`: fresh conn ids (the old
+    connections are gone), original database/identity/sysvars/prepared
+    map restored, `handoff_origin` recording the predecessor conn id.
+    Returns the number of sessions replayed; per-session failures count
+    as handoff failures and never block the rest."""
+    n = 0
+    for st in states or ():
+        try:
+            sess = domain.new_session()
+            sess.current_db = st.get("db") or sess.current_db
+            sess.user = st.get("user") or sess.user
+            for k, v in (st.get("sysvars") or {}).items():
+                sess.vars.set_session(k, v)
+            sess.vars.user_vars.update(st.get("user_vars") or {})
+            sess._prepared.update({str(k): str(v) for k, v
+                                   in (st.get("prepared") or {}).items()})
+            sess.handoff_origin = st.get("conn_id")
+            n += 1
+        except Exception:
+            REGISTRY.inc("coord_handoff_failed_total")
+    if n:
+        REGISTRY.inc("coord_handoff_replayed_total", n)
+    return n

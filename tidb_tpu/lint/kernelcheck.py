@@ -396,6 +396,23 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                              f"range count changed the fused program's "
                              f"jaxpr ({stats} vs {multi}) — range bounds "
                              "must stay runtime data, not program shape")
+                    # membership-epoch guard (coord plane): the epoch is
+                    # host-side control state — re-tracing after a bump
+                    # must yield the identical program.  An epoch baked
+                    # into the jaxpr would recompile on every failover
+                    # AND desync SPMD processes tracing at different
+                    # epochs.
+                    from ..coord import get_plane
+
+                    get_plane().bump("kernelcheck-epoch-guard")
+                    ep_stats = _jaxpr_stats(
+                        trace_fused_fragment(table, dag))
+                    if ep_stats != stats:
+                        emit(name,
+                             f"membership epoch bump changed the fused "
+                             f"program's jaxpr ({stats} vs {ep_stats}) — "
+                             "the epoch must stay host-side control "
+                             "state, never a compiled constant")
                 break
             if stats is None:
                 emit(name, "no fused mesh form for canonical fragment — "

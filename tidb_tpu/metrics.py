@@ -44,3 +44,23 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+#: coordination-plane counters (tidb_tpu/coord) surfaced as one group on
+#: the /status endpoint.  The registry itself is dynamic; this tuple is
+#: the stable contract between the plane, http_status and the tests:
+#: epoch/membership churn, cross-host span forwarding (with the per-host
+#: byte-cap drop counter), and rolling-restart session handoff.
+COORD_STATUS_METRICS = (
+    "coord_epoch_bumps_total",
+    "coord_epoch_mismatch_total",
+    "coord_members_expired_total",
+    "coord_spans_forwarded_total",
+    "coord_spans_ingested_total",
+    "coord_spans_grafted_total",
+    "coord_spans_dropped_total",
+    "coord_span_bytes_total",
+    "coord_handoff_put_total",
+    "coord_handoff_replayed_total",
+    "coord_handoff_failed_total",
+    "coord_rpc_errors_total",
+)

@@ -50,8 +50,10 @@ class StatusServer:
                     self._send(200, body, "text/plain; version=0.0.4")
                     return
                 if path in ("/status", "/"):
+                    from ..coord import get_plane
                     from ..copr.cache import PROGRAM_CACHES
                     from ..copr.device_health import DEVICE_HEALTH
+                    from ..metrics import COORD_STATUS_METRICS
                     from ..trace import TRACE_RING
 
                     running = sum(
@@ -77,6 +79,9 @@ class StatusServer:
                             })
                         except Exception:
                             continue  # a live trace mutating mid-walk
+                    plane = get_plane()
+                    view = plane.view()
+                    snap = REGISTRY.snapshot()
                     body = json.dumps({
                         "version": VERSION,
                         "git_hash": "",
@@ -99,6 +104,22 @@ class StatusServer:
                         # rate tracks query SHAPE CLASSES, not literals
                         "compiled_programs": {
                             c.name: c.stats() for c in PROGRAM_CACHES
+                        },
+                        # coordination plane (ISSUE 9): membership epoch
+                        # + per-process healthy device sets, and the
+                        # failover / span-forwarding / handoff counters
+                        "coord": {
+                            "kind": plane.kind,
+                            "epoch": view.epoch,
+                            "formed": view.formed,
+                            "members": {
+                                str(p): list(ids) for p, ids
+                                in sorted(view.members.items())
+                            },
+                            "metrics": {
+                                name: snap.get(name, 0)
+                                for name in COORD_STATUS_METRICS
+                            },
                         },
                     }).encode()
                     self._send(200, body, "application/json")

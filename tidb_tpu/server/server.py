@@ -81,6 +81,18 @@ class MySQLServer:
         )
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
+        # rolling-restart handoff (coord plane): adopt any session state
+        # a draining predecessor parked — prepared statements + session
+        # sysvars replay into fresh sessions at THIS process's epoch
+        try:
+            from ..coord import get_plane
+            from ..lifecycle import replay_session_states
+
+            states = get_plane().take_handoff()
+            if states:
+                replay_session_states(self.domain, states)
+        except Exception:
+            REGISTRY.inc("coord_handoff_failed_total")
         return addr
 
     async def stop(self):
@@ -130,6 +142,30 @@ class MySQLServer:
         if cancelled:
             REGISTRY.inc("server_drain_cancelled_total", cancelled)
             await asyncio.sleep(0.05)  # flush the ERR 1053 writes
+        # session-state handoff (rolling restart, coord plane): park
+        # every prepared session on the coordinator BEFORE connections
+        # close, so the replacement process replays them when it rejoins
+        # at a new epoch.  A failed put (chaos site coord/handoff, dead
+        # coordinator) must never block the drain — the sessions are
+        # lost, counted, and the shutdown completes.
+        try:
+            from ..coord import get_plane
+            from ..lifecycle import collect_session_states
+
+            states = collect_session_states(self.domain)
+            if states:
+                get_plane().handoff_put(states)
+        except Exception:
+            REGISTRY.inc("coord_handoff_failed_total")
+        try:
+            # graceful departure is independent of handoff success: the
+            # epoch must bump NOW (not at lease expiry) so survivors
+            # rebuild immediately even when the handoff was lost
+            from ..coord import get_plane
+
+            get_plane().leave()
+        except Exception:
+            REGISTRY.inc("coord_rpc_errors_total")
         # unblock connection loops parked in pr.recv() and wait for the
         # handlers to unwind (they run their own session cleanup)
         for _t, (_s, writer) in list(self._conns.items()):

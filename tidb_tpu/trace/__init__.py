@@ -41,4 +41,9 @@ from .recorder import (  # noqa: F401
     tracing_active,
 )
 from .recorder import NOOP  # noqa: F401
+from .export import (  # noqa: F401
+    graft_or_append,
+    import_trace,
+    trace_payload,
+)
 from .slowlog import SlowQueryLog  # noqa: F401

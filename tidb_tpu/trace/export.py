@@ -1,0 +1,81 @@
+"""Cross-host span export/import (coordination plane, ROADMAP trace
+follow-up (a)).
+
+Workers serialize each finished QueryTrace — the same dict tree
+`TRACE FORMAT='json'` renders — and ship it to the coordinator at query
+end (coord/plane.py owns the transport and the per-host byte cap).  The
+coordinator rebuilds the span tree, tags every imported root with the
+source host, and either GRAFTS it under its own trace of the same
+statement (matched by qid, the SPMD statement-sequence correlation id)
+or appends it to the ring standalone.  EXPLAIN ANALYZE, SLOW_QUERY and
+/status then show ONE tree spanning hosts instead of each process
+keeping a private fragment.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .recorder import TRACE_RING, QueryTrace, Span
+
+
+def trace_payload(tr: QueryTrace) -> dict:
+    """JSON-safe payload for one finished trace (adds the cross-host
+    correlation id to the TRACE FORMAT='json' tree)."""
+    d = tr.to_dict()
+    d["qid"] = getattr(tr, "qid", None)
+    return d
+
+
+def import_trace(payload: dict, host: Optional[int] = None) -> QueryTrace:
+    """Rebuild a forwarded payload into a QueryTrace whose span offsets
+    and durations are preserved (start times re-anchor to import time —
+    only RELATIVE offsets travel, so clock skew between hosts never
+    corrupts the tree)."""
+    tr = QueryTrace(payload.get("sql") or "",
+                    int(payload.get("conn_id") or 0), imported=True)
+    tr.qid = payload.get("qid")
+    tr.imported_from = host
+    tr.finished = True
+    start_time = payload.get("start_time")
+    if start_time:
+        tr.start_time = float(start_time)
+    base = tr.root.start_ns
+
+    def build(d: dict) -> Span:
+        s = Span(str(d.get("name") or "span"), tr)
+        s.start_ns = base + int(d.get("start_us") or 0) * 1000
+        s.dur_ns = int(d.get("duration_us") or 0) * 1000
+        attrs = d.get("attrs")
+        if attrs:
+            s.attrs = dict(attrs)
+        s.children = [build(c) for c in d.get("children") or ()]
+        return s
+
+    root = build(payload.get("root") or {})
+    if host is not None:
+        if root.attrs is None:
+            root.attrs = {}
+        root.attrs["host"] = int(host)
+    tr.root = root
+    return tr
+
+
+def graft_or_append(payload: dict, host: Optional[int] = None,
+                    ring=None) -> str:
+    """Join a forwarded trace to the local ring: grafted as a child of
+    the local trace with the same qid when one exists (one tree spanning
+    hosts), appended standalone otherwise.  Imported traces never serve
+    as graft targets — two workers' trees for the same statement both
+    hang under the coordinator's, not under each other."""
+    ring = TRACE_RING if ring is None else ring
+    tr = import_trace(payload, host=host)
+    if tr.qid:
+        for local in reversed(list(ring)):
+            if (getattr(local, "qid", None) == tr.qid
+                    and getattr(local, "imported_from", None) is None):
+                with local._mu:
+                    local.root.children.append(tr.root)
+                return "grafted"
+    ring.append(tr)
+    return "appended"

@@ -15,12 +15,20 @@ attrs (`bytes=`), engine/rung attribution in `engine=` attrs.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+import zlib
 from collections import deque
 from contextvars import ContextVar
 from dataclasses import dataclass
 from typing import Dict, List, Optional
+
+#: per-process statement-trace sequence: multi-controller SPMD runs the
+#: same statement stream in every process, so (sql crc, seq) — the qid —
+#: correlates one statement's traces ACROSS hosts (trace/export.py
+#: grafts a worker's forwarded tree under the coordinator's by qid)
+_TRACE_SEQ = itertools.count()
 
 
 @dataclass
@@ -78,7 +86,8 @@ class QueryTrace:
     """The span tree of one statement execution plus its EXPLAIN ANALYZE
     operator stats — the single execution-stats carrier."""
 
-    def __init__(self, sql: str, conn_id: int = 0):
+    def __init__(self, sql: str, conn_id: int = 0,
+                 imported: bool = False):
         self.sql = sql
         self.conn_id = conn_id
         self.start_time = time.time()
@@ -86,6 +95,20 @@ class QueryTrace:
         self.root = Span("session.execute", self)
         self.op_stats: Dict[int, OperatorStats] = {}
         self.finished = False
+        # cross-host correlation id + import provenance (coord plane).
+        # Imported shells (trace/export.py rebuilding a forwarded tree)
+        # MUST NOT consume the sequence: SPMD correlation relies on every
+        # process assigning the same seq to the same statement, and an
+        # ingest that advanced the coordinator's counter would desync
+        # qids from the workers' forever after the first forwarded trace.
+        self.imported_from: Optional[int] = None
+        if imported:
+            self.seq = -1
+            self.qid: Optional[str] = None
+        else:
+            self.seq = next(_TRACE_SEQ)
+            crc = zlib.crc32(sql.encode("utf-8", "replace")) & 0xFFFFFFFF
+            self.qid = f"{crc:08x}-{self.seq}"
 
     # ---- tree assembly --------------------------------------------------
     def child(self, parent: Span, name: str) -> Span:
@@ -252,6 +275,12 @@ _CUR: ContextVar[Optional[Span]] = ContextVar("tidb_tpu_trace", default=None)
 #: most recent finished traces (process-global; /status + tests)
 TRACE_RING: deque = deque(maxlen=32)
 
+#: cross-host span forwarding hook: a worker-side coordination plane
+#: (tidb_tpu/coord) installs its forward_trace here so every finished
+#: trace ships to the coordinator at query end; None (the default)
+#: keeps finish_trace allocation-free
+TRACE_EXPORT_HOOK = None
+
 
 class _NoopSpan:
     """Singleton returned when tracing is off: every operation is a
@@ -371,6 +400,16 @@ def finish_trace(tr: QueryTrace, token):
     _CUR.reset(token)
     tr.root.finish()
     tr.finished = True
+    hook = TRACE_EXPORT_HOOK
+    if hook is not None:
+        # worker plane active: the finished tree rejoins the
+        # coordinator's ring (failures count, never raise into the
+        # query).  Fires BEFORE the local ring append so an in-process
+        # coordinator grafts under ITS trace, never under this one.
+        try:
+            hook(tr)
+        except Exception:
+            pass
     TRACE_RING.append(tr)
     from ..metrics import REGISTRY
 
