@@ -651,3 +651,46 @@ def test_wire_read_span_records_socket_wait(domain):
             await srv.stop()
 
     run(body())
+
+
+def test_periodic_handoff_checkpoint():
+    """Lifecycle follow-up (d): with tidb_tpu_handoff_checkpoint_s set,
+    the server eagerly parks prepared-session state on the coordination
+    plane on a timer — a SIGKILLed process (no drain) loses at most one
+    interval, because the replacement replays the latest checkpoint."""
+    from tidb_tpu.coord import get_plane
+    from tidb_tpu.metrics import REGISTRY
+    from tidb_tpu.session import Domain
+
+    async def body():
+        dom = Domain()
+        dom.global_vars["tidb_tpu_handoff_checkpoint_s"] = "1"
+        srv = MySQLServer(dom, port=0)
+        await srv.start()
+        try:
+            sess = dom.new_session()
+            sess.execute("set tidb_slow_log_threshold = 777")
+            sess.execute("create table ck (a bigint)")
+            sess.execute("prepare pck from 'select count(*) from ck'")
+            m0 = REGISTRY.get("coord_handoff_checkpoint_total")
+            for _ in range(40):  # first tick lands within ~1s
+                await asyncio.sleep(0.05)
+                if REGISTRY.get("coord_handoff_checkpoint_total") > m0:
+                    break
+            assert REGISTRY.get("coord_handoff_checkpoint_total") > m0
+            # the plane now holds the checkpoint WITHOUT any drain having
+            # run — the hard-kill survivability this policy buys
+            states = get_plane().take_handoff()
+            assert any("pck" in (st.get("prepared") or {})
+                       and st.get("sysvars", {}).get(
+                           "tidb_slow_log_threshold") == "777"
+                       for st in states)
+        finally:
+            await srv.shutdown(drain_s=0.0)
+            dom.maintenance.stop()
+            # the drain itself re-parks the prepared session on the
+            # process-global plane; drain it so later tests' servers
+            # don't adopt this test's session
+            get_plane().take_handoff()
+
+    run(body())

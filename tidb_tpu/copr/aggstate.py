@@ -61,15 +61,59 @@ def group_indices(cols: List[Column]) -> Tuple[np.ndarray, List[tuple], int]:
         np.minimum.at(first, gidx, np.arange(n, dtype=np.int64))
         keys = [(c.get(int(first[g])),) for g in range(G)]
         return gidx, keys, G
-    keys: Dict[tuple, int] = {}
-    gidx = np.zeros(n, dtype=np.int64)
-    rows = list(zip(*[c.to_pylist() for c in cols]))
-    for i, r in enumerate(rows):
-        g = keys.get(r)
-        if g is None:
-            g = keys[r] = len(keys)
-        gidx[i] = g
-    return gidx, list(keys.keys()), len(keys)
+    # multi-column / object keys: per-column vectorized factorize +
+    # mixed-radix combine (re-factorized per step so codes stay < n and
+    # never overflow), then a first-appearance remap so group ids and
+    # key ordering match the old row-at-a-time dict exactly.  NULL is
+    # its own code per column (validity joins the key), and equal float
+    # keys collapse like the single-column bit-domain path.
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), [], 0
+    combined = np.zeros(n, dtype=np.int64)
+    for c in cols:
+        inv, card = _factorize_column(c)
+        combined = combined * card + inv
+        combined = np.unique(combined, return_inverse=True)[1] \
+            .astype(np.int64)
+    G = int(combined.max()) + 1
+    first = np.full(G, n, dtype=np.int64)
+    np.minimum.at(first, combined, np.arange(n, dtype=np.int64))
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(G, dtype=np.int64)
+    rank[order] = np.arange(G, dtype=np.int64)
+    gidx = rank[combined]
+    # G key tuples gathered from each group's first row (G-scale, the
+    # same per-group materialization the single-column path does)
+    keys = [tuple(c.get(int(first[g])) for c in cols) for g in order]
+    return gidx, keys, G
+
+
+def _factorize_column(c: Column) -> Tuple[np.ndarray, int]:
+    """(dense codes, cardinality) for one key column, NULL rows coded 0.
+    np.unique vectorizes str/numeric payloads; exotic object payloads
+    (mixed types that don't compare) fall back to a hash-map pass."""
+    n = len(c)
+    data, valid = c.data, c.valid
+    try:
+        if valid is None:
+            _u, iv = np.unique(data, return_inverse=True)
+            return iv.astype(np.int64, copy=False), max(len(_u), 1)
+        inv = np.zeros(n, dtype=np.int64)
+        _u, iv = np.unique(data[valid], return_inverse=True)
+        inv[valid] = iv.astype(np.int64, copy=False) + 1
+        return inv, len(_u) + 1
+    except TypeError:
+        codes: Dict[object, int] = {}
+        inv = np.zeros(n, dtype=np.int64)
+        vv = valid
+        for i, x in enumerate(data.tolist()):
+            if vv is not None and not vv[i]:
+                continue  # NULL keeps code 0
+            g = codes.get(x)
+            if g is None:
+                g = codes[x] = len(codes) + 1
+            inv[i] = g
+        return inv, len(codes) + 1
 
 
 def partial_states(agg: AggDesc, arg_vecs: List[Vec], gidx: np.ndarray,

@@ -38,6 +38,13 @@ class ByteCapCache:
         self._bytes = 0
         self.capacity = capacity_bytes
         self._mu = threading.Lock()
+        # value-weighted eviction policy (layout autotuner): priority_fn
+        # ranks resident keys (lowest evicts first; None = FIFO) and
+        # demote_fn gets each victim BEFORE it is dropped — the hook that
+        # re-homes a column into the compressed cold tier instead of
+        # losing it outright
+        self._priority_fn: Optional[Callable[[tuple], float]] = None
+        self._demote_fn: Optional[Callable[[tuple, tuple], None]] = None
         # per-key in-flight records: a background prefetch and a query
         # racing on the same column must not BOTH push it over the link
         # (transfers are the expensive part; see _MeshCache)
@@ -45,6 +52,25 @@ class ByteCapCache:
         # keys evicted WHILE their load was in flight: the finished value
         # must not be cached (it may be placed on a dead device)
         self._doomed: set = set()
+
+    def set_policy(self, priority_fn=None, demote_fn=None):
+        """Install the value-weighted eviction policy (both optional)."""
+        with self._mu:
+            self._priority_fn = priority_fn
+            self._demote_fn = demote_fn
+
+    def _eviction_order_locked(self) -> List[tuple]:
+        """Victim order for one eviction pass: priorities are ranked
+        ONCE (one priority_fn call per resident, not per victim) so a
+        multi-victim eviction holds the mutex for O(N log N), never
+        O(V*N) cross-lock lookups.  FIFO fallback when no policy (or a
+        broken one — a bad policy must never wedge the cache)."""
+        if self._priority_fn is not None:
+            try:
+                return sorted(self._order, key=self._priority_fn)
+            except Exception:
+                pass
+        return list(self._order)
 
     def get_or_load(self, key: tuple, loader: Callable[[], Tuple]) -> tuple:
         while True:
@@ -70,24 +96,46 @@ class ByteCapCache:
             rec.ev.set()
             raise
         nbytes = sum(v.nbytes for v in value if v is not None)
+        victims: List[Tuple[tuple, tuple]] = []
         with self._mu:
             rec.value = value
             doomed = key in self._doomed
             self._doomed.discard(key)
             self._inflight.pop(key, None)
             if not doomed:
+                ranked: Optional[List[tuple]] = None
                 while self._bytes + nbytes > self.capacity and self._order:
-                    old = self._order.pop(0)
+                    if ranked is None:
+                        ranked = self._eviction_order_locked()
+                    old = ranked.pop(0)
+                    self._order.remove(old)
                     ov = self._cache.pop(old)
                     self._bytes -= sum(v.nbytes for v in ov if v is not None)
+                    victims.append((old, ov))
                 self._cache[key] = value
                 self._order.append(key)
                 self._bytes += nbytes
+            demote = self._demote_fn
             # doomed: hand the value to this caller and every waiter
             # (their mesh is already condemned and will retry) but never
             # cache it for a future, possibly-restored mesh
         rec.ev.set()
+        if demote is not None:
+            # outside the lock: demotion compresses + transfers, and a
+            # demote hook that loads through ANOTHER cache must not hold
+            # this one's lock
+            for vk, vv in victims:
+                try:
+                    demote(vk, vv)
+                except Exception:
+                    pass  # demotion is best-effort; the drop already won
         return value
+
+    def peek(self, key: tuple):
+        """Resident value for key (no load, no ordering effect); None on
+        miss.  Used for tier bookkeeping (cold-hit/promotion metrics)."""
+        with self._mu:
+            return self._cache.get(key)
 
     def evict_if(self, pred: Callable[[tuple], bool]) -> int:
         """Drop every entry whose key satisfies pred (device-failover

@@ -190,6 +190,37 @@ def projection_outputs(ctx: RegionContext):
     return [compile_expr(p, ctx.cols, ctx.n) for p in ctx.an.proj_exprs]
 
 
+def decode_packed(packed, dict_arg, bits: int, n: int,
+                  kind: str = "unique"):
+    """Decode emitter for COLD-TIER columns (tidb_tpu/layout): bit-packed
+    dictionary codes -> the column's value vector, in-register inside the
+    same fused program as every other phase — a cold column costs a few
+    extra VPU ops, never a second dispatch or a host transfer.
+
+    `packed` is the shard-local packed byte vector (n // (8//bits)
+    bytes).  The unpack is GATHER-FREE: bytes broadcast against the
+    per-slot shift vector and reshape back to rows, so it lowers to pure
+    elementwise VPU work.  `dict_arg` is a RUNTIME operand (layout
+    VALUES never enter the fingerprint, kernelcheck-guarded): for
+    'range' dictionaries it is the scalar bias (decode = code + lo, no
+    dictionary at all); for 'unique' (float) dictionaries it is the
+    value vector indexed by code.  Code arithmetic stays int32: no
+    int64 emulation chain enters the kernel census."""
+    vpb = 8 // bits
+    p = packed.reshape(-1)
+    if vpb == 1:
+        code = p
+    else:
+        # stay in uint8 through the unpack: measured ~1.7x cheaper than
+        # int32 shift chains on the CPU harness (narrower VPU lanes)
+        shifts = jnp.arange(vpb, dtype=jnp.uint8) * jnp.uint8(bits)
+        code = ((p[:, None] >> shifts[None, :])
+                & jnp.uint8((1 << bits) - 1)).reshape(n)
+    if kind == "range":
+        return code.astype(dict_arg.dtype) + dict_arg
+    return dict_arg[code.astype(jnp.int32)]
+
+
 # ---------------------------------------------------------------------------
 # grouped sort-agg emitters: shared by the mesh sort-agg program
 # (parallel._build_sort_agg_core) and the MPP grouped partial-agg phase
@@ -436,11 +467,18 @@ def run_fragment(table, dag: DAG, start: int, end: int, deleted,
 # ---------------------------------------------------------------------------
 
 
-def trace_fused_fragment(table, dag, n_ranges: int = 1):
+def trace_fused_fragment(table, dag, n_ranges: int = 1, cold: bool = False,
+                         dict_shift: int = 0):
     """make_jaxpr for the whole-fragment MESH program over a 1-device
     mesh (deterministic regardless of how many virtual devices the
     harness exposes) — the fused-fragment corpus of lint.kernelcheck.
-    Raises JaxUnsupported when the fragment has no fused mesh form."""
+    Raises JaxUnsupported when the fragment has no fused mesh form.
+
+    `cold=True` traces the cold-tier layout class: every packable scan
+    column rides as bit-packed dictionary codes with its decode emitter
+    fused in, the dictionary-value operands shifted by `dict_shift` —
+    two shifts must trace to the IDENTICAL jaxpr (layout values are
+    runtime slots, never compiled constants)."""
     import numpy as np
     from jax.sharding import Mesh
 
@@ -453,20 +491,41 @@ def trace_fused_fragment(table, dag, n_ranges: int = 1):
         "topn" if an.topn is not None else "filter")
     col_order = an.needed_cols()
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
-    core = par._build_mesh_core(an, kind, col_order, mesh,
-                                tiles_per_shard=1)
     tile = je.TILE
-    datas, valids = [], []
+    datas, valids, col_layout, lvals = [], [], [], []
     from .jax_eval import _np_dtype_for
 
     for ci in col_order:
-        meta = table.cols[an.scan.columns[ci]]
-        # the engine's own dtype mapping (raises JaxUnsupported for
-        # host-only columns), so the traced corpus can never green-light
-        # a shape class the production engine rejects
-        dt = np.dtype(_np_dtype_for(meta.ftype))
-        datas.append(np.zeros((1, tile), dtype=dt))
-        valids.append(np.ones((1, tile), dtype=np.bool_))
+        store_ci = an.scan.columns[ci]
+        meta = table.cols[store_ci]
+        info = None
+        if cold:
+            from ..layout.coldtier import dict_values, pack_info
+
+            info = pack_info(table, store_ci)
+        if info is not None:
+            vpb = 8 // info.bits
+            datas.append(np.zeros((1, tile // vpb), dtype=np.uint8))
+            valids.append(np.ones((1, tile), dtype=np.bool_))
+            col_layout.append((info.bits, info.cap, info.kind))
+            dv = dict_values(table, store_ci, info)
+            if info.kind == "range":
+                lvals.append(dv.dtype.type(info.lo + dict_shift))
+            else:
+                lvals.append(dv + dv.dtype.type(dict_shift))
+        else:
+            # the engine's own dtype mapping (raises JaxUnsupported for
+            # host-only columns), so the traced corpus can never
+            # green-light a shape class the production engine rejects
+            dt = np.dtype(_np_dtype_for(meta.ftype))
+            datas.append(np.zeros((1, tile), dtype=dt))
+            valids.append(np.ones((1, tile), dtype=np.bool_))
+            col_layout.append(None)
+    if cold and not any(col_layout):
+        raise JaxUnsupported("no cold-packable column in fragment")
+    core = par._build_mesh_core(an, kind, col_order, mesh,
+                                tiles_per_shard=1,
+                                col_layout=col_layout if cold else None)
     del_mask = np.ones((1, tile), dtype=np.bool_)
     bounds = []
     for r in range(par.MESH_RANGE_SLOTS):
@@ -475,4 +534,5 @@ def trace_fused_fragment(table, dag, n_ranges: int = 1):
         else:
             bounds += [np.int64(0), np.int64(0)]
     return jax.make_jaxpr(core)(
-        tuple(datas), tuple(valids), del_mask, tuple(bounds))
+        tuple(datas), tuple(valids), del_mask, tuple(bounds),
+        tuple(lvals))

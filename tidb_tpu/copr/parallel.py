@@ -226,7 +226,8 @@ def _check_membership_epoch():
         raise CoordEpochMismatch(_MESH_EPOCH, ep)
 
 
-def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
+def _layout(base_rows: int, n_shards: int, table=None
+            ) -> Tuple[int, int, int]:
     """(n_tiles, n_tiles_padded, tiles_per_shard) for a table.
 
     With shape buckets on (tidb_tpu_shape_buckets, the default) the tile
@@ -235,15 +236,34 @@ def _layout(base_rows: int, n_shards: int) -> Tuple[int, int, int]:
     grows within a class — share one compiled shard_map program shape.
     Padded tiles are zeros and always masked (the row mask clips to
     [start, end) which never exceeds base_rows), so results are
-    identical; the cost is bounded extra masked compute."""
+    identical; the cost is bounded extra masked compute.
+
+    The layout autotuner can flip a table's tiling to EXACT (`table`
+    given + the tuner's tile-bucket decision): under HBM pressure the
+    pow2 padding is pure wasted capacity, so capacity-squeezed tables
+    trade program reuse for resident bytes."""
     from ..serving import shape_bucket, shape_buckets_enabled
 
     tile = je.TILE
     n_tiles = max((base_rows + tile - 1) // tile, 1)
-    if shape_buckets_enabled():
+    if shape_buckets_enabled() and _tile_bucket(table) == "pow2":
         n_tiles = shape_bucket(n_tiles)
     n_pad = ((n_tiles + n_shards - 1) // n_shards) * n_shards
     return n_tiles, n_pad, n_pad // n_shards
+
+
+def _tile_bucket(table) -> str:
+    """The autotuner's table-level tiling decision ('pow2' default)."""
+    if table is None:
+        return "pow2"
+    from ..layout import LAYOUT, layout_enabled
+
+    if not layout_enabled():
+        return "pow2"
+    try:
+        return LAYOUT.tile_bucket(table)
+    except Exception:
+        return "pow2"  # a tuner hiccup must never reshape a scan
 
 
 def _full_dtype(kind) -> np.dtype:
@@ -277,6 +297,49 @@ def _wire_dtype(table, store_ci: int) -> np.dtype:
     return full
 
 
+def _hot_priority(key: tuple) -> float:
+    """Value-weighted eviction rank for a hot mesh-cache key: the layout
+    autotuner's per-column residency priority (lowest evicts first).
+    With the layout engine disabled every key ranks equal, which makes
+    min() pick the FIFO head — the pre-layout behavior exactly."""
+    from ..layout import LAYOUT, layout_enabled
+
+    if not layout_enabled():
+        return 0.0
+    return LAYOUT.priority(key[0], key[2])
+
+
+def _hot_demote(key: tuple, _value: tuple):
+    """Demote an evicted hot column to the compressed cold tier
+    (demote-to-cold before drop).  Only packable columns of a live store
+    whose mesh still matches compress; everything else just drops (and
+    reloads — possibly cold — on next access)."""
+    from ..layout import COLD_CACHE, LAYOUT, compress_column, layout_enabled
+    from ..layout.coldtier import pack_info
+    from ..metrics import REGISTRY
+
+    if not layout_enabled():
+        return
+    store_uid, base_version, store_ci = key[0], key[1], key[2]
+    table = LAYOUT.store_ref(store_uid)
+    if table is None or table.base_version != base_version:
+        return
+    info = pack_info(table, store_ci)
+    if info is None:
+        return
+    mesh = _MESH  # snapshot read: a moved mesh skips the demote (the
+    if mesh is None:  # next access cold-loads against the new mesh)
+        return
+    if tuple(d.id for d in mesh.devices.ravel()) != key[3]:
+        return
+    n_pad = key[5]
+    COLD_CACHE.get_or_load(
+        key + ("cold",),
+        lambda: (compress_column(table, store_ci, mesh, n_pad, info),))
+    LAYOUT.note_demoted(store_uid, store_ci)
+    REGISTRY.inc("layout_cold_demotions_total")
+
+
 class _MeshCache:
     """(store_uid, base_version, store_ci, device_ids, TILE) -> sharded
     [n_pad, TILE] arrays; device ids in the key so a rebuilt same-size mesh
@@ -285,12 +348,26 @@ class _MeshCache:
     The cached data array keeps the NARROW wire dtype (see _wire_dtype) and
     the valid slot is None for columns with no NULLs — consumers cast on
     device / substitute a constant mask, so both the link transfer and the
-    steady-state HBM traffic shrink to the narrow width."""
+    steady-state HBM traffic shrink to the narrow width.
 
-    def __init__(self, capacity_bytes: int = 8 << 30):
+    This is the HOT tier: capacity comes from TIDB_TPU_HBM_BYTES, and
+    eviction is VALUE-WEIGHTED (layout autotuner): the lowest-priority
+    column is the victim, and packable victims DEMOTE to the compressed
+    cold tier (tidb_tpu/layout/coldtier) instead of dropping — a table
+    bigger than the cap degrades to cheaper representations, not to
+    host reloads."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None):
+        import os as _os2
+
         from .cache import ByteCapCache
 
+        if capacity_bytes is None:
+            capacity_bytes = int(_os2.environ.get(
+                "TIDB_TPU_HBM_BYTES", str(8 << 30)))
         self._c = ByteCapCache(capacity_bytes)
+        self._c.set_policy(priority_fn=_hot_priority,
+                           demote_fn=_hot_demote)
 
     @property
     def _cache(self):  # introspected by tests / dryrun
@@ -303,7 +380,7 @@ class _MeshCache:
         # n_pad in the key so a shape-bucket policy change never pairs a
         # stale-shaped cached array with a newly laid-out program
         devs = tuple(d.id for d in mesh.devices.ravel())
-        _, n_pad, _ = _layout(table.base_rows, S)
+        _, n_pad, _ = _layout(table.base_rows, S, table=table)
         key = (table.store_uid, table.base_version, store_ci, devs, je.TILE,
                n_pad)
 
@@ -416,6 +493,69 @@ def load_columns(mesh: Mesh, table, store_cis):
     return [f.result() for f in futs]
 
 
+def get_layout_column(mesh: Mesh, table, store_ci: int):
+    """One column through the adaptive layout: ('hot', (data, valid)) or
+    ('cold', ColdColumn).  Cold-tier hits/loads/promotions are counted;
+    the chaos site `layout/decompress` (and any compression failure)
+    falls back to the hot tier, parity-preserved."""
+    from ..layout import layout_enabled
+
+    if not layout_enabled():
+        return ("hot", MESH_CACHE.get_column(mesh, table, store_ci))
+    from ..errors import TiDBTPUError
+    from ..layout import COLD_CACHE, LAYOUT, compress_column
+    from ..layout.coldtier import DECOMPRESS_FAILPOINT
+    from ..metrics import REGISTRY
+
+    LAYOUT.observe(table, store_ci, "scan")
+    plan = LAYOUT.plan_for(table, store_ci)
+    S = len(mesh.devices.ravel())
+    devs = tuple(d.id for d in mesh.devices.ravel())
+    _, n_pad, _ = _layout(table.base_rows, S, table=table)
+    cold_key = (table.store_uid, table.base_version, store_ci, devs,
+                je.TILE, n_pad, "cold")
+    if plan.tier == "cold" and plan.bits:
+        try:
+            FAILPOINTS.hit(DECOMPRESS_FAILPOINT, col=store_ci,
+                           bits=plan.bits)
+            hit = COLD_CACHE.peek(cold_key) is not None
+            entry = COLD_CACHE.get_or_load(
+                cold_key,
+                lambda: (compress_column(table, store_ci, mesh, n_pad),),
+            )[0]
+            REGISTRY.inc("layout_cold_hits_total" if hit
+                         else "layout_cold_loads_total")
+            return ("cold", entry)
+        except TiDBTPUError:
+            raise  # kill/deadline/quota keep their meaning
+        except Exception:
+            # chaos-armed decompress failure or a compression error:
+            # serve the column hot — slower, never wrong
+            REGISTRY.inc("layout_cold_fallbacks_total")
+    elif COLD_CACHE.peek(cold_key) is not None:
+        # the tuner re-decided hot (priority rose / pressure passed):
+        # promote — drop the compressed copy, load the wire array
+        COLD_CACHE.evict_if(lambda k: k == cold_key)
+        REGISTRY.inc("layout_cold_promotions_total")
+    return ("hot", MESH_CACHE.get_column(mesh, table, store_ci))
+
+
+def load_layout_columns(mesh: Mesh, table, store_cis):
+    """Layout-aware variant of `load_columns`: per-column hot/cold
+    entries, concurrent transfers on the xfer pool (same multi-process
+    determinism rule)."""
+    cis = list(store_cis)
+    if len(cis) <= 1 or jax.process_count() > 1:
+        return [get_layout_column(mesh, table, ci) for ci in cis]
+    from ..trace import current_span, run_attached
+
+    parent = current_span()
+    futs = [_xfer_pool().submit(run_attached, parent,
+                                get_layout_column, mesh, table, ci)
+            for ci in cis]
+    return [f.result() for f in futs]
+
+
 def prefetch_table(storage, table_id: int, min_rows: int = 1 << 20):
     """Warm the mesh column cache for a table in the background (device
     cache warming after bulk load — the TiFlash eager-replica analog).
@@ -448,7 +588,7 @@ def prefetch_table(storage, table_id: int, min_rows: int = 1 << 20):
             for ci in range(len(table.cols)):
                 if _SHUTDOWN or table.base_version != version:
                     return  # interpreter exiting / data changed under us
-                MESH_CACHE.get_column(mesh, table, ci)
+                get_layout_column(mesh, table, ci)  # warms the right tier
         except Exception:
             pass  # prefetch is advisory; queries load on demand
 
@@ -487,15 +627,34 @@ def _all_true(mesh: Mesh, n_pad: int):
 # ---------------------------------------------------------------------------
 
 def _cols_env(an: _Analyzed, col_order: List[int], datas, valids,
-              n_local: int, params=None):
+              n_local: int, params=None, col_layout=None, lvals=()):
     """Per-shard column environment for compile_expr: widen the narrow
     wire arrays to the canonical dtype in-register (XLA fuses the convert
     into every consumer — HBM reads stay narrow), and substitute a traced
     constant mask for columns cached without a validity array (no NULLs:
     zero transfer, zero HBM).  `params` carries the hoisted predicate
-    parameter vectors (pi, pf) for ParamConst slots."""
+    parameter vectors (pi, pf) for ParamConst slots.
+
+    `col_layout[j]` = (bits, cap, kind) marks column j COLD: datas[j] is
+    the shard-local bit-packed code bytes and the matching `lvals` entry
+    its decode runtime operand (scalar bias for 'range', dictionary
+    vector for 'unique') — the decode emitter (fusion.decode_packed)
+    unpacks it in-register, fused with every consumer.  Cold columns are
+    NULL-free by the tuner's contract."""
+    from . import fusion
+
     env = {}
+    lv = 0
     for j, ci in enumerate(col_order):
+        lay = col_layout[j] if col_layout is not None else None
+        if lay is not None:
+            bits, _cap, kind = lay
+            d = fusion.decode_packed(datas[j], lvals[lv], bits, n_local,
+                                     kind=kind)
+            lv += 1
+            v = jnp.ones(n_local, dtype=jnp.bool_)
+            env[ci] = (d, v)
+            continue
         d = datas[j].reshape(n_local)
         target = _full_dtype(an.scan.ftypes[ci].kind)
         if d.dtype != target:
@@ -683,18 +842,20 @@ def _packed_jit(fn):
     return call
 
 
-def _mesh_in_specs(an: _Analyzed, hoisted: bool):
+def _mesh_in_specs(an: _Analyzed, hoisted: bool, n_lvals: int = 0):
     """shard_map input specs shared by every fused mesh program: sharded
     column/validity/deletion arrays, the replicated range-bound slots,
-    then the variadic parg tail."""
+    the replicated layout dictionary-value operands (one per cold
+    column), then the variadic parg tail."""
     return (P("dp"), P("dp"), P("dp"),
-            tuple(P() for _ in range(2 * MESH_RANGE_SLOTS))
+            tuple(P() for _ in range(2 * MESH_RANGE_SLOTS)),
+            tuple(P() for _ in range(n_lvals)),
             ) + _probe_specs(an, hoisted)
 
 
 def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
                      mesh: Mesh, tiles_per_shard: int,
-                     hoisted: bool = False):
+                     hoisted: bool = False, col_layout=None):
     """The raw shard_map'd whole-fragment program (pre-jit).
 
     One body per mesh: each shard flattens its local tiles to a
@@ -706,8 +867,11 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
     jits + packs it) and by kernelcheck's fused-fragment corpus
     (jax.make_jaxpr over a 1-device mesh).
 
-    Signature: core(datas, valids, del_mask, bounds, *pargs) where
-    bounds is the 2*MESH_RANGE_SLOTS scalar tuple from _bounds_args.
+    Signature: core(datas, valids, del_mask, bounds, lvals, *pargs)
+    where bounds is the 2*MESH_RANGE_SLOTS scalar tuple from
+    _bounds_args and lvals the cold columns' dictionary-value runtime
+    operands (empty tuple for an all-hot fragment — the common case
+    compiles the identical program it always did).
     """
     from . import fusion
 
@@ -715,14 +879,16 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
     Tl = tiles_per_shard
     n_local = Tl * je.TILE
     n_global = S * n_local
+    n_lvals = sum(1 for c in (col_layout or ()) if c is not None)
 
     if kind == "agg" and an.agg_mode == "sort":
         return _build_sort_agg_core(an, col_order, mesh, tiles_per_shard,
-                                    hoisted=hoisted)
+                                    hoisted=hoisted, col_layout=col_layout)
 
-    def region_ctx(datas, valids, del_mask, bounds, pargs):
+    def region_ctx(datas, valids, del_mask, bounds, lvals, pargs):
         pargs, params = _split_hoisted(pargs, hoisted)
-        cols = _cols_env(an, col_order, datas, valids, n_local, params)
+        cols = _cols_env(an, col_order, datas, valids, n_local, params,
+                         col_layout=col_layout, lvals=lvals)
         gofs, row_mask = _mesh_masks(del_mask, bounds, n_local)
         ctx = fusion.RegionContext(an=an, cols=cols, n=n_local,
                                    mask=row_mask, axis="dp", gofs=gofs,
@@ -732,8 +898,9 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
         return ctx
 
     if kind == "agg":
-        def shard_fn(datas, valids, del_mask, bounds, *pargs):
-            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+        def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, lvals,
+                             pargs)
             gidx = fusion.dense_group_codes(ctx)
             gcount, results = fusion.dense_agg_results(ctx, gidx)
             return gcount, tuple(results)
@@ -759,40 +926,45 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
         _e, desc = an.topn.order_by[0]
         k = min(topn_budget(an.topn.limit), n_local)
 
-        def shard_fn(datas, valids, del_mask, bounds, *pargs):
-            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+        def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, lvals,
+                             pargs)
             key = fusion.topn_key(ctx)
             idx, cnt = ops.masked_top_k(key, ctx.mask, k, desc)
             return ctx.gofs[idx], cnt.reshape(1)
 
         out_specs = P("dp")
     else:  # filter: the fused selection mask (projection reads it later)
-        def shard_fn(datas, valids, del_mask, bounds, *pargs):
-            ctx = region_ctx(datas, valids, del_mask, bounds, pargs)
+        def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
+            ctx = region_ctx(datas, valids, del_mask, bounds, lvals,
+                             pargs)
             return ctx.mask
 
         out_specs = P("dp")
 
     return shard_map(shard_fn, mesh=mesh,
-                     in_specs=_mesh_in_specs(an, hoisted),
+                     in_specs=_mesh_in_specs(an, hoisted, n_lvals),
                      out_specs=out_specs)
 
 
 def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
-                   mesh: Mesh, tiles_per_shard: int, hoisted: bool = False):
+                   mesh: Mesh, tiles_per_shard: int, hoisted: bool = False,
+                   col_layout=None):
     """One jitted shard_map program over the whole fragment.
 
-    Inputs: datas [n_pad, TILE] x cols, valids likewise, del_mask
-    [n_pad, TILE], the range-bound list (padded to MESH_RANGE_SLOTS
-    runtime scalars), then the variadic parg tail (probe key sets,
-    lookup payloads, and — when `hoisted` — the replicated (pi, pf)
-    predicate parameter vectors).  Every range of a steady-state
-    fragment runs in this ONE dispatch; intermediates never leave HBM.
+    Inputs: datas [n_pad, TILE] x cols (cold columns: [n_pad,
+    TILE*bits/8] packed bytes), valids likewise, del_mask [n_pad, TILE],
+    the range-bound list (padded to MESH_RANGE_SLOTS runtime scalars),
+    the cold columns' dictionary-value operands, then the variadic parg
+    tail (probe key sets, lookup payloads, and — when `hoisted` — the
+    replicated (pi, pf) predicate parameter vectors).  Every range of a
+    steady-state fragment runs in this ONE dispatch; intermediates never
+    leave HBM.
     """
     S = len(mesh.devices.ravel())
     n_local = tiles_per_shard * je.TILE
     core = _build_mesh_core(an, kind, col_order, mesh, tiles_per_shard,
-                            hoisted=hoisted)
+                            hoisted=hoisted, col_layout=col_layout)
 
     if kind == "agg" and an.agg_mode == "sort":
         return _wrap_sort_agg(an, core, S, n_local)
@@ -803,10 +975,10 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         tags = je._agg_tags(agg_ir)
         packed = _packed_jit(core)
 
-        def wrapped(datas, valids, del_mask, bounds, pargs=()):
+        def wrapped(datas, valids, del_mask, bounds, lvals=(), pargs=()):
             gcount, results = packed(
                 tuple(datas), tuple(valids), del_mask,
-                _bounds_args(bounds), *pargs,
+                _bounds_args(bounds), tuple(lvals), *pargs,
             )
             merged = []
             for tag, r in zip(tags, results):
@@ -830,10 +1002,10 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         k = min(topn_budget(an.topn.limit), n_local)
         packed = _packed_jit(core)
 
-        def wrapped(datas, valids, del_mask, bounds, pargs=()):
+        def wrapped(datas, valids, del_mask, bounds, lvals=(), pargs=()):
             gidx, cnt = packed(
                 tuple(datas), tuple(valids), del_mask,
-                _bounds_args(bounds), *pargs,
+                _bounds_args(bounds), tuple(lvals), *pargs,
             )
             return gidx, cnt, k
         return wrapped
@@ -845,14 +1017,14 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         lambda *a: jnp.packbits(core(*a).astype(jnp.uint8))
     )
 
-    def wrapped(datas, valids, del_mask, bounds, pargs=()):
+    def wrapped(datas, valids, del_mask, bounds, lvals=(), pargs=()):
         from ..trace import span
 
         n_rows = S * n_local
         with span("copr.device.execute"):
             out = jitted(
                 tuple(datas), tuple(valids), del_mask,
-                _bounds_args(bounds), *pargs,
+                _bounds_args(bounds), tuple(lvals), *pargs,
             )
         with span("copr.readback") as sp:
             bits = np.asarray(out)
@@ -910,7 +1082,8 @@ def _fd_sort_lookup(an: _Analyzed):
 
 
 def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
-                         tiles_per_shard: int, hoisted: bool = False):
+                         tiles_per_shard: int, hoisted: bool = False,
+                         col_layout=None):
     """Sort-based per-shard partial aggregation for arbitrary group keys
     (any NDV, float, NULLable, expression keys) — the shard_map'd core.
 
@@ -932,10 +1105,12 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
     OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
     agg_ir = an.agg
     fd_lookup = _fd_sort_lookup(an)
+    n_lvals = sum(1 for c in (col_layout or ()) if c is not None)
 
-    def shard_fn(datas, valids, del_mask, bounds, *pargs):
+    def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
         pargs, params = _split_hoisted(pargs, hoisted)
-        cols = _cols_env(an, col_order, datas, valids, n_local, params)
+        cols = _cols_env(an, col_order, datas, valids, n_local, params,
+                        col_layout=col_layout, lvals=lvals)
         gofs, m = _mesh_masks(del_mask, bounds, n_local)
         ctx = fusion.RegionContext(an=an, cols=cols, n=n_local, mask=m,
                                    axis="dp", gofs=gofs, n_global=n_global)
@@ -975,7 +1150,7 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
         return n_uniq.reshape(1), out_keys, tuple(results)
 
     return shard_map(shard_fn, mesh=mesh,
-                     in_specs=_mesh_in_specs(an, hoisted),
+                     in_specs=_mesh_in_specs(an, hoisted, n_lvals),
                      out_specs=P("dp"))
 
 
@@ -986,10 +1161,10 @@ def _wrap_sort_agg(an: _Analyzed, core, S: int, n_local: int):
     tags = je._agg_tags(an.agg)
     packed = _packed_jit(core)
 
-    def wrapped(datas, valids, del_mask, bounds, pargs=()):
+    def wrapped(datas, valids, del_mask, bounds, lvals=(), pargs=()):
         n_uniq, keys, results = packed(
             tuple(datas), tuple(valids), del_mask,
-            _bounds_args(bounds), *pargs,
+            _bounds_args(bounds), tuple(lvals), *pargs,
         )
         return {
             "mode": "sort",
@@ -1152,15 +1327,19 @@ def _handle_mesh_failure(req: CopRequest, exc: BaseException,
     # implicated in the last failure must still be quarantined (and its
     # poisoned sharded arrays dropped) for the NEXT query, which would
     # otherwise re-run over the dead chip before its breaker ever trips
+    from ..layout import coldtier
+
     dead = attribute_devices(exc)
     for did in dead:
         DEVICE_HEALTH.record_error(did, exc)
         MESH_CACHE.evict_device(did)
+        coldtier.evict_device(did)  # packed blocks die with their mesh
         if _ONES_CACHE is not None:
             _ONES_CACHE.evict_if(lambda k, d=did: d in k[0])
     if kind == "oom":
         REGISTRY.inc("mesh_hbm_oom_total")
         MESH_CACHE.clear()
+        coldtier.clear()
         je.DEVICE_CACHE.clear()
         if _ONES_CACHE is not None:
             _ONES_CACHE.clear()
@@ -1281,6 +1460,33 @@ def _guarded_stream(storage, req: CopRequest, tid: int, gen, attempts: int):
             gen = None
 
 
+def _observe_fragment(table, an: _Analyzed):
+    """Feed the fragment's column USAGE to the layout autotuner: which
+    store columns serve as filter inputs, group keys, aggregate
+    arguments and probe keys (the agg-vs-probe signal the residency
+    priority weighs)."""
+    from ..layout import LAYOUT, layout_enabled
+
+    if not layout_enabled():
+        return
+    width = len(an.scan.columns)
+
+    def obs(exprs, kind):
+        refs: set = set()
+        for e in exprs:
+            e.collect_columns(refs)
+        for i in refs:
+            if i < width:
+                LAYOUT.observe(table, an.scan.columns[i], kind)
+
+    obs(an.conds, "filter")
+    obs([p.key for p in an.probes] + [lk.key for lk in an.lookups],
+        "probe_key")
+    if an.agg is not None:
+        obs(an.agg.group_by, "agg_key")
+        obs([x for a in an.agg.aggs for x in a.args], "agg_arg")
+
+
 def _run_mesh_once(storage, req: CopRequest, tid: int,
                    max_cut: Optional[int] = None):
     """One attempt at running the request over the current mesh; None if
@@ -1330,8 +1536,9 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
 
     mesh = get_mesh()
     S = len(mesh.devices.ravel())
-    n_tiles, n_pad, Tl = _layout(table.base_rows, S)
+    n_tiles, n_pad, Tl = _layout(table.base_rows, S, table=table)
     col_order = an.needed_cols()
+    _observe_fragment(table, an)
 
     # runtime join-filter payloads: sorted build keys, padded to a pow2
     # bucket so compiled programs are reused across key-set sizes
@@ -1396,15 +1603,33 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         kpads.append(kpad)
 
     # column arrays load BEFORE the program lookup: the compiled program
-    # is specialized on each column's wire dtype and null pattern.
+    # is specialized on each column's wire dtype/null pattern AND its
+    # layout class (cold columns arrive as packed codes + a dictionary
+    # runtime operand — the decode emitter is part of the fragment).
     # Loads run on the transfer pool so host tile builds overlap link
     # transfers (the tunnel's device_put is synchronous).
-    datas, valids = [], []
-    for d, v in load_columns(
+    datas, valids, col_layout, lvals, wire_sig = [], [], [], [], []
+    for tier, entry in load_layout_columns(
             mesh, table, [an.scan.columns[ci] for ci in col_order]):
-        datas.append(d)
-        valids.append(v)
-    wire_sig = [(str(d.dtype), v is None) for d, v in zip(datas, valids)]
+        if tier == "cold":
+            datas.append(entry.packed)
+            valids.append(None)
+            col_layout.append((entry.bits, entry.cap, entry.kind))
+            # the decode operand (bias scalar / dictionary vector) is
+            # already device-resident and replicated — a cold hit ships
+            # NOTHING over the link
+            lvals.append(entry.operand)
+            wire_sig.append(
+                (f"cold{entry.bits}c{entry.cap}{entry.kind[0]}", True))
+        else:
+            d, v = entry
+            datas.append(d)
+            valids.append(v)
+            col_layout.append(None)
+            wire_sig.append((str(d.dtype), v is None))
+    lvals = tuple(lvals)
+    if not any(col_layout):
+        col_layout = None
 
     # device ids in the key: a rebuilt mesh (even same-size, after a
     # breaker trip + probe-restore cycle) must never reuse a program whose
@@ -1427,7 +1652,8 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mesh_fn(an, kind, col_order, mesh, Tl,
-                            hoisted=hoisted is not None)
+                            hoisted=hoisted is not None,
+                            col_layout=col_layout)
         _COMPILED.put(fp, fn)
         # label this query's FIRST dispatch as the compile: jit compiles
         # lazily, so the program-cache miss pays XLA compilation there
@@ -1471,7 +1697,8 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         # so peak host memory no longer scales with the selected row count
         return _stream_filter(req, table, an, fn, datas, valids, del_mask,
                               inserted, pargs, mesh_ids=mesh_ids,
-                              bounds=bounds, tail=tail, dag=dag)
+                              bounds=bounds, tail=tail, dag=dag,
+                              lvals=lvals)
 
     from ..lifecycle import scope_check
 
@@ -1494,7 +1721,8 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         if kind == "agg" and an.agg_mode == "sort":
             try:
                 with DISPATCH_LOCK:
-                    out = fn(datas, valids, del_mask, bounds, pargs)
+                    out = fn(datas, valids, del_mask, bounds, lvals,
+                             pargs)
                 chunks.extend(_sort_agg_chunks(out, table, an))
             except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
@@ -1511,14 +1739,15 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         elif kind == "agg":
             with DISPATCH_LOCK:
                 gcount, results = fn(datas, valids, del_mask, bounds,
-                                     pargs)
+                                     lvals, pargs)
             # wrapped() already unpacked to numpy and merged shard partials
             agg_accum = _merge_mesh_agg(
                 agg_accum, gcount, results, table, an,
             )
         elif kind == "topn":
             with DISPATCH_LOCK:
-                gidx, cnts, k = fn(datas, valids, del_mask, bounds, pargs)
+                gidx, cnts, k = fn(datas, valids, del_mask, bounds, lvals,
+                                   pargs)
             picks = []
             for s in range(S):
                 c = int(cnts[s])
@@ -1560,7 +1789,8 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
 
 
 def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
-                   pargs=(), mesh_ids=(), bounds=(), tail=None, dag=None):
+                   pargs=(), mesh_ids=(), bounds=(), tail=None, dag=None,
+                   lvals=()):
     """Generator over a mesh filter's result chunks: ONE fused bit-packed
     mask dispatch covering every range, then STREAM_ROWS-sized host
     gathers on demand (distsql/stream.go:33-124; kv.Request.Streaming
@@ -1581,7 +1811,7 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
                        end=bounds[-1][1])
         _check_membership_epoch()
         with DISPATCH_LOCK:
-            mask = fn(datas, valids, del_mask, bounds, pargs)
+            mask = fn(datas, valids, del_mask, bounds, lvals, pargs)
         handles = np.flatnonzero(mask)
         if remaining is not None:
             handles = handles[:remaining]

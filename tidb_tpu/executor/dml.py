@@ -115,12 +115,29 @@ class _DMLBase(Executor):
             offs = t.col_offsets(ix.columns)
             seen = {}
             for pid, full, dele, inserted in per_store:
-                for h in range(full.num_rows):
-                    if h in dele or (pid, h) in buf_rows:
-                        continue
-                    key = tuple(full.row(h)[o] for o in offs)
-                    if None not in key:
-                        seen[key] = (pid, h)
+                # columnar key-set build: one boolean keep mask (delta
+                # deletes, txn-buffered handles, NULL key parts), then a
+                # vectorized gather + C-level tolist — the per-row
+                # full.row(h) walk was the INSERT path's hot loop
+                n = full.num_rows
+                keep = np.ones(n, dtype=np.bool_)
+                if dele:
+                    keep[np.fromiter(dele, dtype=np.int64,
+                                     count=len(dele))] = False
+                for (tid, h) in buf_rows:
+                    if tid == pid and 0 <= h < n:
+                        keep[h] = False
+                kcols = [full.col(o) for o in offs]
+                for c in kcols:
+                    if c.valid is not None:
+                        keep &= c.valid
+                idx = np.flatnonzero(keep)
+                if len(idx):
+                    vals = [c.data[idx].tolist() for c in kcols]
+                    seen.update(zip(
+                        zip(*vals),
+                        ((pid, h) for h in idx.tolist()),
+                    ))
                 for h, row in inserted.items():
                     if (pid, h) in buf_rows:
                         continue

@@ -77,6 +77,7 @@ class TableReaderExec(Executor):
         host_us = rows / max(v.get_int("tidb_opt_host_rows_per_us"), 1)
         dev_us = dispatch_us + rows / max(
             v.get_int("tidb_opt_device_rows_per_us"), 1)
+        dev_us *= self._layout_cost_factor()
         if host_us < dev_us:
             self._cost_routed = True
             from ..metrics import REGISTRY
@@ -84,6 +85,30 @@ class TableReaderExec(Executor):
             REGISTRY.inc("cost_routed_host_total")
             return "cpu"
         return engine
+
+    # cold-resident columns decode in-register inside the fused kernel —
+    # cheap, but not free: a few extra VPU ops per row per cold column.
+    # The routing cost model scales device time by this per-column factor
+    # so a fully-cold scan prices honestly against the host path.
+    COLD_DECODE_FACTOR = 0.15
+
+    def _layout_cost_factor(self) -> float:
+        """1 + COLD_DECODE_FACTOR * (cold fraction of scanned columns):
+        the layout-aware scan-cost adjustment (tidb_tpu/layout)."""
+        try:
+            from ..layout import LAYOUT, layout_enabled
+
+            if not layout_enabled():
+                return 1.0
+            scan = self.dag.scan
+            table = self.ctx.storage.table(scan.table_id)
+            cols = list(scan.columns) or [0]
+            cold = sum(
+                1 for ci in cols
+                if LAYOUT.plan_for(table, ci).tier == "cold")
+            return 1.0 + self.COLD_DECODE_FACTOR * cold / len(cols)
+        except Exception:
+            return 1.0  # cost advice must never fail a scan
 
     def _next(self) -> Optional[Chunk]:
         chunk = self._result.next_chunk()

@@ -564,6 +564,40 @@ def _tidb_tpu_device_health(domain, isc):
     return rows
 
 
+@_register("tidb_tpu_column_layout", [
+    ("table_id", ty_int()), ("store_uid", ty_int()),
+    ("column_name", ty_string()), ("store_offset", ty_int()),
+    ("encoding", ty_string()), ("packed_bits", ty_int()),
+    ("dict_cap", ty_int()), ("tier", ty_string()),
+    ("tile_bucket", ty_string()), ("priority", ty_float()),
+    ("layout_version", ty_int()), ("scans", ty_int()),
+    ("filters", ty_int()), ("agg_keys", ty_int()),
+    ("probe_keys", ty_int()), ("last_selectivity", ty_float()),
+])
+def _tidb_tpu_column_layout(domain, isc):
+    """The layout autotuner's per-column decisions (tidb_tpu/layout):
+    chosen encoding (dictionary vs direct), packed code width, residency
+    tier, tile bucket and eviction priority, next to the observations
+    they derive from — the operator view of 'why is this column cold'."""
+    try:
+        from .layout import LAYOUT
+
+        decisions = LAYOUT.decisions_snapshot()
+    except Exception:
+        return []
+    rows = []
+    for d in decisions:
+        rows.append((
+            d["table_id"], d["store_uid"], d["column"], d["store_ci"],
+            d["encoding"], d["bits"], d["dict_cap"], d["tier"],
+            d["tile_bucket"], float(d["priority"]), d["version"],
+            d["scans"], d["filters"], d["agg_keys"], d["probe_keys"],
+            float(d["last_selectivity"])
+            if d["last_selectivity"] is not None else -1.0,
+        ))
+    return rows
+
+
 @_register("tidb_profile", [
     ("function", ty_string()), ("calls", ty_int()),
     ("total_time_ms", ty_float()), ("cum_time_ms", ty_float()),

@@ -1,0 +1,277 @@
+"""Compressed device-resident cold tier.
+
+Cold columns stay ON DEVICE, but as compressed blocks: bit-packed
+dictionary codes (1/2/4/8 bits per row) instead of full wire arrays.
+The dictionary-value vector is a small RUNTIME operand of the fused
+program and the codes decode in-register (`copr/fusion.decode_packed`),
+so scanning a cold column is still exactly one `copr.device.execute` —
+no host->device transfer, no separate decompression dispatch.  An 8x-64x
+smaller footprint is what lets tables larger than the hot-tier byte cap
+stay queryable without full-table host reloads.
+
+Two dictionary kinds:
+
+- **range** (ints / dates / store-dict string codes): the value range
+  [lo, hi] is itself the dictionary (`arange(lo, hi+1)`) — no probe
+  pass, codes are `value - lo`;
+- **unique** (floats): a one-time `np.unique` probe per base version
+  builds the value dictionary; NDV above 256 means the column is not
+  packable and stays hot.
+
+NULL-able columns stay hot (the packed form carries no validity plane).
+
+Chaos site `layout/decompress` fires on every cold-tier access: an armed
+action forces the loader down the hot path, and the parity sweep asserts
+identical results either way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..copr.cache import ByteCapCache
+from ..types import TypeKind
+
+#: chaos site: armed actions fail the cold access; the loader falls back
+#: to the hot tier (parity-preserving, metric-counted)
+DECOMPRESS_FAILPOINT = "layout/decompress"
+
+#: widest packed code (one byte); NDV / value ranges above 2**MAX_BITS
+#: are not cold-packable
+MAX_BITS = 8
+
+
+def _cold_cap_bytes() -> int:
+    return int(os.environ.get("TIDB_TPU_COLD_BYTES", str(2 << 30)))
+
+
+#: the cold tier itself: byte-capped like the hot mesh cache, FIFO within
+#: the tier (cold entries are already the demotion target; past the cold
+#: cap the oldest compressed column drops and reloads on demand)
+COLD_CACHE = ByteCapCache(_cold_cap_bytes())
+
+
+@dataclass(frozen=True)
+class PackInfo:
+    """A column's compression class (fingerprint-relevant parts: bits +
+    cap; lo and the dictionary VALUES ride runtime operands)."""
+
+    bits: int        # packed code width (1/2/4/8)
+    cap: int         # pow2 dictionary capacity (len(dict_vals))
+    kind: str        # 'range' | 'unique'
+    lo: int = 0      # range-kind bias
+
+
+class ColdColumn:
+    """One cold-resident column: sharded packed codes + decode operand.
+
+    `operand` is the DEVICE-RESIDENT runtime dispatch argument (it never
+    enters the compiled fingerprint): the replicated scalar bias for
+    'range' dictionaries (decode = code + lo), the replicated value
+    vector for 'unique' ones.  Built ONCE at compress time — a
+    steady-state cold hit ships nothing over the link, not even the
+    dictionary.  `nbytes` makes the object directly cacheable by
+    ByteCapCache."""
+
+    __slots__ = ("packed", "operand", "dict_vals", "bits", "cap", "kind",
+                 "lo")
+
+    def __init__(self, packed, operand, dict_vals: np.ndarray, bits: int,
+                 cap: int, kind: str = "unique", lo: int = 0):
+        self.packed = packed
+        self.operand = operand
+        self.dict_vals = dict_vals
+        self.bits = bits
+        self.cap = cap
+        self.kind = kind
+        self.lo = lo
+
+    @property
+    def nbytes(self) -> int:
+        return (int(self.packed.nbytes) + int(self.dict_vals.nbytes)
+                + int(self.operand.nbytes))
+
+
+_mu = threading.Lock()
+#: (store_uid, base_version, store_ci) -> (Optional[PackInfo],
+#: Optional[unique-values vector]).  info=None means probed and not
+#: packable; the uniq vector is kept for 'unique' kinds so the probe's
+#: O(n) pass is paid ONCE per base version — dict_values and the
+#: compress path reuse it instead of rescanning
+_PACK_INFO: Dict[Tuple[int, int, int], tuple] = {}
+
+
+def _pow2cap(n: int) -> int:
+    c = 2
+    while c < n:
+        c <<= 1
+    return c
+
+
+def _bits_for(card: int) -> Optional[int]:
+    for b in (1, 2, 4, 8):
+        if card <= (1 << b):
+            return b
+    return None
+
+
+def pack_info(table, store_ci: int) -> Optional[PackInfo]:
+    """The column's compression class, or None when not packable
+    (NULL-able, wide range, high-NDV).  Cached per base version."""
+    return _pack_entry(table, store_ci)[0]
+
+
+def _pack_entry(table, store_ci: int) -> tuple:
+    key = (table.store_uid, table.base_version, store_ci)
+    with _mu:
+        if key in _PACK_INFO:
+            return _PACK_INFO[key]
+        # drop probes of superseded versions for this store (bounded)
+        for k in [k for k in _PACK_INFO
+                  if k[0] == key[0] and k[1] != key[1]]:
+            del _PACK_INFO[k]
+    entry = _probe(table, store_ci)
+    with _mu:
+        _PACK_INFO[key] = entry
+    return entry
+
+
+def _probe(table, store_ci: int) -> tuple:
+    """(PackInfo | None, unique-values | None) — the probe's one O(n)
+    pass yields BOTH the class and the value dictionary."""
+    meta = table.cols[store_ci]
+    try:
+        lo, hi, has_null = table.column_stats(store_ci)
+    except Exception:
+        return None, None  # host-only payloads (e.g. JSON) never pack
+    if has_null or table.base_rows == 0 or hi < lo:
+        return None, None
+    kind = meta.ftype.kind
+    if kind != TypeKind.FLOAT:
+        # ints / dates / store-dict string codes: a narrow range IS the
+        # dictionary (decode = code + lo, no value table)
+        card = hi - lo + 1
+        bits = _bits_for(card)
+        if bits is not None:
+            return PackInfo(bits=bits, cap=_pow2cap(card), kind="range",
+                            lo=lo), None
+    # wide-range-but-low-NDV columns (floats, scaled decimals like a
+    # price ladder): one unique probe per base version.  The union bails
+    # after every block, so high-NDV columns pay one 64K-row np.unique,
+    # not a full scan.
+    uniq = None
+    for _off, arrs, _vals in table.iter_base_blocks(
+            [store_ci], 0, table.base_rows):
+        u = np.unique(arrs[0])
+        uniq = u if uniq is None else np.union1d(uniq, u)
+        if len(uniq) > (1 << MAX_BITS):
+            return None, None
+    card = max(len(uniq) if uniq is not None else 0, 1)
+    bits = _bits_for(card)
+    if bits is None:
+        return None, None
+    return PackInfo(bits=bits, cap=_pow2cap(card), kind="unique"), uniq
+
+
+def dict_values(table, store_ci: int, info: PackInfo) -> np.ndarray:
+    """The dictionary-value runtime operand, padded to the pow2 cap in
+    the column's canonical device dtype (`parallel._full_dtype`)."""
+    from ..copr.parallel import _full_dtype
+
+    dt = _full_dtype(table.cols[store_ci].ftype.kind)
+    if info.kind == "range":
+        # cap <= 2**bits always, so the range covers every slot
+        return np.arange(info.lo, info.lo + info.cap,
+                         dtype=np.int64).astype(dt)
+    # the probe already paid the unique pass; reuse its vector
+    uniq = _pack_entry(table, store_ci)[1]
+    uniq = uniq if uniq is not None else np.zeros(0, dtype=dt)
+    out = np.zeros(info.cap, dtype=dt)
+    out[: len(uniq)] = uniq[: info.cap].astype(dt)
+    if len(uniq):
+        out[len(uniq):] = out[min(len(uniq), info.cap) - 1]
+    return out
+
+
+def pack_codes(codes: np.ndarray, bits: int) -> np.ndarray:
+    """Bit-pack uint8 codes (< 2**bits) little-endian within each byte:
+    row j lives in byte j // (8//bits) at shift (j % (8//bits)) * bits."""
+    vpb = 8 // bits
+    if vpb == 1:
+        return codes.astype(np.uint8, copy=False)
+    c = codes.astype(np.uint16).reshape(-1, vpb)
+    shifts = (np.arange(vpb, dtype=np.uint16) * bits)
+    return np.bitwise_or.reduce(c << shifts, axis=1).astype(np.uint8)
+
+
+def compress_column(table, store_ci: int, mesh, n_pad: int,
+                    info: Optional[PackInfo] = None) -> ColdColumn:
+    """Host-side compress + single packed transfer onto the mesh: the
+    cold-tier load.  Raises ValueError when the column is not packable
+    (callers fall back to the hot tier)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..copr import jax_engine as je
+
+    if info is None:
+        info = pack_info(table, store_ci)
+    if info is None:
+        raise ValueError(f"column {store_ci} is not cold-packable")
+    from ..copr.parallel import _full_dtype
+
+    tile = je.TILE
+    vpb = 8 // info.bits
+    dt = _full_dtype(table.cols[store_ci].ftype.kind)
+    if info.kind == "unique":
+        packed_vals = dict_values(table, store_ci, info)
+    else:
+        # range decode uses only the scalar bias; no value table exists
+        packed_vals = np.zeros(0, dtype=dt)
+    flat = np.zeros(n_pad * tile, dtype=np.uint8)
+    off = 0
+    for _s, arrs, _vals in table.iter_base_blocks(
+            [store_ci], 0, table.base_rows):
+        blk = arrs[0]
+        n = len(blk)
+        if info.kind == "range":
+            codes = np.clip(blk.astype(np.int64) - info.lo, 0,
+                            info.cap - 1)
+        else:
+            # packed_vals is in the column's canonical dtype; duplicate
+            # pad slots at the tail never shadow a leftmost match
+            codes = np.clip(
+                np.searchsorted(packed_vals,
+                                blk.astype(packed_vals.dtype)), 0,
+                info.cap - 1)
+        flat[off:off + n] = codes
+        off += n
+    packed = pack_codes(flat, info.bits).reshape(n_pad, tile // vpb)
+    from ..trace import span
+
+    rep = NamedSharding(mesh, P())  # decode operands replicate
+    with span("copr.transfer", col=store_ci, tier="cold",
+              bits=info.bits) as sp:
+        sp.set(bytes=packed.nbytes + max(packed_vals.nbytes, dt.itemsize))
+        dev = jax.device_put(packed, NamedSharding(mesh, P("dp")))
+        if info.kind == "range":
+            operand = jax.device_put(dt.type(info.lo), rep)
+        else:
+            operand = jax.device_put(packed_vals, rep)
+    return ColdColumn(dev, operand, packed_vals, info.bits, info.cap,
+                      kind=info.kind, lo=info.lo)
+
+
+def evict_device(device_id: int) -> int:
+    """Device failover: drop cold entries placed on a dead device set
+    (key layout mirrors the mesh cache — device ids at index 3)."""
+    return COLD_CACHE.evict_if(lambda k: device_id in k[3])
+
+
+def clear():
+    COLD_CACHE.clear()

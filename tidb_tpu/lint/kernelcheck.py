@@ -77,6 +77,14 @@ VMAP_BATCH_B = 4
 #: per fused shape class.  Each traces the ENTIRE fragment — scan masks
 #: over the range slots, fused selection, dense/sort agg or topN — as
 #: ONE program, guarding int64-emulation chains per shape class.
+#: the cold-tier decode-emitter fused kernel (tidb_tpu/layout +
+#: fusion.decode_packed): the q6 scalar-agg fragment with every packable
+#: column riding as bit-packed dictionary codes.  The checker asserts
+#: the dictionary VALUES are runtime operands — tracing under shifted
+#: contents must yield the identical jaxpr (a builder that closed over
+#: the values would bake them as constants and recompile per re-tune).
+COLD_FRAGMENT_KERNEL = "fused-mesh-cold-agg"
+
 FUSED_FRAGMENT_KERNELS = [
     ("fused-mesh-dense-agg",
      "select l_returnflag, l_linestatus, sum(l_quantity),"
@@ -434,6 +442,52 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"int64 equation count grew {base.get('i64_eqns')} -> "
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the fused fragment program")
+
+    # -- cold-tier decode-emitter fused kernel --------------------------
+    name = COLD_FRAGMENT_KERNEL
+    try:
+        sql = dict(CANONICAL_KERNEL_QUERIES)["q6-scalar-agg"]
+        phys = s._plan(parse_one(sql))
+        stats = None
+        diverged = False
+        for _p, dag in _reader_dags(phys):
+            try:
+                closed = trace_fused_fragment(table, dag, cold=True)
+            except JaxUnsupported:
+                continue
+            stats = _jaxpr_stats(closed)
+            # layout runtime-slot guard: dictionary values are dispatch
+            # operands — different contents, identical program
+            shifted = trace_fused_fragment(table, dag, cold=True,
+                                           dict_shift=3)
+            if str(closed) != str(shifted):
+                emit(name,
+                     "dictionary contents changed the cold kernel's "
+                     "jaxpr — layout VALUES must ride runtime operands, "
+                     "never compiled constants")
+                diverged = True
+                break
+            break
+        if diverged:
+            pass  # divergence already emitted above
+        elif stats is None:
+            emit(name, "no cold-packable fused form for the canonical "
+                       "fragment — cold-tier decode coverage regressed")
+        elif collect_stats is not None:
+            collect_stats[name] = stats
+        else:
+            base = baseline_kernels.get(name)
+            if base is None:
+                emit(name, f"kernel not in baseline (measured {stats}); "
+                           "run python -m tidb_tpu.lint --update-baseline")
+            elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+                emit(name,
+                     f"int64 equation count grew {base.get('i64_eqns')} "
+                     f"-> {stats['i64_eqns']}: an int64-emulation chain "
+                     "was reintroduced into the cold decode kernel")
+    except Exception as e:  # noqa: BLE001 — contract break
+        emit(name, f"cold fragment trace failed: "
+                   f"{type(e).__name__}: {e}")
 
     # -- micro-batch vmapped padded-batch kernel ------------------------
     name = VMAP_BATCH_KERNEL

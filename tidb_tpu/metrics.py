@@ -62,5 +62,22 @@ COORD_STATUS_METRICS = (
     "coord_handoff_put_total",
     "coord_handoff_replayed_total",
     "coord_handoff_failed_total",
+    "coord_handoff_checkpoint_total",
     "coord_rpc_errors_total",
+)
+
+#: adaptive-layout counters (tidb_tpu/layout) surfaced as one group on
+#: /status: cold-tier traffic (hits = packed columns served with no
+#: host reload, loads = first compressions, promotions/demotions = tier
+#: moves, fallbacks = chaos/compression failures served hot) and the
+#: autotuner's layout-class churn (retunes bump the layout epoch and may
+#: refingerprint; suppressed = rate-limited flips)
+LAYOUT_STATUS_METRICS = (
+    "layout_cold_hits_total",
+    "layout_cold_loads_total",
+    "layout_cold_promotions_total",
+    "layout_cold_demotions_total",
+    "layout_cold_fallbacks_total",
+    "layout_retunes_total",
+    "layout_retunes_suppressed_total",
 )

@@ -48,10 +48,13 @@ from ..copr import jax_engine as je
 from ..copr.device_health import classify_failure
 from ..copr.jax_engine import _Analyzed, _fingerprint, _to_state_dtype
 from ..copr.jax_eval import JaxUnsupported, compile_expr
+from ..coord import CoordEpochMismatch
 from ..copr.parallel import (
+    MAX_MESH_ATTEMPTS,
     MESH_RANGE_SLOTS,
     _all_true,
     _bounds_args,
+    _check_membership_epoch,
     _cols_env,
     _handle_mesh_failure,
     _layout,
@@ -186,7 +189,8 @@ class _SideState:
             if ft.kind == TypeKind.DECIMAL and ft.is_wide_decimal:
                 raise MPPIneligible("wide-decimal column")
         S = len(mesh.devices.ravel())
-        self.n_tiles, self.n_pad, self.Tl = _layout(t.base_rows, S)
+        self.n_tiles, self.n_pad, self.Tl = _layout(t.base_rows, S,
+                                                    table=t)
         self.n_local = self.Tl * je.TILE
         self.col_order = list(range(len(an.scan.columns)))
         self.bounds = [(max(kr.start, 0), min(kr.end, t.base_rows))
@@ -720,6 +724,12 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
             bounds_args(bs))
     if grouped:
         args = args + (jnp.int64(budget),)
+    # dispatch-time membership guard (coordination follow-up (a)): a
+    # cross-host membership move between mesh build and this exchange
+    # program raises the typed retriable CoordEpochMismatch — the rung
+    # loop rebuilds from the new broadcast instead of launching into an
+    # XLA collective whose participant set no longer matches other hosts
+    _check_membership_epoch()
     with DISPATCH_LOCK:
         # collective programs serialize per process (see parallel.py:
         # concurrent shard_map launches deadlock at the rendezvous)
@@ -826,6 +836,17 @@ def run_mpp_join(storage, spec: MPPJoinSpec) -> Tuple[List[Chunk], str]:
                          + mode.replace("+", "_").replace("-", "_")
                          + "_total")
             return chunks, mode
+        except CoordEpochMismatch:
+            # membership moved mid-rung (member lost/rejoined, breaker
+            # trip on another host): rebuild from the new broadcast and
+            # re-run the SAME rung — typed and retriable, never a
+            # collective desync; flapping exhausts the mesh attempt
+            # budget and demotes to the host rung like any device fault
+            attempts += 1
+            if attempts >= MAX_MESH_ATTEMPTS:
+                raise MPPIneligible(
+                    "membership epoch flapping exhausted mesh attempts")
+            continue
         except MPPGroupedAggOverflow as e:
             REGISTRY.inc("mpp_grouped_agg_overflow_total")
             REGISTRY.inc("mpp_grouped_agg_fallback_total")

@@ -17,6 +17,17 @@ from ..metrics import REGISTRY
 VERSION = "8.0.11-tidb-tpu-0.1.0"
 
 
+def _layout_section() -> dict:
+    """The /status layout payload (never lets a tuner hiccup 500 the
+    status port)."""
+    try:
+        from ..layout import status_section
+
+        return status_section()
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
 class StatusServer:
     def __init__(self, domain, host: str = "127.0.0.1", port: int = 10080):
         self.domain = domain
@@ -121,6 +132,10 @@ class StatusServer:
                                 for name in COORD_STATUS_METRICS
                             },
                         },
+                        # adaptive data layout (ISSUE 10): per-column
+                        # encoding/tier decisions, hot/cold tier byte
+                        # gauges and the cold-tier traffic counters
+                        "layout": _layout_section(),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

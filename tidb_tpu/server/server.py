@@ -72,6 +72,13 @@ class MySQLServer:
         # live connections: asyncio task -> (session, writer); drain
         # cancels scopes and closes writers through this registry
         self._conns: Dict[object, tuple] = {}
+        # periodic eager session checkpointing (lifecycle follow-up (d)):
+        # started with the server when tidb_tpu_handoff_checkpoint_s > 0
+        self._checkpoint_task: Optional[asyncio.Task] = None
+        # True while the plane holds a checkpoint THIS server parked: an
+        # empty collection then CLEARS the parked bundle instead of
+        # leaving a stale one for the next restart to resurrect
+        self._checkpointed = False
 
     async def start(self):
         self._admission = asyncio.Semaphore(self.workers)
@@ -93,7 +100,49 @@ class MySQLServer:
                 replay_session_states(self.domain, states)
         except Exception:
             REGISTRY.inc("coord_handoff_failed_total")
+        # periodic eager checkpointing: a HARD-killed process (no drain)
+        # loses at most one interval's worth of prepared-session churn,
+        # because the plane already holds a recent handoff bundle the
+        # replacement replays.  The sysvar is re-read every tick, so
+        # SET GLOBAL tidb_tpu_handoff_checkpoint_s enables/disables the
+        # policy on a live server.
+        self._checkpoint_task = asyncio.create_task(
+            self._checkpoint_loop())
         return addr
+
+    def _checkpoint_interval_s(self) -> float:
+        from ..session.vars import SessionVars
+
+        return float(SessionVars(self.domain.global_vars).get_int(
+            "tidb_tpu_handoff_checkpoint_s", 0))
+
+    async def _checkpoint_loop(self):
+        from ..coord import get_plane
+        from ..lifecycle import collect_session_states
+
+        while not self._draining:
+            iv = self._checkpoint_interval_s()
+            await asyncio.sleep(iv if iv > 0 else 1.0)
+            if iv <= 0 or self._draining:
+                continue
+            try:
+                states = collect_session_states(self.domain)
+                if states:
+                    get_plane().handoff_put(states)
+                    self._checkpointed = True
+                    REGISTRY.inc("coord_handoff_checkpoint_total")
+                elif self._checkpointed:
+                    # every prepared session is gone: clear the parked
+                    # bundle, or a later restart would replay ghost
+                    # sessions no client owns
+                    get_plane().take_handoff()
+                    self._checkpointed = False
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a dead coordinator must never take the server down;
+                # the drain-time handoff still gets its own attempt
+                REGISTRY.inc("coord_handoff_failed_total")
 
     async def stop(self):
         """Immediate stop: drain with a zero budget (in-flight statements
@@ -109,6 +158,9 @@ class MySQLServer:
            'shutdown' (ERR 1053 to the client at the next host seam);
         4. connections close and the worker pool shuts down."""
         self._draining = True
+        if self._checkpoint_task is not None:
+            self._checkpoint_task.cancel()
+            self._checkpoint_task = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -155,6 +207,11 @@ class MySQLServer:
             states = collect_session_states(self.domain)
             if states:
                 get_plane().handoff_put(states)
+            elif self._checkpointed:
+                # a periodic checkpoint parked sessions that have since
+                # gone away: drain-time truth is "nothing to hand off"
+                get_plane().take_handoff()
+            self._checkpointed = False
         except Exception:
             REGISTRY.inc("coord_handoff_failed_total")
         try:

@@ -247,6 +247,14 @@ def _run_batch(ctx: dict, live: List[_Member]):
             hi = np.int64(t1 - tile_start)
             del_mask = je._all_true(None)  # batch eligibility => no deletes
             FAILPOINTS.hit("serving/batch_dispatch", size=B, tile=tile_idx)
+            # membership guard (coordination follow-up (a)): a lost
+            # member between mesh build and this vmapped dispatch raises
+            # CoordEpochMismatch out of the batch — the runner's error
+            # scatter fails every live member back to the SOLO rungs,
+            # which rebuild from the new broadcast (parity-preserving)
+            from ..copr.parallel import _check_membership_epoch
+
+            _check_membership_epoch()
             with span("copr.device.execute", batch=B, tile=tile_idx):
                 out = vfn(datas, valids, lo, hi, del_mask, PI, PF)
             if kind == "agg":

@@ -609,6 +609,10 @@ class Session:
             # learned-selectivity generation: feedback that materially
             # moved an estimate must re-plan cached statements
             self.domain.stats.feedback.epoch,
+            # layout-decision generation (tidb_tpu/layout): a re-tuned
+            # column layout shifts scan cost (cold decode) and program
+            # shapes, so cached plans must not outlive the decision
+            _layout_epoch(),
             getattr(self.domain, "bindings_version", 0),
             getattr(self, "_bindings_version", 0),
             self.vars.get_bool("tidb_enable_pushdown"),
@@ -1876,3 +1880,14 @@ def _show_create(t: TableInfo) -> str:
                 for p in pi.defs)
             out += f"\nPARTITION BY RANGE (`{pi.column}`) ({parts})"
     return out
+
+
+def _layout_epoch() -> int:
+    """Layout-decision generation for plan-cache keys (import kept out of
+    the module prologue: sessions exist in jax-free embedders)."""
+    try:
+        from ..layout import layout_epoch
+
+        return layout_epoch()
+    except Exception:
+        return 0

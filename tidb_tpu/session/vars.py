@@ -93,6 +93,13 @@ SYSVAR_DEFAULTS = {
     # plan-cache capacity per session (planner/core/cache.go's
     # plan-cache-size; used to be a hard-coded 128)
     "tidb_plan_cache_size": ("128", "int"),
+    # periodic server-side eager session checkpointing (lifecycle
+    # follow-up (d)): every N seconds the server parks all prepared
+    # sessions' handoff state on the coordination plane, so even a
+    # SIGKILLed process loses at most one interval of session churn.
+    # 0 disables (drain-time handoff still runs).  GLOBAL scope — the
+    # checkpoint loop is a server resource.
+    "tidb_tpu_handoff_checkpoint_s": ("0", "int"),
     # --- shape-bucketed serving & micro-batching (tidb_tpu/serving) ---
     # shape buckets: compiled programs and plan-cache entries key on
     # pow2 shape CLASSES (row-count buckets, hoisted predicate params,

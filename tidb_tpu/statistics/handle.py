@@ -196,6 +196,15 @@ class StatsHandle:
     def drop(self, table_id: int):
         with self._mu:
             self._cache.pop(table_id, None)
+        try:
+            # the layout autotuner forgets the dropped table's columns
+            # (its store may outlive the drop for MVCC, so the drop
+            # notification — not store GC — is the liveness signal)
+            from ..layout import LAYOUT
+
+            LAYOUT.forget_table(table_id)
+        except Exception:
+            pass  # layout upkeep must never fail a DDL
 
     def get(self, table_id: int) -> Optional[TableStats]:
         with self._mu:
@@ -238,6 +247,26 @@ class StatsHandle:
         baseline = self.estimate_selectivity(table_id, conds,
                                              use_feedback=False)
         self.feedback.record(table_id, dg, actual_sel, baseline)
+        self._feed_layout(table_id, conds, actual_sel)
+
+    def _feed_layout(self, table_id: int, conds, actual_sel: float):
+        """Forward the learned per-scan selectivity to the layout
+        autotuner (tidb_tpu/layout) for every store column the
+        conjunction touches — one of the tuner's observation planes."""
+        try:
+            from ..layout import LAYOUT, layout_enabled
+
+            if not layout_enabled():
+                return
+            store = self.storage.table(table_id)
+            refs: set = set()
+            for c in conds:
+                c.collect_columns(refs)
+            for ci in refs:
+                if 0 <= ci < store.n_cols:
+                    LAYOUT.observe(store, ci, "filter", sel=actual_sel)
+        except Exception:
+            pass  # observation is advisory, never a query failure
 
     def estimate_selectivity(self, table_id: int, conds,
                              use_feedback: bool = True) -> float:
