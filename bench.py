@@ -279,6 +279,13 @@ def _host_fallback_worker():
         out["layout"] = layout_bench(sess, n)
     except BaseException as e:  # noqa: BLE001
         out["layout"] = {"error": repr(e)}
+    # zero-host-tail receipt on the CPU harness: computed-key and
+    # compound-order shapes fused vs the ladder comparator (ISSUE 11)
+    try:
+        sess.execute("set tidb_use_tpu = 1")
+        out["host_tail"] = host_tail_bench(sess, n)
+    except BaseException as e:  # noqa: BLE001
+        out["host_tail"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -827,6 +834,64 @@ def mpp_grouped_bench(sess_m, n_li: int) -> dict:
     return out
 
 
+def host_tail_bench(sess, n: int) -> dict:
+    """Zero-host-tail receipt (ISSUE 11): the shapes that used to split
+    to a host tail — computed string group keys (device dict-code
+    re-mapping) and multi-column TopN (packed compound ordering) — run
+    fully fused vs the TIDB_TPU_FUSION=0 ladder comparator, with the
+    fusion_splits_total delta across the corpus (must stay 0 fused)."""
+    from tidb_tpu.metrics import REGISTRY
+
+    shapes = (
+        ("computed_key",
+         "select concat(l_returnflag, '#'), count(*), sum(l_quantity)"
+         " from lineitem group by concat(l_returnflag, '#')"),
+        ("compound_order",
+         "select l_orderkey from lineitem"
+         " order by l_returnflag desc, l_shipdate, l_orderkey limit 10"),
+    )
+    from tidb_tpu.copr.fusion import SPLIT_REASONS
+
+    def _reason_snap():
+        snap = REGISTRY.snapshot()
+        return {r: snap.get("fusion_splits_reason_"
+                            + r.replace("-", "_") + "_total", 0)
+                for r in SPLIT_REASONS}
+
+    out = {}
+    base_reasons = _reason_snap()  # deltas, like every other field
+    prior = os.environ.get("TIDB_TPU_FUSION")
+    for qname, sql in shapes:
+        try:
+            os.environ["TIDB_TPU_FUSION"] = "1"
+            s0 = REGISTRY.get("fusion_splits_total")
+            _, fused_s = time_query(sess, sql, ITERS)
+            splits = REGISTRY.get("fusion_splits_total") - s0
+            fused_d = _count_device_dispatches(sess, sql)
+            os.environ["TIDB_TPU_FUSION"] = "0"
+            _, unf_s = time_query(sess, sql, ITERS)
+        finally:
+            if prior is None:
+                os.environ.pop("TIDB_TPU_FUSION", None)
+            else:
+                os.environ["TIDB_TPU_FUSION"] = prior
+        out[qname] = {
+            "fused_rows_per_sec": round(n / fused_s, 1),
+            "unfused_rows_per_sec": round(n / unf_s, 1),
+            "fused_dispatches": fused_d,
+            "fusion_splits": int(splits),
+            "speedup": round(unf_s / fused_s, 2),
+        }
+        log(f"host_tail {qname}: fused={n / fused_s:,.0f} rows/s "
+            f"({fused_d} dispatches, {int(splits)} splits) vs "
+            f"unfused={n / unf_s:,.0f} rows/s -> {unf_s / fused_s:.2f}x")
+    end_reasons = _reason_snap()
+    out["splits_by_reason"] = {
+        r: int(end_reasons[r] - base_reasons[r]) for r in SPLIT_REASONS
+    }
+    return out
+
+
 def layout_bench(sess, n: int) -> dict:
     """Adaptive-layout receipt (ISSUE 10) on a price-grid table (one
     group key + six low-NDV DOUBLE measure columns — the wide-wire
@@ -1125,6 +1190,18 @@ def _run_inner(state: dict):
         except BaseException as e:  # noqa: BLE001 — receipt survives
             state["layout"] = {"error": repr(e)}
         state["phases"]["layout_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
+    # zero-host-tail receipt (ISSUE 11): computed-key + compound-order
+    # shapes fused vs the ladder comparator, splits-by-reason breakdown
+    if state.get("q1") and remaining() > 60:
+        try:
+            state["host_tail"] = host_tail_bench(sess,
+                                                 state["loaded_rows"])
+        except BaseException as e:  # noqa: BLE001 — receipt survives
+            state["host_tail"] = {"error": repr(e)}
+        state["phases"]["host_tail_done"] = round(
             time.perf_counter() - T0, 1)
         persist_partial(state)
 

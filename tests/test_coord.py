@@ -330,9 +330,14 @@ def test_span_forwarding_grafts_one_tree():
         tr_w.qid = tr_local.qid  # the SPMD statement-seq correlation
         f0 = REGISTRY.get("coord_spans_forwarded_total")
         g0 = REGISTRY.get("coord_spans_grafted_total")
+        b0 = REGISTRY.get("coord_span_batches_total")
         finish_trace(tr_w, tok_w)
+        # forwarding is batched + backgrounded (ISSUE 11): finish_trace
+        # only enqueues; an explicit flush stands in for the age trigger
+        w.flush_spans()
         assert REGISTRY.get("coord_spans_forwarded_total") == f0 + 1
         assert REGISTRY.get("coord_spans_grafted_total") == g0 + 1
+        assert REGISTRY.get("coord_span_batches_total") == b0 + 1
         # ONE tree: the worker's root hangs under the coordinator's,
         # host-tagged, with its spans intact and renderable
         remote = [s for s in tr_local.root.children
@@ -360,8 +365,59 @@ def test_span_forwarding_respects_byte_cap(monkeypatch):
         f0 = REGISTRY.get("coord_spans_forwarded_total")
         tr, tok = start_trace("select 'oversized payload'", 3)
         finish_trace(tr, tok)
+        # the cap drop happens at ENQUEUE time (before any batching)
+        w.flush_spans()
         assert REGISTRY.get("coord_spans_dropped_total") == d0 + 1
         assert REGISTRY.get("coord_spans_forwarded_total") == f0
+    finally:
+        if w is not None:
+            w.stop()
+        c.stop()
+
+
+def test_span_forwarding_batches_and_drains(monkeypatch):
+    """Coord follow-up (c): finish_trace enqueues; the bounded queue
+    flushes by SIZE (batch threshold) or on drain — one RPC carries the
+    whole batch, and a full queue drops with the counter instead of
+    blocking the statement path."""
+    monkeypatch.setenv("TIDB_TPU_COORD_SPAN_BATCH", "4")
+    monkeypatch.setenv("TIDB_TPU_COORD_SPAN_QUEUE", "6")
+    monkeypatch.setenv("TIDB_TPU_COORD_SPAN_FLUSH_S", "30")  # age off
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        w = WorkerPlane(("127.0.0.1", c.port), pid=11,
+                        lease_s=30.0).start([0])
+        f0 = REGISTRY.get("coord_spans_forwarded_total")
+        b0 = REGISTRY.get("coord_span_batches_total")
+        i0 = REGISTRY.get("coord_spans_ingested_total")
+        for _ in range(4):  # hits the size threshold -> one batch RPC
+            tr, tok = start_trace("select 1", 11)
+            finish_trace(tr, tok)
+        deadline = time.monotonic() + 5.0
+        while (REGISTRY.get("coord_spans_forwarded_total") < f0 + 4
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert REGISTRY.get("coord_spans_forwarded_total") == f0 + 4
+        assert REGISTRY.get("coord_span_batches_total") == b0 + 1
+        assert REGISTRY.get("coord_spans_ingested_total") == i0 + 4
+        # below the threshold nothing flushes until drain
+        tr, tok = start_trace("select 2", 11)
+        finish_trace(tr, tok)
+        assert REGISTRY.get("coord_spans_forwarded_total") == f0 + 4
+        w.stop()  # drain flushes the remainder
+        assert REGISTRY.get("coord_spans_forwarded_total") == f0 + 5
+        w = None
+        # queue bound: with no flusher (stopped), overflow drops
+        w2 = WorkerPlane(("127.0.0.1", c.port), pid=12, lease_s=30.0)
+        w2._span_queue_max = 2
+        d0 = REGISTRY.get("coord_spans_dropped_total")
+        for _ in range(4):
+            tr, tok = start_trace("select 3", 12)
+            finish_trace(tr, tok)  # hook is cleared: no forwarding
+            w2.forward_trace(tr)
+        assert REGISTRY.get("coord_spans_dropped_total") == d0 + 2
     finally:
         if w is not None:
             w.stop()
@@ -409,7 +465,8 @@ def test_forwarding_survives_dead_coordinator():
         c.stop()
         r0 = REGISTRY.get("coord_rpc_errors_total")
         tr, tok = start_trace("select 1", 4)
-        finish_trace(tr, tok)  # must not raise
+        finish_trace(tr, tok)  # must not raise (enqueue only)
+        w.flush_spans()        # the flusher's RPC hits the dead socket
         assert REGISTRY.get("coord_rpc_errors_total") > r0
     finally:
         if w is not None:

@@ -409,6 +409,140 @@ def test_adaptive_window_widens_and_shrinks():
         serving.configure(microbatch_window_ms=0.0)
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 11 zero-host-tail corpus: computed keys, compound ordering,
+# hybrid regions, split-reason labels, the Pallas comparator
+# ---------------------------------------------------------------------------
+
+#: shapes that split to a host tail before ISSUE 11 and now fully fuse
+HOST_TAIL_CORPUS = (
+    # computed string group keys -> device dict-code re-mapping
+    "select substr(c, 2, 2), count(*), sum(x) from ft"
+    " group by substr(c, 2, 2)",
+    "select concat(c, '#'), min(x), max(k) from ft where x < 70"
+    " group by concat(c, '#')",
+    "select upper(c), count(*) from ft group by upper(c)",
+    # multi-column TopN -> packed lexicographic compound key
+    "select k, g, x from ft order by g desc, c, k limit 7",
+    "select k from ft where x < 50 order by c, k limit 9",
+)
+
+
+def test_host_tail_corpus_fuses_with_parity(sess):
+    """The newly-lowered shapes return CPU-oracle results, leave
+    fusion_splits_total untouched (zero host tails), and execute as
+    exactly ONE copr.device.execute in steady state."""
+    sess.execute("set tidb_use_tpu = 1")
+    s0 = REGISTRY.get("fusion_splits_total")
+    for sql in HOST_TAIL_CORPUS:
+        _approx_rows(sess.query(sql), _cpu(sess, sql), sql)
+    assert REGISTRY.get("fusion_splits_total") == s0, \
+        "a newly-lowered shape still split to a host tail"
+    for sql in HOST_TAIL_CORPUS:
+        sess.query(sql)
+        sess.query(sql)  # steady state
+        exe = _spans(sess.last_trace, "copr.device.execute")
+        assert len(exe) == 1, (sql, [s.name for s in exe])
+
+
+def test_host_tail_corpus_vs_unfused_and_pallas_comparators(sess):
+    """Parity through BOTH comparators: TIDB_TPU_FUSION=0 (per-tile
+    dispatch ladder) and TIDB_TPU_PALLAS=0 (plain-XLA compositions in
+    place of the Pallas kernel tier)."""
+    import os
+
+    sess.execute("set tidb_use_tpu = 1")
+    want = {sql: _cpu(sess, sql) for sql in HOST_TAIL_CORPUS}
+    for var in ("TIDB_TPU_FUSION", "TIDB_TPU_PALLAS"):
+        prior = os.environ.get(var)
+        os.environ[var] = "0"
+        try:
+            for sql in HOST_TAIL_CORPUS:
+                _approx_rows(sess.query(sql), want[sql],
+                             f"{var}=0: {sql}")
+        finally:
+            if prior is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prior
+
+
+def test_compound_order_split_reason_labelled(sess):
+    """An order-by list the packer cannot lower (unbounded float second
+    key) still runs — split to a labelled host tail — and the reason
+    shows up on the metric, /status-shaped section and
+    INFORMATION_SCHEMA.TIDB_TPU_FUSION_SPLITS."""
+    sql = "select k from ft where x < 40 order by g, x limit 6"
+    want = _cpu(sess, sql)
+    r0 = REGISTRY.get("fusion_splits_reason_compound_order_total")
+    s0 = REGISTRY.get("fusion_splits_total")
+    _approx_rows(sess.query(sql), want, sql)
+    assert REGISTRY.get("fusion_splits_total") > s0
+    assert REGISTRY.get("fusion_splits_reason_compound_order_total") > r0
+    rows = sess.query(
+        "select reason, splits from information_schema"
+        ".tidb_tpu_fusion_splits")
+    by_reason = {r[0]: r[1] for r in rows}
+    assert by_reason["compound-order"] >= 1
+    assert by_reason["total"] >= sum(
+        v for k, v in by_reason.items() if k != "total")
+
+
+def test_hybrid_projection_head_keeps_device_projection(sess):
+    """Hybrid device-partial/host-final regions: a tail AFTER a device
+    projection keeps the projection fused (the tail reads the projected
+    layout across the boundary) instead of peeling back to scan+sel."""
+    import numpy as np
+
+    from tidb_tpu.copr import parallel as pl
+    from tidb_tpu.copr.cpu_engine import run_dag_on_chunk
+    from tidb_tpu.copr.fusion import plan_regions
+    from tidb_tpu.copr.ir import (DAG, ProjectionIR, SelectionIR,
+                                  TableScanIR)
+    from tidb_tpu.expr.expression import ColumnExpr, Constant, ScalarFunc
+    from tidb_tpu.store.kv import CopRequest, KeyRange
+    from tidb_tpu.types import FieldType, TypeKind, ty_int
+
+    d = sess.domain
+    t = d.catalog.info_schema().table("test", "ft")
+    store = d.storage.table(t.id)
+    f64 = FieldType(TypeKind.FLOAT)
+    i64 = ty_int()
+    scan = TableScanIR(t.id, [0, 2], [i64, f64])
+    sel = SelectionIR([ScalarFunc(
+        "<", [ColumnExpr(1, f64), Constant(30.0, f64)], i64)])
+    proj = ProjectionIR([
+        ColumnExpr(0, i64),
+        ScalarFunc("*", [ColumnExpr(1, f64), Constant(2.0, f64)], f64),
+    ])
+    # the tail: a selection over the PROJECTED layout (x*2 > 20) — a
+    # selection after a projection has no device form, so the splitter
+    # must cut here and the head must keep the projection
+    tail_sel = SelectionIR([ScalarFunc(
+        ">", [ColumnExpr(1, f64), Constant(20.0, f64)], i64)])
+    dag = DAG([scan, sel, proj, tail_sel])
+    plan = plan_regions(DAG.from_dict(dag.to_dict()), store)
+    assert plan.tail and plan.an.projection is not None, \
+        "projection peeled out of the hybrid head"
+    ts = d.storage.current_ts()
+    req = CopRequest(dag=dag.to_dict(),
+                     ranges=[KeyRange(t.id, 0, store.base_rows)],
+                     ts=ts, concurrency=1, keep_order=False,
+                     streaming=False, engine="tpu")
+    s0 = REGISTRY.get("fusion_splits_total")
+    out = pl.try_run_mesh(d.storage, req)
+    assert out is not None, getattr(req, "mesh_reject_reason", None)
+    got = [tuple(float(c.col(j).data[i]) for j in range(2))
+           for c in out for i in range(c.num_rows)]
+    assert REGISTRY.get("fusion_splits_total") > s0
+    # oracle: the whole DAG through the CPU interpreter
+    base = store.base_chunk([0, 2], 0, store.base_rows)
+    ref = run_dag_on_chunk(DAG.from_dict(dag.to_dict()), base)
+    want = [tuple(float(ref.col(j).data[i]) for j in range(2))
+            for i in range(ref.num_rows)]
+    assert sorted(got) == sorted(want)
+
+
 def test_mesh_agg_overflow_peels_agg_to_host_tail():
     """ROADMAP fusion follow-up (c): a blown sort-agg budget re-enters
     the fused mesh with the AGG peeled to the host tail (scan+selection

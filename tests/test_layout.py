@@ -291,6 +291,10 @@ def test_eviction_demotes_then_promotes(layout_env):
     m1 = REGISTRY.snapshot()
     assert m1.get("layout_cold_demotions_total", 0) > m0.get(
         "layout_cold_demotions_total", 0)
+    # layout follow-up (e): demotion re-encodes ON DEVICE and reads
+    # back only the packed codes (8-64x smaller than raw values)
+    assert m1.get("layout_demote_code_readback_bytes", 0) > m0.get(
+        "layout_demote_code_readback_bytes", 0)
     assert len(COLD_CACHE) > 0
     # the demoted column now serves COLD (hit, no reload), still correct
     _approx_rows(s.query(q_li), want_li, "cold after demote")

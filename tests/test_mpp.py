@@ -325,6 +325,15 @@ GROUPED_CORPUS = [
     ("select o_flag, l_comment, count(*), sum(l_price) from li"
      " join orders on l_orderkey = o_orderkey"
      " group by o_flag, l_comment"),
+    # COMPUTED string group keys (ISSUE 11 / MPP follow-up (d)): a
+    # post-join dict-code re-map through a runtime mapping operand,
+    # probe-side and build-side, incl. mixed with a plain key
+    ("select substr(o_clerk, 2, 2), count(*), sum(l_qty) from li"
+     " join orders on l_orderkey = o_orderkey"
+     " group by substr(o_clerk, 2, 2)"),
+    ("select concat(l_comment, '!'), o_flag, count(*), max(o_total)"
+     " from li join orders on l_orderkey = o_orderkey"
+     " where o_flag < 4 group by concat(l_comment, '!'), o_flag"),
 ]
 
 
@@ -558,12 +567,20 @@ def test_multicolumn_join_keys_rows_and_grouped(dup_sess):
              want_mode="shuffle+grouped")
 
 
-def test_multicolumn_left_outer_stays_on_host(dup_sess):
-    """Mix-hash collisions could drop a left-outer probe row's
-    NULL-extension slot, so multi-key louter never plans as MPP."""
+def test_multicolumn_left_outer_runs_on_device(dup_sess):
+    """ISSUE 11 (MPP follow-up (c)): multi-key LEFT-OUTER joins compose
+    their keys EXACTLY (stride packing over both sides' column stats —
+    pack_keys_exact), so no probe row can lose its NULL-extension slot
+    to a hash collision and the join plans + runs as MPP."""
     plan = "\n".join(
         " | ".join(str(x) for x in r)
         for r in dup_sess.execute(
             "explain select x, y from a2 left join b2"
             " on k1 = m1 and k2 = m2")[0].rows)
-    assert "ExchangeSender" not in plan, plan
+    assert "ExchangeSender" in plan, plan
+    q = ("select k1, k2, x, y from a2 left join b2"
+         " on k1 = m1 and k2 = m2 where x < 3")
+    got = _dup_par(dup_sess, q, "multicol-louter", want_mode="shuffle")
+    # ~5% of the 2000 (k1, k2) combos have no build match (6000 build
+    # rows over 2000 combos): unmatched rows NULL-extend the build side
+    assert any(r[3] is None for r in got), "no NULL-extended rows"

@@ -292,8 +292,21 @@ class Coordinator:
             self.put_handoff(pid, req.get("sessions") or [])
             return self._resp(self.view())
         if cmd == "spans":
-            outcome = self.ingest_spans(pid, req.get("payload") or {},
-                                        nbytes)
+            payloads = req.get("payloads")
+            if payloads is None:
+                payloads = [req.get("payload") or {}]
+                sizes = [nbytes]
+            else:
+                # batched forwarding (ISSUE 11 coord follow-up (c)): the
+                # per-host byte cap applies PER PAYLOAD, not to the
+                # batch.  The worker measured each payload at enqueue
+                # time and ships the sizes — re-serializing here would
+                # cost O(span bytes) on the coordinator's request thread
+                sizes = req.get("sizes") or [
+                    len(json.dumps(p)) for p in payloads]
+            outcome = None
+            for p, sz in zip(payloads, sizes):
+                outcome = self.ingest_spans(pid, p, sz)
             return self._resp(self.view(), outcome=outcome)
         return {"ok": False, "error": f"unknown cmd {cmd!r}"}
 
@@ -472,6 +485,21 @@ class WorkerPlane:
         self._handoff_in: List[dict] = []
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
+        # batched span forwarding (ISSUE 11 / coord follow-up (c)): a
+        # bounded queue drained by a background flusher, so finish_trace
+        # enqueues instead of paying a synchronous RPC on the high-QPS
+        # path.  Flushes trigger by SIZE (batch threshold) or AGE
+        # (flush interval); drain/stop flushes whatever remains.
+        self._span_q: List[str] = []
+        self._span_mu = threading.Lock()
+        self._span_wake = threading.Event()
+        self._span_thread: Optional[threading.Thread] = None
+        self._span_batch = max(int(os.environ.get(
+            "TIDB_TPU_COORD_SPAN_BATCH", "16")), 1)
+        self._span_queue_max = max(int(os.environ.get(
+            "TIDB_TPU_COORD_SPAN_QUEUE", "256")), 1)
+        self._span_flush_s = float(os.environ.get(
+            "TIDB_TPU_COORD_SPAN_FLUSH_S", "0.2"))
 
     # ---- lifecycle ------------------------------------------------------
     def start(self, devices=()):
@@ -487,6 +515,10 @@ class WorkerPlane:
         self._hb = threading.Thread(target=self._heartbeat, daemon=True,
                                     name="tidb-tpu-coord-hb")
         self._hb.start()
+        self._span_thread = threading.Thread(
+            target=self._span_flusher, daemon=True,
+            name="tidb-tpu-coord-spans")
+        self._span_thread.start()
         # worker span trees rejoin the coordinator's trace ring
         from ..trace import recorder
 
@@ -497,9 +529,15 @@ class WorkerPlane:
         if leave:
             self.leave()
         self._stop.set()
+        self._span_wake.set()
         if self._hb is not None:
             self._hb.join(timeout=2.0)
             self._hb = None
+        if self._span_thread is not None:
+            self._span_thread.join(timeout=2.0)
+            self._span_thread = None
+        # drain: anything the flusher didn't get to goes out now
+        self.flush_spans()
         from ..trace import recorder
 
         if recorder.TRACE_EXPORT_HOOK == self.forward_trace:
@@ -553,23 +591,72 @@ class WorkerPlane:
             REGISTRY.inc("coord_rpc_errors_total")
 
     def forward_trace(self, tr):
-        """finish_trace hook: ship the finished span tree to the
-        coordinator.  Oversize payloads (per-host byte cap) drop with a
-        counter; a dead coordinator costs one short timeout, never a
-        query failure."""
+        """finish_trace hook: ENQUEUE the finished span tree for the
+        background flusher (batch + age triggered) — no synchronous RPC
+        on the statement path (ISSUE 11 / coord follow-up (c)).
+        Oversize payloads (per-host byte cap) and a full queue drop with
+        counters; a dead coordinator costs the flusher a short timeout,
+        never a query failure."""
         try:
             from ..trace.export import trace_payload
 
-            data = json.dumps({"cmd": "spans", "pid": self.pid,
-                               "payload": trace_payload(tr)})
+            data = json.dumps(trace_payload(tr))
             if len(data) > _span_cap_bytes():
                 REGISTRY.inc("coord_spans_dropped_total")
                 return
-            self._rpc_line(data)
-            REGISTRY.inc("coord_spans_forwarded_total")
-            REGISTRY.inc("coord_span_bytes_total", len(data))
+            with self._span_mu:
+                if len(self._span_q) >= self._span_queue_max:
+                    REGISTRY.inc("coord_spans_dropped_total")
+                    return
+                self._span_q.append(data)
+                depth = len(self._span_q)
+            if depth >= self._span_batch:
+                self._span_wake.set()  # size-triggered flush
         except Exception:
             REGISTRY.inc("coord_rpc_errors_total")
+
+    def _span_flusher(self):
+        """Background worker: flush the span queue when the batch
+        threshold fills (size) or the flush interval lapses (age)."""
+        while not self._stop.is_set():
+            self._span_wake.wait(self._span_flush_s)
+            self._span_wake.clear()
+            self.flush_spans()
+
+    def flush_spans(self):
+        """Drain the span queue now (the flusher's body; also the
+        drain/stop path so no finished trace is left behind)."""
+        while True:
+            with self._span_mu:
+                batch, self._span_q = (
+                    self._span_q[: self._span_batch],
+                    self._span_q[self._span_batch:],
+                )
+            if not batch:
+                return
+            try:
+                sizes = json.dumps([len(b) for b in batch])
+                data = ('{"cmd": "spans", "pid": %d, "sizes": %s,'
+                        ' "payloads": [%s]}'
+                        % (self.pid, sizes, ", ".join(batch)))
+                self._rpc_line(data)
+                REGISTRY.inc("coord_spans_forwarded_total", len(batch))
+                REGISTRY.inc("coord_span_batches_total")
+                REGISTRY.inc("coord_span_bytes_total",
+                             sum(len(b) for b in batch))
+            except Exception:
+                REGISTRY.inc("coord_rpc_errors_total")
+                # coordinator unreachable: requeue this batch at the
+                # front (bounded — overflow drops with the counter) and
+                # let a later flush retry
+                with self._span_mu:
+                    room = self._span_queue_max - len(self._span_q)
+                    kept = batch[:max(room, 0)]
+                    if len(kept) < len(batch):
+                        REGISTRY.inc("coord_spans_dropped_total",
+                                     len(batch) - len(kept))
+                    self._span_q = kept + self._span_q
+                return
 
     def handoff_put(self, states):
         states = list(states or ())

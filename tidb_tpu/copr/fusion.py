@@ -41,6 +41,8 @@ import os
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+import numpy as np
+
 from .. import ops  # noqa: F401  (configures x64)
 import jax
 import jax.numpy as jnp
@@ -52,6 +54,37 @@ from .jax_eval import JaxUnsupported, compile_expr
 #: chaos site: an armed action may raise JaxUnsupported to force the
 #: splitter to cut the fused region at an arbitrary executor boundary
 SPLIT_FAILPOINT = "copr/fusion_split"
+
+#: the measured split-reason inventory (ISSUE 11): every host-tail split
+#: carries one of these labels on `fusion_splits_reason_*_total`, /status
+#: and INFORMATION_SCHEMA.TIDB_TPU_FUSION_SPLITS, so fusion-coverage
+#: regressions are visible per cause, not as one opaque counter
+SPLIT_REASONS = ("unsupported-op", "computed-key", "compound-order",
+                 "head-shape", "agg-overflow")
+
+
+def classify_split_reason(msg: Optional[str]) -> str:
+    """Map a JaxUnsupported message onto the split-reason inventory."""
+    m = (msg or "").lower()
+    if "group key" in m and ("string" in m or "computed" in m
+                             or "remap" in m):
+        return "computed-key"
+    if "sort key" in m or "compound order" in m or "order key" in m:
+        return "compound-order"
+    return "unsupported-op"
+
+
+def note_split(label: Optional[str], boundary: str):
+    """Count one region split under its reason label (the labelled
+    fusion_splits_total of ISSUE 11) and annotate the active trace."""
+    from ..metrics import REGISTRY
+    from ..trace import annotate
+
+    label = label if label in SPLIT_REASONS else "unsupported-op"
+    REGISTRY.inc("fusion_splits_total")
+    REGISTRY.inc("fusion_splits_reason_"
+                 + label.replace("-", "_") + "_total")
+    annotate(fusion_split=boundary, fusion_split_reason=label)
 
 
 def fusion_enabled() -> bool:
@@ -178,16 +211,207 @@ def topn_key(ctx: RegionContext):
     last descending.  The sentinel stays distinguishable from masked-out
     rows (masked_top_k uses -inf for those), so NULLs get a finite
     extreme: -MAX asc (sorts first), -MAX desc (sorts last but still
-    beats masked rows)."""
+    beats masked rows).
+
+    Multi-column orderings with a packed compound spec (`an.topn_pack`,
+    built by _Analyzed from column stats) emit ONE lexicographically
+    exact integer key instead: per-key ranks (NULL slot included,
+    desc keys rank-flipped) compose by stride multiplication, so the
+    device's single top_k IS the exact compound ordering — the
+    "stable key-composition over packed integer and dict-code columns"
+    emitter of ISSUE 11.  Callers sort the packed key ASCENDING."""
+    pack = getattr(ctx.an, "topn_pack", None)
+    if pack is not None:
+        return compound_topn_key(ctx)
     key_expr, _desc = ctx.an.topn.order_by[0]
     d, v = compile_expr(key_expr, ctx.cols, ctx.n)
     key = d.astype(jnp.float64)
     return jnp.where(v, key, -1.7e308)
 
 
+def compound_topn_key(ctx: RegionContext):
+    """The packed lexicographic key over `an.topn_pack` specs: per key
+    (col_idx, lo, hi, slots, desc, has_null), rank ascending-first-wins,
+    strides most-significant-first; the product of slots is capped at
+    2**52 by the analyzer so the f64 top_k stays exact."""
+    key = jnp.zeros(ctx.n, dtype=jnp.int64)
+    for col_idx, lo, hi, slots, desc, has_null in ctx.an.topn_pack:
+        d, v = ctx.cols[col_idx]
+        d = d.astype(jnp.int64)
+        if desc:
+            # largest value first; NULLs last (MySQL desc ordering)
+            rank = jnp.clip(hi - d, 0, slots - 1)
+            if has_null:
+                rank = jnp.where(v, rank, slots - 1)
+        else:
+            # NULLs first ascending: slot 0 reserved when nullable
+            if has_null:
+                rank = jnp.where(v, jnp.clip(d - lo, 0, slots - 2) + 1, 0)
+            else:
+                rank = jnp.clip(d - lo, 0, slots - 1)
+        key = key * slots + rank
+    return key.astype(jnp.float64)
+
+
 def projection_outputs(ctx: RegionContext):
     """Emit the fused projection expressions (device-evaluated outputs)."""
     return [compile_expr(p, ctx.cols, ctx.n) for p in ctx.an.proj_exprs]
+
+
+def topn_desc(an) -> bool:
+    """The descending flag the device top_k runs with: packed compound
+    keys already fold per-key direction into the rank, so they always
+    sort ASCENDING; single keys keep their own flag."""
+    if getattr(an, "topn_pack", None) is not None:
+        return False
+    return an.topn.order_by[0][1]
+
+
+# ---------------------------------------------------------------------------
+# computed string group keys: device-side dictionary-code re-mapping
+# ---------------------------------------------------------------------------
+
+#: the one home of the dictionary-computable function set is the
+#: (jax-free) pushdown module — the planner gate and the engine's remap
+#: builder must agree exactly on it
+from ..expr.pushdown import DICT_COMPUTABLE_FUNCS  # noqa: E402
+
+
+class KeyRemap:
+    """One computed string group key lowered to a code-space gather.
+
+    `mapping` (int32, pow2-padded to `cap`) rides as a RUNTIME operand of
+    the fused program: row code -> computed-key output code.  The output
+    dictionary (`out_dict`, sorted so code order == string order) decodes
+    the compacted group keys host-side after readback."""
+
+    __slots__ = ("src_idx", "mapping", "cap", "out_dict")
+
+    def __init__(self, src_idx: int, mapping: np.ndarray, cap: int,
+                 out_dict: List[str]):
+        self.src_idx = src_idx
+        self.mapping = mapping
+        self.cap = cap
+        self.out_dict = out_dict
+
+
+def _single_dict_column(expr, scan, table):
+    """The ONE dict-encoded string column a remappable expression reads,
+    or None.  The structural walk is the SHARED
+    `pushdown.dict_computable_columns` (one source of truth with the
+    planner gate and plancheck); this adds the engine-side identity
+    check: a single scan index whose store column is dict-encoded."""
+    from ..expr.pushdown import dict_computable_columns
+
+    cols = dict_computable_columns(expr)
+    if cols is None:
+        return None
+    idxs = {c.index for c in cols}
+    if len(idxs) != 1:
+        return None
+    idx = next(iter(idxs))
+    if not (0 <= idx < len(scan.columns)):
+        return None  # join payload column: no store dictionary
+    store_ci = scan.columns[idx]
+    if store_ci not in table.dict_encoded_cols():
+        return None
+    return idx
+
+
+import threading as _threading_mod
+
+_REMAP_MU = _threading_mod.Lock()
+#: (store_uid, base_version, expr json) -> KeyRemap; the host pays the
+#: per-dictionary evaluation ONCE per base version, not once per query.
+#: Bounded: superseded base versions purge per store, and the whole map
+#: caps at _REMAP_CACHE_MAX entries (FIFO) so long-lived servers with
+#: heavy table churn never grow it without bound.
+_REMAP_CACHE: dict = {}
+_REMAP_CACHE_MAX = 256
+
+
+def build_key_remap(table, scan, expr) -> KeyRemap:
+    """Lower a computed STRING group key over a dict-encoded column to a
+    code-space re-mapping: evaluate the expression once per DICTIONARY
+    entry on the host (|dict| rows, not |table| rows), sort-unique the
+    outputs into a new dictionary, and hand the code->code mapping to the
+    device as a runtime gather operand.  Raises JaxUnsupported with a
+    'computed group key' message (the computed-key split reason) when the
+    expression is not remappable."""
+    import json as _json
+
+    from .ir import serialize_expr
+
+    ck = (table.store_uid, table.base_version,
+          _json.dumps(serialize_expr(expr), sort_keys=True))
+    with _REMAP_MU:
+        hit = _REMAP_CACHE.get(ck)
+        if hit is not None:
+            return hit
+        # drop remaps of superseded base versions for this store
+        for k in [k for k in _REMAP_CACHE
+                  if k[0] == ck[0] and k[1] != ck[1]]:
+            del _REMAP_CACHE[k]
+    rm = _build_key_remap_uncached(table, scan, expr)
+    with _REMAP_MU:
+        while len(_REMAP_CACHE) >= _REMAP_CACHE_MAX:
+            _REMAP_CACHE.pop(next(iter(_REMAP_CACHE)))  # FIFO victim
+        _REMAP_CACHE[ck] = rm
+    return rm
+
+
+def _build_key_remap_uncached(table, scan, expr) -> KeyRemap:
+    from ..chunk import Chunk, Column
+    from ..types import TypeKind
+
+    if expr.ftype.kind != TypeKind.STRING:
+        raise JaxUnsupported(
+            f"computed group key not dict-remappable: {expr}")
+    idx = _single_dict_column(expr, scan, table)
+    if idx is None:
+        raise JaxUnsupported(
+            f"computed string group key not dict-remappable: {expr}")
+    store_ci = scan.columns[idx]
+    dictionary = table.cols[store_ci].dictionary or []
+    if not dictionary:
+        raise JaxUnsupported("computed group key over empty dictionary")
+    # evaluate over the dictionary: a chunk wide enough for the source
+    # index, every other slot a zero-row placeholder is unnecessary —
+    # only the source column is ever read (checked by _single_dict_column)
+    nd = len(dictionary)
+    vals = np.empty(nd, dtype=object)
+    vals[:] = [str(s) for s in dictionary]
+    width = idx + 1
+    cols = []
+    for j in range(width):
+        if j == idx:
+            cols.append(Column(expr.ftype, vals))
+        else:
+            cols.append(Column(scan.ftypes[j],
+                               np.zeros(nd, dtype=np.int64)))
+    out = expr.eval(Chunk(cols))
+    if not np.all(out.validity()):
+        raise JaxUnsupported(
+            f"computed group key maps entries to NULL: {expr}")
+    outs = [str(x) for x in out.data]
+    out_dict = sorted(set(outs))
+    rank = {s: i for i, s in enumerate(out_dict)}
+    cap = 2
+    while cap < nd:
+        cap <<= 1
+    mapping = np.zeros(cap, dtype=np.int32)
+    mapping[:nd] = [rank[s] for s in outs]
+    return KeyRemap(idx, mapping, cap, out_dict)
+
+
+def remap_codes(ctx_or_codes, mapping, n: int):
+    """Code-space gather emitter: dictionary codes -> computed-key codes
+    through a runtime mapping operand.  Dispatches to the Pallas tier
+    (copr/pallas) when enabled; the jnp take is the TIDB_TPU_PALLAS=0
+    comparator — parity is test-asserted both ways."""
+    from . import pallas as pk
+
+    return pk.remap_codes(ctx_or_codes, mapping, n)
 
 
 def decode_packed(packed, dict_arg, bits: int, n: int,
@@ -206,10 +430,16 @@ def decode_packed(packed, dict_arg, bits: int, n: int,
     dictionary at all); for 'unique' (float) dictionaries it is the
     value vector indexed by code.  Code arithmetic stays int32: no
     int64 emulation chain enters the kernel census."""
+    from . import pallas as pk
+
     vpb = 8 // bits
     p = packed.reshape(-1)
     if vpb == 1:
         code = p
+    elif pk.pallas_enabled():
+        # the Pallas tier's hand-written unpack kernel (copr/pallas):
+        # one strided shift/mask store per slot, uint8 end to end
+        code = pk.unpack_codes(p, bits, n)
     else:
         # stay in uint8 through the unpack: measured ~1.7x cheaper than
         # int32 shift chains on the CPU harness (narrower VPU lanes)
@@ -367,6 +597,7 @@ class FusionPlan:
     an: object                    # its _Analyzed
     tail: List = field(default_factory=list)  # host-run executor suffix
     split_reason: Optional[str] = None        # why the region was cut
+    reason_label: Optional[str] = None        # SPLIT_REASONS inventory
 
 
 def plan_regions(dag: DAG, table, max_cut: Optional[int] = None
@@ -375,12 +606,22 @@ def plan_regions(dag: DAG, table, max_cut: Optional[int] = None
     suffix becomes the host tail (the per-phase fallback ladder).
     Raises JaxUnsupported (with the first rejection's reason) when not
     even the bare scan analyzes — the CPU interpreter owns those
-    fragments outright."""
+    fragments outright.
+
+    HYBRID device-partial/host-final regions (ISSUE 11): a region whose
+    head ends in a device PROJECTION may still carry a host tail — the
+    tail's executor indices address the projection's OUTPUT layout,
+    which the region hands across the boundary (run_tail interprets over
+    the head's output chunks, whatever their layout).  Partial-agg and
+    topN heads still refuse tails: a Limit over whole-table partials
+    would drop groups, so those peel to the deepest safe boundary and
+    the split is labelled 'head-shape'."""
     from .jax_engine import _Analyzed
 
     execs = dag.executors
     hi = len(execs) if max_cut is None else min(max_cut, len(execs))
     reason: Optional[str] = None
+    guard_cut: Optional[int] = None
     for cut in range(hi, 0, -1):
         head, tail = execs[:cut], list(execs[cut:])
         try:
@@ -395,16 +636,22 @@ def plan_regions(dag: DAG, table, max_cut: Optional[int] = None
             if reason is None:
                 reason = str(e)
             continue
-        if tail and (an.agg is not None or an.topn is not None
-                     or an.projection is not None):
-            # a host tail is only correct over SCAN-LAYOUT rows: partial
-            # agg / topn / projected output must not feed tail executors
-            # (their column indices address the scan layout, and a Limit
-            # over whole-table partials would drop groups) — keep
-            # peeling until the region is scan+selection shaped
+        if tail and (an.agg is not None or an.topn is not None):
+            # partial agg / topn outputs must not feed tail executors (a
+            # Limit over whole-table partials would drop groups) — keep
+            # peeling; projection heads ARE hybrid-eligible (the tail
+            # reads the projected layout)
+            if guard_cut is None:
+                guard_cut = cut
             continue
+        label = None
+        if tail:
+            label = ("head-shape"
+                     if guard_cut is not None and cut < guard_cut
+                     else classify_split_reason(reason))
         return FusionPlan(sub, an, tail,
-                          split_reason=reason if tail else None)
+                          split_reason=reason if tail else None,
+                          reason_label=label)
     raise JaxUnsupported(reason or "no device-eligible fused region")
 
 
@@ -453,11 +700,7 @@ def run_fragment(table, dag: DAG, start: int, end: int, deleted,
                 raise
             cut = len(plan.dag.executors) - 1
     if plan.tail:
-        from ..metrics import REGISTRY
-        from ..trace import annotate
-
-        REGISTRY.inc("fusion_splits_total")
-        annotate(fusion_split=type(plan.tail[0]).__name__)
+        note_split(plan.reason_label, type(plan.tail[0]).__name__)
         chunks = run_tail(dag, plan.tail, chunks, aux)
     return chunks
 
@@ -523,6 +766,11 @@ def trace_fused_fragment(table, dag, n_ranges: int = 1, cold: bool = False,
             col_layout.append(None)
     if cold and not any(col_layout):
         raise JaxUnsupported("no cold-packable column in fragment")
+    # computed-key remap operands ride the lvals tail AFTER the cold
+    # dictionary operands (same ordering contract as _run_mesh_once)
+    for r in (getattr(an, "key_remaps", None) or ()):
+        if r is not None:
+            lvals.append(r.mapping)
     core = par._build_mesh_core(an, kind, col_order, mesh,
                                 tiles_per_shard=1,
                                 col_layout=col_layout if cold else None)

@@ -297,6 +297,11 @@ class _Analyzed:
         # 'sort': per-shard lexsort + boundary segments (arbitrary NDV,
         #         float/NULLable keys) — mesh path only
         self.agg_mode = "dense"
+        #: per-group-key dict-code remaps (computed string keys lowered
+        #: to code-space gathers, ISSUE 11) — None when no key needs one
+        self.key_remaps = None
+        #: packed lexicographic multi-column TopN spec, else None
+        self.topn_pack = None
         if self.agg is not None:
             width = len(self.scan.columns)
             for a in self.agg.aggs:
@@ -318,26 +323,70 @@ class _Analyzed:
             except JaxUnsupported:
                 # high-NDV / float / NULLable / non-column keys: the mesh
                 # engine groups by sorting — keys only need to be
-                # device-compilable.  STRING-typed keys and min/max args
-                # must still be plain columns: the sort path resolves their
-                # dict codes through scan.columns[expr.index]
-                # (_sort_agg_chunks), which a computed expression lacks.
+                # device-compilable.  Computed STRING keys over a
+                # dict-encoded column lower to code-space gathers
+                # (fusion.build_key_remap): the host evaluates the string
+                # function once per DICTIONARY entry and the device
+                # re-maps row codes through a runtime operand — no host
+                # tail, no decode (ISSUE 11; closes MPP follow-up (d)).
+                from .fusion import build_key_remap
+
+                remaps = []
                 for k in self.agg.group_by:
-                    if not can_push_expr(k, dict_cols=dict_scan_idx):
-                        raise
                     if (k.ftype.kind == TypeKind.STRING
                             and not isinstance(k, ColumnExpr)):
-                        raise JaxUnsupported(
-                            "string expression group key on device")
+                        remaps.append(
+                            build_key_remap(table, self.scan, k))
+                        continue
+                    if not can_push_expr(k, dict_cols=dict_scan_idx):
+                        raise
+                    remaps.append(None)
                 # (min/max STRING args need no guard here: can_push_agg
                 # already rejects non-column STRING args upstream)
+                if any(r is not None for r in remaps):
+                    self.key_remaps = remaps
                 self.agg_mode = "sort"
                 self.num_groups = 0
                 self.group_cols = []
                 self.group_card = []
         if self.topn is not None:
             if len(self.topn.order_by) != 1:
-                raise JaxUnsupported("device topn supports one sort key")
+                # exact compound ordering: pack every key's stats-bounded
+                # rank into ONE integer sort key (fusion.compound_topn_key)
+                # so multi-column TopN runs on device; unpackable key sets
+                # raise with the compound-order split reason
+                self._analyze_compound_topn(table)
+
+    def _analyze_compound_topn(self, table):
+        """Build the packed lexicographic sort-key spec for a multi-column
+        TopN: per key (col_idx, lo, hi, slots, desc, has_null) with a NULL
+        rank slot when the column is nullable.  The slot product is capped
+        at 2**52 so the f64 top_k comparison stays exact."""
+        pack = []
+        total = 1
+        for e, desc in self.topn.order_by:
+            if not isinstance(e, ColumnExpr):
+                raise JaxUnsupported(
+                    f"compound order key must be a plain column: {e}")
+            if e.ftype.kind == TypeKind.FLOAT:
+                raise JaxUnsupported(
+                    "compound order over unbounded float sort key")
+            if e.index >= len(self.scan.columns):
+                raise JaxUnsupported(
+                    "compound order key over join payload")
+            store_ci = self.scan.columns[e.index]
+            lo, hi, has_null = table.column_stats(store_ci)
+            if hi < lo:
+                lo, hi = 0, 0
+            slots = (hi - lo + 1) + (1 if has_null else 0)
+            total *= slots
+            if total > (1 << 52):
+                raise JaxUnsupported(
+                    "compound order key space too large for a packed "
+                    "sort key")
+            pack.append((e.index, int(lo), int(hi), int(slots),
+                         bool(desc), bool(has_null)))
+        self.topn_pack = pack
 
     def _analyze_dense_keys(self, table):
         g = 1
@@ -393,7 +442,8 @@ class _Analyzed:
             for p in self.proj_exprs:
                 p.collect_columns(need)
         if self.topn is not None:
-            self.topn.order_by[0][0].collect_columns(need)
+            for e, _d in self.topn.order_by:
+                e.collect_columns(need)
         width = len(self.scan.columns)
         return sorted(i for i in need if i < width)
 
@@ -408,8 +458,14 @@ _COMPILED = ProgramCache("tile")
 
 
 def _fingerprint(an: _Analyzed, kind: str) -> str:
+    from .pallas import pallas_enabled
+
     payload = {
         "kind": kind,
+        # the Pallas tier changes the traced program BODY (kernel calls
+        # vs jnp compositions), so the comparator flip must never reuse
+        # a cached program built under the other setting
+        "pallas": pallas_enabled(),
         "conds": [serialize_expr(c) for c in an.conds],
         "probes": [[serialize_expr(p.key), p.filter_id] for p in an.probes],
         "lookups": [
@@ -443,6 +499,22 @@ def _fingerprint(an: _Analyzed, kind: str) -> str:
             "key": serialize_expr(e), "desc": desc,
             "k": topn_budget(an.topn.limit),
         }
+        if an.topn_pack is not None:
+            # packed compound ordering: every key + its static rank
+            # layout (lo/slots are compiled constants derived from
+            # column stats) shapes the program
+            payload["topn"]["keys"] = [
+                [serialize_expr(e2), bool(d2)]
+                for e2, d2 in an.topn.order_by
+            ]
+            payload["topn"]["pack"] = [
+                [p[1], p[3], p[4], p[5]] for p in an.topn_pack
+            ]
+    if getattr(an, "key_remaps", None):
+        # remap operand arity + pow2 caps shape the program; mapping
+        # CONTENTS stay runtime operands
+        payload["remaps"] = [r.cap if r is not None else None
+                             for r in an.key_remaps]
     return json.dumps(payload, sort_keys=True, default=str)
 
 
@@ -515,7 +587,7 @@ def _tile_core(an: _Analyzed, kind: str, col_order: List[int],
     if kind == "topn":
         from ..serving import topn_budget
 
-        _e, desc = an.topn.order_by[0]
+        desc = fusion.topn_desc(an)
         k = min(topn_budget(an.topn.limit), TILE)
 
         def fn(datas, valids, lo, hi, del_mask, *params):

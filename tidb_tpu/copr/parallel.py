@@ -313,7 +313,10 @@ def _hot_demote(key: tuple, _value: tuple):
     """Demote an evicted hot column to the compressed cold tier
     (demote-to-cold before drop).  Only packable columns of a live store
     whose mesh still matches compress; everything else just drops (and
-    reloads — possibly cold — on next access)."""
+    reloads — possibly cold — on next access).  The evicted device
+    arrays in `_value` feed the device-side re-encode (layout
+    follow-up (e)) so demotion reads back packed codes, not host
+    blocks."""
     from ..layout import COLD_CACHE, LAYOUT, compress_column, layout_enabled
     from ..layout.coldtier import pack_info
     from ..metrics import REGISTRY
@@ -333,9 +336,23 @@ def _hot_demote(key: tuple, _value: tuple):
     if tuple(d.id for d in mesh.devices.ravel()) != key[3]:
         return
     n_pad = key[5]
-    COLD_CACHE.get_or_load(
-        key + ("cold",),
-        lambda: (compress_column(table, store_ci, mesh, n_pad, info),))
+
+    def load():
+        # layout follow-up (e): re-encode ON DEVICE from the evicted
+        # wire array — only the packed codes (8-64x smaller than raw
+        # values) read back for the re-shard, instead of re-reading
+        # every host block; layout_demote_code_readback_bytes counts it
+        from ..layout.coldtier import recompress_from_device
+
+        try:
+            return (recompress_from_device(table, store_ci, mesh, n_pad,
+                                           info, _value),)
+        except Exception:
+            # any device hiccup falls back to the host-block compress
+            return (compress_column(table, store_ci, mesh, n_pad,
+                                    info),)
+
+    COLD_CACHE.get_or_load(key + ("cold",), load)
     LAYOUT.note_demoted(store_uid, store_ci)
     REGISTRY.inc("layout_cold_demotions_total")
 
@@ -681,6 +698,21 @@ from .cache import ProgramCache  # noqa: E402
 
 _COMPILED = ProgramCache("mesh")
 
+
+def _shard_map_norep(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off: the Pallas kernel tier
+    (copr/pallas) has no registered replication rule, and every P()
+    output here comes from a psum/all_gather (replicated by
+    construction) — semantics are unchanged for these programs."""
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+def _n_remaps(an) -> int:
+    """Computed-key remap operands riding the lvals tail (after the cold
+    dictionary operands)."""
+    return sum(1 for r in (getattr(an, "key_remaps", None) or ()) if r)
+
 # max selected rows gathered host-side per streamed chunk (kv.Request
 # Streaming / distsql stream.go: bounded-memory result consumption)
 STREAM_ROWS = 1 << 16
@@ -879,7 +911,8 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
     Tl = tiles_per_shard
     n_local = Tl * je.TILE
     n_global = S * n_local
-    n_lvals = sum(1 for c in (col_layout or ()) if c is not None)
+    n_lvals = sum(1 for c in (col_layout or ()) if c is not None) \
+        + _n_remaps(an)
 
     if kind == "agg" and an.agg_mode == "sort":
         return _build_sort_agg_core(an, col_order, mesh, tiles_per_shard,
@@ -923,7 +956,7 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
     elif kind == "topn":
         from ..serving import topn_budget
 
-        _e, desc = an.topn.order_by[0]
+        desc = fusion.topn_desc(an)
         k = min(topn_budget(an.topn.limit), n_local)
 
         def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
@@ -942,9 +975,9 @@ def _build_mesh_core(an: _Analyzed, kind: str, col_order: List[int],
 
         out_specs = P("dp")
 
-    return shard_map(shard_fn, mesh=mesh,
-                     in_specs=_mesh_in_specs(an, hoisted, n_lvals),
-                     out_specs=out_specs)
+    return _shard_map_norep(shard_fn, mesh,
+                            _mesh_in_specs(an, hoisted, n_lvals),
+                            out_specs)
 
 
 def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
@@ -1105,7 +1138,9 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
     OUT = min(int(_os.environ.get("TIDB_TPU_AGG_OUT", 1 << 17)), n_local)
     agg_ir = an.agg
     fd_lookup = _fd_sort_lookup(an)
-    n_lvals = sum(1 for c in (col_layout or ()) if c is not None)
+    n_cold = sum(1 for c in (col_layout or ()) if c is not None)
+    remaps = getattr(an, "key_remaps", None)
+    n_lvals = n_cold + _n_remaps(an)
 
     def shard_fn(datas, valids, del_mask, bounds, lvals, *pargs):
         pargs, params = _split_hoisted(pargs, hoisted)
@@ -1117,8 +1152,20 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
         fusion.selection_mask(ctx)
         m = _apply_probes(an, cols, ctx.mask, pargs, n_local)
         key_bits, key_flags = [], []
-        for g in agg_ir.group_by:
-            d, v = compile_expr(g, cols, n_local)
+        rslot = 0
+        for gi, g in enumerate(agg_ir.group_by):
+            rem = remaps[gi] if remaps is not None else None
+            if rem is not None:
+                # computed string key: code-space gather through the
+                # runtime mapping operand (the lvals tail after the cold
+                # dictionary operands) — fusion.remap_codes dispatches
+                # to the Pallas tier when enabled
+                d0, v = cols[rem.src_idx]
+                d = fusion.remap_codes(d0, lvals[n_cold + rslot],
+                                       n_local)
+                rslot += 1
+            else:
+                d, v = compile_expr(g, cols, n_local)
             # float keys group in VALUE domain (the backend can't lower the
             # f64<->i64 bitcast); -0.0 folds into 0.0, and NULL rows get a
             # fixed key so the validity flag alone separates them
@@ -1149,9 +1196,9 @@ def _build_sort_agg_core(an: _Analyzed, col_order: List[int], mesh: Mesh,
             order, sm, seg, OUT, sgofs=gofs[order], n_global=n_global)
         return n_uniq.reshape(1), out_keys, tuple(results)
 
-    return shard_map(shard_fn, mesh=mesh,
-                     in_specs=_mesh_in_specs(an, hoisted, n_lvals),
-                     out_specs=P("dp"))
+    return _shard_map_norep(shard_fn, mesh,
+                            _mesh_in_specs(an, hoisted, n_lvals),
+                            P("dp"))
 
 
 def _wrap_sort_agg(an: _Analyzed, core, S: int, n_local: int):
@@ -1200,7 +1247,15 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
             bits = out["keys"][i][lo: lo + k_s]
             flags = out["keys"][nk + i][lo: lo + k_s].astype(np.bool_)
             ft = g.ftype
-            if ft.kind == TK.FLOAT:
+            rem = (an.key_remaps[i]
+                   if getattr(an, "key_remaps", None) else None)
+            if rem is not None:
+                # computed-key codes decode through the remap's OUTPUT
+                # dictionary (sorted, so code order == string order)
+                from ..store.blockstore import _decode_dict
+
+                data = _decode_dict(bits.astype(np.int64), rem.out_dict)
+            elif ft.kind == TK.FLOAT:
                 # value-domain keys; already host numpy (packed readback)
                 data = bits.astype(np.float64, copy=False)
             elif ft.kind == TK.STRING:
@@ -1267,7 +1322,11 @@ def _peel_agg_rerun(storage, req, tid: int, dag: DAG, reason: str):
 
     REGISTRY.inc("mesh_agg_peel_total")
     annotate(mesh_agg_peel=reason[:80])
-    return _run_mesh_once(storage, req, tid, max_cut=cut)
+    # the forced cut analyzes cleanly (no JaxUnsupported), so the split
+    # label must be supplied: this is a data-dependent budget overflow,
+    # not an unsupported operator
+    return _run_mesh_once(storage, req, tid, max_cut=cut,
+                          forced_label="agg-overflow")
 
 
 # ---------------------------------------------------------------------------
@@ -1488,14 +1547,17 @@ def _observe_fragment(table, an: _Analyzed):
 
 
 def _run_mesh_once(storage, req: CopRequest, tid: int,
-                   max_cut: Optional[int] = None):
+                   max_cut: Optional[int] = None,
+                   forced_label: Optional[str] = None):
     """One attempt at running the request over the current mesh; None if
     ineligible.  Raises on runtime failures — try_run_mesh owns failover.
 
     `max_cut` caps the fused region at an executor boundary — the
     MeshAggOverflow peel re-enters here with the cut placed just before
     the aggregation, so the scan+selection head stays on device and only
-    the blown-budget agg moves to the host tail."""
+    the blown-budget agg moves to the host tail.  `forced_label` names
+    the split reason for such forced cuts (the region analyzes cleanly,
+    so plan_regions cannot classify them itself)."""
     dag = DAG.from_dict(req.dag)
     table = storage.table(tid)
     if table.base_rows == 0 or table.base_ts > req.ts:
@@ -1522,6 +1584,11 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         req.mesh_reject_reason = (
             plan.split_reason or "fragment not device-eligible")
         return None
+    if plan.tail and forced_label and plan.split_reason is None:
+        # the forced cut saw no JaxUnsupported (the head analyzes
+        # cleanly), so classify_split_reason defaulted — the caller
+        # knows the true cause (e.g. a blown agg budget)
+        plan.reason_label = forced_label
     an, tail = plan.an, plan.tail
     kind = "agg" if an.agg is not None else (
         "topn" if an.topn is not None else "filter"
@@ -1627,6 +1694,12 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
             valids.append(v)
             col_layout.append(None)
             wire_sig.append((str(d.dtype), v is None))
+    # computed-key remap operands ride the lvals tail AFTER the cold
+    # dictionary operands (one ordering contract with _build_sort_agg_core
+    # and trace_fused_fragment); mapping CONTENTS are runtime data
+    for r in (getattr(an, "key_remaps", None) or ()):
+        if r is not None:
+            lvals.append(jnp.asarray(r.mapping))
     lvals = tuple(lvals)
     if not any(col_layout):
         col_layout = None
@@ -1698,7 +1771,8 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         return _stream_filter(req, table, an, fn, datas, valids, del_mask,
                               inserted, pargs, mesh_ids=mesh_ids,
                               bounds=bounds, tail=tail, dag=dag,
-                              lvals=lvals)
+                              lvals=lvals,
+                              split_label=plan.reason_label)
 
     from ..lifecycle import scope_check
 
@@ -1790,7 +1864,7 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
 
 def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
                    pargs=(), mesh_ids=(), bounds=(), tail=None, dag=None,
-                   lvals=()):
+                   lvals=(), split_label=None):
     """Generator over a mesh filter's result chunks: ONE fused bit-packed
     mask dispatch covering every range, then STREAM_ROWS-sized host
     gathers on demand (distsql/stream.go:33-124; kv.Request.Streaming
@@ -1816,7 +1890,9 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
         if remaining is not None:
             handles = handles[:remaining]
         if tail:
-            REGISTRY.inc("fusion_splits_total")
+            from .fusion import note_split
+
+            note_split(split_label, type(tail[0]).__name__)
         for off in range(0, len(handles), STREAM_ROWS):
             scope_check()  # between streamed host gathers
             sub = handles[off: off + STREAM_ROWS]

@@ -42,6 +42,18 @@ PUSHABLE_AGGS: Set[str] = {
     "bit_and", "bit_or", "bit_xor",
 }
 
+#: string functions whose value on a dictionary-encoded column is a pure
+#: per-entry function of that ONE column (constants allowed): computed
+#: group keys built from these lower to device-side dict-code re-mapping
+#: (copr/fusion.build_key_remap) — the host evaluates once per DICTIONARY
+#: entry, rows re-map in code space.  All are non-null-introducing for
+#: non-null inputs, so the source column's validity plane carries through.
+DICT_COMPUTABLE_FUNCS: Set[str] = {
+    "substr", "substring", "mid", "left", "right",
+    "upper", "lower", "ucase", "lcase",
+    "concat", "reverse", "trim", "ltrim", "rtrim",
+}
+
 # Kinds with fixed-width device representations.  STRING is device-eligible
 # only when dictionary-encoded (decided per column by the block store).
 DEVICE_KINDS = {
@@ -96,6 +108,52 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
             return False
         return all(can_push_expr(a, blacklist, dict_cols) for a in e.args)
     return False
+
+
+def dict_computable_columns(e: Expression):
+    """The STRUCTURAL half of the remap eligibility check, shared by the
+    planner gate (can_remap_group_key), the engine's remap builder
+    (fusion._single_dict_column) and plancheck's registry exemption —
+    ONE walker so the three layers can never drift apart.
+
+    Returns the list of ColumnExpr leaves when `e` is a STRING-typed
+    tree of dictionary-computable functions over STRING column leaves
+    plus non-NULL constants, referencing at least one column; None
+    otherwise.  Callers apply their own column-identity check (uid vs
+    scan index vs store dictionary membership)."""
+    if not isinstance(e, ScalarFunc) or e.ftype.kind != TypeKind.STRING:
+        return None
+    cols = []
+
+    def walk(x) -> bool:
+        if isinstance(x, Constant):
+            return x.value is not None
+        if isinstance(x, ColumnExpr):
+            cols.append(x)
+            return x.ftype.kind == TypeKind.STRING
+        if isinstance(x, ScalarFunc):
+            if x.name not in DICT_COMPUTABLE_FUNCS:
+                return False
+            return all(walk(a) for a in x.args)
+        return False
+
+    if not walk(e) or not cols:
+        return None
+    return cols
+
+
+def can_remap_group_key(e: Expression,
+                        dict_cols: Set[int] = frozenset()) -> bool:
+    """True when a computed STRING group key lowers to a device-side
+    dict-code re-mapping (copr/fusion.build_key_remap): a tree of
+    dictionary-computable string functions over exactly ONE dict-encoded
+    string column plus constants.  The host evaluates the function once
+    per dictionary entry; rows re-map in code space — no host tail."""
+    cols = dict_computable_columns(e)
+    if cols is None:
+        return False
+    keys = {(c.unique_id if c.unique_id >= 0 else c.index) for c in cols}
+    return len(keys) == 1 and next(iter(keys)) in dict_cols
 
 
 def can_push_agg(agg: AggDesc, blacklist: Set[str] = frozenset(),

@@ -564,6 +564,26 @@ def _tidb_tpu_device_health(domain, isc):
     return rows
 
 
+@_register("tidb_tpu_fusion_splits", [
+    ("reason", ty_string()), ("splits", ty_int()),
+])
+def _tidb_tpu_fusion_splits(domain, isc):
+    """Fusion-region splits by reason (ISSUE 11): the measured inventory
+    of why fragments still peel a host tail (unsupported-op,
+    computed-key, compound-order, head-shape) plus the total — the
+    operator view of zero-host-tail progress."""
+    from .copr.fusion import SPLIT_REASONS
+    from .metrics import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    rows = [("total", int(snap.get("fusion_splits_total", 0)))]
+    for r in SPLIT_REASONS:
+        rows.append((r, int(snap.get(
+            "fusion_splits_reason_" + r.replace("-", "_") + "_total",
+            0))))
+    return rows
+
+
 @_register("tidb_tpu_column_layout", [
     ("table_id", ty_int()), ("store_uid", ty_int()),
     ("column_name", ty_string()), ("store_offset", ty_int()),

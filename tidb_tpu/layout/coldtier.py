@@ -267,6 +267,118 @@ def compress_column(table, store_ci: int, mesh, n_pad: int,
                       kind=info.kind, lo=info.lo)
 
 
+#: (kind, bits, cap, lo, base_rows) -> jitted device encoder.  Memoized
+#: so repeated demotions under cache thrash never pay a fresh XLA
+#: compile on the query path (jax.jit caches per FUNCTION OBJECT; a new
+#: closure per demotion would retrace every time).  Bounded: entries are
+#: tiny closures and the key space is per (column class, base version).
+_ENCODERS: Dict[tuple, object] = {}
+_ENCODERS_MAX = 128
+
+
+def _demote_encoder(kind: str, bits: int, cap: int, lo: int,
+                    base_rows: int):
+    import jax
+    import jax.numpy as jnp
+
+    key = (kind, bits, cap, lo, base_rows)
+    with _mu:
+        fn = _ENCODERS.get(key)
+        if fn is not None:
+            return fn
+        if len(_ENCODERS) >= _ENCODERS_MAX:
+            _ENCODERS.clear()  # tiny closures; full reset is fine
+    vpb = 8 // bits
+
+    def encode(d, dvec=None):
+        flat = d.reshape(-1)
+        if dvec is None:
+            codes = jnp.clip(flat.astype(jnp.int64) - lo, 0, cap - 1)
+        else:
+            codes = jnp.clip(
+                jnp.searchsorted(dvec, flat.astype(dvec.dtype)), 0,
+                cap - 1)
+        # pad rows beyond base_rows must pack to 0 (the host compress
+        # path's layout, byte-for-byte)
+        gofs = jnp.arange(flat.shape[0], dtype=jnp.int64)
+        codes = jnp.where(gofs < base_rows, codes, 0).astype(jnp.uint8)
+        if vpb == 1:
+            return codes
+        c = codes.reshape(-1, vpb)
+        shifts = jnp.arange(vpb, dtype=jnp.uint8) * jnp.uint8(bits)
+        out = jnp.zeros(c.shape[0], dtype=jnp.uint8)
+        for s in range(vpb):
+            out = out | (c[:, s] << shifts[s])
+        return out
+
+    fn = jax.jit(encode)
+    with _mu:
+        _ENCODERS[key] = fn
+    return fn
+
+
+def recompress_from_device(table, store_ci: int, mesh, n_pad: int,
+                           info: Optional[PackInfo],
+                           hot_value) -> ColdColumn:
+    """Layout follow-up (e): demote a hot column to the cold tier by
+    re-encoding ON DEVICE from the evicted wire array — codes compute
+    and bit-pack in one jitted program over the already-resident data,
+    and only the PACKED bytes (8-64x smaller than the raw values) read
+    back for the re-shard, counted on `layout_demote_code_readback_bytes`.
+    The old path decoded nothing but re-read every host block and paid a
+    full packed re-transfer; this one never touches host blocks.
+
+    Raises when the column is not packable or the hot value is unusable
+    (callers fall back to `compress_column`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..copr import jax_engine as je
+    from ..copr.parallel import _full_dtype
+    from ..metrics import REGISTRY
+
+    if info is None:
+        info = pack_info(table, store_ci)
+    if info is None:
+        raise ValueError(f"column {store_ci} is not cold-packable")
+    data = hot_value[0]  # the evicted [n_pad, TILE] wire array
+    tile = je.TILE
+    vpb = 8 // info.bits
+    dt = _full_dtype(table.cols[store_ci].ftype.kind)
+    if info.kind == "unique":
+        packed_vals = dict_values(table, store_ci, info)
+        dvec = jnp.asarray(packed_vals)
+    else:
+        packed_vals = np.zeros(0, dtype=dt)
+        dvec = None
+    encode_jit = _demote_encoder(info.kind, info.bits, info.cap, info.lo,
+                                 table.base_rows)
+    from ..trace import span
+
+    with span("copr.readback", tier="cold-demote") as sp:
+        # the designed readback: ONLY the packed codes cross the link
+        if dvec is None:
+            packed_host = np.asarray(encode_jit(data))
+        else:
+            packed_host = np.asarray(encode_jit(data, dvec))
+        sp.set(bytes=packed_host.nbytes)
+    REGISTRY.inc("layout_demote_code_readback_bytes",
+                 float(packed_host.nbytes))
+    packed = packed_host.reshape(n_pad, tile // vpb)
+    rep = NamedSharding(mesh, P())
+    with span("copr.transfer", col=store_ci, tier="cold",
+              bits=info.bits) as sp:
+        sp.set(bytes=packed.nbytes + max(packed_vals.nbytes, dt.itemsize))
+        dev = jax.device_put(packed, NamedSharding(mesh, P("dp")))
+        if info.kind == "range":
+            operand = jax.device_put(dt.type(info.lo), rep)
+        else:
+            operand = jax.device_put(packed_vals, rep)
+    return ColdColumn(dev, operand, packed_vals, info.bits, info.cap,
+                      kind=info.kind, lo=info.lo)
+
+
 def evict_device(device_id: int) -> int:
     """Device failover: drop cold entries placed on a dead device set
     (key layout mirrors the mesh cache — device ids at index 3)."""

@@ -102,7 +102,22 @@ FUSED_FRAGMENT_KERNELS = [
     ("fused-mesh-topn",
      "select l_orderkey from lineitem order by l_extendedprice desc"
      " limit 5"),
+    # ISSUE 11 zero-host-tail shapes: a computed STRING group key lowered
+    # to a device dict-code re-map, and a packed-compound multi-column
+    # TopN ordering — both must trace as ONE fused mesh program
+    ("fused-mesh-computed-key-agg",
+     "select substr(l_returnflag, 1, 1), count(*), sum(l_quantity)"
+     " from lineitem group by substr(l_returnflag, 1, 1)"),
+    ("fused-mesh-compound-topn",
+     "select l_orderkey from lineitem"
+     " order by l_returnflag desc, l_shipdate, l_orderkey limit 5"),
 ]
+
+#: the Pallas kernel tier (copr/pallas): hand-written cores below the
+#: fusion emitters.  Each traces on a canonical shape, guards the
+#: operand-value rule (shifted mapping contents -> identical jaxpr), and
+#: EXECUTES against the TIDB_TPU_PALLAS=0 jnp reference for parity.
+PALLAS_KERNELS = ("pallas-remap-codes", "pallas-unpack-codes")
 
 
 def _iter_eqns(jaxpr):
@@ -488,6 +503,73 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
     except Exception as e:  # noqa: BLE001 — contract break
         emit(name, f"cold fragment trace failed: "
                    f"{type(e).__name__}: {e}")
+
+    # -- Pallas kernel tier (copr/pallas) -------------------------------
+    for name in PALLAS_KERNELS:
+        try:
+            import os as _os2
+
+            from ..copr.pallas import (trace_remap_kernel,
+                                       trace_unpack_kernel)
+
+            if name == "pallas-remap-codes":
+                closed = trace_remap_kernel(shift=0)
+                other = trace_remap_kernel(shift=5)
+                if str(closed) != str(other):
+                    emit(name,
+                         "mapping contents changed the remap kernel's "
+                         "jaxpr — the mapping must stay a runtime "
+                         "operand, never a compiled constant")
+                    continue
+                # executed parity vs the TIDB_TPU_PALLAS=0 jnp reference
+                from ..copr.pallas import remap_codes
+
+                codes = (np.arange(257, dtype=np.int32) * 7) % 16
+                mapping = (np.arange(16, dtype=np.int32) * 3 + 1)
+                got = np.asarray(remap_codes(codes, mapping, 257))
+                prior = _os2.environ.get("TIDB_TPU_PALLAS")
+                _os2.environ["TIDB_TPU_PALLAS"] = "0"
+                try:
+                    ref = np.asarray(remap_codes(codes, mapping, 257))
+                finally:
+                    if prior is None:
+                        _os2.environ.pop("TIDB_TPU_PALLAS", None)
+                    else:
+                        _os2.environ["TIDB_TPU_PALLAS"] = prior
+                if not np.array_equal(got, ref):
+                    emit(name, "pallas remap disagrees with the jnp "
+                               "reference path")
+                    continue
+                stats = _jaxpr_stats(closed)
+            else:
+                from ..copr.pallas import unpack_codes
+                from ..layout.coldtier import pack_codes
+
+                closed = trace_unpack_kernel(bits=4)
+                stats = _jaxpr_stats(closed)
+                raw = (np.arange(512) % 16).astype(np.uint8)
+                packed = pack_codes(raw, 4)
+                got = np.asarray(unpack_codes(packed, 4, 512))
+                if not np.array_equal(got, raw):
+                    emit(name, "pallas unpack disagrees with "
+                               "pack_codes round-trip")
+                    continue
+        except Exception as e:  # noqa: BLE001 — contract break
+            emit(name, f"pallas kernel trace failed: "
+                       f"{type(e).__name__}: {e}")
+            continue
+        if collect_stats is not None:
+            collect_stats[name] = stats
+            continue
+        base = baseline_kernels.get(name)
+        if base is None:
+            emit(name, f"kernel not in baseline (measured {stats}); run "
+                       "python -m tidb_tpu.lint --update-baseline")
+        elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+            emit(name,
+                 f"int64 equation count grew {base.get('i64_eqns')} -> "
+                 f"{stats['i64_eqns']}: an int64-emulation chain was "
+                 "reintroduced into the pallas kernel")
 
     # -- micro-batch vmapped padded-batch kernel ------------------------
     name = VMAP_BATCH_KERNEL

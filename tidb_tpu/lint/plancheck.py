@@ -140,10 +140,20 @@ class _Checker:
                                     registry=True)
                 fts = [e.ftype for e in ex.exprs]
             elif isinstance(ex, AggregationIR):
+                from ..expr.pushdown import dict_computable_columns
+
                 out = []
                 for g in ex.group_by:
+                    # computed STRING keys built from dictionary-
+                    # computable functions over ONE string column lower
+                    # via device dict-code re-mapping (ISSUE 11):
+                    # registry-exempt (same shared walker as the
+                    # planner gate), but column refs/widths still verify
+                    cols = dict_computable_columns(g)
+                    remap_ok = (cols is not None
+                                and len({c.index for c in cols}) == 1)
                     self.check_expr(node, g, fts, "cop Agg group key",
-                                    registry=True)
+                                    registry=not remap_ok)
                     out.append(g.ftype)
                 for a in ex.aggs:
                     if a.name not in PUSHABLE_AGGS:

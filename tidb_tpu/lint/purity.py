@@ -57,7 +57,11 @@ TIME_DOTTED = {"time.time", "time.perf_counter", "time.monotonic",
                "datetime.now", "datetime.datetime.now"}
 ROW_ITER_METHODS = {"to_pylist", "iter_rows"}
 ROW_COUNT_ATTRS = {"num_rows"}
-JIT_WRAPPERS = {"jax.jit", "jit", "_packed_jit"}
+#: factories whose return value IS a jitted callable: assignment from
+#: one opens a readback-boundary name (`out = jitted(...)` then
+#: `np.asarray(out)`).  `_demote_encoder` (layout/coldtier) memoizes
+#: jax.jit closures per column class so demotions never retrace.
+JIT_WRAPPERS = {"jax.jit", "jit", "_packed_jit", "_demote_encoder"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:

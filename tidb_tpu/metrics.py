@@ -55,6 +55,7 @@ COORD_STATUS_METRICS = (
     "coord_epoch_mismatch_total",
     "coord_members_expired_total",
     "coord_spans_forwarded_total",
+    "coord_span_batches_total",
     "coord_spans_ingested_total",
     "coord_spans_grafted_total",
     "coord_spans_dropped_total",
@@ -80,4 +81,5 @@ LAYOUT_STATUS_METRICS = (
     "layout_cold_fallbacks_total",
     "layout_retunes_total",
     "layout_retunes_suppressed_total",
+    "layout_demote_code_readback_bytes",
 )

@@ -228,6 +228,87 @@ class _SideState:
                 for ci in self.col_order]
 
 
+def _shift_expr(e, delta: int):
+    """Clone an expression with every column index shifted by `delta`
+    (joined-layout indices -> one side's scan layout)."""
+    from ..copr.ir import deserialize_expr, serialize_expr
+    from ..expr.expression import ColumnExpr, ScalarFunc
+
+    e2 = deserialize_expr(serialize_expr(e))
+
+    def walk(x):
+        if isinstance(x, ColumnExpr):
+            x.index += delta
+        elif isinstance(x, ScalarFunc):
+            for a in x.args:
+                walk(a)
+
+    walk(e2)
+    return e2
+
+
+def _mpp_key_remaps(spec: MPPJoinSpec, ps: "_SideState", bs: "_SideState"):
+    """Dict-code remaps for computed STRING group keys over the JOINED
+    layout (MPP follow-up (d)): each key's single source column resolves
+    to its OWNING side's store and the remap builds there; the device
+    then re-maps codes post-join, inside the same exchange program.
+    Raises MPPIneligible (host rung) when a computed key is not
+    remappable."""
+    from ..copr import fusion
+    from ..expr.expression import ColumnExpr
+
+    if spec.aggs is None or spec.group_by is None:
+        return None
+    wp = len(ps.col_order)
+    remaps = []
+    for g in spec.group_by:
+        if g.ftype.kind != TypeKind.STRING or isinstance(g, ColumnExpr):
+            remaps.append(None)
+            continue
+        # JOINED-layout POSITIONS (collect_columns would return planner
+        # uids here — these exprs still carry them; the engine works in
+        # index space)
+        refs: set = set()
+
+        def walk(x):
+            if isinstance(x, ColumnExpr):
+                refs.add(x.index)
+            for c in getattr(x, "args", ()) or ():
+                walk(c)
+
+        walk(g)
+        if refs and all(i < wp for i in refs):
+            st, shift = ps, 0
+        elif refs and all(i >= wp for i in refs):
+            st, shift = bs, wp
+        else:
+            raise MPPIneligible(
+                f"computed group key spans both join sides: {g}")
+        try:
+            rm = fusion.build_key_remap(
+                st.table, st.an.scan, _shift_expr(g, -shift))
+        except JaxUnsupported as e:
+            raise MPPIneligible(str(e))
+        remaps.append(fusion.KeyRemap(
+            rm.src_idx + shift, rm.mapping, rm.cap, rm.out_dict))
+    return remaps if any(r is not None for r in remaps) else None
+
+
+def _compound_pack(ps: "_SideState", bs: "_SideState"):
+    """(los, cards) for exact multi-column key packing, or None when the
+    packed space overflows int64 (the mix-hash ladder then remains)."""
+    if len(ps.side.key_pos) <= 1:
+        return None
+
+    def stats(st, kp):
+        lo, hi, _null = st.table.column_stats(st.an.scan.columns[kp])
+        return (lo, hi)
+
+    pairs = [(stats(ps, kp), stats(bs, kb))
+             for kp, kb in zip(ps.side.key_pos, bs.side.key_pos)]
+    return ex.compound_pack_spec(pairs)
+
+
 def _shard_side(an: _Analyzed, col_order, n_local: int, n_ranges: int):
     """Returns fn(datas, valids, del_mask, bounds) -> (cols env, selected
     row mask) for one side, evaluated per shard pre-exchange."""
@@ -250,13 +331,19 @@ def _shard_side(an: _Analyzed, col_order, n_local: int, n_ranges: int):
 
 def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
                   mode: str, mesh, cap_p: int, cap_b: int, cap_out: int,
-                  cap_g: int):
+                  cap_g: int, pack=None, remaps=None):
     """One shard_map program: per-shard scan+filter on both sides,
     partition exchange (or build broadcast), two-pass count+emit local
     join (non-unique and multi-column keys), then row emission, scalar
     partial aggregation, or grouped partial aggregation with the
     cross-shard merge ON DEVICE (all_gather of compacted (key, state)
-    rows + a second sort-merge), so only O(G) group rows leave."""
+    rows + a second sort-merge), so only O(G) group rows leave.
+
+    `pack` = (los, cards) composes multi-column keys EXACTLY (stride
+    packing over the union of both sides' column stats): no collision
+    re-verify, and left-outer multi-key joins become sound on device.
+    `remaps` carries per-group-key dict-code remaps (computed string
+    keys); their mapping operands ride trailing runtime args."""
     S = len(mesh.devices.ravel())
     p_an, b_an = ps.an, bs.an
     # capture ONLY scalars/analysis objects in the shard closure: the
@@ -279,17 +366,33 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
     nk = len(group_by) if grouped else 0
     gchunk = cap_g // S if grouped else 0
 
+    def mk_keys(cols_env, key_pos):
+        """(join key, partition key): the join key is the EXACT packed
+        composition when `pack` is set (mix-hash otherwise); the
+        partition key is ALWAYS the mix-hash — its 64-bit avalanche
+        spreads clustered key spaces across the static bucket capacity
+        better than the dense packed values, and both sides agree on it
+        either way."""
+        keys = [cols_env[kp][0].astype(jnp.int64) for kp in key_pos]
+        mix = ex.combine_keys(keys)
+        if pack is not None:
+            return ex.pack_keys_exact(keys, pack[0], pack[1]), mix
+        return mix, mix
+
     def shard_fn(p_datas, p_valids, p_del, p_bounds,
-                 b_datas, b_valids, b_del, b_bounds, gbudget=None):
+                 b_datas, b_valids, b_del, b_bounds, *extra):
+        from ..copr import fusion
         from ..copr.fusion import (grouped_partial_states,
                                    merge_grouped_partials,
                                    sort_group_segments)
         from ..copr.parallel import _key_device
 
+        gbudget = extra[0] if grouped else None
+        rvals = extra[1:] if grouped else ()
+
         # ---- build side: filter, partition, exchange ------------------
         b_cols, bm = b_prep(b_datas, b_valids, b_del, b_bounds)
-        bk = ex.combine_keys(
-            [b_cols[kp][0].astype(jnp.int64) for kp in b_key_pos])
+        bk, bmix = mk_keys(b_cols, b_key_pos)
         bk_v = b_cols[b_key_pos[0]][1]
         for kp in b_key_pos[1:]:
             bk_v = bk_v & b_cols[kp][1]
@@ -300,7 +403,7 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             b_arrays.append(d)
             b_arrays.append(v)
         if mode == "shuffle":
-            bpid = ex.partition_ids(bk, S)
+            bpid = ex.partition_ids(bmix, S)
             bucketed, bval, b_over = ex.pack_buckets(
                 bpid, bsel, S, cap_b, b_arrays)
             recv_b = [ex.exchange(a) for a in bucketed]
@@ -314,8 +417,7 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
 
         # ---- probe side ----------------------------------------------
         p_cols, pm = p_prep(p_datas, p_valids, p_del, p_bounds)
-        pk = ex.combine_keys(
-            [p_cols[kp][0].astype(jnp.int64) for kp in p_key_pos])
+        pk, pmix = mk_keys(p_cols, p_key_pos)
         pk_v = p_cols[p_key_pos[0]][1]
         for kp in p_key_pos[1:]:
             pk_v = pk_v & p_cols[kp][1]
@@ -328,7 +430,7 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             p_arrays.append(d)
             p_arrays.append(v)
         if mode == "shuffle":
-            ppid = ex.partition_ids(p_arrays[0], S)
+            ppid = ex.partition_ids(jnp.where(pk_v, pmix, 0), S)
             bucketed, pval, p_over = ex.pack_buckets(
                 ppid, psel, S, cap_p, p_arrays)
             recv_p = [ex.exchange(a) for a in bucketed]
@@ -350,10 +452,11 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             probe_out.append(
                 (recv_p[2 + 2 * j][src], recv_p[3 + 2 * j][src]))
         hit = matched
-        if len(p_key_pos) > 1:
+        if len(p_key_pos) > 1 and pack is None:
             # multi-column keys exchange/sort on a MIX-HASH: candidate
             # spans can hold colliding unequal keys, so re-verify TRUE
             # per-column equality on device before any row counts
+            # (stride-packed keys are exact — no re-verify needed)
             for kp, kb in zip(p_key_pos, b_key_pos):
                 jp = p_order.index(kp)
                 jb = b_order.index(kb)
@@ -389,8 +492,17 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
             # on every shard), and each shard emits its 1/S slice — the
             # readback is O(cap_g), never O(joined rows)
             key_bits, key_flags = [], []
-            for g in group_by:
-                d, v = compile_expr(g, env, cap_out)
+            rslot = 0
+            for gi, g in enumerate(group_by):
+                rem = remaps[gi] if remaps is not None else None
+                if rem is not None:
+                    # computed string key: post-join code-space gather
+                    # through the runtime mapping operand
+                    d0, v = env[rem.src_idx]
+                    d = fusion.remap_codes(d0, rvals[rslot], cap_out)
+                    rslot += 1
+                else:
+                    d, v = compile_expr(g, env, cap_out)
                 k = _key_device(d)
                 zero = (jnp.float64(0.0) if k.dtype == jnp.float64
                         else jnp.int64(0))
@@ -492,8 +604,11 @@ def _build_mpp_fn(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
                                                  range(2 * n_bb)))
     if grouped:
         in_specs = in_specs + (P(),)  # the runtime group-budget slot
+        # replicated remap-mapping operands (computed string keys)
+        in_specs = in_specs + tuple(
+            P() for r in (remaps or ()) if r is not None)
     fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs)
+                   out_specs=out_specs, check_rep=False)
     return _packed_jit(fn)
 
 
@@ -569,7 +684,7 @@ def _assemble_partials(spec: MPPJoinSpec, states, S: int) -> List[Chunk]:
 
 
 def _assemble_grouped(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
-                      n_uniq, keys, states) -> List[Chunk]:
+                      n_uniq, keys, states, remaps=None) -> List[Chunk]:
     """Device-merged grouped partials -> ONE partial chunk in the
     [keys..., states...] layout the root final HashAgg merges.  String
     group keys come back as dictionary codes and decode through the
@@ -585,7 +700,14 @@ def _assemble_grouped(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
         bits = keys[i][:k]
         flags = keys[nk + i][:k].astype(np.bool_)
         ft = g.ftype
-        if ft.kind == TK.FLOAT:
+        rem = remaps[i] if remaps is not None else None
+        if rem is not None:
+            # computed-key codes decode through the remap's OUTPUT
+            # dictionary, not any store column's
+            from ..store.blockstore import _decode_dict
+
+            data = _decode_dict(bits.astype(np.int64), rem.out_dict)
+        elif ft.kind == TK.FLOAT:
             data = bits.astype(np.float64, copy=False)
         elif ft.kind == TK.STRING:
             from ..store.blockstore import _decode_dict
@@ -670,6 +792,21 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
         cap_g0 = _pow2ceil(budget)
         cap_g = S * (-(-cap_g0 // S))
 
+    # exact compound-key packing for multi-column keys (ISSUE 11): the
+    # union of both sides' column stats strides every key into ONE int64,
+    # so equality is exact and LEFT-OUTER multi-key joins are sound on
+    # device; an overflowing key space keeps the mix-hash (inner-only —
+    # left-outer then takes the host rung)
+    pack = _compound_pack(ps, bs)
+    if (spec.kind == "left_outer" and len(spec.probe.key_pos) > 1
+            and pack is None):
+        raise MPPIneligible(
+            "multi-key left-outer join needs exact compound ordering "
+            "(packed key space exceeds int64)")
+    # computed STRING group keys -> per-side dict-code remaps (runtime
+    # mapping operands; MPPIneligible when not remappable)
+    remaps = _mpp_key_remaps(spec, ps, bs)
+
     # column arrays load before the program lookup (compiled programs are
     # specialized on wire dtypes / null patterns, like the mesh scan)
     ps.load(mesh)
@@ -694,11 +831,14 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
           f"|k={spec.probe.key_pos}|wire={ps.wire_sig}"
           f"|b:{_fingerprint(bs.an, 'filter')}|Tl={bs.Tl}"
           f"|k={spec.build.key_pos}|wire={bs.wire_sig}"
-          f"|aggs={agg_sig}|gb={group_sig}|capg={cap_g}")
+          f"|aggs={agg_sig}|gb={group_sig}|capg={cap_g}"
+          f"|pack={pack}"
+          + (f"|rcaps={[r.cap if r else None for r in remaps]}"
+             if remaps else ""))
     fn = _COMPILED.get(fp)
     if fn is None:
         fn = _build_mpp_fn(spec, ps, bs, mode, mesh, cap_p, cap_b,
-                           cap_out, cap_g)
+                           cap_out, cap_g, pack=pack, remaps=remaps)
         _COMPILED.put(fp, fn)
 
     # deterministic mid-shuffle fault injection (chaos harness): fires
@@ -724,6 +864,9 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
             bounds_args(bs))
     if grouped:
         args = args + (jnp.int64(budget),)
+        for r in (remaps or ()):
+            if r is not None:
+                args = args + (jnp.asarray(r.mapping),)
     # dispatch-time membership guard (coordination follow-up (a)): a
     # cross-host membership move between mesh build and this exchange
     # program raises the typed retriable CoordEpochMismatch — the rung
@@ -777,7 +920,8 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     if grouped:
         REGISTRY.inc("mpp_grouped_agg_pushed_total")
         annotate(groups=int(out[4][0]), group_budget=budget)
-        return _assemble_grouped(spec, ps, bs, out[4], out[5], out[6])
+        return _assemble_grouped(spec, ps, bs, out[4], out[5], out[6],
+                                 remaps=remaps)
     if spec.aggs is not None:
         return _assemble_partials(spec, out[2], S)
     return _assemble_rows(spec, ps, bs, out[2], out[3])

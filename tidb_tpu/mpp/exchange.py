@@ -99,6 +99,40 @@ def combine_keys(keys):
     return h
 
 
+def pack_keys_exact(keys, los, cards):
+    """EXACT compound-key composition (ISSUE 11): stats-bounded key
+    columns pack into ONE int64 by stride multiplication — equal packed
+    keys iff every column is equal, so no collision re-verify is needed
+    and dropping candidates is sound for LEFT-OUTER joins (the mix-hash
+    cannot promise that).  Callers guarantee prod(cards) <= 2**62 and
+    that `los`/`cards` cover BOTH sides' value ranges (the union of
+    per-side column stats)."""
+    h = jnp.zeros_like(keys[0])
+    for k, lo, card in zip(keys, los, cards):
+        h = h * card + jnp.clip(k - lo, 0, card - 1)
+    return h
+
+
+def compound_pack_spec(stat_pairs, max_bits: int = 62):
+    """(los, cards) for pack_keys_exact from per-key ((lo,hi), (lo,hi))
+    stat pairs (probe side, build side), or None when the packed space
+    exceeds 2**max_bits — callers then keep the mix-hash ladder."""
+    los, cards = [], []
+    total = 1
+    for (p_lo, p_hi), (b_lo, b_hi) in stat_pairs:
+        lo = min(p_lo, b_lo)
+        hi = max(p_hi, b_hi)
+        if hi < lo:
+            lo, hi = 0, 0
+        card = hi - lo + 1
+        total *= card
+        if total > (1 << max_bits):
+            return None
+        los.append(int(lo))
+        cards.append(int(card))
+    return los, cards
+
+
 def sorted_build(keys, valid):
     """(sorted keys with invalid rows pushed to +inf, source order,
     valid count) — the device hash table: searchsorted probes against

@@ -31,7 +31,8 @@ from ..copr.ir import (
 from ..errors import KVError, PlanError
 from ..expr.aggregation import AggDesc
 from ..expr.expression import ColumnExpr, Constant, Expression, ScalarFunc
-from ..expr.pushdown import can_push_agg, can_push_expr
+from ..expr.pushdown import (can_push_agg, can_push_expr,
+                             can_remap_group_key)
 from ..store.kv import KeyRange
 from ..store.regions import INF
 from ..types import FieldType, TypeKind, common_compare_type
@@ -1308,6 +1309,9 @@ def _physical_agg(plan: LogicalAggregation,
             ok = all(
                 can_push_expr(g, pctx.pushdown_blacklist, dict_uids)
                 or _is_plain_col(g)
+                # computed STRING keys over dict columns lower to device
+                # dict-code re-mapping (ISSUE 11) — push the agg
+                or can_remap_group_key(g, dict_uids)
                 for g in plan.group_by
             ) and all(
                 can_push_agg(a, pctx.pushdown_blacklist, dict_uids)
@@ -1703,12 +1707,11 @@ def _mpp_join_parts(join: LogicalJoin, pctx: PhysicalContext):
     if join.kind not in ("inner", "left_outer") or not join.eq_conds \
             or join.other_conds:
         return None
-    # multi-column keys exchange on a mix-hash whose collisions are
-    # filtered per-candidate on device — that drops candidate rows,
-    # which is only sound for inner joins (a left-outer probe row could
-    # lose its NULL-extension slot to a collision)
-    if len(join.eq_conds) > 1 and join.kind != "inner":
-        return None
+    # multi-column LEFT-OUTER keys are planner-eligible since ISSUE 11:
+    # the engine composes them EXACTLY (stride packing over both sides'
+    # column stats — mpp/exchange.pack_keys_exact), so no probe row can
+    # lose its NULL-extension slot to a hash collision; key spaces too
+    # wide to pack raise MPPIneligible at run time and take the host rung
     if not pctx.allow_mpp or not pctx.enable_pushdown \
             or pctx.prefer_merge_join:
         return None
@@ -1950,11 +1953,16 @@ def _try_mpp_join_agg(plan: LogicalAggregation, join: LogicalJoin,
         g.collect_columns(refs)
         if any(u not in probe_uids and u not in build_pos for u in refs):
             return None
+        remappable = can_remap_group_key(g, dict_uids)
         if not (can_push_expr(g, pctx.pushdown_blacklist, dict_uids)
-                or _is_plain_col(g)):
+                or _is_plain_col(g) or remappable):
             return None
-        if g.ftype.kind == TypeKind.STRING and not isinstance(g, ColumnExpr):
-            return None  # dict decode needs a store column, not an expr
+        if (g.ftype.kind == TypeKind.STRING
+                and not isinstance(g, ColumnExpr) and not remappable):
+            # computed STRING keys lower via dict-code re-mapping
+            # (ISSUE 11 / MPP follow-up (d)); anything else still needs
+            # a store column for the dict decode
+            return None
         group_by.append(g.remap_columns(mapping))
     aggs = []
     for a in plan.aggs:

@@ -17,6 +17,25 @@ from ..metrics import REGISTRY
 VERSION = "8.0.11-tidb-tpu-0.1.0"
 
 
+def _fusion_section(snap: dict) -> dict:
+    """Per-reason fusion-split breakdown (ISSUE 11): the measured
+    inventory of why fragments still split to host tails."""
+    try:
+        from ..copr.fusion import SPLIT_REASONS
+
+        return {
+            "splits_total": snap.get("fusion_splits_total", 0),
+            "by_reason": {
+                r: snap.get(
+                    "fusion_splits_reason_"
+                    + r.replace("-", "_") + "_total", 0)
+                for r in SPLIT_REASONS
+            },
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
 def _layout_section() -> dict:
     """The /status layout payload (never lets a tuner hiccup 500 the
     status port)."""
@@ -136,6 +155,10 @@ class StatusServer:
                         # encoding/tier decisions, hot/cold tier byte
                         # gauges and the cold-tier traffic counters
                         "layout": _layout_section(),
+                        # zero-host-tail compilation (ISSUE 11): region
+                        # splits by reason — regressions in fusion
+                        # coverage are visible per cause at a glance
+                        "fusion": _fusion_section(snap),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

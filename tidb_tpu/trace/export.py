@@ -24,6 +24,7 @@ def trace_payload(tr: QueryTrace) -> dict:
     correlation id to the TRACE FORMAT='json' tree)."""
     d = tr.to_dict()
     d["qid"] = getattr(tr, "qid", None)
+    d["uid"] = getattr(tr, "uid", None)
     return d
 
 
@@ -70,10 +71,16 @@ def graft_or_append(payload: dict, host: Optional[int] = None,
     hang under the coordinator's, not under each other."""
     ring = TRACE_RING if ring is None else ring
     tr = import_trace(payload, host=host)
+    src_uid = payload.get("uid")
     if tr.qid:
         for local in reversed(list(ring)):
             if (getattr(local, "qid", None) == tr.qid
-                    and getattr(local, "imported_from", None) is None):
+                    and getattr(local, "imported_from", None) is None
+                    # never graft a trace under ITSELF: with batched
+                    # background forwarding the origin trace may already
+                    # sit in this process's ring when its payload lands
+                    and (src_uid is None
+                         or getattr(local, "uid", None) != src_uid)):
                 with local._mu:
                     local.root.children.append(tr.root)
                 return "grafted"

@@ -18,6 +18,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import uuid
 import zlib
 from collections import deque
 from contextvars import ContextVar
@@ -29,6 +30,8 @@ from typing import Dict, List, Optional
 #: correlates one statement's traces ACROSS hosts (trace/export.py
 #: grafts a worker's forwarded tree under the coordinator's by qid)
 _TRACE_SEQ = itertools.count()
+_TRACE_UID = itertools.count()
+_PROC_TOKEN = uuid.uuid4().hex[:12]
 
 
 @dataclass
@@ -102,6 +105,15 @@ class QueryTrace:
         # ingest that advanced the coordinator's counter would desync
         # qids from the workers' forever after the first forwarded trace.
         self.imported_from: Optional[int] = None
+        # process-unique identity: with forwarding now BATCHED and
+        # backgrounded (coord follow-up (c)), a trace may already sit in
+        # this process's ring when its own payload flushes — the graft
+        # step uses the uid to never graft a trace under itself.  The
+        # token is RANDOM per process, not the pid: containerized SPMD
+        # hosts all run as pid 1 with lockstep statement counters, and a
+        # pid-based uid would collide across hosts and wrongly suppress
+        # cross-host grafts.
+        self.uid = f"{_PROC_TOKEN}-{next(_TRACE_UID)}"
         if imported:
             self.seq = -1
             self.qid: Optional[str] = None
