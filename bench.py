@@ -286,6 +286,12 @@ def _host_fallback_worker():
         out["host_tail"] = host_tail_bench(sess, n)
     except BaseException as e:  # noqa: BLE001
         out["host_tail"] = {"error": repr(e)}
+    # TPC-H residency matrix on the CPU harness (ISSUE 12): the fused
+    # fraction over all 22 queries survives a dead tunnel
+    try:
+        out["tpch_matrix"] = tpch_matrix_bench(scale=1.0)
+    except BaseException as e:  # noqa: BLE001
+        out["tpch_matrix"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -721,7 +727,8 @@ def _count_device_dispatches(sess, sql: str) -> int:
         n = {"d": 0}
 
         def walk(s):
-            if s.name == "copr.device.execute" or (
+            if s.name in ("copr.device.execute", "mpp.rung",
+                          "mpp.tree.final") or (
                     s.name == "copr.compile"
                     and (s.attrs or {}).get("cache") == "miss"):
                 n["d"] += 1
@@ -996,6 +1003,69 @@ def layout_bench(sess, n: int) -> dict:
     return out
 
 
+def tpch_matrix_bench(scale: float = 2.0) -> dict:
+    """Full-suite residency matrix (ISSUE 12): all 22 TPC-H queries
+    classified fused (every scan/join/agg engine-attributed to the
+    device: mesh or mpp) / partial (mixed) / host, with steady-state
+    rows/s and device-dispatch counts — the fused fraction is the
+    PR-over-PR tracking number for the paper's all-22-on-device arc."""
+    import re
+
+    from tidb_tpu.tpch_data import (TPCH_N_TABLES, TPCH_QUERIES,
+                                    build_tpch_domain)
+
+    sess = build_tpch_domain(scale=scale)
+    # per-table row counts measured off the built domain (not
+    # re-derived formulas, which would silently drift from the recipe)
+    sess.execute("set tidb_use_tpu = 0")
+    counts = {t: sess.query(f"select count(*) from {t}")[0][0]
+              for t in ("lineitem", "orders", "customer", "part",
+                        "partsupp", "supplier", "nation", "region")}
+    sess.execute("set tidb_use_tpu = 1")
+    out: dict = {"scale": scale, "queries": {}}
+    matrix = {"fused": [], "partial": [], "host": []}
+    for name in sorted(TPCH_QUERIES,
+                       key=lambda q: int(q.lstrip("q"))):
+        sql = TPCH_QUERIES[name]
+        entry: dict = {"n_tables": TPCH_N_TABLES[name]}
+        try:
+            rows_in = sum(c for t, c in counts.items()
+                          if re.search(rf"\b{t}\b", sql))
+            _, secs = time_query(sess, sql, 1)
+            engines = set()
+            for r in sess.execute("explain analyze " + sql)[0].rows:
+                for m in re.finditer(r"engine:([^\s|]+)", str(r[4])):
+                    engines.add(m.group(1).rstrip(","))
+            device = {e for e in engines
+                      if e.startswith(("mesh", "mpp-"))}
+            if engines and device == engines:
+                klass = "fused"
+            elif device:
+                klass = "partial"
+            else:
+                klass = "host"
+            entry.update({
+                "class": klass,
+                "engines": sorted(engines),
+                "s": round(secs, 4),
+                "rows_per_sec": round(rows_in / secs, 1),
+                "device_dispatches": _count_device_dispatches(sess, sql),
+            })
+        except BaseException as e:  # noqa: BLE001 — receipt survives
+            klass = "host"
+            entry.update({"class": "host", "error": repr(e)})
+        matrix[klass].append(name)
+        out["queries"][name] = entry
+    out["matrix"] = matrix
+    out["fused_count"] = len(matrix["fused"])
+    out["fused_ge4_tables"] = [q for q in matrix["fused"]
+                               if TPCH_N_TABLES[q] >= 4]
+    log(f"tpch_matrix: fused={len(matrix['fused'])}/22 "
+        f"(>=4-table fused: {out['fused_ge4_tables']}) "
+        f"partial={len(matrix['partial'])} host={len(matrix['host'])}")
+    return out
+
+
 def _run(state: dict):
     try:
         _run_inner(state)
@@ -1202,6 +1272,20 @@ def _run_inner(state: dict):
         except BaseException as e:  # noqa: BLE001 — receipt survives
             state["host_tail"] = {"error": repr(e)}
         state["phases"]["host_tail_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
+    # TPC-H residency matrix (ISSUE 12): per-query fused/partial/host
+    # classification over all 22 queries — the join-tree compiler's
+    # fused fraction, tracked PR over PR.  Gate above the stubbed-loop
+    # wall budget (tests run _run_inner with WALL_LIMIT=140): the
+    # matrix builds its own real domain, ~22 compiles
+    if remaining() > 240:
+        try:
+            state["tpch_matrix"] = tpch_matrix_bench()
+        except BaseException as e:  # noqa: BLE001 — receipt survives
+            state["tpch_matrix"] = {"error": repr(e)}
+        state["phases"]["tpch_matrix_done"] = round(
             time.perf_counter() - T0, 1)
         persist_partial(state)
 

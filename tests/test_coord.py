@@ -453,6 +453,70 @@ def test_coordinator_plane_take_handoff_reads_live_store():
     assert plane.take_handoff() == []  # consumed exactly once
 
 
+def test_coordinator_kill_restart_replays_state(tmp_path):
+    """Persist-backed coordinator (ISSUE 12 / ROADMAP coord (b)): a
+    coordinator killed mid-epoch REPLAYS membership + parked handoff
+    from the persist layer on restart — the epoch resumes strictly
+    above anything ever broadcast, and a parked session survives the
+    kill to ride back to its re-registering worker."""
+    path = str(tmp_path / "coord_state.json")
+    c = Coordinator(lease_s=30.0, expect=2, state_path=path)
+    c.start()
+    w1 = w2 = None
+    try:
+        w1 = WorkerPlane(("127.0.0.1", c.port), pid=1, lease_s=30.0,
+                         heartbeat_s=5.0).start([0, 1])
+        w2 = WorkerPlane(("127.0.0.1", c.port), pid=2, lease_s=30.0,
+                         heartbeat_s=5.0).start([2, 3])
+        e0 = c.view().epoch
+        c.put_handoff(1, [{"conn_id": 7, "prepared": {"p": "select 1"}}])
+    finally:
+        c.stop()  # SIGKILL stand-in: no leave protocol ever runs
+        for w in (w1, w2):
+            if w is not None:
+                w.stop()  # leave=False: the state file keeps both pids
+
+    r0 = REGISTRY.snapshot().get("coord_state_replayed_total", 0)
+    c2 = Coordinator(lease_s=30.0, expect=2, state_path=path)
+    c2.start()
+    try:
+        assert REGISTRY.snapshot().get(
+            "coord_state_replayed_total", 0) > r0
+        v = c2.view()
+        # the restart renumbers ONCE above the replayed epoch: surviving
+        # workers' stamped meshes are strictly behind, never ambiguous
+        assert v.epoch > e0
+        assert set(v.members) == {1, 2} and v.formed
+        assert v.members[1] == (0, 1) and v.members[2] == (2, 3)
+        # the parked session rides back on re-registration, exactly once
+        out = c2.register(1, [0, 1])
+        assert out["handoff"] and out["handoff"][0]["conn_id"] == 7
+        assert c2.register(1, [0, 1])["handoff"] == []
+    finally:
+        c2.stop()
+
+    # a third restart still replays (the handoff pop persisted durably)
+    c3 = Coordinator(lease_s=30.0, expect=2, state_path=path)
+    try:
+        assert c3.pop_handoff(1) == []
+        assert c3.view().epoch > v.epoch
+    finally:
+        c3.stop()
+
+
+def test_coordinator_state_survives_torn_write(tmp_path):
+    """A torn/corrupt state file loads as a fresh start, never a crash
+    (the table persister's crash contract)."""
+    path = str(tmp_path / "coord_state.json")
+    with open(path, "w") as f:
+        f.write('{"epoch": 5, "members": {')  # torn mid-document
+    c = Coordinator(lease_s=30.0, state_path=path)
+    try:
+        assert c.view().epoch == 0  # fresh start, no replay
+    finally:
+        c.stop()
+
+
 def test_forwarding_survives_dead_coordinator():
     """A dead coordinator costs a counted RPC error, never a query
     failure."""

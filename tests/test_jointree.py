@@ -1,0 +1,279 @@
+"""Join-tree compiler: multi-way device-resident rung ladders (ISSUE 12).
+
+Acceptance coverage:
+
+- a >=3-table equi-join tree lowers to ONE MPPJoinTree ladder whose
+  intermediate results stay device-resident between rungs — trace-
+  asserted: zero `copr.transfer` spans inside the warm `mpp.tree` span;
+- EXPLAIN shows the chosen join order with est_rows per rung;
+- EXISTS / NOT EXISTS / IN / NOT IN subqueries (Q4-shaped) decorrelate
+  into semi / anti-semi RUNGS of the same ladder, with parity vs the
+  CPU oracle;
+- per-rung overflow steps down the ladder (emission-buffer boost,
+  partition overflow -> broadcast) without wrong results, and the chaos
+  site `mpp/tree_rung` drives the host-chain fallback with parity.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.session import Domain
+
+N_CUST = 300
+N_ORD = 2000
+N_ITEM = 9000
+N_PART = 150
+
+
+@pytest.fixture(scope="module")
+def sess():
+    d = Domain()
+    s = d.new_session()
+    rng = np.random.default_rng(23)
+    s.execute("create table cust (c_id bigint primary key,"
+              " c_nation bigint, c_seg varchar(10))")
+    s.execute("create table ord (o_id bigint primary key,"
+              " o_cust bigint, o_flag bigint, o_total double)")
+    s.execute("create table item (i_ord bigint, i_part bigint,"
+              " i_qty bigint, i_price decimal(12,2))")
+    s.execute("create table part (p_id bigint primary key,"
+              " p_cat varchar(12))")
+    ts = d.storage.current_ts()
+
+    def table(name):
+        return d.storage.table(d.catalog.info_schema().table(
+            "test", name).id)
+
+    segs = np.array(["BUILDING", "MACHINERY", "AUTO", "HOUSE"],
+                    dtype=object)
+    table("cust").bulk_load_arrays([
+        np.arange(N_CUST, dtype=np.int64),
+        rng.integers(0, 12, N_CUST),
+        segs[rng.integers(0, 4, N_CUST)],
+    ], ts=ts)
+    # 60 trailing custkeys get no orders (NOT IN / anti-semi fodder)
+    table("ord").bulk_load_arrays([
+        np.arange(N_ORD, dtype=np.int64),
+        rng.integers(0, N_CUST - 60, N_ORD),
+        rng.integers(0, 5, N_ORD),
+        rng.uniform(10, 9999, N_ORD),
+    ], ts=ts)
+    ik = rng.integers(0, N_ORD * 2, N_ITEM)  # >50% dangling keys
+    ivalid = [np.ones(N_ITEM, np.bool_), None, None, None]
+    ivalid[0][rng.integers(0, N_ITEM, 300)] = False
+    table("item").bulk_load_arrays([
+        ik,
+        rng.integers(0, N_PART, N_ITEM),
+        rng.integers(1, 51, N_ITEM),
+        rng.integers(100, 1_000_000, N_ITEM),
+    ], ivalid, ts=ts)
+    cats = np.array([f"CAT{i:02d}" for i in range(9)], dtype=object)
+    table("part").bulk_load_arrays([
+        np.arange(N_PART, dtype=np.int64),
+        cats[rng.integers(0, 9, N_PART)],
+    ], ts=ts)
+    for t in ("cust", "ord", "item", "part"):
+        s.execute(f"analyze table {t}")
+    s.execute("set tidb_enforce_mpp = 1")
+    return s
+
+
+def _cpu(sess, sql):
+    sess.execute("set tidb_use_tpu = 0")
+    try:
+        return sess.query(sql)
+    finally:
+        sess.execute("set tidb_use_tpu = 1")
+
+
+def _nullsafe(r):
+    return tuple((None is x and (0, "") or (1, x)) for x in r)
+
+
+def _rows_eq(got, want, ctx=""):
+    assert len(got) == len(want), (ctx, len(got), len(want))
+    for ra, rb in zip(sorted(got, key=_nullsafe),
+                      sorted(want, key=_nullsafe)):
+        for a, b in zip(ra, rb):
+            if isinstance(a, float) or isinstance(b, float):
+                assert a == pytest.approx(b, rel=1e-9, abs=1e-9), \
+                    (ctx, ra, rb)
+            else:
+                assert a == b, (ctx, ra, rb)
+
+
+def _snap(*names):
+    s = REGISTRY.snapshot()
+    return tuple(s.get(n, 0) for n in names)
+
+
+def _run_tree(sess, sql):
+    t0, f0 = _snap("mpp_tree_joins_total", "mpp_tree_fallback_total")
+    rows = sess.query(sql)
+    t1, f1 = _snap("mpp_tree_joins_total", "mpp_tree_fallback_total")
+    assert t1 > t0, f"not served by the device rung ladder: {sql}"
+    assert f1 == f0, f"fell back to the host join chain: {sql}"
+    return rows
+
+
+def _spans(sess, name):
+    out = []
+
+    def walk(s):
+        if s.name == name:
+            out.append(s)
+        for c in s.children:
+            walk(c)
+
+    walk(sess.last_trace.root)
+    return out
+
+
+THREE_WAY = ("select i_qty, i_price, o_flag, o_total, c_nation"
+             " from item join ord on i_ord = o_id"
+             " join cust on o_cust = c_id where i_qty < 40")
+FOUR_WAY_AGG = ("select c_nation, count(*), sum(i_price)"
+                " from item join ord on i_ord = o_id"
+                " join cust on o_cust = c_id"
+                " join part on i_part = p_id"
+                " where o_flag < 4 group by c_nation")
+EXISTS_Q4 = ("select o_flag, count(*) from ord"
+             " where exists (select 1 from item"
+             "               where i_ord = o_id and i_qty > 30)"
+             " group by o_flag")
+NOT_EXISTS = ("select count(*), sum(o_total) from ord"
+              " where not exists (select 1 from item"
+              "                   where i_ord = o_id and i_qty > 45)")
+IN_SUB = ("select o_flag, count(*) from ord"
+          " where o_cust in (select c_id from cust"
+          "                  where c_seg = 'BUILDING')"
+          " group by o_flag")
+NOT_IN = ("select count(*) from cust"
+          " where c_id not in (select o_cust from ord)")
+
+
+def test_explain_shows_join_order_and_est_rows(sess):
+    rows = sess.execute("explain " + THREE_WAY)[0].rows
+    plan = "\n".join(" | ".join(str(x) for x in r) for r in rows)
+    assert "MPPJoinTree" in plan and "mpp[tpu]" in plan, plan
+    assert "order: " in plan, plan
+    rungs = [r for r in rows if r[0].strip().startswith("└─Rung_")]
+    assert len(rungs) == 2, plan
+    for r in rungs:
+        assert float(r[1]) >= 1.0, r  # est_rows annotated per rung
+        assert "build:" in r[3], r
+
+
+def test_three_way_rows_parity(sess):
+    got = _run_tree(sess, THREE_WAY)
+    assert len(got) > 0
+    _rows_eq(got, _cpu(sess, THREE_WAY), "3way-rows")
+
+
+def test_four_way_grouped_agg_parity(sess):
+    got = _run_tree(sess, FOUR_WAY_AGG)
+    assert len(got) > 0
+    _rows_eq(got, _cpu(sess, FOUR_WAY_AGG), "4way-agg")
+
+
+def test_no_transfers_between_rungs_when_warm(sess):
+    """Device residency: on a warm column cache the whole ladder runs
+    with ZERO copr.transfer spans — intermediate results never leave
+    HBM between rungs (ISSUE 12 acceptance)."""
+    sess.query(FOUR_WAY_AGG)  # warm the compiled programs + cache
+    sess.query(FOUR_WAY_AGG)
+    sess.execute("trace " + FOUR_WAY_AGG)
+    trees = _spans(sess, "mpp.tree")
+    assert trees, "query no longer served by the rung ladder"
+    rungs = _spans(sess, "mpp.rung")
+    assert len(rungs) == 3, [s.attrs for s in rungs]
+    transfers = _spans(sess, "copr.transfer")
+    assert not transfers, (
+        f"{len(transfers)} host transfers inside the warm ladder")
+    finals = _spans(sess, "mpp.tree.final")
+    assert len(finals) == 1  # on-device partial agg, O(G) readback
+
+
+def test_exists_decorrelates_to_semi_rung(sess):
+    rows = sess.execute("explain " + EXISTS_Q4)[0].rows
+    plan = "\n".join(" | ".join(str(x) for x in r) for r in rows)
+    assert "MPPJoinTree" in plan, plan
+    assert "semi build:item" in plan, plan
+    got = _run_tree(sess, EXISTS_Q4)
+    assert len(got) > 0
+    _rows_eq(got, _cpu(sess, EXISTS_Q4), "exists-q4")
+
+
+def test_not_exists_decorrelates_to_anti_rung(sess):
+    rows = sess.execute("explain " + NOT_EXISTS)[0].rows
+    plan = "\n".join(" | ".join(str(x) for x in r) for r in rows)
+    assert "anti_semi build:item" in plan, plan
+    got = _run_tree(sess, NOT_EXISTS)
+    _rows_eq(got, _cpu(sess, NOT_EXISTS), "not-exists")
+
+
+def test_in_and_not_in_subqueries_parity(sess):
+    got = _run_tree(sess, IN_SUB)
+    assert len(got) > 0
+    _rows_eq(got, _cpu(sess, IN_SUB), "in-sub")
+    got = _run_tree(sess, NOT_IN)
+    assert got[0][0] > 0  # the 60 order-less custkeys
+    _rows_eq(got, _cpu(sess, NOT_IN), "not-in")
+
+
+def test_correlated_exists_with_noneq_conjunct(sess):
+    """A correlated non-equality conjunct rides as a rung other-cond,
+    evaluated per candidate pair on device."""
+    q = ("select count(*) from ord"
+         " where exists (select 1 from item"
+         "               where i_ord = o_id and i_price > o_total)")
+    got = _run_tree(sess, q)
+    _rows_eq(got, _cpu(sess, q), "corr-noneq")
+
+
+def test_emission_overflow_boosts_rung_buffer(sess):
+    """An emission-buffer overflow grows THAT rung's cap_out and
+    retries on device (duplicate keys expand past the estimate)."""
+    from tidb_tpu.mpp.jointree import MPPTreeOverflow
+    from tidb_tpu.store.fault import failpoint, once
+
+    with failpoint("mpp/tree_rung",
+                   once(MPPTreeOverflow(0, "emit", "chaos emit"))):
+        got = _run_tree(sess, THREE_WAY)
+    _rows_eq(got, _cpu(sess, THREE_WAY), "emit-boost")
+
+
+def test_partition_overflow_demotes_rung_to_broadcast(sess):
+    """Partition-bucket overflow steps ONE rung down to the broadcast
+    strategy; the rest of the ladder stays on shuffle."""
+    from tidb_tpu.mpp.jointree import MPPTreeOverflow
+    from tidb_tpu.store.fault import failpoint, once
+
+    with failpoint("mpp/tree_rung",
+                   once(MPPTreeOverflow(1, "partition", "chaos part"))):
+        got = _run_tree(sess, THREE_WAY)
+    _rows_eq(got, _cpu(sess, THREE_WAY), "bcast-demote")
+    sess.execute("trace " + THREE_WAY)  # disarmed: all-shuffle again
+    assert _spans(sess, "mpp.tree"), "ladder did not recover"
+
+
+def test_chaos_ineligible_falls_back_to_host_chain(sess):
+    """A structural decline mid-ladder serves the SAME join order as
+    chained host hash joins — correctness never depends on the mesh."""
+    from tidb_tpu.mpp.engine import MPPIneligible
+    from tidb_tpu.store.fault import failpoint, once
+
+    f0 = _snap("mpp_tree_fallback_total")[0]
+    with failpoint("mpp/tree_rung", once(MPPIneligible("chaos"))):
+        got = sess.query(THREE_WAY)
+    assert _snap("mpp_tree_fallback_total")[0] > f0
+    _rows_eq(got, _cpu(sess, THREE_WAY), "host-chain")
+    _run_tree(sess, THREE_WAY)  # disarmed: back on the device ladder
+
+
+def test_explain_analyze_attributes_tree_engine(sess):
+    rows = sess.execute("explain analyze " + THREE_WAY)[0].rows
+    trees = [r for r in rows if "MPPJoinTree" in r[0]]
+    assert trees, rows
+    assert any("engine:mpp-tree" in str(r[4]) for r in trees), trees

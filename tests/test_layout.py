@@ -60,18 +60,25 @@ def _mk_domain(n=N, seed=7):
 @pytest.fixture
 def layout_env(monkeypatch):
     """Fast re-tunes + guaranteed restoration of the hot cap, tiers and
-    tuner state (the LAYOUT engine and caches are process-global)."""
+    tuner state (the LAYOUT engine and caches are process-global).
+
+    EVERY env knob the layout engine reads (`TIDB_TPU_HBM_BYTES`,
+    `TIDB_TPU_LAYOUT`, the cache capacities) is snapshotted here and
+    restored on teardown — tests mutating layout state outside this
+    fixture were a known cross-test flake source (ISSUE 12 hygiene)."""
     from tidb_tpu.copr.parallel import MESH_CACHE
     from tidb_tpu.layout import LAYOUT, coldtier
 
     monkeypatch.setenv("TIDB_TPU_LAYOUT_RETUNE_S", "0")
     old_cap = MESH_CACHE._c.capacity
-    old_env = os.environ.get("TIDB_TPU_HBM_BYTES")
+    saved = {k: os.environ.get(k)
+             for k in ("TIDB_TPU_HBM_BYTES", "TIDB_TPU_LAYOUT")}
     yield
-    if old_env is None:
-        os.environ.pop("TIDB_TPU_HBM_BYTES", None)
-    else:
-        os.environ["TIDB_TPU_HBM_BYTES"] = old_env
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
     MESH_CACHE._c.capacity = old_cap
     MESH_CACHE.clear()
     coldtier.clear()
@@ -310,32 +317,25 @@ def test_eviction_demotes_then_promotes(layout_env):
         "layout_cold_promotions_total", 0)
 
 
-def test_retune_rate_limit(monkeypatch):
-    from tidb_tpu.copr.parallel import MESH_CACHE
-    from tidb_tpu.layout import LAYOUT, coldtier, set_hot_cap_bytes
+def test_retune_rate_limit(layout_env, monkeypatch):
+    from tidb_tpu.layout import LAYOUT, set_hot_cap_bytes
 
+    # layout_env snapshots/restores the env knobs and caches; this test
+    # only needs a SLOW retune window on top of it
     monkeypatch.setenv("TIDB_TPU_LAYOUT_RETUNE_S", "3600")
-    old_cap = MESH_CACHE._c.capacity
-    try:
-        d, s = _mk_domain(n=4096)
-        store = d.storage.table(
-            d.catalog.info_schema().table("test", "li").id)
-        set_hot_cap_bytes(10_000)
-        p0 = LAYOUT.plan_for(store, 0)
-        assert p0.tier == "cold"
-        # pressure vanishes immediately: the class flip is SUPPRESSED
-        # (rate limit) — no refingerprint storm from a flapping signal
-        m0 = REGISTRY.get("layout_retunes_suppressed_total")
-        set_hot_cap_bytes(8 << 30)
-        p1 = LAYOUT.plan_for(store, 0)
-        assert p1.tier == "cold"  # kept the old class
-        assert REGISTRY.get("layout_retunes_suppressed_total") > m0
-    finally:
-        os.environ.pop("TIDB_TPU_HBM_BYTES", None)
-        MESH_CACHE._c.capacity = old_cap
-        MESH_CACHE.clear()
-        coldtier.clear()
-        LAYOUT.reset()
+    d, s = _mk_domain(n=4096)
+    store = d.storage.table(
+        d.catalog.info_schema().table("test", "li").id)
+    set_hot_cap_bytes(10_000)
+    p0 = LAYOUT.plan_for(store, 0)
+    assert p0.tier == "cold"
+    # pressure vanishes immediately: the class flip is SUPPRESSED
+    # (rate limit) — no refingerprint storm from a flapping signal
+    m0 = REGISTRY.get("layout_retunes_suppressed_total")
+    set_hot_cap_bytes(8 << 30)
+    p1 = LAYOUT.plan_for(store, 0)
+    assert p1.tier == "cold"  # kept the old class
+    assert REGISTRY.get("layout_retunes_suppressed_total") > m0
 
 
 # ---------------------------------------------------------------------------

@@ -254,10 +254,27 @@ order by 2 desc, o_orderdate limit 10
 
 
 def test_q3_plans_runtime_filter(q3_sess):
-    rs = q3_sess.execute("explain " + Q3)[0]
+    """With MPP lanes off, Q3 keeps the host hash-join plan whose build
+    side pushes a runtime filter into the probe scan.  (With MPP on the
+    join-tree compiler now owns this shape end-to-end — ISSUE 12 — so
+    the runtime-filter lane is the fallback under test here.)"""
+    q3_sess.execute("set tidb_allow_mpp = 0")
+    try:
+        rs = q3_sess.execute("explain " + Q3)[0]
+    finally:
+        q3_sess.execute("set tidb_allow_mpp = 1")
     plan = "\n".join(str(r) for r in rs.rows)
     assert "JoinProbe" in plan, plan
     assert "runtime-filter" in plan, plan
+
+
+def test_q3_plans_device_join_tree(q3_sess):
+    """The default plan for the Q3 shape is now the device rung ladder
+    with the chosen join order and per-rung estimates."""
+    rs = q3_sess.execute("explain " + Q3)[0]
+    plan = "\n".join(str(r) for r in rs.rows)
+    assert "MPPJoinTree" in plan, plan
+    assert "order: " in plan, plan
 
 
 def test_q3_parity_with_device_probe(q3_sess):

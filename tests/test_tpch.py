@@ -2,402 +2,24 @@
 
 Every query runs on BOTH engines — device (jax) and host oracle (numpy) —
 and the result sets must be identical (the north-star's result-identity
-requirement).  Data is synthetic TPC-H-shaped at a tiny scale factor,
-deterministic, loaded through the columnar bulk path with multi-region
-splits so the DP fan-out is exercised.
+requirement).  The schema/data recipe AND the 22-query corpus live in
+tidb_tpu/tpch_data.py (shared with bench.py's `tpch_matrix` receipt so
+the parity suite and the fused-fraction receipt can never drift apart).
 
 Reference: cmd/explaintest/t/tpch.test (golden TPC-H plans).
 """
 
-import numpy as np
 import pytest
 
-from tidb_tpu.session import Domain
-from tidb_tpu.types.values import parse_date
-
-N_LINE = 8000
-N_ORDERS = 2000
-N_CUST = 300
-N_PART = 200
-N_SUPP = 40
-N_NATION = 25
+from tidb_tpu.tpch_data import TPCH_QUERIES, build_tpch_domain
 
 
 @pytest.fixture(scope="module")
 def sess():
-    d = Domain()
-    s = d.new_session()
-    rng = np.random.default_rng(1234)
-    base = parse_date("1992-01-01")
-    span = parse_date("1998-12-01") - base
-
-    def load(name, ddl, arrays):
-        s.execute(ddl)
-        t = d.catalog.info_schema().table("test", name)
-        store = d.storage.table(t.id)
-        store.bulk_load_arrays(arrays, ts=d.storage.current_ts())
-        d.storage.regions.split_even(t.id, 4, store.base_rows)
-        return t
-
-    load("nation", "create table nation (n_nationkey bigint, n_name "
-         "varchar(25), n_regionkey bigint)", [
-        np.arange(N_NATION, dtype=np.int64),
-        np.array([f"NATION{i:02d}" for i in range(N_NATION)], dtype=object),
-        rng.integers(0, 5, N_NATION, dtype=np.int64),
-    ])
-    load("region", "create table region (r_regionkey bigint, r_name "
-         "varchar(25))", [
-        np.arange(5, dtype=np.int64),
-        np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"],
-                 dtype=object),
-    ])
-    scomments = np.array(["quick brown fox", "Customer stuff Complaints",
-                          "regular deposits", "silent Customer noise"],
-                         dtype=object)
-    load("supplier", "create table supplier (s_suppkey bigint, s_name "
-         "varchar(25), s_nationkey bigint, s_acctbal decimal(12,2), "
-         "s_comment varchar(40))", [
-        np.arange(N_SUPP, dtype=np.int64),
-        np.array([f"SUPP{i:04d}" for i in range(N_SUPP)], dtype=object),
-        rng.integers(0, N_NATION, N_SUPP, dtype=np.int64),
-        np.round(rng.uniform(-999, 9999, N_SUPP) * 100).astype(np.int64),
-        scomments[rng.integers(0, 4, N_SUPP)],
-    ])
-    load("partsupp", "create table partsupp (ps_partkey bigint, ps_suppkey "
-         "bigint, ps_availqty bigint, ps_supplycost decimal(12,2))", [
-        np.repeat(np.arange(N_PART, dtype=np.int64), 4),
-        rng.integers(0, N_SUPP, N_PART * 4, dtype=np.int64),
-        rng.integers(1, 10000, N_PART * 4, dtype=np.int64),
-        np.round(rng.uniform(1, 1000, N_PART * 4) * 100).astype(np.int64),
-    ])
-    phones = np.array([f"{cc}-555-{i:04d}" for i, cc in zip(
-        range(N_CUST),
-        np.array(["13", "31", "23", "29", "30", "18", "17", "44", "99"])[
-            rng.integers(0, 9, N_CUST)])], dtype=object)
-    load("customer", "create table customer (c_custkey bigint, c_name "
-         "varchar(25), c_nationkey bigint, c_mktsegment varchar(10), "
-         "c_acctbal decimal(12,2), c_phone varchar(15))", [
-        np.arange(N_CUST, dtype=np.int64),
-        np.array([f"CUST{i:05d}" for i in range(N_CUST)], dtype=object),
-        rng.integers(0, N_NATION, N_CUST, dtype=np.int64),
-        np.array(["BUILDING", "MACHINERY", "AUTOMOBILE", "HOUSEHOLD",
-                  "FURNITURE"], dtype=object)[rng.integers(0, 5, N_CUST)],
-        np.round(rng.uniform(-999, 9999, N_CUST) * 100).astype(np.int64),
-        phones,
-    ])
-    load("part", "create table part (p_partkey bigint, p_name varchar(30), "
-         "p_type varchar(25), p_size bigint, p_brand varchar(10))", [
-        np.arange(N_PART, dtype=np.int64),
-        np.array([f"PART{i:05d}" for i in range(N_PART)], dtype=object),
-        np.array(["PROMO BRUSHED", "STANDARD POLISHED", "SMALL PLATED",
-                  "MEDIUM BURNISHED"], dtype=object)[
-            rng.integers(0, 4, N_PART)],
-        rng.integers(1, 50, N_PART, dtype=np.int64),
-        np.array([f"Brand#{i}" for i in range(1, 6)], dtype=object)[
-            rng.integers(0, 5, N_PART)],
-    ])
-    odate = (base + rng.integers(0, span, N_ORDERS)).astype(np.int32)
-    ocomments = np.array(["ordinary request", "special packed requests",
-                          "pending special asks", "normal special requests",
-                          "quiet commentary"], dtype=object)
-    load("orders", "create table orders (o_orderkey bigint, o_custkey "
-         "bigint, o_orderstatus varchar(1), o_totalprice decimal(15,2), "
-         "o_orderdate date, o_orderpriority varchar(15), "
-         "o_comment varchar(40))", [
-        np.arange(N_ORDERS, dtype=np.int64),
-        # leave the top 60 custkeys order-less so NOT IN subqueries hit
-        rng.integers(0, N_CUST - 60, N_ORDERS, dtype=np.int64),
-        np.array(["O", "F", "P"], dtype=object)[
-            rng.integers(0, 3, N_ORDERS)],
-        np.round(rng.uniform(1000, 400000, N_ORDERS) * 100).astype(np.int64),
-        odate,
-        np.array(["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
-                  "5-LOW"], dtype=object)[rng.integers(0, 5, N_ORDERS)],
-        ocomments[rng.integers(0, 5, N_ORDERS)],
-    ])
-    okeys = rng.integers(0, N_ORDERS, N_LINE, dtype=np.int64)
-    sdate = odate[okeys] + rng.integers(1, 120, N_LINE).astype(np.int32)
-    cdate = sdate + rng.integers(-30, 30, N_LINE).astype(np.int32)
-    rdate = sdate + rng.integers(1, 30, N_LINE).astype(np.int32)
-    load("lineitem", "create table lineitem (l_orderkey bigint, l_partkey "
-         "bigint, l_suppkey bigint, l_quantity decimal(15,2), "
-         "l_extendedprice decimal(15,2), l_discount decimal(15,2), "
-         "l_tax decimal(15,2), "
-         "l_returnflag varchar(1), l_linestatus varchar(1), "
-         "l_shipdate date, l_commitdate date, l_receiptdate date, "
-         "l_shipmode varchar(10))", [
-        okeys,
-        rng.integers(0, N_PART, N_LINE, dtype=np.int64),
-        rng.integers(0, N_SUPP, N_LINE, dtype=np.int64),
-        rng.integers(100, 5100, N_LINE, dtype=np.int64),  # scaled .2
-        np.round(rng.uniform(900, 105000, N_LINE) * 100).astype(np.int64),
-        np.round(rng.uniform(0.0, 0.1, N_LINE) * 100).astype(np.int64),
-        np.round(rng.uniform(0.0, 0.08, N_LINE) * 100).astype(np.int64),
-        np.array(["A", "N", "R"], dtype=object)[rng.integers(0, 3, N_LINE)],
-        np.array(["O", "F"], dtype=object)[rng.integers(0, 2, N_LINE)],
-        sdate,
-        cdate,
-        rdate,
-        np.array(["AIR", "MAIL", "SHIP", "TRUCK", "RAIL", "REG AIR",
-                  "FOB"], dtype=object)[rng.integers(0, 7, N_LINE)],
-    ])
-    for t in ("lineitem", "orders", "customer"):
-        s.execute(f"analyze table {t}")
-    return s
+    return build_tpch_domain()
 
 
-QUERIES = {
-    "q1": """
-select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
-       sum(l_extendedprice) as sum_base_price,
-       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
-       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
-       avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
-       avg(l_discount) as avg_disc, count(*) as count_order
-from lineitem
-where l_shipdate <= date '1998-09-02'
-group by l_returnflag, l_linestatus
-order by l_returnflag, l_linestatus""",
-    "q3": """
-select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
-       o_orderdate
-from customer, orders, lineitem
-where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
-  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
-  and l_shipdate > date '1995-03-15'
-group by l_orderkey, o_orderdate
-order by revenue desc, o_orderkey
-limit 10""",
-    "q5": """
-select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
-from customer, orders, lineitem, supplier, nation
-where c_custkey = o_custkey and l_orderkey = o_orderkey
-  and l_suppkey = s_suppkey and c_nationkey = s_nationkey
-  and s_nationkey = n_nationkey
-  and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'
-group by n_name order by revenue desc""",
-    "q6": """
-select sum(l_extendedprice * l_discount) as revenue
-from lineitem
-where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
-  and l_discount between 0.05 and 0.07 and l_quantity < 24""",
-    "q10": """
-select c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) as revenue
-from customer, orders, lineitem
-where c_custkey = o_custkey and l_orderkey = o_orderkey
-  and o_orderdate >= date '1993-10-01' and o_orderdate < date '1994-01-01'
-  and l_returnflag = 'R'
-group by c_custkey, c_name
-order by revenue desc, c_custkey limit 20""",
-    "q12": """
-select l_shipmode,
-       sum(case when o_orderpriority = '1-URGENT'
-                  or o_orderpriority = '2-HIGH' then 1 else 0 end)
-         as high_line_count,
-       sum(case when o_orderpriority <> '1-URGENT'
-                 and o_orderpriority <> '2-HIGH' then 1 else 0 end)
-         as low_line_count
-from orders join lineitem on o_orderkey = l_orderkey
-where l_shipmode in ('MAIL', 'SHIP')
-  and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
-  and l_receiptdate >= date '1994-01-01'
-  and l_receiptdate < date '1995-01-01'
-group by l_shipmode order by l_shipmode""",
-    "q13": """
-select c_count, count(*) as custdist from (
-  select c_custkey, count(o_orderkey) as c_count
-  from customer left join orders on c_custkey = o_custkey
-      and o_comment not like '%special%requests%'
-  group by c_custkey
-) c_orders
-group by c_count
-order by custdist desc, c_count desc limit 10""",
-    "q14": """
-select 100.00 * sum(case when p_type like 'PROMO%%'
-                         then l_extendedprice * (1 - l_discount)
-                         else 0 end) / sum(l_extendedprice * (1 - l_discount))
-       as promo_revenue
-from lineitem, part
-where l_partkey = p_partkey
-  and l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'""",
-    "q18": """
-select c_custkey, o_orderkey, o_totalprice, sum(l_quantity)
-from customer, orders, lineitem
-where o_orderkey in (
-    select l_orderkey from lineitem group by l_orderkey
-    having sum(l_quantity) > 100
-  )
-  and c_custkey = o_custkey and o_orderkey = l_orderkey
-group by c_custkey, o_orderkey, o_totalprice
-order by o_totalprice desc, o_orderkey limit 10""",
-    "q19": """
-select sum(l_extendedprice * (1 - l_discount)) as revenue
-from lineitem, part
-where p_partkey = l_partkey
-  and ((p_size >= 1 and p_size <= 15 and l_quantity >= 1)
-       or (p_size >= 16 and l_quantity >= 10))
-  and l_shipdate >= date '1994-01-01'""",
-    "q4": """
-select o_orderpriority, count(*) as order_count
-from orders
-where o_orderdate >= date '1993-07-01' and o_orderdate < date '1993-10-01'
-  and exists (select 1 from lineitem
-              where l_orderkey = o_orderkey and l_shipdate > o_orderdate)
-group by o_orderpriority order by o_orderpriority""",
-    "q17": """
-select sum(l_extendedprice) / 7.0 as avg_yearly
-from lineitem, part
-where p_partkey = l_partkey and p_type = 'PROMO BRUSHED'
-  and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
-                    where l_partkey = p_partkey)""",
-    "q2": """
-select s_acctbal, s_name, n_name, p_partkey, p_name
-from part, supplier, partsupp, nation, region
-where p_partkey = ps_partkey and s_suppkey = ps_suppkey
-  and p_size < 25 and p_type like '%%POLISHED%%'
-  and s_nationkey = n_nationkey and n_regionkey = r_regionkey
-  and r_name = 'EUROPE'
-  and ps_supplycost = (
-    select min(ps_supplycost)
-    from partsupp, supplier, nation, region
-    where p_partkey = ps_partkey and s_suppkey = ps_suppkey
-      and s_nationkey = n_nationkey and n_regionkey = r_regionkey
-      and r_name = 'EUROPE')
-order by s_acctbal desc, n_name, s_name, p_partkey limit 100""",
-    "q7": """
-select supp_nation, cust_nation, l_year, sum(volume) as revenue
-from (
-  select n1.n_name as supp_nation, n2.n_name as cust_nation,
-         year(l_shipdate) as l_year,
-         l_extendedprice * (1 - l_discount) as volume
-  from supplier, lineitem, orders, customer, nation n1, nation n2
-  where s_suppkey = l_suppkey and o_orderkey = l_orderkey
-    and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
-    and c_nationkey = n2.n_nationkey
-    and ((n1.n_name = 'NATION01' and n2.n_name = 'NATION02')
-         or (n1.n_name = 'NATION02' and n2.n_name = 'NATION01'))
-    and l_shipdate between date '1995-01-01' and date '1996-12-31'
-) shipping
-group by supp_nation, cust_nation, l_year
-order by supp_nation, cust_nation, l_year""",
-    "q8": """
-select o_year,
-       sum(case when nation = 'NATION02' then volume else 0 end)
-         / sum(volume) as mkt_share
-from (
-  select year(o_orderdate) as o_year,
-         l_extendedprice * (1 - l_discount) as volume,
-         n2.n_name as nation
-  from part, supplier, lineitem, orders, customer, nation n1, nation n2,
-       region
-  where p_partkey = l_partkey and s_suppkey = l_suppkey
-    and l_orderkey = o_orderkey and o_custkey = c_custkey
-    and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey
-    and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey
-    and o_orderdate between date '1995-01-01' and date '1996-12-31'
-    and p_type = 'STANDARD POLISHED'
-) all_nations
-group by o_year order by o_year""",
-    "q9": """
-select nation, o_year, sum(amount) as sum_profit
-from (
-  select n_name as nation, year(o_orderdate) as o_year,
-         l_extendedprice * (1 - l_discount)
-           - ps_supplycost * l_quantity as amount
-  from part, supplier, lineitem, partsupp, orders, nation
-  where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
-    and ps_partkey = l_partkey and p_partkey = l_partkey
-    and o_orderkey = l_orderkey and s_nationkey = n_nationkey
-    and p_name like '%%1%%'
-) profit
-group by nation, o_year
-order by nation, o_year desc limit 30""",
-    "q11": """
-select ps_partkey, sum(ps_supplycost * ps_availqty) as value
-from partsupp, supplier, nation
-where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
-  and n_name = 'NATION16'
-group by ps_partkey
-having sum(ps_supplycost * ps_availqty) > (
-  select sum(ps_supplycost * ps_availqty) * 0.02
-  from partsupp, supplier, nation
-  where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
-    and n_name = 'NATION16')
-order by value desc""",
-    "q15": """
-select s_suppkey, s_name, total_revenue
-from supplier, (
-  select l_suppkey as supplier_no,
-         sum(l_extendedprice * (1 - l_discount)) as total_revenue
-  from lineitem
-  where l_shipdate >= date '1996-01-01' and l_shipdate < date '1996-04-01'
-  group by l_suppkey) revenue
-where s_suppkey = supplier_no
-  and total_revenue = (
-    select max(total_revenue) from (
-      select l_suppkey as supplier_no,
-             sum(l_extendedprice * (1 - l_discount)) as total_revenue
-      from lineitem
-      where l_shipdate >= date '1996-01-01'
-        and l_shipdate < date '1996-04-01'
-      group by l_suppkey) r)
-order by s_suppkey""",
-    "q16": """
-select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
-from partsupp, part
-where p_partkey = ps_partkey and p_brand <> 'Brand#1'
-  and p_type not like 'SMALL%%'
-  and p_size in (1, 5, 10, 15, 20, 25, 30, 35)
-  and ps_suppkey not in (
-    select s_suppkey from supplier
-    where s_comment like '%%Customer%%Complaints%%')
-group by p_brand, p_type, p_size
-order by supplier_cnt desc, p_brand, p_type, p_size limit 20""",
-    "q20": """
-select s_name, s_nationkey
-from supplier, nation
-where s_suppkey in (
-    select ps_suppkey from partsupp
-    where ps_partkey in (select p_partkey from part
-                         where p_name like 'PART000%%')
-      and ps_availqty > (
-        select 0.5 * sum(l_quantity) from lineitem
-        where l_partkey = ps_partkey and l_suppkey = ps_suppkey
-          and l_shipdate >= date '1994-01-01'
-          and l_shipdate < date '1995-01-01'))
-  and s_nationkey = n_nationkey and n_name = 'NATION03'
-order by s_name""",
-    "q21": """
-select s_name, count(*) as numwait
-from supplier, lineitem l1, orders, nation
-where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
-  and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
-  and exists (select 1 from lineitem l2
-              where l2.l_orderkey = l1.l_orderkey
-                and l2.l_suppkey <> l1.l_suppkey)
-  and not exists (select 1 from lineitem l3
-                  where l3.l_orderkey = l1.l_orderkey
-                    and l3.l_suppkey <> l1.l_suppkey
-                    and l3.l_receiptdate > l3.l_commitdate)
-  and s_nationkey = n_nationkey and n_name = 'NATION05'
-group by s_name
-order by numwait desc, s_name limit 100""",
-    "q22": """
-select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
-from (
-  select substring(c_phone, 1, 2) as cntrycode, c_acctbal
-  from customer
-  where substring(c_phone, 1, 2) in ('13', '31', '23', '29', '30', '18',
-                                     '17')
-    and c_acctbal > (
-      select avg(c_acctbal) from customer
-      where c_acctbal > 0.00
-        and substring(c_phone, 1, 2) in ('13', '31', '23', '29', '30',
-                                         '18', '17'))
-    and not exists (select 1 from orders where o_custkey = c_custkey)
-) custsale
-group by cntrycode order by cntrycode""",
-}
+QUERIES = TPCH_QUERIES
 
 
 def _norm(rows):

@@ -81,7 +81,8 @@ class Coordinator:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  lease_s: float = 5.0, expect: Optional[int] = None,
-                 self_pid: Optional[int] = None, clock=time.monotonic):
+                 self_pid: Optional[int] = None, clock=time.monotonic,
+                 state_path: Optional[str] = None):
         self.host = host
         self.port = port
         self.lease_s = lease_s
@@ -93,9 +94,96 @@ class Coordinator:
         self._formed = expect is None
         self._members: Dict[int, dict] = {}
         self._handoff: Dict[int, List[dict]] = {}
+        self._save_dirty = False
+        self._save_io_mu = threading.Lock()
         self._stop = threading.Event()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
+        # persist-layer backing (ISSUE 12 / ROADMAP coord (b)): epoch,
+        # membership and parked handoff survive a coordinator restart —
+        # a restarted coordinator REPLAYS the epoch (strictly above any
+        # epoch ever broadcast) instead of renumbering from 0, so
+        # surviving workers' stamped meshes stay safely "behind" and
+        # their parked sessions ride back after the kill
+        if state_path is None:
+            state_path = os.environ.get("TIDB_TPU_COORD_STATE") or None
+        self._persist = None
+        if state_path:
+            from ..store.persist import JsonStatePersister
+
+            self._persist = JsonStatePersister(state_path)
+            self._load_state()
+
+    # ---- persist backing -----------------------------------------------
+    def _load_state(self):
+        doc = self._persist.load()
+        if not doc:
+            return
+        self._epoch = int(doc.get("epoch", 0))
+        now = self._clock()
+        for pid_s, m in (doc.get("members") or {}).items():
+            self._members[int(pid_s)] = {
+                "devices": tuple(int(d) for d in m.get("devices", ())),
+                # a fresh lease window: live members re-heartbeat within
+                # one lease, dead ones expire exactly like a lost member
+                "last_seen": now,
+                "lease_s": float(m.get("lease_s", self.lease_s)),
+            }
+        self._handoff = {int(p): list(v) for p, v in
+                         (doc.get("handoff") or {}).items()}
+        # the restart itself is a membership event: renumber once so
+        # every surviving worker rebuilds from the replayed broadcast
+        self._epoch += 1
+        if self.expect is not None and len(self._members) >= self.expect:
+            self._formed = True
+        REGISTRY.inc("coord_state_replayed_total")
+        REGISTRY.set("coord_epoch", self._epoch)
+        # persist the renumbered epoch IMMEDIATELY: a second restart
+        # before any membership change must replay strictly above THIS
+        # incarnation's broadcasts, not re-issue the same epoch
+        self._save_dirty = True
+        self._flush_state()
+
+    def _save_locked(self):
+        """Mark the persisted document dirty; the actual double-fsync
+        write happens in _flush_state() AFTER the mutex is released
+        (public entry points call it before acking), so a bump storm
+        never serializes every membership RPC behind disk I/O."""
+        if self._persist is None:
+            return
+        self._save_dirty = True
+
+    def _flush_state(self):
+        """Write the current state document if dirty — called outside
+        `self._mu` but BEFORE the mutating RPC acks, so durability
+        ordering (e.g. a handoff pop persisted before its response) is
+        preserved.  `_save_io_mu` serializes concurrent writers; each
+        write snapshots fresh full state, so last-writer-wins is safe."""
+        if self._persist is None:
+            return
+        # take the io lock BEFORE checking the dirty flag: if another
+        # thread's in-flight write already snapshotted our change (and
+        # cleared the flag), we must WAIT for that write's fsync before
+        # acking — an early return on a pre-checked flag would ack a
+        # pop whose covering write could still be torn by a crash
+        with self._save_io_mu:
+            with self._mu:
+                if not self._save_dirty:
+                    return
+                self._save_dirty = False
+                doc = {
+                    "epoch": self._epoch,
+                    "members": {str(p): {"devices": list(m["devices"]),
+                                         "lease_s": m.get("lease_s",
+                                                          self.lease_s)}
+                                for p, m in self._members.items()},
+                    "handoff": {str(p): list(v)
+                                for p, v in self._handoff.items()},
+                }
+            try:
+                self._persist.save(doc)
+            except OSError:
+                REGISTRY.inc("coord_state_save_errors_total")
 
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> Tuple[str, int]:
@@ -130,10 +218,12 @@ class Coordinator:
         REGISTRY.inc("coord_epoch_bumps_total")
         REGISTRY.set("coord_epoch", self._epoch)
         REGISTRY.set("coord_members", len(self._members))
+        self._save_locked()
 
     def bump(self, reason: str = ""):
         with self._mu:
             self._bump_locked(reason)
+        self._flush_state()
 
     def _expire_locked(self):
         now = self._clock()
@@ -170,13 +260,19 @@ class Coordinator:
                     and len(self._members) >= self.expect:
                 self._formed = True
             handoff = self._handoff.pop(pid, [])
-            return {"view": self._view_locked(), "handoff": handoff}
+            if handoff:
+                self._save_locked()  # consumed exactly once, durably
+            out = {"view": self._view_locked(), "handoff": handoff}
+        self._flush_state()
+        return out
 
     def poll(self, pid: int) -> MembershipView:
         with self._mu:
             self._touch_locked(pid)
             self._expire_locked()
-            return self._view_locked()
+            view = self._view_locked()
+        self._flush_state()
+        return view
 
     def report(self, pid: int, healthy_devices) -> MembershipView:
         """A member publishes its CURRENT healthy device set (fed by its
@@ -191,24 +287,34 @@ class Coordinator:
                     m["devices"] = devices
                     self._bump_locked(f"member {pid} health changed")
             self._expire_locked()
-            return self._view_locked()
+            view = self._view_locked()
+        self._flush_state()
+        return view
 
     def leave(self, pid: int) -> MembershipView:
         with self._mu:
             if self._members.pop(pid, None) is not None:
                 self._bump_locked(f"member {pid} left")
             self._expire_locked()
-            return self._view_locked()
+            view = self._view_locked()
+        self._flush_state()
+        return view
 
     def put_handoff(self, pid: int, states: List[dict]):
         with self._mu:
             self._handoff[pid] = list(states)
             self._touch_locked(pid)
+            self._save_locked()
+        self._flush_state()
         REGISTRY.inc("coord_handoff_put_total", len(states))
 
     def pop_handoff(self, pid: int) -> List[dict]:
         with self._mu:
-            return self._handoff.pop(pid, [])
+            out = self._handoff.pop(pid, [])
+            if out:
+                self._save_locked()
+        self._flush_state()
+        return out
 
     def ingest_spans(self, pid: int, payload: dict, nbytes: int) -> str:
         """Rebuild a worker's forwarded span tree into this process's

@@ -278,12 +278,15 @@ from ..expr.pushdown import DICT_COMPUTABLE_FUNCS  # noqa: E402
 
 
 class KeyRemap:
-    """One computed string group key lowered to a code-space gather.
+    """One computed group key lowered to a code-space gather.
 
-    `mapping` (int32, pow2-padded to `cap`) rides as a RUNTIME operand of
-    the fused program: row code -> computed-key output code.  The output
-    dictionary (`out_dict`, sorted so code order == string order) decodes
-    the compacted group keys host-side after readback."""
+    `mapping` (pow2-padded to `cap`) rides as a RUNTIME operand of the
+    fused program: row code -> computed-key output.  STRING keys map
+    code -> output-dictionary code (int32) and `out_dict` (sorted so
+    code order == string order) decodes the compacted group keys
+    host-side after readback; INT-valued computed keys (LENGTH/ASCII —
+    ISSUE 12 satellite (a)) map code -> the computed VALUE directly
+    (int64, `out_dict` None)."""
 
     __slots__ = ("src_idx", "mapping", "cap", "out_dict")
 
@@ -295,15 +298,20 @@ class KeyRemap:
         self.out_dict = out_dict
 
 
-def _single_dict_column(expr, scan, table):
+def _single_dict_column(expr, scan, table, cols=None):
     """The ONE dict-encoded string column a remappable expression reads,
     or None.  The structural walk is the SHARED
-    `pushdown.dict_computable_columns` (one source of truth with the
+    `pushdown.dict_computable_columns` /
+    `pushdown._computed_dict_tree_columns` (one source of truth with the
     planner gate and plancheck); this adds the engine-side identity
     check: a single scan index whose store column is dict-encoded."""
-    from ..expr.pushdown import dict_computable_columns
+    from ..expr.pushdown import (_computed_dict_tree_columns,
+                                 dict_computable_columns)
 
-    cols = dict_computable_columns(expr)
+    if cols is None:
+        cols = dict_computable_columns(expr)
+        if cols is None:
+            cols = _computed_dict_tree_columns(expr)
     if cols is None:
         return None
     idxs = {c.index for c in cols}
@@ -360,48 +368,104 @@ def build_key_remap(table, scan, expr) -> KeyRemap:
     return rm
 
 
-def _build_key_remap_uncached(table, scan, expr) -> KeyRemap:
+def _eval_over_dictionary(table, scan, expr, idx):
+    """Evaluate `expr` once per DICTIONARY entry of scan column `idx`
+    (the shared recipe of the key-remap and predicate-code lowerings):
+    a chunk wide enough for the source index, every other slot a zero
+    placeholder — only the source column is ever read (checked by
+    _single_dict_column)."""
     from ..chunk import Chunk, Column
+    from ..types import ty_string
+
+    store_ci = scan.columns[idx]
+    dictionary = table.cols[store_ci].dictionary or []
+    if not dictionary:
+        raise JaxUnsupported("computed dict expression over empty "
+                             "dictionary")
+    nd = len(dictionary)
+    vals = np.empty(nd, dtype=object)
+    vals[:] = [str(s) for s in dictionary]
+    cols = []
+    for j in range(idx + 1):
+        if j == idx:
+            cols.append(Column(ty_string(False), vals))
+        else:
+            cols.append(Column(scan.ftypes[j],
+                               np.zeros(nd, dtype=np.int64)))
+    return expr.eval(Chunk(cols)), nd
+
+
+def _build_key_remap_uncached(table, scan, expr) -> KeyRemap:
     from ..types import TypeKind
 
-    if expr.ftype.kind != TypeKind.STRING:
+    if expr.ftype.kind not in (TypeKind.STRING, TypeKind.INT,
+                               TypeKind.UINT):
         raise JaxUnsupported(
             f"computed group key not dict-remappable: {expr}")
     idx = _single_dict_column(expr, scan, table)
     if idx is None:
         raise JaxUnsupported(
             f"computed string group key not dict-remappable: {expr}")
-    store_ci = scan.columns[idx]
-    dictionary = table.cols[store_ci].dictionary or []
-    if not dictionary:
-        raise JaxUnsupported("computed group key over empty dictionary")
-    # evaluate over the dictionary: a chunk wide enough for the source
-    # index, every other slot a zero-row placeholder is unnecessary —
-    # only the source column is ever read (checked by _single_dict_column)
-    nd = len(dictionary)
-    vals = np.empty(nd, dtype=object)
-    vals[:] = [str(s) for s in dictionary]
-    width = idx + 1
-    cols = []
-    for j in range(width):
-        if j == idx:
-            cols.append(Column(expr.ftype, vals))
-        else:
-            cols.append(Column(scan.ftypes[j],
-                               np.zeros(nd, dtype=np.int64)))
-    out = expr.eval(Chunk(cols))
+    out, nd = _eval_over_dictionary(table, scan, expr, idx)
     if not np.all(out.validity()):
         raise JaxUnsupported(
             f"computed group key maps entries to NULL: {expr}")
-    outs = [str(x) for x in out.data]
-    out_dict = sorted(set(outs))
-    rank = {s: i for i, s in enumerate(out_dict)}
     cap = 2
     while cap < nd:
         cap <<= 1
+    if expr.ftype.kind != TypeKind.STRING:
+        # INT-valued computed key (LENGTH/ASCII, ISSUE 12 satellite (a)):
+        # the mapping carries the computed VALUE per code — no output
+        # dictionary, the key bits ARE the values
+        mapping = np.zeros(cap, dtype=np.int64)
+        mapping[:nd] = [int(x) for x in out.data]
+        return KeyRemap(idx, mapping, cap, None)
+    outs = [str(x) for x in out.data]
+    out_dict = sorted(set(outs))
+    rank = {s: i for i, s in enumerate(out_dict)}
     mapping = np.zeros(cap, dtype=np.int32)
     mapping[:nd] = [rank[s] for s in outs]
     return KeyRemap(idx, mapping, cap, out_dict)
+
+
+def dict_pred_codes(table, scan, expr):
+    """Lower a computed predicate over ONE dict-encoded column to its
+    matching CODE SET (ISSUE 12: LIKE / SUBSTR / LENGTH predicates on
+    the device probe path): evaluate the whole predicate once per
+    dictionary entry on the host (NULL -> no match, SQL filter
+    semantics) and return (src_idx, sorted matching codes ndarray,
+    dictionary size).  Raises JaxUnsupported when not loweable.
+    Cached per (store, base_version, expr) alongside the key remaps."""
+    import json as _json
+
+    from ..expr.pushdown import dict_pred_source
+    from .ir import serialize_expr
+
+    cols = dict_pred_source(expr)
+    idx = (_single_dict_column(expr, scan, table, cols=cols)
+           if cols is not None else None)
+    if idx is None:
+        raise JaxUnsupported(
+            f"predicate not dict-code-loweable: {expr}")
+    ck = (table.store_uid, table.base_version,
+          "pred:" + _json.dumps(serialize_expr(expr), sort_keys=True))
+    with _REMAP_MU:
+        hit = _REMAP_CACHE.get(ck)
+    if hit is not None:
+        return hit
+    out, nd = _eval_over_dictionary(table, scan, expr, idx)
+    truth = np.zeros(nd, dtype=np.bool_)
+    valid = out.validity()
+    for i, v in enumerate(out.data):
+        if valid[i] and v:
+            truth[i] = True  # NULL predicate results drop the row
+    codes = np.flatnonzero(truth).astype(np.int64)
+    res = (idx, codes, nd)
+    with _REMAP_MU:
+        while len(_REMAP_CACHE) >= _REMAP_CACHE_MAX:
+            _REMAP_CACHE.pop(next(iter(_REMAP_CACHE)))
+        _REMAP_CACHE[ck] = res
+    return res
 
 
 def remap_codes(ctx_or_codes, mapping, n: int):

@@ -67,6 +67,24 @@ _RANGE_OPS = {"<", "<=", ">", ">="}
 def rewrite_for_dict(e: Expression, table, scan: TableScanIR) -> Expression:
     """Rewrite string-vs-constant comparisons over dict-encoded columns into
     integer code comparisons.  Raises JaxUnsupported for raw string use."""
+    return rewrite_for_dict_resolved(e, _scan_resolver(table, scan))
+
+
+def _scan_resolver(table, scan: TableScanIR):
+    """resolve(col_index) -> (table, scan, scan_pos): the single-side
+    identity resolver; the join-tree engine (mpp/jointree.py) supplies a
+    multi-side one that maps pair-layout positions onto each owning
+    side's (table, scan)."""
+
+    def resolve(idx: int):
+        if 0 <= idx < len(scan.columns):
+            return table, scan, idx
+        return None
+
+    return resolve
+
+
+def rewrite_for_dict_resolved(e: Expression, resolve) -> Expression:
     if isinstance(e, (ColumnExpr, Constant)):
         return e
     assert isinstance(e, ScalarFunc)
@@ -74,7 +92,12 @@ def rewrite_for_dict(e: Expression, table, scan: TableScanIR) -> Expression:
     if name in ("=", "!=") or name in _RANGE_OPS or name == "in":
         col, consts, col_first = _split_col_consts(e)
         if col is not None and col.ftype.kind == TypeKind.STRING:
-            store_ci = scan.columns[col.index]
+            where = resolve(col.index)
+            if where is None:
+                raise JaxUnsupported("string column not resolvable to a "
+                                     "dict-encoded store column")
+            table, scan, sp = where
+            store_ci = scan.columns[sp]
             if store_ci not in table.dict_encoded_cols():
                 raise JaxUnsupported("string column not dict-encoded")
             if name in ("=", "!="):
@@ -106,8 +129,98 @@ def rewrite_for_dict(e: Expression, table, scan: TableScanIR) -> Expression:
             return ScalarFunc(
                 newop, [col, Constant(bound, col.ftype)], e.ftype, e.meta
             )
-    new_args = [rewrite_for_dict(a, table, scan) for a in e.args]
+    from ..expr.pushdown import DICT_PRED_HEADS, dict_pred_source
+
+    if name in DICT_PRED_HEADS and dict_pred_source(e) is not None:
+        # computed predicate over ONE dict column (LIKE patterns,
+        # SUBSTR/LENGTH comparisons, ISSUE 12): the host evaluates the
+        # predicate once per DICTIONARY entry and the device tests CODE
+        # membership — a range conjunction when the matching codes are
+        # contiguous (prefix patterns on sorted dictionaries), an
+        # in-list otherwise
+        return _lower_dict_pred(e, resolve)
+    new_args = [rewrite_for_dict_resolved(a, resolve) for a in e.args]
     return ScalarFunc(e.name, new_args, e.ftype, e.meta)
+
+
+def _reindex_expr(e: Expression, mapping) -> Expression:
+    """Clone `e` with every ColumnExpr index passed through `mapping`."""
+    from .ir import deserialize_expr, serialize_expr
+
+    e2 = deserialize_expr(serialize_expr(e))
+
+    def walk(x):
+        if isinstance(x, ColumnExpr):
+            x.index = mapping(x.index)
+        elif isinstance(x, ScalarFunc):
+            for a in x.args:
+                walk(a)
+
+    walk(e2)
+    return e2
+
+
+#: largest non-contiguous dict-predicate code set lowered as an in-list
+#: (one Constant per code rides the program AND its fingerprint; sorted
+#: dictionaries keep prefix patterns contiguous, so real LIKE-prefix
+#: shapes never reach this cap — only mid-string matches over
+#: high-cardinality dictionaries do, and those belong on the host lane)
+DICT_PRED_IN_MAX = 256
+
+
+def _lower_dict_pred(e: ScalarFunc, resolve) -> Expression:
+    from . import fusion
+    from ..expr.pushdown import dict_pred_source
+
+    cols = dict_pred_source(e)
+    src = cols[0]
+    where = resolve(src.index)
+    if where is None:
+        raise JaxUnsupported("dict predicate column not resolvable")
+    table, scan, sp = where
+    # evaluate in the owning side's scan layout (the predicate reads ONE
+    # column, so reindexing every leaf to `sp` is exact), then emit the
+    # lowered comparison against the ORIGINAL position
+    shifted = _reindex_expr(e, lambda _i: sp)
+    _idx, codes, nd = fusion.dict_pred_codes(table, scan, shifted)
+    col = ColumnExpr(src.index, src.ftype, src.name, -1)
+    if len(codes) == 0:
+        # never-matching comparison, NOT a bare FALSE constant: the
+        # column's validity plane must keep riding (NULL rows evaluate
+        # to NULL, so `NOT <pred>` stays NULL instead of flipping TRUE)
+        return ScalarFunc("=", [col, Constant(-1, col.ftype)],
+                          e.ftype, {})
+    # no all-match shortcut: the code comparison must keep carrying the
+    # column's validity plane (a NULL row never matches a predicate)
+    lo, hi = int(codes[0]), int(codes[-1])
+    if hi - lo + 1 == len(codes):
+        # contiguous code range (sorted dictionaries make every prefix
+        # pattern contiguous): two comparisons instead of a member scan
+        if lo == hi:
+            return ScalarFunc("=", [col, Constant(lo, col.ftype)],
+                              e.ftype, {})
+        return ScalarFunc("and", [
+            ScalarFunc(">=", [col, Constant(lo, col.ftype)], e.ftype, {}),
+            ScalarFunc("<=", [col, Constant(hi, col.ftype)], e.ftype, {}),
+        ], e.ftype, {})
+    if len(codes) > DICT_PRED_IN_MAX:
+        # a non-contiguous match set over a high-cardinality dictionary
+        # (e.g. `%needle%` on a near-unique comment column) would embed
+        # one Constant per code into the traced program AND its
+        # fingerprint — decline so the host lane serves it instead
+        raise JaxUnsupported("dict predicate code set too large")
+    return ScalarFunc(
+        "in", [col] + [Constant(int(c), col.ftype) for c in codes],
+        e.ftype, {})
+
+
+def _string_leaf(e: Expression) -> bool:
+    """Does the expression read any STRING-typed column?"""
+    if isinstance(e, ColumnExpr):
+        return e.ftype.kind == TypeKind.STRING
+    if isinstance(e, ScalarFunc):
+        return any(_string_leaf(a) for a in e.args)
+    return False
 
 
 def _split_col_consts(e: ScalarFunc):
@@ -290,6 +403,21 @@ class _Analyzed:
             ]
         else:
             self.proj_exprs = None
+        if self.agg is not None:
+            # rewrite agg ARGS and group keys for dict codes too (ISSUE
+            # 12: CASE-heavy aggregate arguments with string comparisons
+            # — `sum(case when prio = '1-URGENT' ...)` — compile against
+            # integer codes).  A fresh AggregationIR: the DAG's own IR
+            # keeps the original string constants for the host engines.
+            self.agg = AggregationIR(
+                [rewrite_for_dict(g, table, self.scan)
+                 for g in self.agg.group_by],
+                [AggDesc(a.name,
+                         [rewrite_for_dict(x, table, self.scan)
+                          for x in a.args],
+                         a.distinct, a.ftype)
+                 for a in self.agg.aggs],
+                mode=self.agg.mode, stream=self.agg.stream)
         # group-key layout for device aggregation
         self.group_cols: List[int] = []  # scan-output indices
         self.group_card: List[Tuple[int, int]] = []  # (lo, card) per key
@@ -333,8 +461,13 @@ class _Analyzed:
 
                 remaps = []
                 for k in self.agg.group_by:
-                    if (k.ftype.kind == TypeKind.STRING
-                            and not isinstance(k, ColumnExpr)):
+                    if not isinstance(k, ColumnExpr) and (
+                            k.ftype.kind == TypeKind.STRING
+                            or _string_leaf(k)):
+                        # computed key READING a string column: STRING
+                        # outputs remap into an output dictionary;
+                        # INT-valued ones (LENGTH/ASCII, ISSUE 12) remap
+                        # straight to computed values
                         remaps.append(
                             build_key_remap(table, self.scan, k))
                         continue

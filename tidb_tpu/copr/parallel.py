@@ -1249,9 +1249,11 @@ def _sort_agg_chunks(out: dict, table, an: _Analyzed) -> List[Chunk]:
             ft = g.ftype
             rem = (an.key_remaps[i]
                    if getattr(an, "key_remaps", None) else None)
-            if rem is not None:
+            if rem is not None and rem.out_dict is not None:
                 # computed-key codes decode through the remap's OUTPUT
-                # dictionary (sorted, so code order == string order)
+                # dictionary (sorted, so code order == string order);
+                # INT-valued remaps (out_dict None) carry the computed
+                # values in the key bits directly
                 from ..store.blockstore import _decode_dict
 
                 data = _decode_dict(bits.astype(np.int64), rem.out_dict)

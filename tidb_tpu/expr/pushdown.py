@@ -54,6 +54,23 @@ DICT_COMPUTABLE_FUNCS: Set[str] = {
     "concat", "reverse", "trim", "ltrim", "rtrim",
 }
 
+#: INT-valued per-entry functions of one dict column (ISSUE 12 satellite:
+#: the zero-host-tail follow-up (a)): `LENGTH(c)` / `ASCII(c)` group keys
+#: lower to the same code-space re-mapping, with the mapping operand
+#: carrying the computed INT value per dictionary code instead of an
+#: output-dictionary code.
+DICT_COMPUTABLE_INT_FUNCS: Set[str] = {
+    "length", "char_length", "character_length", "ascii",
+}
+
+#: predicate heads a computed-dict-column predicate may use: the whole
+#: predicate is evaluated ONCE per dictionary entry on the host and
+#: lowers to a code-set membership test over the source column's codes
+#: (`WHERE SUBSTR(c,1,2)='ab'`, LIKE/NOT-LIKE patterns, `LENGTH(c)>3`).
+DICT_PRED_HEADS: Set[str] = {
+    "=", "!=", "<", "<=", ">", ">=", "in", "like",
+}
+
 # Kinds with fixed-width device representations.  STRING is device-eligible
 # only when dictionary-encoded (decided per column by the block store).
 DEVICE_KINDS = {
@@ -83,6 +100,12 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
         key = e.unique_id if e.unique_id >= 0 else e.index
         return e.ftype.kind == TypeKind.STRING and key in dict_cols
     if isinstance(e, ScalarFunc):
+        if e.name not in blacklist and can_push_dict_pred(e, dict_cols):
+            # computed predicate over ONE dict column: lowers to a
+            # code-set membership test at analysis time
+            # (jax_engine.rewrite_for_dict), so the device only ever
+            # sees integer code comparisons
+            return True
         if e.name in blacklist or e.name not in PUSHABLE_FUNCS:
             return False
         if e.name in ("=", "!=", "in", "<", "<=", ">", ">="):
@@ -108,6 +131,77 @@ def can_push_expr(e: Expression, blacklist: Set[str] = frozenset(),
             return False
         return all(can_push_expr(a, blacklist, dict_cols) for a in e.args)
     return False
+
+
+def _computed_dict_tree_columns(e: Expression):
+    """Column leaves when `e` is a computed (non-bare-column) tree of
+    dictionary-computable string/int functions over STRING column leaves
+    plus non-NULL constants; None otherwise.  The generalization of
+    `dict_computable_columns` that also admits INT-valued roots
+    (LENGTH/ASCII...) — ISSUE 12 satellite (a)."""
+    if not isinstance(e, ScalarFunc):
+        return None
+    if e.ftype.kind not in (TypeKind.STRING, TypeKind.INT, TypeKind.UINT):
+        return None
+    cols = []
+
+    def walk(x) -> bool:
+        if isinstance(x, Constant):
+            return x.value is not None
+        if isinstance(x, ColumnExpr):
+            cols.append(x)
+            return x.ftype.kind == TypeKind.STRING
+        if isinstance(x, ScalarFunc):
+            if x.name not in DICT_COMPUTABLE_FUNCS \
+                    and x.name not in DICT_COMPUTABLE_INT_FUNCS:
+                return False
+            return all(walk(a) for a in x.args)
+        return False
+
+    if not walk(e) or not cols:
+        return None
+    return cols
+
+
+def dict_pred_source(e: Expression):
+    """The column leaves of a code-set-loweable predicate, or None.
+
+    Shape: a DICT_PRED_HEADS comparison whose ONE non-constant operand
+    is either a dict-encoded STRING column inside a computed tree
+    (`SUBSTR(c,1,2)='ab'`, `LENGTH(c)>3`) or, for LIKE, the bare column
+    itself; every other operand is a non-NULL constant.  Boolean
+    combinations are handled by the callers' recursion (and/or/not are
+    ordinary pushable functions once the leaves lower).  The host
+    evaluates the WHOLE predicate once per dictionary entry
+    (fusion.dict_pred_codes) and the device tests code membership."""
+    if not isinstance(e, ScalarFunc) or e.name not in DICT_PRED_HEADS:
+        return None
+    var_args = [a for a in e.args if not isinstance(a, Constant)]
+    if len(var_args) != 1:
+        return None
+    if any(isinstance(a, Constant) and a.value is None for a in e.args):
+        return None
+    v = var_args[0]
+    if e.name == "like" and isinstance(v, ColumnExpr):
+        if v.ftype.kind != TypeKind.STRING:
+            return None
+        return [v]
+    cols = _computed_dict_tree_columns(v)
+    if cols is None:
+        return None
+    return cols
+
+
+def can_push_dict_pred(e: Expression,
+                       dict_cols: Set[int] = frozenset()) -> bool:
+    """True when a predicate lowers to a code-set membership test over
+    exactly ONE dict-encoded string column (ISSUE 12: LIKE / computed
+    string predicates on the device probe path)."""
+    cols = dict_pred_source(e)
+    if cols is None:
+        return False
+    keys = {(c.unique_id if c.unique_id >= 0 else c.index) for c in cols}
+    return len(keys) == 1 and next(iter(keys)) in dict_cols
 
 
 def dict_computable_columns(e: Expression):
@@ -144,12 +238,15 @@ def dict_computable_columns(e: Expression):
 
 def can_remap_group_key(e: Expression,
                         dict_cols: Set[int] = frozenset()) -> bool:
-    """True when a computed STRING group key lowers to a device-side
-    dict-code re-mapping (copr/fusion.build_key_remap): a tree of
-    dictionary-computable string functions over exactly ONE dict-encoded
-    string column plus constants.  The host evaluates the function once
-    per dictionary entry; rows re-map in code space — no host tail."""
+    """True when a computed group key lowers to a device-side dict-code
+    re-mapping (copr/fusion.build_key_remap): a tree of
+    dictionary-computable string (or, since ISSUE 12, INT-valued:
+    LENGTH/ASCII) functions over exactly ONE dict-encoded string column
+    plus constants.  The host evaluates the function once per dictionary
+    entry; rows re-map in code space — no host tail."""
     cols = dict_computable_columns(e)
+    if cols is None:
+        cols = _computed_dict_tree_columns(e)
     if cols is None:
         return False
     keys = {(c.unique_id if c.unique_id >= 0 else c.index) for c in cols}

@@ -66,6 +66,13 @@ MPP_EXCHANGE_KERNELS = ("mpp-shuffle-join", "mpp-broadcast-join")
 #: budget values and fails on any jaxpr divergence.
 MPP_GROUPED_KERNEL = "mpp-grouped-agg-merge"
 
+#: the 3-way join-tree rung-ladder kernel (ISSUE 12, mpp/jointree.py's
+#: canonical shape in mpp/exchange.trace_tree_join_kernel): two
+#: exchange/local-join rungs chained inside ONE traced program with the
+#: intermediate staying in registers — jaxpr-identical across key
+#: operand shifts, and EXECUTED against the row-at-a-time CPU oracle.
+TREE_JOIN_KERNEL = "mpp-tree-3way-join"
+
 #: the micro-batcher's vmapped padded-batch kernel (serving/batcher.py):
 #: the q6-scalar-agg shape with predicate constants hoisted to parameter
 #: slots, vmapped over a pow2-padded batch of parameter vectors.
@@ -360,6 +367,51 @@ def lint_kernels(baseline_kernels: Optional[Dict[str, dict]] = None,
                  f"int64 equation count grew {base.get('i64_eqns')} -> "
                  f"{stats['i64_eqns']}: an int64-emulation chain was "
                  "reintroduced into the exchange program")
+
+    # -- 3-way join-tree rung-ladder kernel (ISSUE 12) ------------------
+    name = TREE_JOIN_KERNEL
+    try:
+        from ..mpp.exchange import (run_tree_join_kernel,
+                                    trace_tree_join_kernel,
+                                    tree_join_oracle)
+
+        closed = trace_tree_join_kernel(0)
+        stats = _jaxpr_stats(closed)
+        # key operands are runtime data: tracing under SHIFTED key
+        # values must produce the identical ladder program
+        other = trace_tree_join_kernel(3)
+        if str(closed) != str(other):
+            emit(name,
+                 "shifted key operands changed the 3-way ladder's jaxpr "
+                 "— key values must never become compiled constants")
+        else:
+            over, jover, total = run_tree_join_kernel(0)
+            want = tree_join_oracle(0)
+            if over or jover:
+                emit(name, f"canonical ladder overflowed (partition "
+                           f"{over}, emit {jover}) — capacities no "
+                           "longer fit the canonical shape")
+            elif abs(total - want) > 1e-6 * max(abs(want), 1.0):
+                emit(name,
+                     f"executed 3-way ladder disagrees with the CPU "
+                     f"oracle: {total} != {want}")
+            elif collect_stats is not None:
+                collect_stats[name] = stats
+            else:
+                base = baseline_kernels.get(name)
+                if base is None:
+                    emit(name, f"kernel not in baseline (measured "
+                               f"{stats}); run python -m tidb_tpu.lint "
+                               "--update-baseline")
+                elif stats["i64_eqns"] > int(base.get("i64_eqns", 0)):
+                    emit(name,
+                         f"int64 equation count grew "
+                         f"{base.get('i64_eqns')} -> {stats['i64_eqns']}"
+                         ": an int64-emulation chain was reintroduced "
+                         "into the rung ladder")
+    except Exception as e:  # noqa: BLE001 — contract break
+        emit(name, f"tree join kernel trace failed: "
+                   f"{type(e).__name__}: {e}")
 
     # -- MPP grouped-partial + on-device-merge kernel -------------------
     name = MPP_GROUPED_KERNEL

@@ -84,8 +84,18 @@ class _Checker:
             return
         if isinstance(e, ScalarFunc):
             if registry:
-                from ..expr.pushdown import PUSHABLE_FUNCS
+                from ..expr.pushdown import PUSHABLE_FUNCS, dict_pred_source
 
+                if dict_pred_source(e) is not None:
+                    # computed dict-column predicate (LIKE / SUBSTR /
+                    # LENGTH comparisons, ISSUE 12): lowers to a
+                    # code-set membership test at analysis time — the
+                    # function head is registry-exempt, but the column
+                    # refs below still verify
+                    for a in e.args:
+                        self.check_expr(node, a, input_fts, where,
+                                        registry=False)
+                    return
                 if e.name not in PUSHABLE_FUNCS:
                     self.fail(node, f"{where}: function {e.name!r} is in a "
                                     "cop DAG but not in the TPU-executable "
@@ -140,16 +150,19 @@ class _Checker:
                                     registry=True)
                 fts = [e.ftype for e in ex.exprs]
             elif isinstance(ex, AggregationIR):
-                from ..expr.pushdown import dict_computable_columns
+                from ..expr.pushdown import (_computed_dict_tree_columns,
+                                             dict_computable_columns)
 
                 out = []
                 for g in ex.group_by:
-                    # computed STRING keys built from dictionary-
-                    # computable functions over ONE string column lower
-                    # via device dict-code re-mapping (ISSUE 11):
-                    # registry-exempt (same shared walker as the
-                    # planner gate), but column refs/widths still verify
+                    # computed STRING (or INT-valued, ISSUE 12) keys
+                    # built from dictionary-computable functions over
+                    # ONE string column lower via device dict-code
+                    # re-mapping: registry-exempt (same shared walker as
+                    # the planner gate), but column refs/widths verify
                     cols = dict_computable_columns(g)
+                    if cols is None:
+                        cols = _computed_dict_tree_columns(g)
                     remap_ok = (cols is not None
                                 and len({c.index for c in cols}) == 1)
                     self.check_expr(node, g, fts, "cop Agg group key",
@@ -279,6 +292,69 @@ class _Checker:
             if not _kinds_ok(ft, sc.ftype):
                 self.fail(p, f"join schema col #{i} {sc.ftype.kind.name} "
                              f"!= child output {ft.kind.name}")
+
+    def _chk_PhysMPPJoinTree(self, p):
+        """The rung ladder (ISSUE 12): senders are table readers (their
+        own check covers the cop DAGs); verify every rung's key slots /
+        build positions resolve with matching int domains, slot sources
+        are in range, and the output schema width matches rows-mode
+        slots or the partial-agg layout."""
+        slot_fts = []
+        for side, sp in p.slot_src:
+            if not (0 <= side < len(p.children)):
+                self.fail(p, f"slot source side {side} out of range")
+                return
+            sch = p.children[side].schema
+            if not (0 <= sp < len(sch)):
+                self.fail(p, f"slot source pos {sp} out of range for "
+                             f"side {side}")
+                return
+            slot_fts.append(sch.col(sp).ftype)
+        for i, r in enumerate(p.rungs):
+            side = p.children[r["side"]].schema
+            if len(r["left_slots"]) != len(r["build_pos"]):
+                self.fail(p, f"rung {i}: key count mismatch")
+                continue
+            for s, kp in zip(r["left_slots"], r["build_pos"]):
+                if not (0 <= s < len(slot_fts)):
+                    self.fail(p, f"rung {i}: left slot {s} out of range")
+                    continue
+                if not (0 <= kp < len(side)):
+                    self.fail(p, f"rung {i}: build pos {kp} out of range")
+                    continue
+                lft, bft = slot_fts[s], side.col(kp).ftype
+                if lft.kind != bft.kind or lft.scale != bft.scale:
+                    self.fail(p, f"rung {i}: key domains differ: "
+                                 f"{lft.kind.name}(s{lft.scale}) vs "
+                                 f"{bft.kind.name}(s{bft.scale})")
+        if p.aggs is not None:
+            for i, g in enumerate(p.group_by or ()):
+                from ..expr.pushdown import (_computed_dict_tree_columns,
+                                             dict_computable_columns)
+
+                cols = dict_computable_columns(g)
+                if cols is None:
+                    cols = _computed_dict_tree_columns(g)
+                remap_ok = (cols is not None
+                            and len({c.index for c in cols}) == 1)
+                self.check_expr(p, g, slot_fts, f"tree group key #{i}",
+                                registry=not remap_ok)
+            width = sum(len(a.partial_types()) for a in p.aggs) \
+                + len(p.group_by or ())
+            if len(p.schema) != width:
+                self.fail(p, f"partial-agg schema width {len(p.schema)} "
+                             f"!= {width} group key + partial state cols")
+            return
+        if len(p.schema) != len(p.out_slots):
+            self.fail(p, f"rows schema width {len(p.schema)} != "
+                         f"{len(p.out_slots)} output slots")
+            return
+        for i, (slot, sc) in enumerate(zip(p.out_slots, p.schema.cols)):
+            if not (0 <= slot < len(slot_fts)):
+                self.fail(p, f"output slot {slot} out of range")
+            elif not _kinds_ok(slot_fts[slot], sc.ftype):
+                self.fail(p, f"rows schema col #{i} {sc.ftype.kind.name} "
+                             f"!= slot {slot} {slot_fts[slot].kind.name}")
 
     def _chk_PhysProjection(self, p):
         fts = self._child_fts(p)

@@ -48,7 +48,8 @@ from . import Finding
 
 ENGINE_DIRS = ("tidb_tpu/coord", "tidb_tpu/copr", "tidb_tpu/executor",
                "tidb_tpu/expr", "tidb_tpu/layout", "tidb_tpu/lifecycle",
-               "tidb_tpu/mpp", "tidb_tpu/ops", "tidb_tpu/serving")
+               "tidb_tpu/mpp", "tidb_tpu/ops", "tidb_tpu/planner",
+               "tidb_tpu/serving")
 
 HOST_SYNC_DOTTED = {"np.asarray", "numpy.asarray", "jax.device_get"}
 HOST_SYNC_METHODS = {"block_until_ready"}

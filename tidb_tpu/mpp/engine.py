@@ -259,10 +259,13 @@ def _mpp_key_remaps(spec: MPPJoinSpec, ps: "_SideState", bs: "_SideState"):
 
     if spec.aggs is None or spec.group_by is None:
         return None
+    from ..copr.jax_engine import _string_leaf
+
     wp = len(ps.col_order)
     remaps = []
     for g in spec.group_by:
-        if g.ftype.kind != TypeKind.STRING or isinstance(g, ColumnExpr):
+        if isinstance(g, ColumnExpr) or not (
+                g.ftype.kind == TypeKind.STRING or _string_leaf(g)):
             remaps.append(None)
             continue
         # JOINED-layout POSITIONS (collect_columns would return planner
@@ -701,9 +704,10 @@ def _assemble_grouped(spec: MPPJoinSpec, ps: _SideState, bs: _SideState,
         flags = keys[nk + i][:k].astype(np.bool_)
         ft = g.ftype
         rem = remaps[i] if remaps is not None else None
-        if rem is not None:
+        if rem is not None and rem.out_dict is not None:
             # computed-key codes decode through the remap's OUTPUT
-            # dictionary, not any store column's
+            # dictionary, not any store column's (INT-valued remaps
+            # carry the computed values in the key bits directly)
             from ..store.blockstore import _decode_dict
 
             data = _decode_dict(bits.astype(np.int64), rem.out_dict)

@@ -28,19 +28,28 @@ from .. import ops  # noqa: F401  (configures x64)
 import jax
 import jax.numpy as jnp
 
-# splitmix64's multiplicative constant, wrapped into int64 — spreads
+# splitmix64's multiplicative constants, wrapped into int64 — spreads
 # clustered keys (sequential order keys, FK ranges) across partitions so
 # the static bucket capacity sees near-uniform load
 _MIX = np.int64(np.uint64(0x9E3779B97F4A7C15).astype(np.int64))
+_MIX2 = np.int64(np.uint64(0xBF58476D1CE4E5B9).astype(np.int64))
 
 I64_MAX = np.iinfo(np.int64).max
 
 
 def partition_ids(key, n_parts: int):
     """[0, n_parts) partition id per int64 key, identical on both join
-    sides (the ExchangeSender hash of tipb.ExchangeType_Hash)."""
+    sides (the ExchangeSender hash of tipb.ExchangeType_Hash).
+
+    Two mixing rounds (splitmix64's finalizer shape, value arithmetic
+    only — no 64-bit bitcasts): the single-round mix left small
+    sequential key domains (dimension-table primary keys) piled onto
+    half the buckets, overflowing static capacities and demoting joins
+    to the broadcast rung for no reason (ISSUE 12)."""
     h = key * _MIX
     h = h ^ (h >> 31)  # arithmetic shift: sign bits only perturb, not bias
+    h = h * _MIX2
+    h = h ^ (h >> 29)
     return jnp.mod(h, n_parts)
 
 
@@ -243,6 +252,133 @@ def trace_exchange_kernel(mode: str = "shuffle"):
         jnp.zeros(n_local, jnp.float64),
     )
     return jax.make_jaxpr(fn)(*args)
+
+
+def _canonical_tree_fn(S: int, cap: int, n_local: int, cap_out: int):
+    """The canonical 3-way rung-ladder program shape (ISSUE 12,
+    mpp/jointree.py): rung 0 joins base(key a, payload) against side B
+    (key a -> key b mapping), rung 1 joins the DEVICE-RESIDENT
+    intermediate against side C (key b, measure) — both rungs inside
+    ONE traced program so kernelcheck guards the whole ladder's int64
+    census.  Operand SHIFTS (the caller adds a constant to every key
+    column) must trace to the IDENTICAL jaxpr: key values are runtime
+    data, never compiled constants."""
+
+    def one_rung(pk, pm, slots, bk, bm, b_payload):
+        bpid = partition_ids(bk, S)
+        packed, bval, b_over = pack_buckets(
+            bpid, bm, S, cap, (bk, b_payload))
+        rbk = exchange(packed[0])
+        rbv = exchange(packed[1])
+        b_ok = exchange(bval)
+        ppid = partition_ids(pk, S)
+        parrs = [pk] + [a for pair in slots for a in pair]
+        packed_p, pval, p_over = pack_buckets(ppid, pm, S, cap, parrs)
+        recv = [exchange(a) for a in packed_p]
+        p_ok = exchange(pval)
+        sbk, bord, nb = sorted_build(rbk, b_ok)
+        src, bidx, out_valid, matched, j_over = expand_matches(
+            sbk, bord, nb, recv[0], p_ok, p_ok, cap_out, False)
+        out_slots = [(recv[1 + 2 * i][src], recv[2 + 2 * i][src])
+                     for i in range(len(slots))]
+        out_slots.append((rbv[bidx], matched))
+        keep = out_valid & matched
+        over = jax.lax.psum(p_over + b_over, "dp")
+        jover = jax.lax.psum(j_over, "dp")
+        return out_slots, keep, over, jover
+
+    def shard_fn(ak, av, bk_a, bk_b, bm, ck, cv, cm):
+        # rung 0: base(a_key, a_payload) ⋈ B(a_key -> b_key)
+        slots0, keep0, ov0, jo0 = one_rung(
+            ak, jnp.ones_like(ak, dtype=jnp.bool_),
+            [(av, jnp.ones_like(ak, dtype=jnp.bool_))], bk_a, bm, bk_b)
+        # rung 1: intermediate(b_key) ⋈ C(b_key, measure) — the
+        # intermediate arrays feed straight in, no host boundary
+        bkey = slots0[1][0].astype(jnp.int64)
+        slots1, keep1, ov1, jo1 = one_rung(
+            bkey, keep0 & slots0[1][1], slots0, ck, cm, cv)
+        payload = jnp.where(keep1, slots1[0][0], 0.0)
+        measure = jnp.where(keep1, slots1[-1][0], 0.0)
+        total = jax.lax.psum((payload * measure).sum(), "dp")
+        return ov0 + ov1, jo0 + jo1, keep1, total
+
+    return shard_fn
+
+
+#: canonical tree-kernel shape (S, cap, n_local, cap_out) — one source
+#: for the shard_map builder AND the numpy oracle's input size, so a
+#: retune can never make executed-parity compare different row counts
+_TREE_KERNEL_SHAPE = (1, 256, 64, 1024)
+
+
+def _tree_kernel_fn():
+    """The canonical 3-way ladder wrapped in its 1-device shard_map —
+    shared by trace_tree_join_kernel and run_tree_join_kernel so the
+    traced jaxpr and the executed result can never diverge on mesh or
+    spec constants.  Returns (fn, n_local)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    S, cap, n_local, cap_out = _TREE_KERNEL_SHAPE
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    fn = shard_map(
+        _canonical_tree_fn(S, cap, n_local, cap_out), mesh=mesh,
+        in_specs=(P("dp"),) * 8,
+        out_specs=(P(), P(), P("dp"), P()),
+    )
+    return fn, n_local
+
+
+def trace_tree_join_kernel(shift: int = 0):
+    """make_jaxpr stats for the canonical 3-way ladder over a 1-device
+    mesh; `shift` offsets every key operand — lint.kernelcheck traces
+    two shifts and requires identical jaxprs (key VALUES must never
+    shape the compiled ladder)."""
+    fn, n_local = _tree_kernel_fn()
+    args = _tree_kernel_args(n_local, shift)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _tree_kernel_args(n_local: int, shift: int = 0):
+    rng = np.random.default_rng(5)
+    ak = rng.integers(0, 16, n_local).astype(np.int64) + shift
+    av = rng.uniform(0, 1, n_local)
+    bk_a = rng.integers(0, 16, n_local).astype(np.int64) + shift
+    bk_b = rng.integers(0, 8, n_local).astype(np.int64) + shift
+    bm = rng.random(n_local) < 0.5
+    ck = rng.integers(0, 8, n_local).astype(np.int64) + shift
+    cv = rng.uniform(0, 1, n_local)
+    cm = rng.random(n_local) < 0.8
+    # host numpy: trace/run callers device_put, the oracle reads direct
+    return (ak, av, bk_a, bk_b, bm, ck, cv, cm)
+
+
+def run_tree_join_kernel(shift: int = 0):
+    """Execute the canonical ladder concretely (1 device) and return the
+    scalar result — kernelcheck compares it against the numpy oracle
+    (`tree_join_oracle`) for executed parity."""
+    fn, n_local = _tree_kernel_fn()
+    over, jover, _keep, total = fn(*_tree_kernel_args(n_local, shift))
+    return int(over), int(jover), float(total)
+
+
+def tree_join_oracle(shift: int = 0) -> float:
+    """Numpy reference for run_tree_join_kernel: the same 3-way join
+    evaluated row-at-a-time on the host."""
+    n_local = _TREE_KERNEL_SHAPE[2]
+    ak, av, bk_a, bk_b, bm, ck, cv, cm = _tree_kernel_args(n_local, shift)
+    total = 0.0
+    for i in range(n_local):
+        for j in range(n_local):
+            if not bm[j] or bk_a[j] != ak[i]:
+                continue
+            for k in range(n_local):
+                if cm[k] and ck[k] == np.int64(bk_b[j]):
+                    total += av[i] * cv[k]
+    return float(total)
 
 
 def _canonical_grouped_fn(S: int, cap_out: int, cap_g: int):

@@ -229,6 +229,129 @@ class MPPReaderExec(Executor):
             self._fallback = None
 
 
+class MPPTreeReaderExec(Executor):
+    """Root executor for the multi-way join-tree ladder (ISSUE 12): own
+    every side's cop DAG, hand the rung ladder to the device engine
+    (mpp/jointree.py), and stream joined rows or partial-agg chunks.
+    When the engine declines, the SAME ladder runs as CHAINED host hash
+    joins in the compiler's join order — correctness never depends on
+    the mesh."""
+
+    def __init__(self, ctx: ExecContext, spec, ftypes, plan_id: int = -1):
+        super().__init__(ctx, ftypes, [], plan_id)
+        self.spec = spec
+        self._chunks: Optional[List[Chunk]] = None
+        self._pos = 0
+
+    def _open(self):
+        self._chunks = None
+        self._pos = 0
+
+    def _attribute(self, engine: str):
+        if self.plan_id >= 0:
+            self.ctx.op_stats(self.plan_id).engine = engine
+
+    def _slot_ftypes(self):
+        spec = self.spec
+        fts = []
+        for side, sp in spec.slot_src:
+            ft = spec.sides[side].out_ftypes[sp]
+            fts.append(ft)
+        return fts
+
+    def _run(self):
+        from .jointree import run_mpp_jointree
+
+        spec = self.spec
+        spec.ts = self.ctx.snapshot_ts()
+        if self.ctx.engine != "tpu":
+            self._run_host("engine=cpu")
+            return
+        from .engine import MPPIneligible
+
+        try:
+            self._chunks, mode = run_mpp_jointree(self.ctx.storage, spec)
+            self._attribute(f"mpp-{mode}")
+        except MPPIneligible as e:
+            self._run_host(str(e))
+
+    # ---- host rung: chained hash joins in the same join order --------
+    def _side_reader(self, side) -> Executor:
+        from ..copr.ir import DAG
+        from ..executor.readers import TableReaderExec
+
+        dag = DAG.from_dict(side.dag)
+        return TableReaderExec(self.ctx, dag, list(side.ranges),
+                               dag.output_ftypes(), plan_id=-1)
+
+    def _build_host_chain(self) -> Executor:
+        from ..executor.join import HashJoinExec
+
+        spec = self.spec
+        slot_fts = self._slot_ftypes()
+        cur = self._side_reader(spec.sides[0])
+        for rung in spec.rungs:
+            side = spec.sides[rung.side]
+            pkeys = [ColumnExpr(s, slot_fts[s], "pk", -1)
+                     for s in rung.left_slots]
+            bkeys = [ColumnExpr(kp, side.out_ftypes[kp], "bk", -1)
+                     for kp in rung.build_key_pos]
+            build = self._side_reader(side)
+            cur = HashJoinExec(
+                self.ctx, build, cur, rung.kind, bkeys, pkeys,
+                list(rung.other_conds), probe_is_left=True, plan_id=-1)
+        return cur
+
+    def _run_host(self, reason: str):
+        REGISTRY.inc("mpp_fallback_total")
+        REGISTRY.inc("mpp_tree_fallback_total")
+        self._attribute(f"host-tree [mpp rejected: {reason}]")
+        from ..trace import span
+
+        spec = self.spec
+        grouped = spec.aggs is not None and spec.group_by is not None
+        folds = ([_AggFold(a) for a in spec.aggs]
+                 if spec.aggs is not None and not grouped else None)
+        chunks: List[Chunk] = []
+        join = self._build_host_chain()
+        with span("mpp.host_join", reason=reason[:80]):
+            join.open()
+            try:
+                while True:
+                    c = join.next()
+                    if c is None:
+                        break
+                    if not c.num_rows:
+                        continue
+                    if grouped:
+                        chunks.extend(_grouped_fold(spec, c))
+                    elif folds is not None:
+                        for f in folds:
+                            f.consume(c)
+                    else:
+                        chunks.append(self._project_rows(c))
+            finally:
+                join.close()
+        if folds is not None:
+            chunks = [Chunk([col for f in folds for col in f.partials()])]
+        self._chunks = chunks
+
+    def _project_rows(self, c: Chunk) -> Chunk:
+        spec = self.spec
+        if spec.out_slots == list(range(len(spec.slot_src))):
+            return c
+        return Chunk([c.columns[s] for s in spec.out_slots])
+
+    def _next(self) -> Optional[Chunk]:
+        if self._chunks is None:
+            self._run()
+        if self._pos >= len(self._chunks):
+            return None
+        c = self._chunks[self._pos]
+        self._pos += 1
+        return c
+
+
 def _grouped_fold(spec, chunk: Chunk) -> List[Chunk]:
     """Host-rung grouped partials for one joined chunk (the shared
     copr recipe; the parent FINAL HashAgg merges across chunks)."""

@@ -23,6 +23,7 @@ from .columns import Schema, SchemaCol, next_uid
 from .expr_build import (
     CorrelatedColumn,
     ExprBuilder,
+    expr_uids as _expr_uids,
     fold_constant,
     literal_to_constant,
     split_and,
@@ -269,88 +270,15 @@ class PlanBuilder:
         return handler
 
     def _is_correlated_agg(self, query, schema: Schema, outer) -> bool:
-        """Cheap AST check: single aggregate select field, no GROUP BY, and
-        the WHERE references an enclosing column."""
-        if not isinstance(query, ast.SelectStmt) or query.group_by:
-            return False
-        if len(query.fields) != 1 or not _contains_agg(query.fields[0].expr):
-            return False
-        return _references_outer(query, schema, self.infoschema,
-                                 self.current_db)
+        from .decorrelate import is_correlated_agg
+
+        return is_correlated_agg(self, query, schema, outer)
 
     def _decorrelate_scalar(self, query, schema: Schema, outer,
                             plan_holder):
-        """t1.x > (SELECT agg(e) FROM t2 WHERE t2.k = t1.k AND ...) becomes
-        LEFT JOIN (SELECT t2.k, agg(e) FROM t2 WHERE ... GROUP BY t2.k) ON
-        t2.k = t1.k, with the expression reading the agg output column."""
-        inner = self.build_from(query.from_clause, [schema] + outer)
-        outer_uids = set(schema.uids())
-        conds: List[Expression] = []
-        if query.where is not None:
-            eb = ExprBuilder(inner.schema, None, None, [schema] + outer,
-                             self.param_values)
-            # widen resolution: correlated refs resolve via outer schemas
-            for conj in split_and(query.where):
-                conds.append(eb.build(conj))
-        pairs, residual = _split_corr_eqs(conds, outer_uids,
-                                          set(inner.schema.uids()))
-        if any(_expr_uids([c]) & outer_uids for c in residual):
-            raise PlanError("correlated predicate must be an equality "
-                            "with an outer column")
-        if residual:
-            inner = LogicalSelection(inner, residual)
-        # build the select field: arbitrary expression over collected aggs
-        aggs: List[AggDesc] = []
-        agg_uids: List[int] = []
+        from .decorrelate import decorrelate_scalar
 
-        def collector(name, args, distinct):
-            d = AggDesc(name, args, distinct)
-            aggs.append(d)
-            uid = next_uid()
-            agg_uids.append(uid)
-            col = ColumnExpr(-1, d.ftype.with_nullable(True), str(d), uid)
-            if name == "count":
-                # the LEFT JOIN below yields NULL for unmatched outer rows,
-                # but COUNT over an empty group must read 0 (the classic
-                # COUNT decorrelation bug; reference rule_decorrelate.go
-                # wraps count outputs the same way)
-                from ..expr.builtins import infer_ftype
-
-                zero = Constant(0, d.ftype)
-                ft = infer_ftype("ifnull", [col.ftype, zero.ftype], {})
-                return ScalarFunc("ifnull", [col, zero], ft, {})
-            return col
-
-        feb = ExprBuilder(inner.schema, collector, None, [schema] + outer,
-                          self.param_values)
-        field_expr = feb.build(query.fields[0].expr)
-        if not aggs:
-            raise PlanError("correlated subquery must aggregate")
-        used = _expr_uids([field_expr])
-        if used - set(agg_uids):
-            raise PlanError("correlated subquery field may only combine "
-                            "aggregates and constants")
-        group_exprs = [ie for ie, _oe in pairs]
-        gcols = []
-        for ge in group_exprs:
-            uid = ge.unique_id if isinstance(ge, ColumnExpr) and \
-                ge.unique_id >= 0 else next_uid()
-            gcols.append(SchemaCol(uid, str(ge), ge.ftype, "", str(ge)))
-        agg_schema = Schema(gcols + [
-            SchemaCol(uid, str(a), a.ftype.with_nullable(True), "", str(a))
-            for uid, a in zip(agg_uids, aggs)
-        ])
-        inner_agg = LogicalAggregation(inner, group_exprs, aggs, agg_schema)
-        p = plan_holder[0]
-        eqs = [(oe, gc.to_expr()) for (_ie, oe), gc in zip(pairs, gcols)]
-        joined_schema = Schema(
-            list(p.schema.cols)
-            + [SchemaCol(c.uid, c.name, c.ftype.with_nullable(True), c.table,
-                         c.display, c.store_offset) for c in agg_schema.cols]
-        )
-        plan_holder[0] = LogicalJoin(p, inner_agg, "left_outer", eqs, [],
-                                     joined_schema)
-        return field_expr
+        return decorrelate_scalar(self, query, schema, outer, plan_holder)
 
     def _eval_subplan(self, plan: LogicalPlan) -> List[tuple]:
         if self.exec_subplan is None:
@@ -678,68 +606,15 @@ class PlanBuilder:
 
     def _semi_join(self, p: LogicalPlan, query, operand, negated: bool,
                    outer) -> LogicalPlan:
-        kind = "anti_semi" if negated else "semi"
-        eb = ExprBuilder(p.schema, None, None, outer, self.param_values)
-        left_key = eb.build(operand)
-        if _references_outer(query, p.schema, self.infoschema, self.current_db):
-            inner, pairs, other = self._correlated_source(
-                query, p.schema, outer)
-            veb = ExprBuilder(inner.schema, None, None,
-                              [p.schema] + outer, self.param_values)
-            value = veb.build(query.fields[0].expr)
-            eqs = [(left_key, value)] + [(oe, ie) for ie, oe in pairs]
-            return LogicalJoin(p, inner, kind, eqs, other, p.schema)
-        sub = self.build_select(query, [p.schema] + outer)
-        if len(sub.schema) != 1:
-            raise PlanError("IN subquery must return one column")
-        right_key = sub.schema.col(0).to_expr()
-        return LogicalJoin(p, sub, kind, [(left_key, right_key)], [],
-                           p.schema)
+        from .decorrelate import semi_join
+
+        return semi_join(self, p, query, operand, negated, outer)
 
     def _exists_join(self, p: LogicalPlan, query, negated: bool,
                      outer) -> LogicalPlan:
-        kind = "anti_semi" if negated else "semi"
-        if _references_outer(query, p.schema, self.infoschema, self.current_db):
-            inner, pairs, other = self._correlated_source(
-                query, p.schema, outer)
-            eqs = [(oe, ie) for ie, oe in pairs]
-            return LogicalJoin(p, inner, kind, eqs, other, p.schema)
-        sub = self.build_select(query, [p.schema] + outer)
-        return LogicalJoin(p, sub, kind, [], [], p.schema)
+        from .decorrelate import exists_join
 
-    def _correlated_source(self, query, schema: Schema, outer,
-                           allow_other: bool = True):
-        """FROM+WHERE of a correlated IN/EXISTS block, with the correlated
-        equality pairs pulled out (rule_decorrelate.go): returns
-        (inner_plan, [(inner_expr, outer_colexpr)], other_corr_conds).
-        Non-equality correlated conjuncts become semi-join other-conds when
-        allowed (they evaluate over the outer++inner pair layout)."""
-        if not isinstance(query, ast.SelectStmt):
-            raise PlanError("correlated subquery must be a simple SELECT")
-        if query.group_by or query.having:
-            raise PlanError(
-                "GROUP BY/HAVING in a correlated IN/EXISTS is not supported"
-            )
-        inner = self.build_from(query.from_clause, [schema] + outer)
-        outer_uids = set(schema.uids())
-        conds: List[Expression] = []
-        if query.where is not None:
-            eb = ExprBuilder(inner.schema, None, None, [schema] + outer,
-                             self.param_values)
-            for conj in split_and(query.where):
-                conds.append(eb.build(conj))
-        pairs, residual = _split_corr_eqs(conds, outer_uids,
-                                          set(inner.schema.uids()))
-        other_corr = [c for c in residual if _expr_uids([c]) & outer_uids]
-        residual = [c for c in residual if not (_expr_uids([c]) & outer_uids)]
-        if other_corr and not allow_other:
-            raise PlanError("correlated predicate must be an equality "
-                            "with an outer column")
-        if residual:
-            inner = LogicalSelection(inner, residual)
-        if not pairs and not other_corr:
-            raise PlanError("could not decorrelate subquery")
-        return inner, pairs, other_corr
+        return exists_join(self, p, query, negated, outer)
 
     # ------------------------------------------------------------------
     # UNION
@@ -916,112 +791,19 @@ def _root_uids(e: Expression) -> set:
     return out
 
 
-def _expr_uids(exprs) -> set:
-    out: set = set()
-    for e in exprs:
-        e.collect_columns(out)
-    return out
 
 
 def _split_corr_eqs(conds, outer_uids: set, inner_uids: set):
-    """Partition conjuncts into correlated equality pairs
-    [(inner_expr, outer_colexpr)] and residual conds."""
-    pairs, residual = [], []
-    for cond in conds:
-        uids = _expr_uids([cond])
-        if not (uids & outer_uids):
-            residual.append(cond)
-            continue
-        ok = False
-        if isinstance(cond, ScalarFunc) and cond.name == "=" and \
-                len(cond.args) == 2:
-            a, b = cond.args
-            ua, ub = _expr_uids([a]), _expr_uids([b])
-            if isinstance(a, ColumnExpr) and a.unique_id in outer_uids \
-                    and ub and ub <= inner_uids:
-                pairs.append((b, a))
-                ok = True
-            elif isinstance(b, ColumnExpr) and b.unique_id in outer_uids \
-                    and ua and ua <= inner_uids:
-                pairs.append((a, b))
-                ok = True
-        if not ok:
-            residual.append(cond)
-    return pairs, residual
+    from .decorrelate import split_corr_eqs
+
+    return split_corr_eqs(conds, outer_uids, inner_uids)
 
 
 def _references_outer(query, schema: Schema,
                       infoschema=None, current_db: str = "") -> bool:
-    """Does the subquery's AST reference a column resolvable ONLY in the
-    outer schema?  Walk over ColumnRefs: names the inner FROM cannot
-    provide but the outer schema can."""
-    outer_names = {(c.table.lower(), c.name.lower()) for c in schema.cols}
-    outer_bare = {c.name.lower() for c in schema.cols}
-    inner_tables = set()
-    inner_cols = set()  # bare column names the inner FROM provides
+    from .decorrelate import references_outer
 
-    def from_names(node):
-        if isinstance(node, ast.TableName):
-            inner_tables.add((node.alias or node.name).lower())
-            if infoschema is not None:
-                try:
-                    t = infoschema.table(node.db or current_db, node.name)
-                    inner_cols.update(c.name.lower()
-                                      for c in t.public_columns())
-                except Exception:
-                    pass
-        elif isinstance(node, ast.SubqueryRef):
-            inner_tables.add(node.alias.lower())
-            for f in getattr(node.query, "fields", []):
-                if f.alias:
-                    inner_cols.add(f.alias.lower())
-                elif isinstance(f.expr, ast.ColumnRef):
-                    inner_cols.add(f.expr.name.lower())
-        elif isinstance(node, ast.Join):
-            from_names(node.left)
-            from_names(node.right)
-
-    if isinstance(query, ast.SelectStmt):
-        from_names(query.from_clause)
-
-    hit = [False]
-
-    def walk_expr(e):
-        if hit[0] or not isinstance(e, ast.Node):
-            return
-        if isinstance(e, ast.ColumnRef):
-            if e.table:
-                if e.table.lower() not in inner_tables and \
-                        (e.table.lower(), e.name.lower()) in outer_names:
-                    hit[0] = True
-            else:
-                if infoschema is not None and e.name.lower() in outer_bare \
-                        and e.name.lower() not in inner_cols:
-                    hit[0] = True
-            return
-        if isinstance(e, (ast.InSubquery, ast.Exists, ast.ScalarSubquery)):
-            return  # nested blocks judge their own correlation
-        for attr in ("left", "right", "operand", "expr", "low", "high",
-                     "else_expr", "value"):
-            v = getattr(e, attr, None)
-            if isinstance(v, ast.Node):
-                walk_expr(v)
-        for attr in ("args", "items"):
-            v = getattr(e, attr, None)
-            if isinstance(v, list):
-                for x in v:
-                    walk_expr(x)
-        if isinstance(e, ast.CaseWhen):
-            for w, t in e.branches:
-                walk_expr(w)
-                walk_expr(t)
-
-    if isinstance(query, ast.SelectStmt):
-        for f in query.fields:
-            walk_expr(f.expr)
-        if query.where is not None:
-            walk_expr(query.where)
-    return hit[0]
+    return references_outer(query, schema, infoschema, current_db)
 
 
 def _walk_exprs(plan: LogicalPlan):

@@ -385,6 +385,15 @@ def split_and(e: ast.Expr) -> List[ast.Expr]:
     return [e]
 
 
+def expr_uids(exprs) -> set:
+    """Every column uid referenced by `exprs` (the shared walk used by
+    the plan builder, the decorrelator, and the join-tree compiler)."""
+    out: set = set()
+    for e in exprs:
+        e.collect_columns(out)
+    return out
+
+
 def fold_constant(e: Expression) -> Expression:
     """Bottom-up constant folding (expression/constant_fold.go)."""
     if isinstance(e, ScalarFunc):

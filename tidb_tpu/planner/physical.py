@@ -360,6 +360,93 @@ class PhysMPPJoin(PhysicalPlan):
         return MPPReaderExec(ctx, spec, self.schema.ftypes(), self.id)
 
 
+class PhysMPPJoinTree(PhysicalPlan):
+    """Multi-way device-resident join ladder (ISSUE 12): children are
+    one ExchangeSender scan fragment per side in JOIN ORDER; each rung
+    joins the device-resident intermediate against the next side inside
+    one exchange program, and the final phase emits joined rows or the
+    on-device partial aggregation.  EXPLAIN shows the chosen join order
+    with est_rows per rung; the executor (MPPTreeReaderExec) falls back
+    to a chained host hash join when the mesh declines."""
+
+    def __init__(self, senders, rungs, slot_src, out_slots, out_ftypes,
+                 schema: Schema, aggs=None, group_by=None,
+                 group_budget: int = 0):
+        super().__init__(schema, list(senders))
+        self.rungs = rungs          # [{side, kind, left_slots, build_pos,
+        #                              others, est}]
+        self.slot_src = slot_src
+        self.out_slots = out_slots
+        self.out_ftypes = out_ftypes
+        self.aggs = aggs
+        self.group_by = group_by
+        self.group_budget = group_budget
+
+    @property
+    def name(self) -> str:
+        return "MPPJoinTree"
+
+    def task(self) -> str:
+        return "mpp[tpu]"
+
+    def info(self) -> str:
+        order = " -> ".join(c.cop.table.name for c in self.children)
+        s = f"order: {order}"
+        if self.aggs is not None:
+            s += f", partial aggs:[{', '.join(map(str, self.aggs))}]"
+        if self.group_by:
+            s += (f", group by:[{', '.join(map(str, self.group_by))}]"
+                  f" budget:{self.group_budget}")
+        return s
+
+    def explain_tree(self, indent: int = 0, lines=None):
+        lines = lines if lines is not None else []
+        pad = ("  " * indent + "└─") if indent else ""
+        lines.append((f"{pad}{self.name}_{self.id}", self._est_str(),
+                      self.task(), self.info()))
+        for i, r in enumerate(self.rungs):
+            pad2 = "  " * (indent + 1) + "└─"
+            build = self.children[r["side"]].cop.table.name
+            info = (f"{r['kind']} build:{build}, "
+                    f"keys:{r['left_slots']}=={r['build_pos']}")
+            if r["others"]:
+                info += " other:[" + ", ".join(
+                    map(str, r["others"])) + "]"
+            lines.append((f"{pad2}Rung_{i}", f"{r['est']:.2f}",
+                          "mpp[tpu]", info))
+        for c in self.children:
+            c.explain_tree(indent + 1, lines)
+        return lines
+
+    def build(self, ctx):
+        from ..mpp import MPPJoinSide
+        from ..mpp.jointree import MPPJoinTreeSpec, TreeRung
+        from ..mpp.reader import MPPTreeReaderExec
+
+        sides = []
+        for sender in self.children:
+            sides.append(MPPJoinSide(
+                table_id=sender.cop.table.id,
+                dag=sender.dag.to_dict(),
+                ranges=list(sender.ranges),
+                key_pos=list(sender.key_pos),
+                out_ftypes=sender.dag.output_ftypes(),
+            ))
+        rungs = [TreeRung(side=r["side"], kind=r["kind"],
+                          left_slots=list(r["left_slots"]),
+                          build_key_pos=list(r["build_pos"]),
+                          other_conds=list(r["others"]),
+                          est_rows=float(r["est"]))
+                 for r in self.rungs]
+        spec = MPPJoinTreeSpec(
+            sides=sides, rungs=rungs, slot_src=list(self.slot_src),
+            out_slots=list(self.out_slots),
+            out_ftypes=list(self.out_ftypes),
+            aggs=self.aggs, group_by=self.group_by,
+            group_budget=self.group_budget)
+        return MPPTreeReaderExec(ctx, spec, self.schema.ftypes(), self.id)
+
+
 class PhysIndexLookUp(PhysicalPlan):
     """Index-range read: binary search the sorted index for handles, sparse
     block gather for rows (root task, host path — the OLTP lane)."""
@@ -1350,6 +1437,16 @@ def _physical_agg(plan: LogicalAggregation,
         mj = _try_mpp_join_agg(plan, child_l, pctx)
         if mj is not None:
             return mj
+    # agg over a multi-way join TREE (optionally through a projection,
+    # the derived-table shape of Q7/Q8/Q9): the join-tree compiler
+    # lowers the whole ladder + partial agg onto the device (ISSUE 12)
+    if isinstance(child_l, (LogicalJoin, LogicalProjection)) \
+            and pctx.enable_pushdown:
+        from .jointree import try_jointree_agg
+
+        tj = try_jointree_agg(plan, child_l, pctx)
+        if tj is not None:
+            return tj
     child = to_physical(child_l, pctx)
     gb = _remap(plan.group_by, child.schema)
     aggs = [a.remap_columns(child.schema.position_map()) for a in plan.aggs]
@@ -2010,6 +2107,13 @@ def _physical_join(plan: LogicalJoin, pctx: PhysicalContext) -> PhysicalPlan:
             mpp = _try_mpp_join(plan, pctx)
             if mpp is not None:
                 return mpp
+        # multi-way join trees / decorrelated semi-anti filter rungs:
+        # the join-tree compiler keeps the whole ladder device-resident
+        from .jointree import try_jointree
+
+        jt = try_jointree(plan, pctx)
+        if jt is not None:
+            return jt
     left = to_physical(plan.children[0], pctx)
     right = to_physical(plan.children[1], pctx)
     lmap = left.schema.position_map()
@@ -2184,6 +2288,12 @@ def _est_rows(p: PhysicalPlan, pctx: PhysicalContext) -> float:
             # merge keeps roughly the group count
             return max(_est_rows(p.children[0], pctx), 1)
         return max(_est_rows(p.children[0], pctx) * 0.1, 1)
+    if isinstance(p, PhysMPPJoinTree):
+        if p.aggs is not None:
+            if p.group_by:
+                return float(max(p.group_budget, 1))
+            return 1.0
+        return max(float(p.rungs[-1]["est"]) if p.rungs else 1.0, 1.0)
     if isinstance(p, PhysMPPJoin):
         if p.aggs is not None:
             if p.group_by:

@@ -258,3 +258,48 @@ def _np_scalar(o):
     if hasattr(o, "item"):
         return o.item()
     raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class JsonStatePersister:
+    """Small durable JSON document with the same crash contract as the
+    table persister: atomic tmp-write + rename + dir fsync, torn/corrupt
+    files load as `None` instead of crashing the owner.  Backs the
+    coordination plane's membership/handoff state (ISSUE 12: a
+    coordinator restart replays the epoch instead of starting at 0)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.dir = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(self.dir, exist_ok=True)
+
+    def save(self, doc: dict):
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, default=_np_scalar)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load(self) -> Optional[dict]:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (ValueError, OSError):
+            return None  # torn write: the owner starts fresh
+
+    def remove(self):
+        if os.path.exists(self.path):
+            os.unlink(self.path)
