@@ -292,6 +292,12 @@ def _host_fallback_worker():
         out["tpch_matrix"] = tpch_matrix_bench(scale=1.0)
     except BaseException as e:  # noqa: BLE001
         out["tpch_matrix"] = {"error": repr(e)}
+    # trace + profiler overhead on the CPU harness (ISSUE 13): the <2%
+    # claim is a recorded receipt even when the tunnel is down
+    try:
+        out["trace_overhead"] = trace_overhead_bench(sess)
+    except BaseException as e:  # noqa: BLE001
+        out["trace_overhead"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -775,6 +781,41 @@ def fusion_bench(sess, n: int) -> dict:
     return out
 
 
+def trace_overhead_bench(sess, iters: int = None) -> dict:
+    """Trace-overhead receipt (ISSUE 4, extended by ISSUE 13): steady-
+    state Q1 untraced vs traced vs traced+profiled.  The continuous
+    profiler folds every finished trace into the flame windows, so the
+    profiled leg is the real production configuration — both deltas
+    must stay under 2%."""
+    from tidb_tpu.trace import PROFILER
+
+    iters = ITERS if iters is None else iters
+    prof_prev = PROFILER.enabled
+    try:
+        sess.execute("set tidb_enable_slow_log = 0")
+        _, t_off = time_query(sess, Q1, iters)
+        PROFILER.enabled = False
+        sess.execute("set tidb_enable_slow_log = 1")
+        _, t_on = time_query(sess, Q1, iters)
+        PROFILER.enabled = True
+        _, t_prof = time_query(sess, Q1, iters)
+    finally:
+        PROFILER.enabled = prof_prev
+        sess.execute("set tidb_enable_slow_log = 1")
+    delta_pct = (t_on - t_off) / t_off * 100.0
+    prof_pct = (t_prof - t_off) / t_off * 100.0
+    return {
+        "untraced_s": round(t_off, 5),
+        "traced_s": round(t_on, 5),
+        "profiled_s": round(t_prof, 5),
+        "delta_pct": round(delta_pct, 3),
+        "profiled_delta_pct": round(prof_pct, 3),
+        "ok": delta_pct < 2.0,
+        "profiled_ok": prof_pct < 2.0,
+        "flame_stacks": len(PROFILER.folded().splitlines()),
+    }
+
+
 def _trace_span_sum(sess, sql: str, span_name: str, attr: str) -> int:
     """Run `sql` once under TRACE and sum `attr` over `span_name` spans
     (e.g. host-readback bytes across copr.readback)."""
@@ -1147,22 +1188,15 @@ def _run_inner(state: dict):
 
     # trace-overhead receipt: the span recorder runs on every statement
     # when the slow log is enabled (the default) — steady-state Q1 with
-    # tracing on vs off must stay within 2% (ISSUE 4 acceptance)
+    # tracing off vs on vs on+profiler must stay within 2% (ISSUE 4
+    # acceptance, profiler leg added by ISSUE 13)
     if state.get("q1") and remaining() > 60:
-        sess.execute("set tidb_enable_slow_log = 1")
-        _, t_on = time_query(sess, Q1, ITERS)
-        sess.execute("set tidb_enable_slow_log = 0")
-        _, t_off = time_query(sess, Q1, ITERS)
-        sess.execute("set tidb_enable_slow_log = 1")
-        delta_pct = (t_on - t_off) / t_off * 100.0
-        state["trace_overhead"] = {
-            "traced_s": round(t_on, 5),
-            "untraced_s": round(t_off, 5),
-            "delta_pct": round(delta_pct, 3),
-            "ok": delta_pct < 2.0,
-        }
-        log(f"trace overhead: on={t_on:.4f}s off={t_off:.4f}s "
-            f"delta={delta_pct:+.2f}% ok={delta_pct < 2.0}")
+        to = trace_overhead_bench(sess)
+        state["trace_overhead"] = to
+        log(f"trace overhead: off={to['untraced_s']}s "
+            f"on={to['traced_s']}s (+{to['delta_pct']}%) "
+            f"profiled={to['profiled_s']}s (+{to['profiled_delta_pct']}%)"
+            f" ok={to['ok']} profiled_ok={to['profiled_ok']}")
         state["phases"]["trace_overhead_done"] = round(
             time.perf_counter() - T0, 1)
         persist_partial(state)

@@ -163,7 +163,7 @@ def test_device_kill_mid_scan_serves_from_rebuilt_mesh(sess):
     assert h[3][1] == "tripped" and h[3][2] >= 1 and h[3][3] >= 1
     assert h[3][4] == 0  # quarantined out of the live mesh
     assert h[0][1] == "healthy" and h[0][4] == 1
-    assert REGISTRY.snapshot().get("device_health_tripped_devices") == 1
+    assert REGISTRY.snapshot().get("device_health_tripped_count") == 1
 
     # sharded arrays keyed to the dead device set were evicted: nothing in
     # the mesh cache may reference device 3
@@ -181,7 +181,7 @@ def test_device_kill_mid_scan_serves_from_rebuilt_mesh(sess):
         "select device_id, state, in_current_mesh"
         " from information_schema.tidb_tpu_device_health")}
     assert h[3][1] == "healthy" and h[3][2] == 1
-    assert REGISTRY.snapshot().get("device_health_tripped_devices") == 0
+    assert REGISTRY.snapshot().get("device_health_tripped_count") == 0
 
 
 def test_failed_probe_retrips_breaker(sess):

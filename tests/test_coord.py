@@ -424,6 +424,70 @@ def test_span_forwarding_batches_and_drains(monkeypatch):
         c.stop()
 
 
+def test_metrics_piggyback_on_span_batches(monkeypatch):
+    """Fleet aggregation (ISSUE 13): workers piggyback registry
+    snapshots on the span batches they already send; the coordinator
+    stores the latest per pid and merges counters/histograms/gauges."""
+    monkeypatch.setenv("TIDB_TPU_COORD_METRICS_S", "0")  # every batch
+    from tidb_tpu.metrics import merge_fleet
+
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        w = WorkerPlane(("127.0.0.1", c.port), pid=21,
+                        lease_s=30.0).start([0])
+        REGISTRY.inc("statements_total")
+        REGISTRY.observe_hist("stmt_latency_point_ms", 3.0)
+        m0 = REGISTRY.get("coord_metrics_snapshots_total")
+        tr, tok = start_trace("select 1", 21)
+        finish_trace(tr, tok)
+        w.flush_spans()
+        assert REGISTRY.get("coord_metrics_snapshots_total") == m0 + 1
+        snaps = c.fleet_snapshot()
+        assert 21 in snaps
+        assert snaps[21]["counters"].get("statements_total", 0) >= 1
+        merged = merge_fleet(snaps)
+        assert merged["counters"]["statements_total"] >= 1
+        assert merged["hists"]["stmt_latency_point_ms"]["count"] >= 1
+        # gauges stay per-host, never summed
+        assert "21" in merged["gauges"].get("coord_epoch", {})
+        # a graceful leave prunes the snapshot — a departed host must
+        # not inflate fleet totals forever (it has no lease to expire)
+        w.stop(leave=True)
+        w = None
+        assert 21 not in c.fleet_snapshot()
+    finally:
+        if w is not None:
+            w.stop(leave=True)
+        c.stop()
+
+
+def test_localplane_fleet_merge_degenerate_loop():
+    """LocalPlane degenerates to a single-member fleet, so the whole
+    merge path (counter sums, bucket-wise histogram merge, per-host
+    gauges) runs in tier-1 without spawning workers."""
+    from tidb_tpu.coord.plane import LocalPlane
+    from tidb_tpu.metrics import merge_fleet
+
+    REGISTRY.inc("statements_total")
+    plane = LocalPlane()
+    snaps = plane.fleet_metrics()
+    assert list(snaps) == [0]
+    payload = snaps[0]
+    assert payload["counters"].get("statements_total", 0) >= 1
+    merged = merge_fleet(snaps)
+    assert merged["hosts"] == ["0"]
+    # merging the same payload twice doubles every counter exactly
+    doubled = merge_fleet({0: payload, 1: payload})
+    assert doubled["hosts"] == ["0", "1"]
+    for name, v in payload["counters"].items():
+        if name.endswith("_total"):
+            assert doubled["counters"][name] == pytest.approx(2 * v)
+    for name, h in merged["hists"].items():
+        assert doubled["hists"][name]["count"] == 2 * h["count"]
+
+
 def test_import_does_not_consume_trace_seq():
     """Ingesting a forwarded trace must not advance the local statement
     sequence: SPMD qid correlation relies on every process assigning the
@@ -734,6 +798,24 @@ def test_two_process_failover_and_rolling_restart():
         # ---- cross-host spans rejoined the coordinator's ring -------
         assert any(getattr(tr, "imported_from", None) in (0, 1)
                    for tr in list(TRACE_RING))
+
+        # ---- fleet metric snapshots piggybacked on span batches -----
+        # (ISSUE 13): both live workers' registries reach the
+        # coordinator and merge — counters summed, histograms
+        # bucket-merged across REAL OS processes
+        assert _wait(lambda: {0, 1} <= set(c.fleet_snapshot()), 15.0), \
+            c.fleet_snapshot().keys()
+        from tidb_tpu.metrics import merge_fleet
+
+        fleet = c.fleet_snapshot()
+        for pid in (0, 1):
+            assert fleet[pid]["counters"].get(
+                "statements_total", 0) > 0, pid
+        merged = merge_fleet(fleet)
+        assert merged["counters"]["statements_total"] >= sum(
+            fleet[p]["counters"]["statements_total"] for p in (0, 1))
+        assert any(n.startswith("stmt_latency_")
+                   for n in merged["hists"]), merged["hists"].keys()
 
         # ---- graceful drains ----------------------------------------
         w0.send_signal(signal.SIGTERM)

@@ -165,6 +165,33 @@ def test_explain_shows_join_order_and_est_rows(sess):
         assert "build:" in r[3], r
 
 
+def test_rung_est_rows_single_sourced_from_dp(sess, monkeypatch):
+    """Jointree follow-up (f): the containment cardinality estimate
+    lives ONCE — the DP's per-step numbers ARE the EXPLAIN est_rows
+    (and thereby the grouped-agg budgets), never a second copy of the
+    formula in rung assembly."""
+    from tidb_tpu.planner import jointree as jt
+
+    captured = []
+    orig = jt._order_members
+
+    def spy(sides, edges, pctx):
+        out = orig(sides, edges, pctx)
+        if out is not None:
+            captured.append(list(out[1]))
+        return out
+
+    monkeypatch.setattr(jt, "_order_members", spy)
+    sess._plan_cache.clear()  # a cached plan would skip assembly
+    rows = sess.execute("explain " + THREE_WAY)[0].rows
+    rungs = [r for r in rows if r[0].strip().startswith("└─Rung_")]
+    assert captured and len(rungs) == 2, (captured, rows)
+    dp_ests = captured[-1]
+    assert len(dp_ests) == len(rungs)
+    assert [r[1] for r in rungs] == [f"{e:.2f}" for e in dp_ests], \
+        (rungs, dp_ests)
+
+
 def test_three_way_rows_parity(sess):
     got = _run_tree(sess, THREE_WAY)
     assert len(got) > 0

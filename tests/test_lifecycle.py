@@ -583,8 +583,12 @@ def test_graceful_drain_finishes_inflight_then_closes():
         await cli.connect()
         await cli.query("create table d (a bigint)")
         await cli.query("insert into d values (42)")
-        await cli.send_query("select a, sleep(0.3) from d")
-        await asyncio.sleep(0.05)  # statement is in flight now
+        await cli.send_query("select a, sleep(1.0) from d")
+        # the statement must be EXECUTING (not just parked in the
+        # admission queue) before the drain starts, or a loaded box
+        # races the drain's in-flight census — 0.05 s flaked under
+        # full-suite load on the 1-vCPU harness
+        await asyncio.sleep(0.3)
         drain = asyncio.ensure_future(srv.shutdown(drain_s=5.0))
         await asyncio.sleep(0.05)
         # mid-drain: the listener is closed to NEW work

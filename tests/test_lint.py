@@ -383,6 +383,45 @@ def test_kernelcheck_catches_shape_broken_kernel(corpus_session):
         trace_kernel(_lineitem_table(corpus_session), dag)
 
 
+def test_metric_name_pass_catches_violations():
+    """ISSUE 13: every literal metric name must match [a-z0-9_]+ and
+    carry a conventional suffix — the fleet merge keys sum-vs-gauge
+    semantics off `_total`, so a misnamed counter silently becomes a
+    per-host gauge."""
+    from tidb_tpu.lint.metricnames import lint_source as lint_metrics
+
+    src = textwrap.dedent("""
+        from tidb_tpu.metrics import REGISTRY
+
+        def f(cls):
+            REGISTRY.inc("Bad-Name")
+            REGISTRY.inc("queries_served")
+            REGISTRY.inc("queries_served_total")
+            REGISTRY.observe_hist("lat_ms", 1.0)
+            REGISTRY.observe_hist("lat", 1.0)
+            REGISTRY.set("queue_depth", 3)
+            REGISTRY.inc(f"slo_{cls}_breach_total")
+            REGISTRY.inc(f"trace_phase_{cls}")
+    """)
+    fs = lint_metrics(src, "tidb_tpu/x.py")
+    tokens = {f.token for f in fs}
+    assert "Bad-Name" in tokens                # charset violation
+    assert "queries_served" in tokens          # counter missing _total
+    assert "lat" in tokens                     # histogram missing unit
+    assert "queries_served_total" not in tokens
+    assert "lat_ms" not in tokens
+    assert "queue_depth" not in tokens
+    # f-strings: literal tail is checked, dynamic tail is skipped
+    assert "slox_breach_total" not in tokens
+    assert "trace_phase_x" not in tokens
+
+
+def test_metric_name_pass_runs_in_cli_families():
+    from tidb_tpu.lint import PASS_RULES
+
+    assert PASS_RULES["metric"] == ("metric-name",)
+
+
 def test_kernelcheck_detects_int64_chain_growth():
     """A tightened baseline must flip the suite red: this is the guard
     against reintroducing the int64-emulation chains VERDICT.md names as

@@ -192,6 +192,157 @@ def test_hash_and_encoding_functions(d):
         ("roundtrip",)]
 
 
+# ---------------------------------------------------------------------------
+# histogram SLO metrics, continuous profiling, fleet /status (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantiles_within_one_log2_bucket():
+    """p50/p95/p99 from the bounded log2 buckets are exact to one
+    bucket: true_q <= estimate <= 2 * true_q (the estimator returns the
+    bucket's upper edge)."""
+    import numpy as np
+
+    from tidb_tpu.metrics import Registry
+
+    r = Registry()
+    vals = np.random.default_rng(7).lognormal(2.0, 1.5, 4000)
+    for v in vals:
+        r.observe_hist("unit_lat_ms", float(v))
+    for q in (0.50, 0.95, 0.99):
+        true = float(np.quantile(vals, q))
+        est = r.quantile("unit_lat_ms", q)
+        assert true <= est <= 2.0 * true + 1e-9, (q, true, est)
+    st = r.hist_stats("unit_lat_ms")
+    assert st["count"] == 4000
+    assert abs(st["sum"] - float(vals.sum())) < 1e-6 * float(vals.sum())
+    # merge parity: two copies bucket-merge to doubled counts, same edges
+    from tidb_tpu.metrics import merge_fleet
+
+    payload = r.export_fleet_payload()
+    merged = merge_fleet({0: payload, 1: payload})
+    h = merged["hists"]["unit_lat_ms"]
+    assert h["count"] == 8000
+    assert h["p99"] == r.quantile("unit_lat_ms", 0.99)
+
+
+def test_prometheus_histogram_exposition():
+    from tidb_tpu.metrics import Registry
+
+    r = Registry()
+    r.inc("x_total", 2)
+    for v in (0.5, 3.0, 100.0):
+        r.observe_hist("y_ms", v)
+    lines = r.prometheus_lines()
+    assert "tidb_tpu_x_total 2.0" in lines
+    buckets = [ln for ln in lines
+               if ln.startswith("tidb_tpu_y_ms_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts) and counts[-1] == 3  # cumulative
+    assert 'le="+Inf"' in buckets[-1]
+    assert "tidb_tpu_y_ms_count 3" in lines
+    assert any(ln.startswith("tidb_tpu_y_ms_sum") for ln in lines)
+
+
+def test_status_profile_slo_memory_fleet_sections_and_flame(d):
+    """The ISSUE 13 /status sections + /flame over the wire: profile
+    has stacks after traced statements, slo carries thresholds + burn,
+    memory reports every named device cache with watermarks, fleet
+    degenerates to the single LocalPlane host — and /flame emits
+    parseable folded-stacks text."""
+    from tidb_tpu.server import StatusServer
+
+    s = d.new_session()
+    s.execute("create table ob13 (a bigint, b bigint)")
+    s.execute("insert into ob13 values (1,2),(3,4),(5,6),(7,8)")
+    s.execute("analyze table ob13")
+    s.query("select sum(a) from ob13 where b > 1")
+    s.query("select a from ob13 where a = 3")
+    srv = StatusServer(d, port=0)
+    host, port = srv.start()
+    try:
+        base = f"http://{host}:{port}"
+        st = json.loads(urllib.request.urlopen(base + "/status").read())
+        for key in ("profile", "slo", "memory", "fleet"):
+            assert key in st, st.keys()
+            assert "error" not in st[key], (key, st[key])
+        assert st["profile"]["top"], st["profile"]
+        assert st["profile"]["top"][0]["stack"].startswith(
+            "session.execute")
+        slo = st["slo"]
+        assert set(slo) == {"point", "agg", "join", "dml", "other"}
+        assert slo["agg"]["threshold_ms"] > 0
+        assert slo["agg"].get("count", 0) >= 1  # the sum() above
+        caches = st["memory"]["caches"]
+        assert "mesh" in caches and "tile" in caches
+        for cs in caches.values():
+            assert cs["watermark_bytes"] >= cs["bytes"] >= 0
+        fleet = st["fleet"]
+        assert fleet["hosts"] == ["0"] and fleet["kind"] == "local"
+        assert fleet["counters"].get("statements_total", 0) > 0
+        assert any(n.startswith("stmt_latency_") for n in fleet["hists"])
+        flame = urllib.request.urlopen(base + "/flame").read().decode()
+        assert flame.strip(), "/flame must be non-empty after queries"
+        for ln in flame.strip().splitlines():
+            stack, weight = ln.rsplit(" ", 1)
+            assert stack and int(weight) >= 0
+        assert any(ln.startswith("session.execute")
+                   for ln in flame.splitlines())
+        metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "_bucket{le=" in metrics
+        assert "tidb_tpu_stmt_latency_agg_ms_count" in metrics
+        assert "tidb_tpu_cache_mesh_watermark_bytes" in metrics
+    finally:
+        srv.stop()
+    # the same data through INFORMATION_SCHEMA
+    rows = s.query("select stack, count, self_ms from"
+                   " information_schema.tidb_tpu_profile")
+    assert rows and any(r[0].startswith("session.execute") for r in rows)
+    fm = s.query("select host, kind, value from"
+                 " information_schema.tidb_tpu_fleet_metrics"
+                 " where name = 'statements_total'")
+    assert ("fleet", "counter") in {(r[0], r[1]) for r in fm}
+    assert all(r[2] > 0 for r in fm)
+
+
+def test_slo_burn_counters_ride_sysvars(d):
+    from tidb_tpu.metrics import REGISTRY
+
+    s = d.new_session()
+    s.execute("set global tidb_tpu_slo_point_ms = 1")
+    b0 = REGISTRY.get("slo_point_breach_total")
+    ok0 = REGISTRY.get("slo_point_ok_total")
+    try:
+        s.query("select sleep(0.05)")  # point-class, forced breach
+    finally:
+        s.execute("set global tidb_tpu_slo_point_ms = 100000")
+    s.query("select 1")  # point-class, comfortably inside
+    assert REGISTRY.get("slo_point_breach_total") == b0 + 1
+    assert REGISTRY.get("slo_point_ok_total") >= ok0 + 1
+    # 0 disables burn accounting (histogram still records)
+    s.execute("set global tidb_tpu_slo_point_ms = 0")
+    b1 = REGISTRY.get("slo_point_breach_total")
+    ok1 = REGISTRY.get("slo_point_ok_total")
+    h0 = REGISTRY.hist_stats("stmt_latency_point_ms")["count"]
+    try:
+        s.query("select 1")
+    finally:
+        s.execute("set global tidb_tpu_slo_point_ms = 100")
+    assert REGISTRY.get("slo_point_breach_total") == b1
+    assert REGISTRY.get("slo_point_ok_total") == ok1
+    assert REGISTRY.hist_stats("stmt_latency_point_ms")["count"] == h0 + 1
+    # a SESSION-scope override never drives the fleet-wide burn
+    # counters (they must agree with the global threshold /status
+    # reports); the global threshold (100ms) still counts it ok
+    s.execute("set session tidb_tpu_slo_point_ms = 1")
+    b2 = REGISTRY.get("slo_point_breach_total")
+    try:
+        s.query("select sleep(0.05)")
+    finally:
+        s.execute("set session tidb_tpu_slo_point_ms = 100")
+    assert REGISTRY.get("slo_point_breach_total") == b2
+
+
 def test_show_stats_healthy_and_analyze_status(d):
     import time as _time
 

@@ -342,3 +342,144 @@ def test_trace_ring_feeds_status_surface(env):
     tot = tr.phase_totals()
     assert set(tot) >= {"compile_ms", "transfer_bytes", "device_ms",
                         "readback_ms", "backoff_ms", "engines"}
+
+
+# ---------------------------------------------------------------------------
+# continuous profiling + SLO plane (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_folds_finished_traces(env):
+    d, s = env
+    from tidb_tpu.trace import PROFILER
+
+    f0 = REGISTRY.get("profile_traces_folded_total")
+    s.query(Q1ISH)
+    assert REGISTRY.get("profile_traces_folded_total") == f0 + 1
+    folded = PROFILER.folded()
+    assert folded.strip()
+    stacks = dict(ln.rsplit(" ", 1) for ln in folded.strip().splitlines())
+    assert any(st.startswith("session.execute") for st in stacks)
+    # engine attribution rides the frames (compiled vs interpreted path)
+    assert any(":" in st for st in stacks), stacks
+
+
+def test_profiler_chains_export_hook(env):
+    """The profiler hook CHAINS whatever export hook is installed (the
+    coord forwarder seam) — both must see every finished trace."""
+    from tidb_tpu.trace import Profiler, recorder
+
+    d, s = env
+    seen = []
+    prev = recorder.TRACE_EXPORT_HOOK
+    recorder.TRACE_EXPORT_HOOK = lambda tr: seen.append(tr.sql)
+    try:
+        p = Profiler(enabled=True)
+        p.install()
+        s.query("select count(*) from li")
+        assert seen and "count(*)" in seen[-1]  # forwarder still ran
+        assert p.folded().strip()               # and the profiler folded
+    finally:
+        recorder.TRACE_EXPORT_HOOK = prev
+
+
+def test_profiler_disabled_paths_are_noop(env):
+    d, s = env
+    from tidb_tpu.trace import PROFILER
+
+    # tracing disabled: nothing reaches the export hook, and the span
+    # seam degenerates to the no-op singleton (one contextvar read)
+    s.execute("set tidb_enable_slow_log = 0")
+    try:
+        f0 = REGISTRY.get("profile_traces_folded_total")
+        s.query("select count(*) from li")
+        assert REGISTRY.get("profile_traces_folded_total") == f0
+        assert trace_mod.span("anything") is trace_mod.NOOP
+    finally:
+        s.execute("set tidb_enable_slow_log = 1")
+    # profiler disabled: traces still record, the fold is a no-op
+    prev = PROFILER.enabled
+    PROFILER.enabled = False
+    try:
+        PROFILER.reset()
+        f0 = REGISTRY.get("profile_traces_folded_total")
+        s.query("select count(*) from li")
+        assert REGISTRY.get("profile_traces_folded_total") == f0
+        assert PROFILER.folded() == ""
+    finally:
+        PROFILER.enabled = prev
+
+
+def test_stmt_class_and_latency_histograms(env):
+    from tidb_tpu.trace import stmt_class
+
+    assert stmt_class("select * from t where a = 1") == "point"
+    assert stmt_class("SELECT sum(a) FROM t") == "agg"
+    assert stmt_class("select a from t group by a") == "agg"
+    assert stmt_class("select * from a join b on a.x = b.x") == "join"
+    assert stmt_class("insert into t values (1)") == "dml"
+    assert stmt_class("update t set a = 1") == "dml"
+    assert stmt_class("show tables") == "other"
+    d, s = env
+    h0 = (REGISTRY.hist_stats("stmt_latency_agg_ms") or
+          {"count": 0})["count"]
+    s.query("select count(*) from li")
+    assert REGISTRY.hist_stats("stmt_latency_agg_ms")["count"] == h0 + 1
+
+
+def test_explain_analyze_reports_hbm_peak(env):
+    """Device-memory telemetry (ISSUE 13): EXPLAIN ANALYZE surfaces the
+    statement's HBM high-water mark stamped on the execute spans."""
+    d, s = env
+    s.query(Q1ISH)  # warm the mesh cache so resident bytes are nonzero
+    rs = s.execute("explain analyze " + Q1ISH)[-1]
+    extra = rs.rows[0][4]
+    assert "hbm_peak:" in extra, rs.rows
+    peak = int(extra.split("hbm_peak:")[1].split()[0])
+    assert peak > 0
+
+
+# ---------------------------------------------------------------------------
+# slow-log rotation (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_log_rotation_caps_size(tmp_path):
+    import os
+
+    from tidb_tpu.trace.slowlog import SlowQueryLog
+
+    path = str(tmp_path / "slow_query.log")
+    log = SlowQueryLog(path, max_bytes=500, keep=2)
+    r0 = REGISTRY.get("slow_log_rotations_total")
+    for i in range(40):
+        log.record({"query": f"q{i}", "time": "t", "conn_id": i})
+    assert REGISTRY.get("slow_log_rotations_total") > r0
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")  # keep=2 drops older files
+    assert os.path.getsize(path) <= 500 + 128  # one record past the cap
+    assert len(log.entries()) == 40  # the in-memory ring is unaffected
+    # torn-tail recovery still honored on the ACTIVE file post-rotation
+    with open(path, "ab") as f:
+        f.write(b'{"query": "torn-tail')
+    t0 = REGISTRY.get("slow_log_torn_tail_total")
+    recovered = SlowQueryLog(path)
+    assert REGISTRY.get("slow_log_torn_tail_total") == t0 + 1
+    assert all("torn-tail" not in e.get("query", "")
+               for e in recovered.entries())
+
+
+def test_slow_log_rotation_rides_global_sysvar(tmp_path):
+    d, s = _mk_session(str(tmp_path))
+    s.execute("set global tidb_tpu_slow_log_max_bytes = 400")
+    s.execute("set tidb_slow_log_threshold = 0")
+    r0 = REGISTRY.get("slow_log_rotations_total")
+    try:
+        for _ in range(4):
+            s.query("select count(*) from li")
+    finally:
+        s.execute("set tidb_slow_log_threshold = 300")
+    assert REGISTRY.get("slow_log_rotations_total") > r0
+    import os
+
+    assert os.path.exists(str(tmp_path / "slow_query.log.1"))

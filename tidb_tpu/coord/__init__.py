@@ -129,6 +129,12 @@ def reset_plane():
     from ..trace import recorder
 
     recorder.TRACE_EXPORT_HOOK = None
+    # wiping the seam drops the continuous profiler from the chain —
+    # re-chain it so profiling survives plane teardown (install() sees
+    # the None seam and re-installs)
+    from ..trace import install_profiler
+
+    install_profiler()
     from ..copr.device_health import DEVICE_HEALTH
 
     DEVICE_HEALTH.set_epoch_hook(None)

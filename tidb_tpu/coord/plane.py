@@ -60,6 +60,21 @@ def _hit_handoff(pid: int, n: int):
     FAILPOINTS.hit("coord/handoff", pid=pid, sessions=n)
 
 
+def _local_fleet_payload(refresh_memory: bool = True) -> dict:
+    """This process's metric snapshot for fleet aggregation (counters +
+    histograms + gauges), with the device-cache gauges refreshed first
+    so HBM watermarks travel with it (skippable when the caller just
+    refreshed them — e.g. the /status memory section)."""
+    if refresh_memory:
+        try:
+            from ..copr.cache import memory_stats
+
+            memory_stats()
+        except Exception:
+            pass
+    return REGISTRY.export_fleet_payload()
+
+
 def _view_from_resp(resp: dict) -> MembershipView:
     return MembershipView(
         epoch=int(resp.get("epoch", 0)),
@@ -94,6 +109,10 @@ class Coordinator:
         self._formed = expect is None
         self._members: Dict[int, dict] = {}
         self._handoff: Dict[int, List[dict]] = {}
+        # fleet metric snapshots (ISSUE 13): workers piggyback their
+        # registry exports on span batches; in-memory only (a restarted
+        # coordinator re-learns them within one snapshot interval)
+        self._fleet: Dict[int, dict] = {}
         self._save_dirty = False
         self._save_io_mu = threading.Lock()
         self._stop = threading.Event()
@@ -217,7 +236,7 @@ class Coordinator:
         self._epoch += 1
         REGISTRY.inc("coord_epoch_bumps_total")
         REGISTRY.set("coord_epoch", self._epoch)
-        REGISTRY.set("coord_members", len(self._members))
+        REGISTRY.set("coord_member_count", len(self._members))
         self._save_locked()
 
     def bump(self, reason: str = ""):
@@ -232,6 +251,7 @@ class Coordinator:
                 and now - m["last_seen"] > m.get("lease_s", self.lease_s)]
         for pid in dead:
             del self._members[pid]
+            self._fleet.pop(pid, None)
             REGISTRY.inc("coord_members_expired_total")
             self._bump_locked(f"member {pid} lease expired")
 
@@ -295,6 +315,10 @@ class Coordinator:
         with self._mu:
             if self._members.pop(pid, None) is not None:
                 self._bump_locked(f"member {pid} left")
+            # a departed member's metric snapshot leaves with it — only
+            # lease expiry pruned _fleet otherwise, and an ex-member has
+            # no lease to expire
+            self._fleet.pop(pid, None)
             self._expire_locked()
             view = self._view_locked()
         self._flush_state()
@@ -333,6 +357,29 @@ class Coordinator:
         with self._mu:
             self._touch_locked(pid)
         return outcome
+
+    def ingest_metrics(self, pid: int, payload: dict):
+        """Store a worker's piggybacked metric snapshot (latest wins —
+        snapshots are cumulative registry exports, not deltas).  Only
+        CURRENT members store: a snapshot racing in after lease expiry /
+        leave would otherwise resurrect a ghost host in the fleet view
+        with nothing left to prune it."""
+        with self._mu:
+            if pid not in self._members:
+                return
+            self._fleet[pid] = dict(payload or {})
+            self._touch_locked(pid)
+        REGISTRY.inc("coord_metrics_snapshots_total")
+
+    def fleet_snapshot(self, refresh: bool = True) -> Dict[int, dict]:
+        """Per-host metric payloads: every worker's latest snapshot plus
+        this process's live registry when it is a member itself."""
+        with self._mu:
+            self._expire_locked()
+            snaps = dict(self._fleet)
+        if self.self_pid is not None:
+            snaps[self.self_pid] = _local_fleet_payload(refresh)
+        return snaps
 
     def _view_locked(self) -> MembershipView:
         return MembershipView(
@@ -413,6 +460,11 @@ class Coordinator:
             outcome = None
             for p, sz in zip(payloads, sizes):
                 outcome = self.ingest_spans(pid, p, sz)
+            # fleet aggregation (ISSUE 13): workers piggyback periodic
+            # metric snapshots on the span batches they already send
+            m = req.get("metrics")
+            if m:
+                self.ingest_metrics(pid, m)
             return self._resp(self.view(), outcome=outcome)
         return {"ok": False, "error": f"unknown cmd {cmd!r}"}
 
@@ -472,6 +524,11 @@ class LocalPlane:
 
     def forward_trace(self, tr):  # local traces are already in the ring
         pass
+
+    def fleet_metrics(self, refresh: bool = True) -> Dict[int, dict]:
+        """Single-host degenerate fleet: this process IS the fleet, so
+        the merge path runs in tier-1 with one member."""
+        return {self.pid: _local_fleet_payload(refresh)}
 
     def handoff_put(self, states):
         states = list(states or ())
@@ -542,6 +599,15 @@ class CoordinatorPlane:
     def forward_trace(self, tr):  # the coordinator's traces are local
         pass
 
+    def fleet_metrics(self, refresh: bool = True) -> Dict[int, dict]:
+        """Workers' piggybacked snapshots + this host's live registry
+        (fleet_snapshot already exports it when the coordinator knows
+        its own pid — don't build the registry payload twice)."""
+        snaps = self.coord.fleet_snapshot(refresh)
+        if self.pid not in snaps:
+            snaps[self.pid] = _local_fleet_payload(refresh)
+        return snaps
+
     def handoff_put(self, states):
         states = list(states or ())
         if not states:
@@ -606,6 +672,15 @@ class WorkerPlane:
             "TIDB_TPU_COORD_SPAN_QUEUE", "256")), 1)
         self._span_flush_s = float(os.environ.get(
             "TIDB_TPU_COORD_SPAN_FLUSH_S", "0.2"))
+        # fleet metric snapshots (ISSUE 13) piggyback on span batches at
+        # most once per interval (0 = every batch)
+        self._metrics_interval_s = float(os.environ.get(
+            "TIDB_TPU_COORD_METRICS_S", "2.0"))
+        self._metrics_sent = 0.0
+        # TRACE_EXPORT_HOOK chaining (a continuous profiler may already
+        # hold the seam — both must run)
+        self._export_hook = None
+        self._prev_hook = None
 
     # ---- lifecycle ------------------------------------------------------
     def start(self, devices=()):
@@ -625,10 +700,24 @@ class WorkerPlane:
             target=self._span_flusher, daemon=True,
             name="tidb-tpu-coord-spans")
         self._span_thread.start()
-        # worker span trees rejoin the coordinator's trace ring
+        # worker span trees rejoin the coordinator's trace ring.  CHAIN
+        # any already-installed hook (the continuous profiler): both the
+        # forwarder and the profiler must see every finished trace.
         from ..trace import recorder
 
-        recorder.TRACE_EXPORT_HOOK = self.forward_trace
+        prev = recorder.TRACE_EXPORT_HOOK
+        self._prev_hook = prev
+
+        def hook(tr, _prev=prev, _plane=self):
+            _plane.forward_trace(tr)
+            if _prev is not None:
+                try:
+                    _prev(tr)
+                except Exception:
+                    pass
+
+        self._export_hook = hook
+        recorder.TRACE_EXPORT_HOOK = hook
         return self
 
     def stop(self, leave: bool = False):
@@ -646,8 +735,11 @@ class WorkerPlane:
         self.flush_spans()
         from ..trace import recorder
 
-        if recorder.TRACE_EXPORT_HOOK == self.forward_trace:
-            recorder.TRACE_EXPORT_HOOK = None
+        if recorder.TRACE_EXPORT_HOOK is self._export_hook \
+                and self._export_hook is not None:
+            # restore the chained hook (profiler keeps folding)
+            recorder.TRACE_EXPORT_HOOK = self._prev_hook
+        self._export_hook = None
 
     def leave(self):
         try:
@@ -703,6 +795,11 @@ class WorkerPlane:
         Oversize payloads (per-host byte cap) and a full queue drop with
         counters; a dead coordinator costs the flusher a short timeout,
         never a query failure."""
+        if self._stop.is_set():
+            # stop() may fail to unchain this hook when something (the
+            # profiler, a later plane) chained on top of it — a stopped
+            # plane must not keep feeding a queue nobody drains
+            return
         try:
             from ..trace.export import trace_payload
 
@@ -740,12 +837,25 @@ class WorkerPlane:
                 )
             if not batch:
                 return
+            # piggyback a metric snapshot at most once per interval: the
+            # batch is already crossing the wire, so fleet aggregation
+            # costs one extra JSON field, not a new RPC
+            extra = ""
+            now = time.monotonic()
+            if now - self._metrics_sent >= self._metrics_interval_s:
+                try:
+                    extra = (', "metrics": '
+                             + json.dumps(_local_fleet_payload()))
+                except Exception:
+                    extra = ""
             try:
                 sizes = json.dumps([len(b) for b in batch])
-                data = ('{"cmd": "spans", "pid": %d, "sizes": %s,'
+                data = ('{"cmd": "spans", "pid": %d, "sizes": %s%s,'
                         ' "payloads": [%s]}'
-                        % (self.pid, sizes, ", ".join(batch)))
+                        % (self.pid, sizes, extra, ", ".join(batch)))
                 self._rpc_line(data)
+                if extra:
+                    self._metrics_sent = now
                 REGISTRY.inc("coord_spans_forwarded_total", len(batch))
                 REGISTRY.inc("coord_span_batches_total")
                 REGISTRY.inc("coord_span_bytes_total",
@@ -763,6 +873,11 @@ class WorkerPlane:
                                      len(batch) - len(kept))
                     self._span_q = kept + self._span_q
                 return
+
+    def fleet_metrics(self, refresh: bool = True) -> Dict[int, dict]:
+        """A worker's /status shows its own host; the merged fleet view
+        lives on the coordinator."""
+        return {self.pid: _local_fleet_payload(refresh)}
 
     def handoff_put(self, states):
         states = list(states or ())

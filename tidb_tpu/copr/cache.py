@@ -32,11 +32,16 @@ class _InFlight:
 class ByteCapCache:
     """key -> tuple of device arrays (anything with .nbytes)."""
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, name: Optional[str] = None):
         self._cache: Dict[tuple, tuple] = {}
         self._order: List[tuple] = []
         self._bytes = 0
         self.capacity = capacity_bytes
+        # device-memory telemetry (ISSUE 13): hwm_bytes is the
+        # high-water mark since process start (or the last clear) — the
+        # "how close did we get to the cap" gauge
+        self.name = name
+        self.hwm_bytes = 0
         self._mu = threading.Lock()
         # value-weighted eviction policy (layout autotuner): priority_fn
         # ranks resident keys (lowest evicts first; None = FIFO) and
@@ -52,6 +57,11 @@ class ByteCapCache:
         # keys evicted WHILE their load was in flight: the finished value
         # must not be cached (it may be placed on a dead device)
         self._doomed: set = set()
+        # named caches register for the /status "memory" section and the
+        # fleet metric snapshots — LAST, fully constructed: memory_stats
+        # on another thread may iterate the registry immediately
+        if name is not None:
+            BYTE_CAP_CACHES[name] = self
 
     def set_policy(self, priority_fn=None, demote_fn=None):
         """Install the value-weighted eviction policy (both optional)."""
@@ -115,6 +125,8 @@ class ByteCapCache:
                 self._cache[key] = value
                 self._order.append(key)
                 self._bytes += nbytes
+                if self._bytes > self.hwm_bytes:
+                    self.hwm_bytes = self._bytes
             demote = self._demote_fn
             # doomed: hand the value to this caller and every waiter
             # (their mesh is already condemned and will retry) but never
@@ -164,9 +176,40 @@ class ByteCapCache:
     def __len__(self):
         return len(self._cache)
 
+    def stats(self) -> dict:
+        with self._mu:
+            return {"entries": len(self._cache), "bytes": self._bytes,
+                    "capacity_bytes": self.capacity,
+                    "watermark_bytes": self.hwm_bytes}
+
     @property
     def items_view(self):
         return self._cache
+
+
+#: named ByteCapCache instances (mesh column cache, cold tier, per-tile
+#: device cache) — one registry so the /status "memory" section and the
+#: fleet metric snapshots see every device-resident byte pool
+BYTE_CAP_CACHES: Dict[str, "ByteCapCache"] = {}
+
+
+def memory_stats() -> Dict[str, dict]:
+    """Byte/capacity/watermark stats for every named device cache, also
+    refreshed into REGISTRY gauges (`cache_<name>_bytes` etc.) so fleet
+    snapshots and /metrics carry them without a pull from each cache."""
+    from ..metrics import REGISTRY
+
+    out = {}
+    for name, cache in sorted(BYTE_CAP_CACHES.items()):
+        st = cache.stats()
+        out[name] = st
+        REGISTRY.set(f"cache_{name}_bytes", float(st["bytes"]))
+        REGISTRY.set(f"cache_{name}_capacity_bytes",
+                     float(st["capacity_bytes"]))
+        REGISTRY.set(f"cache_{name}_watermark_bytes",
+                     float(st["watermark_bytes"]))
+        REGISTRY.set(f"cache_{name}_entry_count", float(st["entries"]))
+    return out
 
 
 #: every ProgramCache registers here so /status can report one

@@ -271,7 +271,7 @@ class DeviceHealthRegistry:
 
     def _publish(self):
         # gauge, not counter: reflects the CURRENT quarantine set
-        REGISTRY.set("device_health_tripped_devices",
+        REGISTRY.set("device_health_tripped_count",
                      sum(1 for st in self._devices.values()
                          if st.state == TRIPPED))
 

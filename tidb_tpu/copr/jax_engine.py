@@ -251,7 +251,7 @@ class _DeviceCache:
     def __init__(self, capacity_bytes: int = 8 << 30):
         from .cache import ByteCapCache
 
-        self._c = ByteCapCache(capacity_bytes)
+        self._c = ByteCapCache(capacity_bytes, name="tile")
 
     def get_tile(self, table, store_ci: int, tile_idx: int, start: int,
                  end: int, device=None):
@@ -892,6 +892,9 @@ def run_base_jax(table, dag: DAG, start: int, end: int,
         # lazily): label it so compile time lands in the compile phase
         dspan = ("copr.compile" if compiled_now else "copr.device.execute")
         dattr = {"cache": "miss"} if compiled_now else {}
+        # per-trace HBM attribution (ISSUE 13): resident tile-cache
+        # bytes at dispatch time ride the execute span
+        dattr["hbm_bytes"] = DEVICE_CACHE._c._bytes
         compiled_now = False
         if kind == "filter":
             with span(dspan, kind=kind, tile=tile_idx, **dattr):

@@ -382,7 +382,7 @@ class _MeshCache:
         if capacity_bytes is None:
             capacity_bytes = int(_os2.environ.get(
                 "TIDB_TPU_HBM_BYTES", str(8 << 30)))
-        self._c = ByteCapCache(capacity_bytes)
+        self._c = ByteCapCache(capacity_bytes, name="mesh")
         self._c.set_policy(priority_fn=_hot_priority,
                            demote_fn=_hot_demote)
 
@@ -449,6 +449,20 @@ class _MeshCache:
 
 
 MESH_CACHE = _MeshCache()
+
+
+def _hbm_bytes() -> int:
+    """Resident device bytes (hot mesh cache + compressed cold tier) at
+    this instant — stamped on execute spans so a finished trace carries
+    its HBM high-water mark (EXPLAIN ANALYZE / slow-log attribution)."""
+    n = MESH_CACHE._c._bytes
+    try:
+        from ..layout.coldtier import COLD_CACHE
+
+        n += COLD_CACHE._bytes
+    except Exception:
+        pass
+    return n
 
 # h2d transfers over the tunnel are synchronous (~113MB/s single-stream,
 # ~170MB/s with 4 streams measured) — a small shared pool overlaps the
@@ -852,7 +866,7 @@ def _packed_jit(fn):
     def call(*args):
         from ..trace import span
 
-        with span("copr.device.execute"):
+        with span("copr.device.execute", hbm_bytes=_hbm_bytes()):
             out = jitted(*args)
         with span("copr.readback") as sp:
             buf = np.asarray(out)
@@ -1054,7 +1068,7 @@ def _build_mesh_fn(an: _Analyzed, kind: str, col_order: List[int],
         from ..trace import span
 
         n_rows = S * n_local
-        with span("copr.device.execute"):
+        with span("copr.device.execute", hbm_bytes=_hbm_bytes()):
             out = jitted(
                 tuple(datas), tuple(valids), del_mask,
                 _bounds_args(bounds), tuple(lvals), *pargs,

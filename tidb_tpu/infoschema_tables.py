@@ -618,6 +618,52 @@ def _tidb_tpu_column_layout(domain, isc):
     return rows
 
 
+@_register("tidb_tpu_profile", [
+    ("window_start", ty_string()), ("stack", ty_string()),
+    ("count", ty_int()), ("self_ms", ty_float()),
+])
+def _tidb_tpu_profile(domain, isc):
+    """Continuous-profiling stacks (ISSUE 13): the rotating flame
+    windows the profiler folds every finished QueryTrace into — one row
+    per (window, span path), weight = accumulated self time.  The same
+    data /flame renders as folded-stacks text."""
+    from .trace import PROFILER
+
+    return PROFILER.rows()
+
+
+@_register("tidb_tpu_fleet_metrics", [
+    ("host", ty_string()), ("name", ty_string()),
+    ("kind", ty_string()), ("value", ty_float()),
+])
+def _tidb_tpu_fleet_metrics(domain, isc):
+    """Fleet-merged metrics (ISSUE 13): workers piggyback registry
+    snapshots on coord span batches; counters sum across hosts
+    (host='fleet'), gauges stay per-host, histogram quantiles merge
+    bucket-wise.  LocalPlane degenerates to a single-member fleet."""
+    from .coord import get_plane
+    from .metrics import merge_fleet
+
+    try:
+        merged = merge_fleet(get_plane().fleet_metrics())
+    except Exception:
+        return []
+    rows = []
+    for name in sorted(merged["counters"]):
+        rows.append(("fleet", name, "counter",
+                     float(merged["counters"][name])))
+    for name in sorted(merged["gauges"]):
+        for host in sorted(merged["gauges"][name]):
+            rows.append((host, name, "gauge",
+                         float(merged["gauges"][name][host])))
+    for name in sorted(merged["hists"]):
+        h = merged["hists"][name]
+        for k in ("p50", "p95", "p99"):
+            rows.append(("fleet", name, k, float(h[k])))
+        rows.append(("fleet", name, "count", float(h["count"])))
+    return rows
+
+
 @_register("tidb_profile", [
     ("function", ty_string()), ("calls", ty_int()),
     ("total_time_ms", ty_float()), ("cum_time_ms", ty_float()),

@@ -53,7 +53,7 @@ def _cold_cap_bytes() -> int:
 #: the cold tier itself: byte-capped like the hot mesh cache, FIFO within
 #: the tier (cold entries are already the demotion target; past the cold
 #: cap the oldest compressed column drops and reloads on demand)
-COLD_CACHE = ByteCapCache(_cold_cap_bytes())
+COLD_CACHE = ByteCapCache(_cold_cap_bytes(), name="cold")
 
 
 @dataclass(frozen=True)

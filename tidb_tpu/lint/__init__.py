@@ -88,6 +88,7 @@ PASS_RULES = {
                "rng-in-jit", "static-unhashable"),
     "plan": ("plan-schema",),
     "kernel": ("kernel-contract",),
+    "metric": ("metric-name",),
 }
 
 
@@ -101,12 +102,16 @@ def run_all(repo_root: Optional[str] = None,
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-    passes = passes or ["purity", "plan", "kernel"]
+    passes = passes or ["purity", "plan", "kernel", "metric"]
     findings: List[Finding] = []
     if "purity" in passes:
         from .purity import lint_tree
 
         findings += lint_tree(repo_root)
+    if "metric" in passes:
+        from .metricnames import lint_tree as lint_metric_names
+
+        findings += lint_metric_names(repo_root)
     if "plan" in passes:
         from .plancheck import lint_canonical_plans
 

@@ -233,12 +233,19 @@ def _join_est(rows_built: float, built_idx: set, rows_new: float,
     return max(est, 1.0)
 
 
-def _order_members(sides: List[_Side], edges, pctx) -> Optional[List[int]]:
+def _order_members(sides: List[_Side], edges, pctx
+                   ) -> Optional[Tuple[List[int], List[float]]]:
     """Left-deep join order minimizing the summed intermediate sizes:
-    exact DP over connected subsets up to DP_MAX_RELS, greedy beyond."""
+    exact DP over connected subsets up to DP_MAX_RELS, greedy beyond.
+
+    Returns (order, per-step estimates): ests[k] is the estimated
+    intermediate after joining order[k+1] — the SAME numbers the DP
+    costed with, so rung assembly (EXPLAIN est_rows, grouped-agg
+    budgets) never re-derives them from a second copy of the
+    containment formula (ISSUE 13 / jointree follow-up (f))."""
     n = len(sides)
     if n == 1:
-        return [0]
+        return [0], []
     rows = [_side_rows(s, pctx) for s in sides]
 
     def ndv_of(e):
@@ -250,12 +257,12 @@ def _order_members(sides: List[_Side], edges, pctx) -> Optional[List[int]]:
         return None
 
     if n <= DP_MAX_RELS:
-        # best[frozenset] = (cost, rows, order): Selinger over left-deep
-        # connected extensions
-        best = {frozenset([i]): (0.0, rows[i], (i,)) for i in range(n)}
+        # best[frozenset] = (cost, rows, order, ests): Selinger over
+        # left-deep connected extensions
+        best = {frozenset([i]): (0.0, rows[i], (i,), ()) for i in range(n)}
         for _size in range(1, n):
             nxt = {}
-            for subset, (cost, r, order) in best.items():
+            for subset, (cost, r, order, ests) in best.items():
                 if len(subset) != _size:
                     continue
                 for j in range(n):
@@ -265,7 +272,7 @@ def _order_members(sides: List[_Side], edges, pctx) -> Optional[List[int]]:
                     if est < 0:
                         continue
                     key = subset | {j}
-                    cand = (cost + est, est, order + (j,))
+                    cand = (cost + est, est, order + (j,), ests + (est,))
                     cur = nxt.get(key)
                     if cur is None or cand[0] < cur[0]:
                         nxt[key] = cand
@@ -273,13 +280,14 @@ def _order_members(sides: List[_Side], edges, pctx) -> Optional[List[int]]:
         full = best.get(frozenset(range(n)))
         if full is None:
             return None  # disconnected graph: cross joins stay host
-        return list(full[2])
+        return list(full[2]), list(full[3])
 
     # greedy: start from the smallest member, repeatedly add the
     # connected member minimizing the estimated intermediate
     order = [min(range(n), key=lambda i: rows[i])]
     joined = set(order)
     cur_rows = rows[order[0]]
+    step_ests: List[float] = []
     while len(order) < n:
         cands = []
         for j in range(n):
@@ -293,8 +301,9 @@ def _order_members(sides: List[_Side], edges, pctx) -> Optional[List[int]]:
         est, j = min(cands)
         joined.add(j)
         order.append(j)
+        step_ests.append(est)
         cur_rows = est
-    return order
+    return order, step_ests
 
 
 # ---------------------------------------------------------------------------
@@ -384,9 +393,12 @@ def _assemble(col: _Collected, pctx) -> Optional[_TreePlan]:
             if not _key_ok(oe, ie):
                 return None
 
-    order = _order_members(member_sides, edges, pctx)
-    if order is None:
+    ordered = _order_members(member_sides, edges, pctx)
+    if ordered is None:
         return None
+    # one formula drives ordering AND EXPLAIN/budget estimates: the DP's
+    # per-step numbers ARE the rung est_rows (jointree follow-up (f))
+    order, step_ests = ordered
 
     tp = _TreePlan()
     dict_all: set = set()
@@ -404,14 +416,6 @@ def _assemble(col: _Collected, pctx) -> Optional[_TreePlan]:
 
     rows = [_side_rows(s, pctx) for s in member_sides]
 
-    def ndv_of(e):
-        if not isinstance(e, ColumnExpr) or e.unique_id < 0:
-            return None
-        for s in member_sides:
-            if e.unique_id in s.uid_pos:
-                return _side_ndv(s, e.unique_id, pctx)
-        return None
-
     base = member_sides[order[0]]
     tp.sides.append(base)
     add_slots(base, 0)
@@ -420,7 +424,7 @@ def _assemble(col: _Collected, pctx) -> Optional[_TreePlan]:
     built_idx = {order[0]}
     built_uids = set(base.ds.schema.uids())
     cur_rows = rows[order[0]]
-    for mi in order[1:]:
+    for step, mi in enumerate(order[1:]):
         side = member_sides[mi]
         ordinal = len(tp.sides)
         keys = []
@@ -435,12 +439,7 @@ def _assemble(col: _Collected, pctx) -> Optional[_TreePlan]:
                 placed_eq[k] = True
         if not keys:
             return None  # cross-join rung: host lane
-        est = cur_rows * rows[mi]
-        for le, re_ in keys:
-            nl = min(ndv_of(le) or 100.0, cur_rows)
-            nr = min(ndv_of(re_) or 100.0, rows[mi])
-            est /= max(nl, nr, 1.0)
-        est = max(est, 1.0)
+        est = step_ests[step]
         muids = set(side.ds.schema.uids())
         avail = built_uids | muids
         oth = []

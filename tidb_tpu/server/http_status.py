@@ -47,6 +47,76 @@ def _layout_section() -> dict:
         return {"error": repr(e)}
 
 
+def _profile_section() -> dict:
+    """Continuous-profiling summary (ISSUE 13): rotating flame windows
+    with the top self-time stacks; the full folded text is /flame."""
+    try:
+        from ..trace import PROFILER
+
+        return PROFILER.status_section()
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
+def _slo_section(domain) -> dict:
+    """Per-statement-class SLO state (ISSUE 13): threshold, error-budget
+    burn counters and latency quantiles from the log2 histograms."""
+    try:
+        from ..metrics import REGISTRY, STMT_CLASSES
+        from ..session.vars import SessionVars
+
+        # the SAME read Session._observe_slo acts on (global scope with
+        # SYSVAR_DEFAULTS fallback) — the reported threshold must never
+        # desync from the enforced one
+        gvars = SessionVars(domain.global_vars)
+        snap = REGISTRY.snapshot()
+        out = {}
+        for cls in STMT_CLASSES:
+            thr = gvars.get_global_int(f"tidb_tpu_slo_{cls}_ms", 0)
+            ok = snap.get(f"slo_{cls}_ok_total", 0)
+            breach = snap.get(f"slo_{cls}_breach_total", 0)
+            total = ok + breach
+            sec = {"threshold_ms": thr, "ok": ok, "breach": breach,
+                   "burn": round(breach / total, 6) if total else 0.0}
+            hs = REGISTRY.hist_stats(f"stmt_latency_{cls}_ms")
+            if hs is not None:
+                sec.update({"count": hs["count"], "p50_ms": hs["p50"],
+                            "p95_ms": hs["p95"], "p99_ms": hs["p99"]})
+            out[cls] = sec
+        return out
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
+def _memory_section() -> dict:
+    """Device-memory telemetry (ISSUE 13): bytes/capacity/high-water for
+    every named ByteCapCache (mesh columns, cold tier, per-tile cache)."""
+    try:
+        from ..copr.cache import memory_stats
+
+        return {"caches": memory_stats()}
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
+def _fleet_section() -> dict:
+    """Fleet-merged metrics (ISSUE 13): counters summed across hosts,
+    histograms merged bucket-wise, gauges kept per-host.  LocalPlane
+    degenerates to a single-member fleet."""
+    try:
+        from ..coord import get_plane
+        from ..metrics import merge_fleet
+
+        plane = get_plane()
+        # refresh=False: the /status memory section just ran
+        # memory_stats(), the cache gauges are already current
+        merged = merge_fleet(plane.fleet_metrics(refresh=False))
+        merged["kind"] = plane.kind
+        return merged
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
 class StatusServer:
     def __init__(self, domain, host: str = "127.0.0.1", port: int = 10080):
         self.domain = domain
@@ -72,12 +142,29 @@ class StatusServer:
             def do_GET(self):
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path == "/metrics":
-                    lines = []
-                    for name, val in sorted(REGISTRY.snapshot().items()):
-                        metric = "tidb_tpu_" + name
-                        lines.append(f"{metric} {val}")
+                    # refresh pull-time gauges (device-cache bytes /
+                    # watermarks) so scrapes see live values
+                    try:
+                        from ..copr.cache import memory_stats
+
+                        memory_stats()
+                    except Exception:
+                        pass
+                    lines = REGISTRY.prometheus_lines()
                     body = ("\n".join(lines) + "\n").encode()
                     self._send(200, body, "text/plain; version=0.0.4")
+                    return
+                if path == "/flame":
+                    # standard folded-stacks text (flamegraph.pl /
+                    # speedscope / inferno consumable) over the
+                    # profiler's retained windows
+                    try:
+                        from ..trace import PROFILER
+
+                        body = PROFILER.folded().encode()
+                    except Exception as e:
+                        body = f"# profiler unavailable: {e!r}\n".encode()
+                    self._send(200, body, "text/plain")
                     return
                 if path in ("/status", "/"):
                     from ..coord import get_plane
@@ -159,6 +246,20 @@ class StatusServer:
                         # splits by reason — regressions in fusion
                         # coverage are visible per cause at a glance
                         "fusion": _fusion_section(snap),
+                        # continuous profiling (ISSUE 13): rotating
+                        # flame windows, top self-time stacks (full
+                        # folded text on /flame)
+                        "profile": _profile_section(),
+                        # per-statement-class SLOs: thresholds, error-
+                        # budget burn, p50/p95/p99 from log2 histograms
+                        "slo": _slo_section(domain),
+                        # device-memory telemetry: per-cache bytes,
+                        # capacity and high-water marks
+                        "memory": _memory_section(),
+                        # fleet-merged metrics: counters summed across
+                        # hosts, histograms bucket-merged, gauges
+                        # per-host (LocalPlane = single-member fleet)
+                        "fleet": _fleet_section(),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

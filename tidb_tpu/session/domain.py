@@ -83,7 +83,14 @@ class Domain:
 
             _os.makedirs(data_dir, exist_ok=True)
             slow_path = _os.path.join(data_dir, "slow_query.log")
-        self.slow_log = SlowQueryLog(slow_path)
+        self.slow_log = SlowQueryLog(
+            slow_path, max_bytes=self._slow_log_max_bytes())
+        # continuous profiler (ISSUE 13): every finished trace folds
+        # into the rotating flame windows; chains onto the trace export
+        # hook (never replacing a coord plane's forwarder), idempotent
+        from ..trace import install_profiler
+
+        install_profiler()
         if data_dir:
             self._recover(data_dir)
         self._bootstrap()
@@ -187,6 +194,16 @@ class Domain:
             except Exception:
                 pass  # stats are advisory; never fail the statement
 
+    def _slow_log_max_bytes(self) -> int:
+        from .vars import SYSVAR_DEFAULTS
+
+        try:
+            return int(self.global_vars.get(
+                "tidb_tpu_slow_log_max_bytes",
+                SYSVAR_DEFAULTS["tidb_tpu_slow_log_max_bytes"][0]))
+        except (TypeError, ValueError):
+            return 0
+
     def _digest_row(self, digest: str, sql: str) -> dict:
         """Get-or-create one statement summary row; caller holds _mu.
         Bounded like the reference's stmtsummary cap."""
@@ -275,6 +292,9 @@ class Domain:
             "rows": totals.get("result_rows", 0),
             "termination": (tr.root.attrs or {}).get("termination", "ok"),
         }
+        # the rotation cap is a GLOBAL sysvar; refresh it on the write
+        # path so SET GLOBAL takes effect without a restart
+        self.slow_log.max_bytes = self._slow_log_max_bytes()
         self.slow_log.record(entry)
         from ..metrics import REGISTRY
 

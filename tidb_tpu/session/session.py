@@ -226,6 +226,7 @@ class Session:
                 self.last_trace = tr
                 totals = finish_trace(tr, token)
                 self._maybe_slow_log(tr, totals)
+                self._observe_slo(sql, tr)
 
     def query(self, sql: str, params: Optional[list] = None) -> List[tuple]:
         """Convenience: rows of the last result set."""
@@ -244,6 +245,31 @@ class Session:
             # the slow log is advisory and must never fail the
             # statement — but silent breakage would disable the whole
             # accounting pipeline invisibly, so count it
+            from ..metrics import REGISTRY
+
+            REGISTRY.inc("trace_accounting_errors_total")
+
+    def _observe_slo(self, sql: str, tr):
+        """Per-statement-class end-to-end latency histogram + SLO
+        error-budget burn counters (ISSUE 13): the class threshold rides
+        `tidb_tpu_slo_<class>_ms` sysvars (0 disables burn accounting;
+        the histogram always records)."""
+        try:
+            from ..metrics import REGISTRY
+            from ..trace import stmt_class
+
+            cls = stmt_class(sql)
+            dur_ms = tr.duration_ms()
+            REGISTRY.observe_hist(f"stmt_latency_{cls}_ms", dur_ms)
+            # GLOBAL scope only: the burn counters are fleet-wide and
+            # must agree with the threshold /status reports
+            thr = self.vars.get_global_int(f"tidb_tpu_slo_{cls}_ms", 0)
+            if thr > 0:
+                if dur_ms > thr:
+                    REGISTRY.inc(f"slo_{cls}_breach_total")
+                else:
+                    REGISTRY.inc(f"slo_{cls}_ok_total")
+        except Exception:
             from ..metrics import REGISTRY
 
             REGISTRY.inc("trace_accounting_errors_total")
@@ -765,6 +791,19 @@ class Session:
                     if st.engine:
                         extra += f" engine:{st.engine}"
                 rows.append((nm, est, task, info, extra))
+            # per-statement HBM high-water attribution (ISSUE 13): the
+            # dispatch sites stamp resident device bytes on the execute
+            # spans; surface the peak on the root operator's line
+            from ..trace import current_trace
+
+            ltr = current_trace()
+            if ltr is not None and rows:
+                peak = ltr.phase_totals().get("hbm_peak_bytes", 0)
+                if peak:
+                    nm, est, task, info, extra = rows[0]
+                    extra = (extra + " " if extra else "") \
+                        + f"hbm_peak:{peak}"
+                    rows[0] = (nm, est, task, info, extra)
             return ResultSet(
                 headers=["id", "estRows", "task", "info", "execution info"],
                 rows=rows, is_query=True)

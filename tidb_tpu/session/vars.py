@@ -76,6 +76,24 @@ SYSVAR_DEFAULTS = {
     # Disabled, span hooks are a single contextvar read (zero-cost).
     "tidb_enable_slow_log": ("1", "bool"),
     "tidb_slow_log_threshold": ("300", "int"),
+    # size-capped slow-log rotation (ISSUE 13): when the active file
+    # exceeds this many bytes it rotates (atomic rename) into
+    # slow_query.log.1..N (N = TIDB_TPU_SLOW_LOG_KEEP env, default 3);
+    # 0 disables rotation.  GLOBAL scope — the log file is a domain
+    # resource.  Torn-tail recovery applies to the active file only.
+    "tidb_tpu_slow_log_max_bytes": (str(64 << 20), "int"),
+    # --- per-statement-class SLO thresholds (ISSUE 13) ----------------
+    # end-to-end latency SLO per statement class (point/agg/join/DML);
+    # every finished traced statement observes a log2-bucket histogram
+    # `stmt_latency_<class>_ms` and, when its class threshold is > 0,
+    # bumps `slo_<class>_{ok,breach}_total` — the error-budget burn
+    # counters the /status "slo" section reports.  0 disables burn
+    # accounting for a class (the histogram still records).
+    "tidb_tpu_slo_point_ms": ("100", "int"),
+    "tidb_tpu_slo_agg_ms": ("1000", "int"),
+    "tidb_tpu_slo_join_ms": ("5000", "int"),
+    "tidb_tpu_slo_dml_ms": ("500", "int"),
+    "tidb_tpu_slo_other_ms": ("0", "int"),
     # auto-capture plan baselines for repeated statements
     # (bindinfo/handle.go:545 CaptureBaselines)
     "tidb_capture_plan_baselines": ("0", "bool"),
@@ -145,6 +163,20 @@ class SessionVars:
 
     def get_int(self, name: str, default: int = 0) -> int:
         v = self.get(name)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    def get_global_int(self, name: str, default: int = 0) -> int:
+        """GLOBAL-scope read (skips any session override): for shared
+        resources — SLO burn counters, the slow log — where every
+        session must act on the same value /status reports."""
+        name = name.lower()
+        v = self._globals.get(name)
+        if v is None:
+            d = SYSVAR_DEFAULTS.get(name)
+            v = d[0] if d else None
         try:
             return int(v)
         except (TypeError, ValueError):

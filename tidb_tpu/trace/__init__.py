@@ -47,3 +47,9 @@ from .export import (  # noqa: F401
     trace_payload,
 )
 from .slowlog import SlowQueryLog  # noqa: F401
+from .profiler import (  # noqa: F401
+    PROFILER,
+    Profiler,
+    install_profiler,
+    stmt_class,
+)

@@ -1,0 +1,233 @@
+"""Continuous profiler: every finished QueryTrace folds into weighted
+span-path stacks over rotating time windows (ISSUE 13).
+
+The profiler rides the `TRACE_EXPORT_HOOK` seam — the same hook the
+coordination plane uses to forward worker traces — CHAINING the
+previously installed hook, never replacing it.  Folding one finished
+trace is O(spans): walk the span tree once, attribute each span's SELF
+time (duration minus children) to its root-to-span path, and accumulate
+into the current window's bounded path table.  With tracing disabled
+nothing ever reaches the hook, so the disabled path stays the span
+recorder's single contextvar read.
+
+Surfaces:
+
+- `/flame` — standard folded-stacks text (``frame;frame;frame weight``
+  per line, weight in self-microseconds), directly consumable by
+  flamegraph.pl / speedscope / inferno;
+- `/status` "profile" section — window metadata + the top stacks;
+- ``INFORMATION_SCHEMA.TIDB_TPU_PROFILE`` — one row per (window, stack).
+
+Frames carry engine attribution (``copr.device.execute:mesh`` vs
+``...:tile-fanout`` vs MPP rungs) so compiled-path vs interpreted-path
+time separates per Flare's compile-attribution argument.
+
+Knobs (env, read at construction): ``TIDB_TPU_PROFILE`` (0 disables),
+``TIDB_TPU_PROFILE_WINDOW_S`` (rotation period, default 60),
+``TIDB_TPU_PROFILE_WINDOWS`` (windows retained, default 5),
+``TIDB_TPU_PROFILE_MAX_PATHS`` (distinct stacks per window; overflow
+folds into ``<other>``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..metrics import REGISTRY
+
+#: depth cap on folded stacks: deeper spans attribute to their ancestor
+#: path (flame views past ~32 frames are unreadable anyway)
+MAX_STACK_DEPTH = 32
+
+
+class Profiler:
+    def __init__(self, window_s: Optional[float] = None,
+                 n_windows: Optional[int] = None,
+                 max_paths: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self.window_s = float(window_s if window_s is not None else
+                              os.environ.get("TIDB_TPU_PROFILE_WINDOW_S",
+                                             "60"))
+        self.n_windows = int(n_windows if n_windows is not None else
+                             os.environ.get("TIDB_TPU_PROFILE_WINDOWS",
+                                            "5"))
+        self.max_paths = int(max_paths if max_paths is not None else
+                             os.environ.get("TIDB_TPU_PROFILE_MAX_PATHS",
+                                            "512"))
+        self.enabled = (os.environ.get("TIDB_TPU_PROFILE", "1") != "0"
+                        if enabled is None else bool(enabled))
+        self._mu = threading.Lock()
+        self._windows: deque = deque(maxlen=max(self.n_windows, 1))
+        self._installed = False
+
+    # ---- hook install (chains, never replaces) --------------------------
+    def install(self):
+        """Chain this profiler onto TRACE_EXPORT_HOOK.  Idempotent: the
+        Domain constructor calls it every time, and a coordination plane
+        installed before or after stays in the chain (WorkerPlane chains
+        too)."""
+        from . import recorder
+
+        with self._mu:
+            if self._installed and recorder.TRACE_EXPORT_HOOK is not None:
+                # a None seam means something (coord.reset_plane) wiped
+                # the chain we were part of — fall through and re-chain
+                return
+            prev = recorder.TRACE_EXPORT_HOOK
+
+            def hook(tr, _prev=prev, _profiler=self):
+                if _prev is not None:
+                    try:
+                        _prev(tr)
+                    except Exception:
+                        pass
+                _profiler.fold(tr)
+
+            recorder.TRACE_EXPORT_HOOK = hook
+            self._installed = True
+
+    # ---- folding --------------------------------------------------------
+    def fold(self, tr):
+        """Fold one finished QueryTrace into the current window."""
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._mu:
+            w = self._current_locked(now)
+            w["traces"] += 1
+            self._walk(tr.root, "", w["paths"], 0)
+        REGISTRY.inc("profile_traces_folded_total")
+
+    def _current_locked(self, now: float) -> dict:
+        if not self._windows or \
+                now - self._windows[-1]["start"] >= self.window_s:
+            if self._windows:
+                REGISTRY.inc("profile_windows_rotated_total")
+            self._windows.append({"start": now, "traces": 0, "paths": {}})
+        return self._windows[-1]
+
+    def _walk(self, s, prefix: str, paths: dict, depth: int):
+        name = s.name
+        a = s.attrs
+        if a:
+            eng = a.get("engine") or a.get("rung")
+            if eng:
+                name = f"{name}:{eng}"
+        stack = f"{prefix};{name}" if prefix else name
+        dur = s.dur_ns or 0
+        recurse = depth < MAX_STACK_DEPTH and s.children
+        if recurse:
+            self_ns = max(dur - sum(c.dur_ns or 0 for c in s.children), 0)
+        else:
+            # depth cap: un-walked children attribute their whole time
+            # to this truncated ancestor frame instead of vanishing
+            self_ns = dur
+        self_us = self_ns // 1000
+        if self_us > 0 or not s.children:
+            key = stack
+            rec = paths.get(key)
+            if rec is None:
+                if len(paths) >= self.max_paths:
+                    # bounded path table: long-tail stacks fold into one
+                    # overflow frame instead of growing without limit
+                    key = "<other>"
+                    rec = paths.setdefault(key, [0, 0])
+                else:
+                    rec = paths[key] = [0, 0]
+            rec[0] += self_us
+            rec[1] += 1
+        if recurse:
+            for c in s.children:
+                self._walk(c, stack, paths, depth + 1)
+
+    # ---- reads ----------------------------------------------------------
+    def _merged_locked(self) -> Dict[str, list]:
+        merged: Dict[str, list] = {}
+        for w in self._windows:
+            for stack, (us, n) in w["paths"].items():
+                rec = merged.setdefault(stack, [0, 0])
+                rec[0] += us
+                rec[1] += n
+        return merged
+
+    def folded(self) -> str:
+        """Folded-stacks text over all retained windows: one
+        ``frame;frame weight`` line per stack, weight = accumulated
+        self-time in microseconds, heaviest first."""
+        with self._mu:
+            merged = self._merged_locked()
+        lines = [f"{stack} {us}" for stack, (us, _n)
+                 in sorted(merged.items(), key=lambda kv: -kv[1][0])]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def status_section(self, top: int = 12) -> dict:
+        with self._mu:
+            merged = self._merged_locked()
+            windows = [{"start": w["start"], "traces": w["traces"],
+                        "stacks": len(w["paths"])} for w in self._windows]
+        ranked = sorted(merged.items(), key=lambda kv: -kv[1][0])
+        return {
+            "enabled": self.enabled,
+            "window_s": self.window_s,
+            "windows": windows,
+            "stacks": len(merged),
+            "top": [{"stack": stack, "self_ms": round(us / 1000.0, 3),
+                     "count": n} for stack, (us, n) in ranked[:top]],
+        }
+
+    def rows(self) -> List[tuple]:
+        """INFORMATION_SCHEMA.TIDB_TPU_PROFILE rows: (window_start,
+        stack, count, self_ms), newest window last, heaviest first."""
+        out = []
+        with self._mu:
+            snap = [(w["start"], dict(w["paths"])) for w in self._windows]
+        for start, paths in snap:
+            ts = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(start))
+            for stack, (us, n) in sorted(paths.items(),
+                                         key=lambda kv: -kv[1][0]):
+                out.append((ts, stack, n, round(us / 1000.0, 3)))
+        return out
+
+    def reset(self):
+        with self._mu:
+            self._windows.clear()
+
+
+#: process-global profiler (installed by the Domain constructor)
+PROFILER = Profiler()
+
+
+def install_profiler():
+    PROFILER.install()
+
+
+# ---------------------------------------------------------------------------
+# statement classification (SLO plane)
+# ---------------------------------------------------------------------------
+
+_DML_WORDS = ("insert", "update", "delete", "replace", "load")
+_JOIN_RE = re.compile(r"\bjoin\b")
+_AGG_RE = re.compile(
+    r"\b(?:sum|count|avg|min|max|group_concat)\s*\(|\bgroup\s+by\b")
+
+
+def stmt_class(sql: str) -> str:
+    """Coarse statement class for per-class latency SLOs: point | agg |
+    join | dml | other.  One cheap scan of the text — classification
+    must not cost more than the histogram observation it labels."""
+    s = sql.lstrip().lower()
+    head = s.split(None, 1)[0].lstrip("(") if s else ""
+    if head in _DML_WORDS:
+        return "dml"
+    if head not in ("select", "with"):
+        return "other"
+    if _JOIN_RE.search(s):
+        return "join"
+    if _AGG_RE.search(s):
+        return "agg"
+    return "point"

@@ -198,6 +198,7 @@ class QueryTrace:
             "backfill_ms": 0.0,
             "compile_hits": 0, "compile_misses": 0, "cop_tasks": 0,
             "wire_bytes": 0, "result_rows": 0,
+            "hbm_peak_bytes": 0,
             "engines": set(), "devices": set(),
         }
 
@@ -238,6 +239,13 @@ class QueryTrace:
                 tot["wire_bytes"] += int(a.get("bytes", 0))
             tot["wire_bytes"] += int(a.get("wire_read_bytes", 0))
             tot["backoff_ms"] += float(a.get("backoff_ms", 0.0))
+            # device-memory telemetry (ISSUE 13): dispatch sites stamp
+            # the resident HBM bytes (hot mesh cache + cold tier) on the
+            # execute span — the trace-level high-water mark feeds
+            # EXPLAIN ANALYZE's per-statement HBM attribution
+            hbm = a.get("hbm_bytes")
+            if hbm is not None and int(hbm) > tot["hbm_peak_bytes"]:
+                tot["hbm_peak_bytes"] = int(hbm)
             eng = a.get("engine") or a.get("rung")
             if eng:
                 tot["engines"].add(str(eng))
@@ -426,10 +434,12 @@ def finish_trace(tr: QueryTrace, token):
     from ..metrics import REGISTRY
 
     totals = tr.phase_totals()
+    # real log2-bucket histograms (ISSUE 13): p50/p95/p99 per phase on
+    # /metrics and /status instead of the old _count/_sum/_max triple
     for key in _METRIC_PHASES:
         v = totals.get(key, 0)
         if v:
-            REGISTRY.observe(f"trace_phase_{key}", float(v))
+            REGISTRY.observe_hist(f"trace_phase_{key}", float(v))
     if totals["transfer_bytes"]:
         REGISTRY.inc("trace_transfer_bytes_total",
                      float(totals["transfer_bytes"]))

@@ -20,6 +20,7 @@ handle is scoped per record.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
 from typing import List, Optional
@@ -36,10 +37,21 @@ ENTRY_FIELDS = (
 
 
 class SlowQueryLog:
-    def __init__(self, path: Optional[str] = None, capacity: int = 256):
+    def __init__(self, path: Optional[str] = None, capacity: int = 256,
+                 max_bytes: int = 0, keep: Optional[int] = None):
         self.path = path
         self._mu = threading.Lock()
         self._ring: deque = deque(maxlen=capacity)
+        # size-capped rotation (ISSUE 13): when the active file crosses
+        # max_bytes it renames to .1 (shifting .1->.2 .. up to `keep`
+        # rotated files, oldest dropped).  0 = unbounded (the old
+        # behavior); the domain refreshes max_bytes from the
+        # tidb_tpu_slow_log_max_bytes global on every record.
+        self.max_bytes = int(max_bytes)
+        self.keep = max(int(keep if keep is not None else os.environ.get(
+            "TIDB_TPU_SLOW_LOG_KEEP", "3")), 1)
+        self._io_mu = threading.Lock()  # append + rotate are one unit
+        self._size = 0
         if path is not None:
             self._recover()
 
@@ -56,24 +68,54 @@ class SlowQueryLog:
         from ..metrics import REGISTRY
 
         line = json.dumps(entry, sort_keys=True, default=str)
-        try:
-            with open(self.path, "a", encoding="utf-8") as f:
-                # torn-write window: the chaos harness kills the writer
-                # here, leaving a prefix of the record on disk
-                f.write(line[: len(line) // 2])
-                FAILPOINTS.hit("trace/slow_log_write", entry=entry)
-                f.write(line[len(line) // 2:] + "\n")
-        except Exception:
-            # advisory log: a failed append never fails the statement.
-            # Resync the stream: terminate whatever partial bytes landed
-            # so the NEXT (successful) record never merges into the torn
-            # one and get lost with it at recovery time.
-            REGISTRY.inc("slow_log_write_errors_total")
+        with self._io_mu:
             try:
                 with open(self.path, "a", encoding="utf-8") as f:
-                    f.write("\n")
+                    # torn-write window: the chaos harness kills the
+                    # writer here, leaving a prefix of the record on disk
+                    f.write(line[: len(line) // 2])
+                    FAILPOINTS.hit("trace/slow_log_write", entry=entry)
+                    f.write(line[len(line) // 2:] + "\n")
+                # size is tracked in BYTES (recovery counts bytes too;
+                # non-ASCII SQL makes len(str) undercount)
+                self._size += len(line.encode("utf-8")) + 1
             except Exception:
-                pass
+                # advisory log: a failed append never fails the
+                # statement.  Resync the stream: terminate whatever
+                # partial bytes landed so the NEXT (successful) record
+                # never merges into the torn one and get lost with it at
+                # recovery time.
+                REGISTRY.inc("slow_log_write_errors_total")
+                try:
+                    with open(self.path, "a", encoding="utf-8") as f:
+                        f.write("\n")
+                    # the torn prefix landed too: resync from the file
+                    self._size = os.path.getsize(self.path)
+                except Exception:
+                    pass
+            if self.max_bytes > 0 and self._size > self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self):
+        """Rotate the active file into `.1` (shifting `.1`->`.2` ... up
+        to `keep`, oldest dropped).  Every move is an atomic rename, so
+        a crash mid-rotation never tears a record: the active file is
+        either pre- or post-rename, and torn-tail recovery continues to
+        apply to whichever file is active on restart."""
+        from ..metrics import REGISTRY
+
+        try:
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            self._size = 0
+            REGISTRY.inc("slow_log_rotations_total")
+        except OSError:
+            # rotation is best-effort: a failed rename keeps appending
+            # to the (oversized) active file rather than losing records
+            REGISTRY.inc("slow_log_rotation_errors_total")
 
     # ---- read / recovery ----------------------------------------------
     def entries(self) -> List[dict]:
@@ -101,6 +143,7 @@ class SlowQueryLog:
             return
         if not raw:
             return
+        self._size = len(raw)
         lines = raw.split(b"\n")
         torn = lines[-1] != b""  # no trailing newline: torn final record
         body, tail = (lines[:-1], lines[-1]) if torn else (lines[:-1], None)
@@ -113,6 +156,7 @@ class SlowQueryLog:
             try:
                 with open(self.path, "r+b") as f:
                     f.truncate(len(raw) - len(tail))
+                self._size = len(raw) - len(tail)
             except OSError:
                 pass
         with self._mu:
