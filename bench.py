@@ -298,6 +298,12 @@ def _host_fallback_worker():
         out["trace_overhead"] = trace_overhead_bench(sess)
     except BaseException as e:  # noqa: BLE001
         out["trace_overhead"] = {"error": repr(e)}
+    # lock-order witness receipt (ISSUE 16): the corpus replayed once
+    # with TIDB_TPU_LOCKCHECK=1 in a fresh subprocess
+    try:
+        out["lockcheck"] = lockcheck_bench()
+    except BaseException as e:  # noqa: BLE001
+        out["lockcheck"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -781,6 +787,79 @@ def fusion_bench(sess, n: int) -> dict:
     return out
 
 
+_LOCKCHECK_WORKER_SRC = r"""
+import json
+import os
+import sys
+import threading
+
+os.environ["TIDB_TPU_LOCKCHECK"] = "1"
+os.environ.setdefault("TIDB_TPU_TILE", "1024")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["LOCKCHECK_REPO"])
+from bench import Q1, Q6, build_lineitem
+
+from tidb_tpu.util_concurrency import witness_stats
+
+n = int(os.environ.get("LOCKCHECK_ROWS", "65536"))
+sess = build_lineitem(n)
+sess.execute("set tidb_use_tpu = 1")
+for q in (Q1, Q6):
+    sess.query(q)
+sess.execute("update lineitem set l_quantity = l_quantity + 1"
+             " where l_orderkey = 1")
+
+
+def client():
+    s2 = sess.domain.new_session()
+    for _ in range(3):
+        s2.query(Q6)
+
+
+threads = [threading.Thread(target=client) for _ in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print("LOCKCHECK_JSON " + json.dumps(witness_stats()), flush=True)
+"""
+
+
+def lockcheck_bench(n: int = None) -> dict:
+    """Lock-order witness receipt (ISSUE 16): replay the bench corpus
+    (Q1/Q6 + DML + 4 concurrent client threads) once in a FRESH
+    subprocess with TIDB_TPU_LOCKCHECK=1 — the witness wraps locks at
+    construction time, so the parent process (whose locks are already
+    plain) cannot flip it on after import — and report total guarded
+    acquisitions, max held-lock depth and violations (must be zero)."""
+    import subprocess
+
+    n = int(n or 65_536)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1",
+               LOCKCHECK_ROWS=str(n),
+               LOCKCHECK_REPO=os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    proc = subprocess.run([sys.executable, "-c", _LOCKCHECK_WORKER_SRC],
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("LOCKCHECK_JSON "):
+            stats = json.loads(ln[len("LOCKCHECK_JSON "):])
+            return {
+                "rows": n,
+                "acquisitions": stats["acquisitions"],
+                "max_held_depth": stats["max_depth"],
+                "violations": stats["violations"],
+                "ok": (stats["violations"] == 0
+                       and stats["acquisitions"] > 0),
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+    raise RuntimeError("lockcheck worker emitted no stats: "
+                       + (proc.stderr or proc.stdout)[-400:])
+
+
 def trace_overhead_bench(sess, iters: int = None) -> dict:
     """Trace-overhead receipt (ISSUE 4, extended by ISSUE 13): steady-
     state Q1 untraced vs traced vs traced+profiled.  The continuous
@@ -1201,6 +1280,21 @@ def _run_inner(state: dict):
             time.perf_counter() - T0, 1)
         persist_partial(state)
 
+    # lock-order witness receipt (ISSUE 16): corpus replay with the
+    # witness on (fresh CPU subprocess; the tunnel is irrelevant here)
+    if remaining() > 90:
+        try:
+            lc = lockcheck_bench()
+            state["lockcheck"] = lc
+            log(f"lockcheck: acquisitions={lc['acquisitions']} "
+                f"max_depth={lc['max_held_depth']} "
+                f"violations={lc['violations']} ok={lc['ok']}")
+        except BaseException as e:  # noqa: BLE001
+            state["lockcheck"] = {"error": repr(e)}
+        state["phases"]["lockcheck_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
     # Q3-shaped device join: scan+filter+JOIN+partial agg in ONE device
     # program (JoinLookupIR) vs the CPU oracle's root-side hash join
     if state.get("q1") and remaining() > 180:
@@ -1420,6 +1514,7 @@ def emit(state: dict):
                 "layout": state.get("layout"),
                 "scales": state.get("scales"),
                 "trace_overhead": state.get("trace_overhead"),
+                "lockcheck": state.get("lockcheck"),
                 "devices": state.get("devices"),
                 "complete": bool(state.get("done")),
                 "worker_error": state.get("worker_error"),

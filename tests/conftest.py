@@ -13,6 +13,12 @@ import os
 # masks beyond tile 0, and shard_map collective paths all execute under test.
 os.environ.setdefault("TIDB_TPU_TILE", "1024")
 
+# Run the whole suite under the lock-order witness (ISSUE 16): every
+# make_lock/make_rlock returns a RankedLock that raises on rank
+# inversion.  Must be set before tidb_tpu is imported anywhere — the
+# factories read it at lock construction time.
+os.environ.setdefault("TIDB_TPU_LOCKCHECK", "1")
+
 # Must be set before jax is imported anywhere.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -50,3 +56,19 @@ def _no_leaked_failpoints():
     if leaked:
         FAILPOINTS.clear()
         pytest.fail(f"test leaked armed failpoints: {leaked}")
+
+
+@pytest.fixture(autouse=True)
+def _no_lock_order_violations():
+    """The witness raises LockOrderError at the acquire site, but a
+    violation swallowed by a broad except (RPC boundaries, hook
+    dispatch) still counts — fail the test that produced it."""
+    from tidb_tpu.util_concurrency import witness_stats
+
+    before = witness_stats()["violations"]
+    yield
+    after = witness_stats()["violations"]
+    if after > before:
+        pytest.fail(
+            f"lock-order witness recorded {after - before} violation(s)"
+            " during this test (TIDB_TPU_LOCKCHECK)")

@@ -1,5 +1,7 @@
 """Regression tests for round-1 advisor findings (ADVICE.md)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -351,6 +353,14 @@ def test_rehash_partitions_racing_commit_survives(sess, monkeypatch):
     sess.execute("insert into hp values "
                  + ", ".join(f"({i}, {i})" for i in range(40)))
     s2 = d.new_session()
+    # a real racer resolved its schema BEFORE the DDL took Catalog._mu;
+    # pin that pre-DDL snapshot so the in-window commit below doesn't
+    # re-enter the catalog lock (which the DDL thread holds)
+    isc = d.catalog.info_schema()
+    monkeypatch.setattr(s2, "_infoschema", lambda: isc)
+    # ... and post-commit auto-analyze re-reads the live schema too; it's
+    # incidental bookkeeping, not the race under test
+    monkeypatch.setattr(d, "maybe_auto_analyze", lambda table_ids: None)
     orig = d.storage.detach_table
     fired = []
 
@@ -358,8 +368,16 @@ def test_rehash_partitions_racing_commit_survives(sess, monkeypatch):
         if not fired:
             fired.append(pid)
             # the racing commit: lands after any fold-TSO taken before
-            # detach, but before any store is actually detached
-            s2.execute("insert into hp values (777, 777)")
+            # detach, but before any store is actually detached.  Run it
+            # on its own thread (joined) the way a real racer would — the
+            # DDL thread holds Catalog._mu here, and the lock-order
+            # witness rightly rejects same-thread re-entry into the
+            # session path from under it.
+            t = threading.Thread(
+                target=s2.execute, args=("insert into hp values (777, 777)",))
+            t.start()
+            t.join(timeout=30)
+            assert not t.is_alive(), "racing commit wedged"
         return orig(pid)
 
     monkeypatch.setattr(d.storage, "detach_table", detach_hook)

@@ -1,0 +1,220 @@
+"""Concurrency stress + lock-order witness tests (ISSUE 16).
+
+The suite-wide conftest sets TIDB_TPU_LOCKCHECK=1 before tidb_tpu is
+imported, so every lock here is a RankedLock and the autouse
+`_no_lock_order_violations` fixture fails any test whose threads invert
+the declared rank order.  These tests hammer the three most contended
+shared structures (ByteCapCache, metrics.Registry,
+DeviceHealthRegistry) from 8 threads and assert the invariants the
+locks exist to protect: no lost increments, consistent byte
+accounting, no torn breaker state.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tidb_tpu.metrics import REGISTRY
+from tidb_tpu.util_concurrency import (
+    LockOrderError,
+    held_depth,
+    lockcheck_enabled,
+    make_lock,
+    make_rlock,
+    reset_witness_stats,
+    witness_stats,
+)
+
+N_THREADS = 8
+
+
+def _run_threads(fn, n=N_THREADS):
+    """Run fn(i) on n threads; re-raise the first worker exception."""
+    errs = []
+
+    def wrap(i):
+        try:
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+
+
+# ---------------------------------------------------------------------------
+# witness semantics
+# ---------------------------------------------------------------------------
+
+def test_witness_enabled_in_suite():
+    assert lockcheck_enabled()
+    assert witness_stats()["enabled"]
+
+
+def test_rank_inversion_raises(monkeypatch):
+    from tidb_tpu.lint import concur
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:LO", 1)
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:HI", 2)
+    lo = make_lock("tests.concur:LO")
+    hi = make_lock("tests.concur:HI")
+    with lo:
+        with hi:  # increasing rank: legal
+            assert held_depth() == 2
+    assert held_depth() == 0
+    v0 = witness_stats()["violations"]
+    with hi:
+        with pytest.raises(LockOrderError):
+            lo.acquire()
+    assert held_depth() == 0
+    assert witness_stats()["violations"] == v0 + 1
+    # the violation above was deliberate — reset so the autouse
+    # fixture does not fail this test for its own assertion
+    reset_witness_stats()
+
+
+def test_equal_rank_never_nests(monkeypatch):
+    from tidb_tpu.lint import concur
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:A", 7)
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:B", 7)
+    a = make_rlock("tests.concur:A")
+    b = make_rlock("tests.concur:B")
+    with a:
+        with a:  # same-OBJECT RLock re-entry is legal
+            pass
+        with pytest.raises(LockOrderError):
+            b.acquire()  # same RANK, different lock: never legal
+    reset_witness_stats()
+
+
+def test_unregistered_lock_name_raises():
+    with pytest.raises(LockOrderError):
+        make_lock("tests.concur:not-in-the-registry")
+
+
+# ---------------------------------------------------------------------------
+# 8-thread stress
+# ---------------------------------------------------------------------------
+
+def test_registry_stress_no_lost_increments():
+    per_thread = 2000
+    c0 = REGISTRY.get("concurrency_stress_test_total")
+
+    def work(_i):
+        for _ in range(per_thread):
+            REGISTRY.inc("concurrency_stress_test_total")
+
+    _run_threads(work)
+    got = REGISTRY.get("concurrency_stress_test_total") - c0
+    assert got == N_THREADS * per_thread, f"lost {N_THREADS*per_thread-got}"
+
+
+def test_bytecap_cache_stress_byte_accounting():
+    from tidb_tpu.copr.cache import ByteCapCache
+
+    cache = ByteCapCache(capacity_bytes=64 * 1024)
+    # value-weighted eviction exercised concurrently too
+    cache.set_policy(priority_fn=lambda k: k[1] % 3)
+    n_keys = 23
+
+    def _load(idx):
+        # deterministic per-key size, 1..5 KiB of float32
+        return (np.full(256 * (1 + idx % 5), float(idx), np.float32),)
+
+    def work(i):
+        for j in range(300):
+            idx = (i * 7 + j) % n_keys
+            v = cache.get_or_load(("stress", idx),
+                                  lambda idx=idx: _load(idx))
+            assert float(v[0][0]) == float(idx)  # never a torn value
+
+    _run_threads(work)
+    with cache._mu:
+        resident = sum(sum(a.nbytes for a in v if a is not None)
+                       for v in cache._cache.values())
+        assert resident == cache._bytes  # accounting matches contents
+        assert cache._bytes <= cache.capacity
+        assert sorted(cache._order) == sorted(cache._cache)
+        assert not cache._inflight  # every loader completed
+        assert cache.hwm_bytes >= cache._bytes
+
+
+def test_device_health_stress_consistent_states():
+    from tidb_tpu.copr.device_health import (
+        DeviceFailure,
+        DeviceHealthRegistry,
+    )
+
+    class _Dev:
+        __slots__ = ("id",)
+
+        def __init__(self, i):
+            self.id = i
+
+    devs = [_Dev(i) for i in range(8)]
+    reg = DeviceHealthRegistry(trip_threshold=3, probe_after_s=0.01)
+
+    def work(i):
+        for j in range(200):
+            d = (i + j) % 8
+            if (i + j) % 3 == 0:
+                reg.record_error(d, DeviceFailure("stress", (d,)))
+            else:
+                reg.record_success([d])
+            healthy = reg.select_devices(devs)
+            assert len(healthy) <= 8
+            reg.tripped_ids()
+            if j % 50 == 0:
+                reg.expire_cooldowns()
+
+    _run_threads(work)
+    snap = reg.snapshot()
+    assert {s.device_id for s in snap} <= set(range(8))
+    for s in snap:
+        assert s.error_count >= 0 and s.trip_count >= 0
+        # a consecutive-error run can never exceed the trip threshold:
+        # hitting it trips the breaker (torn updates would overshoot)
+        assert s.consecutive_errors <= 3
+
+
+# ---------------------------------------------------------------------------
+# regression: coordinator state replay vs concurrent registers
+# ---------------------------------------------------------------------------
+
+def test_coordinator_replay_races_register(tmp_path):
+    """_load_state used to mutate _epoch/_members/_handoff OUTSIDE the
+    membership mutex; a replay racing a register could clobber the
+    concurrent join.  Replay now holds _mu (and flushes after releasing
+    it — the witness enforces the _save_io_mu -> _mu order)."""
+    from tidb_tpu.coord.plane import Coordinator
+
+    state = tmp_path / "coord.json"
+    c = Coordinator(lease_s=30.0, state_path=str(state))
+    c.register(1, [0])
+    c.register(2, [1])
+    c._flush_state()
+
+    stop = threading.Event()
+
+    def replayer():
+        while not stop.is_set():
+            c._load_state()
+
+    t = threading.Thread(target=replayer)
+    t.start()
+    try:
+        for pid in range(10, 40):
+            c.register(pid, [pid % 8])
+    finally:
+        stop.set()
+        t.join()
+    members = c.view().members
+    for pid in [1, 2] + list(range(10, 40)):
+        assert pid in members, f"replay clobbered concurrent join {pid}"

@@ -424,6 +424,32 @@ def test_span_forwarding_batches_and_drains(monkeypatch):
         c.stop()
 
 
+def test_idle_worker_metrics_ride_heartbeat(monkeypatch):
+    """ISSUE 16 satellite (d): a worker that finishes ZERO traces never
+    sends a span batch, so its metric snapshot must piggyback on the
+    heartbeat poll — an idle worker still appears in the coordinator's
+    fleet view after one heartbeat interval."""
+    monkeypatch.setenv("TIDB_TPU_COORD_METRICS_S", "0")  # every beat
+    c = Coordinator(lease_s=30.0)
+    c.start()
+    w = None
+    try:
+        w = WorkerPlane(("127.0.0.1", c.port), pid=33, lease_s=30.0,
+                        heartbeat_s=0.05).start([0])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if 33 in c.fleet_snapshot(refresh=False):
+                break
+            time.sleep(0.02)
+        snaps = c.fleet_snapshot(refresh=False)
+        assert 33 in snaps, "idle worker missing from fleet view"
+        assert "counters" in snaps[33]
+    finally:
+        if w is not None:
+            w.stop(leave=True)
+        c.stop()
+
+
 def test_metrics_piggyback_on_span_batches(monkeypatch):
     """Fleet aggregation (ISSUE 13): workers piggyback registry
     snapshots on the span batches they already send; the coordinator

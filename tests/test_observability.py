@@ -280,6 +280,11 @@ def test_status_profile_slo_memory_fleet_sections_and_flame(d):
         fleet = st["fleet"]
         assert fleet["hosts"] == ["0"] and fleet["kind"] == "local"
         assert fleet["counters"].get("statements_total", 0) > 0
+        # lock-order witness counters (ISSUE 16): the suite runs with
+        # TIDB_TPU_LOCKCHECK=1, so acquisitions accumulate and depth>0
+        lc = st["lockcheck"]
+        assert lc["enabled"] and lc["violations"] == 0
+        assert lc["acquisitions"] > 0 and lc["max_depth"] >= 1
         assert any(n.startswith("stmt_latency_") for n in fleet["hists"])
         flame = urllib.request.urlopen(base + "/flame").read().decode()
         assert flame.strip(), "/flame must be non-empty after queries"

@@ -365,21 +365,35 @@ def test_profiler_folds_finished_traces(env):
 
 
 def test_profiler_chains_export_hook(env):
-    """The profiler hook CHAINS whatever export hook is installed (the
-    coord forwarder seam) — both must see every finished trace."""
+    """The profiler hook CHAINS onto the recorder export chain — a
+    directly-installed forwarder (the coord seam) and the profiler both
+    see every finished trace, and unchaining is list-removal: either
+    participant can leave without dropping the other."""
     from tidb_tpu.trace import Profiler, recorder
 
     d, s = env
     seen = []
+
+    def forwarder(tr):
+        seen.append(tr.sql)
+
     prev = recorder.TRACE_EXPORT_HOOK
-    recorder.TRACE_EXPORT_HOOK = lambda tr: seen.append(tr.sql)
+    recorder.TRACE_EXPORT_HOOK = forwarder  # direct install (third party)
+    p = Profiler(enabled=True)
     try:
-        p = Profiler(enabled=True)
-        p.install()
+        p.install()  # adopts the direct hook into the chain
         s.query("select count(*) from li")
         assert seen and "count(*)" in seen[-1]  # forwarder still ran
         assert p.folded().strip()               # and the profiler folded
+        # list-removal semantics: the forwarder leaves mid-chain while
+        # the profiler (chained AFTER it) keeps running
+        recorder.unchain_export_hook(forwarder)
+        n = len(seen)
+        s.query("select count(*) from li")
+        assert len(seen) == n  # forwarder gone, regardless of order
     finally:
+        recorder.unchain_export_hook(forwarder)
+        recorder.unchain_export_hook(p.fold)
         recorder.TRACE_EXPORT_HOOK = prev
 
 

@@ -46,6 +46,7 @@ from .schema import (
     IndexInfo,
     TableInfo,
 )
+from ..util_concurrency import make_rlock
 
 
 @dataclass
@@ -135,7 +136,7 @@ class InfoSchema:
 class Catalog:
     def __init__(self, storage):
         self.storage = storage
-        self._mu = threading.RLock()
+        self._mu = make_rlock("catalog.catalog:Catalog._mu")
         self._dbs: Dict[str, DBInfo] = {}
         self._next_id = 100
         self.schema_version = 0
@@ -173,7 +174,7 @@ class Catalog:
             self._next_id += 1
             return self._next_id
 
-    def _bump(self):
+    def _bump_locked(self):
         # DDL paths mutate DBInfo.tables in place before bumping, so the
         # snapshot here reflects the POST-change schema as of now; per-DB
         # table dicts are copied because future DDLs keep mutating them
@@ -189,16 +190,16 @@ class Catalog:
         if self.on_ddl is not None:
             self.on_ddl(self)
 
-    def _touch(self, tid: int):
+    def _touch_locked(self, tid: int):
         self.table_versions[tid] = self.schema_version
 
-    def _touch_info(self, t):
+    def _touch_info_locked(self, t):
         """Touch the logical id AND every partition's physical id: txn
         write-sets key on physical ids, so the commit-time schema check
         (domain/schema_validator.go analog) must see partition bumps."""
-        self._touch(t.id)
+        self._touch_locked(t.id)
         for pid in t.physical_ids():
-            self._touch(pid)
+            self._touch_locked(pid)
 
     def info_schema(self) -> InfoSchema:
         with self._mu:
@@ -231,7 +232,7 @@ class Catalog:
         if getattr(self, "on_ddl", None) is not None:
             self.on_ddl(self)
 
-    def _record(self, job: DDLJob):
+    def _record_locked(self, job: DDLJob):
         job.schema_version = self.schema_version
         job.start_time = time.time()
         self.jobs.append(job)
@@ -247,8 +248,8 @@ class Catalog:
                     return
                 raise KVError(f"database {name!r} exists")
             self._dbs[key] = DBInfo(self.gen_id(), name)
-            self._bump()
-            self._record(DDLJob(self.gen_id(), "create_schema", name, ""))
+            self._bump_locked()
+            self._record_locked(DDLJob(self.gen_id(), "create_schema", name, ""))
 
     def drop_database(self, name: str, if_exists: bool = False):
         with self._mu:
@@ -264,8 +265,8 @@ class Catalog:
                         self.storage.drop_table(pid)
                         self._notify_drop(pid)
             del self._dbs[key]
-            self._bump()
-            self._record(DDLJob(self.gen_id(), "drop_schema", name, ""))
+            self._bump_locked()
+            self._record_locked(DDLJob(self.gen_id(), "drop_schema", name, ""))
 
     # ------------------------------------------------------------------
     # tables
@@ -292,12 +293,12 @@ class Catalog:
                             pd.id = self.gen_id()
                         self.storage.create_table(pd.id,
                                                   info.storage_columns())
-                        self._touch(pd.id)
+                        self._touch_locked(pd.id)
                 else:
                     self.storage.create_table(info.id, info.storage_columns())
-            self._bump()
-            self._touch(info.id)
-            self._record(DDLJob(self.gen_id(), "create_table", db, info.name))
+            self._bump_locked()
+            self._touch_locked(info.id)
+            self._record_locked(DDLJob(self.gen_id(), "create_table", db, info.name))
             return info
 
     def drop_table(self, db: str, name: str, if_exists: bool = False,
@@ -324,9 +325,9 @@ class Catalog:
                 self.recycle_bin.append(
                     {"t": t, "db": db.lower(), "stores": stores,
                      "drop_wall": time.time()})
-            self._bump()
-            self._touch_info(t)
-            self._record(DDLJob(self.gen_id(), "drop_table", db, name))
+            self._bump_locked()
+            self._touch_info_locked(t)
+            self._record_locked(DDLJob(self.gen_id(), "drop_table", db, name))
 
     def recover_table(self, db: str, name: str) -> TableInfo:
         """RECOVER TABLE: restore the newest recycle-bin entry for
@@ -346,12 +347,12 @@ class Catalog:
                     t = e["t"]
                     for pid, st in e["stores"].items():
                         self.storage.attach_table(pid, st)
-                        self._touch(pid)
+                        self._touch_locked(pid)
                     d.tables[name.lower()] = t
-                    self._bump()
-                    self._touch_info(t)
+                    self._bump_locked()
+                    self._touch_info_locked(t)
                     self._persist()
-                    self._record(DDLJob(self.gen_id(), "recover_table",
+                    self._record_locked(DDLJob(self.gen_id(), "recover_table",
                                         db, name))
                     return t
             raise KVError(
@@ -396,13 +397,13 @@ class Catalog:
                      for p in t.partition_info.defs])
                 for pd in new.partition_info.defs:
                     self.storage.create_table(pd.id, new.storage_columns())
-                    self._touch(pd.id)
+                    self._touch_locked(pd.id)
             else:
                 self.storage.create_table(new.id, new.storage_columns())
-            self._bump()
-            self._touch_info(t)
-            self._touch_info(new)
-            self._record(DDLJob(self.gen_id(), "truncate_table", db, name))
+            self._bump_locked()
+            self._touch_info_locked(t)
+            self._touch_info_locked(new)
+            self._record_locked(DDLJob(self.gen_id(), "truncate_table", db, name))
 
     def rename_table(self, db: str, old: str, new: str):
         with self._mu:
@@ -421,10 +422,10 @@ class Catalog:
             t2 = dataclasses.replace(t, name=new,
                                      foreign_keys=list(t.foreign_keys))
             d.tables[new.lower()] = t2
-            self._rewrite_referencing_fks(db, old, new_table=new)
-            self._bump()
-            self._touch_info(t)
-            self._record(DDLJob(self.gen_id(), "rename_table", db, new))
+            self._rewrite_referencing_fks_locked(db, old, new_table=new)
+            self._bump_locked()
+            self._touch_info_locked(t)
+            self._record_locked(DDLJob(self.gen_id(), "rename_table", db, new))
 
     # ------------------------------------------------------------------
     # columns (add/drop rebuild storage blocks; the reference reorganizes
@@ -444,8 +445,8 @@ class Catalog:
             new_cols = t.columns + [col]
             default = col.default if col.has_default else None
             self._rebuild_storage(t, new_cols, add_default=(col, default))
-            self._replace_table(db, table, t, columns=new_cols)
-            self._record(job)
+            self._replace_table_locked(db, table, t, columns=new_cols)
+            self._record_locked(job)
 
     def drop_column(self, db: str, table: str, name: str):
         with self._mu:
@@ -467,9 +468,9 @@ class Catalog:
                        if col.name.lower() not in
                        [c.lower() for c in fk["columns"]]]
             self._rebuild_storage(t, new_cols, drop=col.name)
-            self._replace_table(db, table, t, columns=new_cols,
+            self._replace_table_locked(db, table, t, columns=new_cols,
                                 indexes=new_idx, foreign_keys=new_fks)
-            self._record(job)
+            self._record_locked(job)
 
     def modify_column(self, db: str, table: str, col: ColumnInfo):
         """Change column type (lossy conversions surface as errors)."""
@@ -482,8 +483,8 @@ class Catalog:
             new_cols = list(t.columns)
             new_cols[old.offset] = col
             self._rebuild_storage(t, new_cols, retype=(old.offset, col.ftype))
-            self._replace_table(db, table, t, columns=new_cols)
-            self._record(DDLJob(self.gen_id(), "modify_column", db, table))
+            self._replace_table_locked(db, table, t, columns=new_cols)
+            self._record_locked(DDLJob(self.gen_id(), "modify_column", db, table))
 
     def change_column(self, db: str, table: str, old_name: str,
                       col: ColumnInfo):
@@ -511,14 +512,14 @@ class Catalog:
             self._rebuild_storage(t, new_cols,
                                   retype=(old.offset, col.ftype),
                                   rename=(old.name, col.name))
-            self._replace_table(db, table, t, columns=new_cols,
+            self._replace_table_locked(db, table, t, columns=new_cols,
                                 indexes=new_ixs, foreign_keys=new_fks)
             # other tables referencing THIS column track the new name
-            self._rewrite_referencing_fks(
+            self._rewrite_referencing_fks_locked(
                 db, table, ref_col_rename=(old.name, col.name))
-            self._record(DDLJob(self.gen_id(), "change_column", db, table))
+            self._record_locked(DDLJob(self.gen_id(), "change_column", db, table))
 
-    def _rewrite_referencing_fks(self, ref_db: str, ref_table: str,
+    def _rewrite_referencing_fks_locked(self, ref_db: str, ref_table: str,
                                  ref_col_rename=None, new_table=None):
         """Keep FK metadata in OTHER tables pointing at (ref_db,
         ref_table) consistent across renames (SHOW CREATE TABLE must emit
@@ -586,8 +587,8 @@ class Catalog:
                         self._check_unique(t, columns, name, store_id=pd.id)
                 ix = IndexInfo(self.gen_id(), name, list(columns), unique,
                                primary, STATE_PUBLIC)
-                self._replace_table(db, table, t, indexes=t.indexes + [ix])
-                self._record(DDLJob(self.gen_id(), "add_index", db, table))
+                self._replace_table_locked(db, table, t, indexes=t.indexes + [ix])
+                self._record_locked(DDLJob(self.gen_id(), "add_index", db, table))
                 return
             if unique:
                 self._check_unique(t, columns, name)
@@ -633,7 +634,7 @@ class Catalog:
             # owner-resume split).
             with self._mu:
                 t = self.info_schema().table(job.db, job.table)
-                self._replace_table(
+                self._replace_table_locked(
                     job.db, job.table, t,
                     indexes=[i for i in t.indexes if i.name != ix.name])
                 job.state = "rollback"
@@ -652,7 +653,7 @@ class Catalog:
         with self._mu:
             t = self.info_schema().table(job.db, job.table)
             others = [i for i in t.indexes if i.name != ix.name]
-            self._replace_table(job.db, job.table, t,
+            self._replace_table_locked(job.db, job.table, t,
                                 indexes=others + [dc_replace(ix, state=st)])
             job.schema_version = self.schema_version
 
@@ -867,7 +868,9 @@ class Catalog:
         bad job neither blocks later jobs nor fails the domain open."""
         from ..metrics import REGISTRY
 
-        for job in list(self.jobs):
+        with self._mu:
+            pending = list(self.jobs)
+        for job in pending:
             if job.state == "running":
                 try:
                     self.run_ddl_job(job)
@@ -888,10 +891,10 @@ class Catalog:
             ix = t.find_index(name)
             if ix is None:
                 raise KVError(f"no index {name!r}")
-            self._replace_table(
+            self._replace_table_locked(
                 db, table, t, indexes=[i for i in t.indexes if i is not ix]
             )
-            self._record(DDLJob(self.gen_id(), "drop_index", db, table))
+            self._record_locked(DDLJob(self.gen_id(), "drop_index", db, table))
 
     def _check_unique(self, t: TableInfo, columns: List[str], name: str,
                       store_id: Optional[int] = None):
@@ -930,7 +933,7 @@ class Catalog:
             seen.add(key)
 
     # ------------------------------------------------------------------
-    def _replace_table(self, db: str, table: str, t: TableInfo, **overrides):
+    def _replace_table_locked(self, db: str, table: str, t: TableInfo, **overrides):
         d = self._dbs[db.lower()]
         new = TableInfo(
             t.id, t.name,
@@ -941,8 +944,8 @@ class Catalog:
             overrides.get("foreign_keys", list(t.foreign_keys)),
         )
         d.tables[table.lower()] = new
-        self._bump()
-        self._touch_info(new)
+        self._bump_locked()
+        self._touch_info_locked(new)
 
     # ------------------------------------------------------------------
     # light ALTERs: metadata-only changes (ddl_api.go RebaseAutoID :1999,
@@ -957,9 +960,9 @@ class Catalog:
                             t.comment, t.is_view, t.view_select,
                             t.partition_info, list(t.foreign_keys))
             self._dbs[db.lower()].tables[table.lower()] = new
-            self._bump()
-            self._touch_info(new)
-            self._record(DDLJob(self.gen_id(), "rebase_auto_id", db, table))
+            self._bump_locked()
+            self._touch_info_locked(new)
+            self._record_locked(DDLJob(self.gen_id(), "rebase_auto_id", db, table))
 
     def set_table_comment(self, db: str, table: str, comment: str):
         with self._mu:
@@ -969,9 +972,9 @@ class Catalog:
                             t.is_view, t.view_select, t.partition_info,
                             list(t.foreign_keys))
             self._dbs[db.lower()].tables[table.lower()] = new
-            self._bump()
-            self._touch_info(new)
-            self._record(DDLJob(self.gen_id(), "modify_comment", db, table))
+            self._bump_locked()
+            self._touch_info_locked(new)
+            self._record_locked(DDLJob(self.gen_id(), "modify_comment", db, table))
 
     def rename_index(self, db: str, table: str, old: str, new_name: str):
         with self._mu:
@@ -985,8 +988,8 @@ class Catalog:
             new_ixs = [IndexInfo(x.id, new_name if x is ix else x.name,
                                  x.columns, x.unique, x.primary, x.state)
                        for x in t.indexes]
-            self._replace_table(db, table, t, indexes=new_ixs)
-            self._record(DDLJob(self.gen_id(), "rename_index", db, table))
+            self._replace_table_locked(db, table, t, indexes=new_ixs)
+            self._record_locked(DDLJob(self.gen_id(), "rename_index", db, table))
 
     def add_foreign_key(self, db: str, table: str, name: str, columns,
                         ref_db: str, ref_table: str, ref_columns):
@@ -1014,9 +1017,9 @@ class Catalog:
                             t.pk_is_handle, t.auto_inc_id, t.comment,
                             t.is_view, t.view_select, t.partition_info, fks)
             self._dbs[db.lower()].tables[table.lower()] = new
-            self._bump()
-            self._touch_info(new)
-            self._record(DDLJob(self.gen_id(), "add_foreign_key", db, table))
+            self._bump_locked()
+            self._touch_info_locked(new)
+            self._record_locked(DDLJob(self.gen_id(), "add_foreign_key", db, table))
 
     def drop_foreign_key(self, db: str, table: str, name: str):
         with self._mu:
@@ -1029,9 +1032,9 @@ class Catalog:
                             t.pk_is_handle, t.auto_inc_id, t.comment,
                             t.is_view, t.view_select, t.partition_info, fks)
             self._dbs[db.lower()].tables[table.lower()] = new
-            self._bump()
-            self._touch_info(new)
-            self._record(DDLJob(self.gen_id(), "drop_foreign_key", db,
+            self._bump_locked()
+            self._touch_info_locked(new)
+            self._record_locked(DDLJob(self.gen_id(), "drop_foreign_key", db,
                                 table))
 
     # ------------------------------------------------------------------
@@ -1084,12 +1087,12 @@ class Catalog:
             for name, less_than in defs:
                 pd = PartitionDef(self.gen_id(), name, less_than)
                 self.storage.create_table(pd.id, t.storage_columns())
-                self._touch(pd.id)
+                self._touch_locked(pd.id)
                 cur.append(pd)
             new_pi = PartitionInfo(pi.kind, pi.column, cur)
-            self._replace_table(db, table, t, partition_info=new_pi)
+            self._replace_table_locked(db, table, t, partition_info=new_pi)
             self._persist()
-            self._record(DDLJob(self.gen_id(), "add_partition", db, table))
+            self._record_locked(DDLJob(self.gen_id(), "add_partition", db, table))
 
     def drop_partition(self, db: str, table: str, names):
         from .schema import PartitionInfo
@@ -1116,9 +1119,9 @@ class Catalog:
                 self.storage.drop_table(pd.id)
                 self._notify_drop(pd.id)
             new_pi = PartitionInfo(pi.kind, pi.column, keep)
-            self._replace_table(db, table, t, partition_info=new_pi)
+            self._replace_table_locked(db, table, t, partition_info=new_pi)
             self._persist()
-            self._record(DDLJob(self.gen_id(), "drop_partition", db, table))
+            self._record_locked(DDLJob(self.gen_id(), "drop_partition", db, table))
 
     def truncate_partition(self, db: str, table: str, names):
         from .schema import PartitionDef, PartitionInfo
@@ -1143,14 +1146,14 @@ class Catalog:
                     new_pd = PartitionDef(self.gen_id(), pd.name,
                                           pd.less_than)
                     self.storage.create_table(new_pd.id, t.storage_columns())
-                    self._touch(new_pd.id)
+                    self._touch_locked(new_pd.id)
                     out.append(new_pd)
                 else:
                     out.append(pd)
             new_pi = PartitionInfo(pi.kind, pi.column, out)
-            self._replace_table(db, table, t, partition_info=new_pi)
+            self._replace_table_locked(db, table, t, partition_info=new_pi)
             self._persist()
-            self._record(DDLJob(self.gen_id(), "truncate_partition", db,
+            self._record_locked(DDLJob(self.gen_id(), "truncate_partition", db,
                                 table))
 
     def coalesce_partition(self, db: str, table: str, n: int):
@@ -1213,7 +1216,7 @@ class Catalog:
         for pd in new_defs:
             stores[pd.id] = self.storage.create_table(
                 pd.id, t.storage_columns())
-            self._touch(pd.id)
+            self._touch_locked(pd.id)
         for chunk in parts_data:
             n = chunk.num_rows
             if not n:
@@ -1232,9 +1235,9 @@ class Catalog:
                     valids.append(col.validity()[m])
                 stores[pd.id].bulk_load_arrays(arrays, valids, ts)
         new_pi = PartitionInfo(pi.kind, pi.column, new_defs)
-        self._replace_table(db, t.name, t, partition_info=new_pi)
+        self._replace_table_locked(db, t.name, t, partition_info=new_pi)
         self._persist()
-        self._record(DDLJob(self.gen_id(), "rehash_partition", db, t.name))
+        self._record_locked(DDLJob(self.gen_id(), "rehash_partition", db, t.name))
 
     def _rebuild_storage(self, t: TableInfo, new_cols: List[ColumnInfo],
                          add_default=None, drop: str = None, retype=None,

@@ -43,9 +43,10 @@ from .plane import (  # noqa: F401
     LocalPlane,
     WorkerPlane,
 )
+from ..util_concurrency import make_lock
 
 _PLANE = None
-_PLANE_LOCK = threading.Lock()
+_PLANE_LOCK = make_lock("coord:_PLANE_LOCK")
 
 
 def get_plane():
@@ -128,10 +129,9 @@ def reset_plane():
             pass
     from ..trace import recorder
 
-    recorder.TRACE_EXPORT_HOOK = None
-    # wiping the seam drops the continuous profiler from the chain —
-    # re-chain it so profiling survives plane teardown (install() sees
-    # the None seam and re-installs)
+    recorder.clear_export_hooks()
+    # wiping the chain drops the continuous profiler too — re-chain it
+    # so profiling survives plane teardown
     from ..trace import install_profiler
 
     install_profiler()

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from ..metrics import REGISTRY
 from ..store.fault import FAILPOINTS
 from .membership import MembershipView
+from ..util_concurrency import make_lock, make_rlock
 
 
 def _span_cap_bytes() -> int:
@@ -104,7 +105,7 @@ class Coordinator:
         self.expect = expect
         self.self_pid = self_pid  # exempt from lease expiry (no heartbeat)
         self._clock = clock
-        self._mu = threading.RLock()
+        self._mu = make_rlock("coord.plane:Coordinator._mu")
         self._epoch = 0
         self._formed = expect is None
         self._members: Dict[int, dict] = {}
@@ -114,7 +115,7 @@ class Coordinator:
         # coordinator re-learns them within one snapshot interval)
         self._fleet: Dict[int, dict] = {}
         self._save_dirty = False
-        self._save_io_mu = threading.Lock()
+        self._save_io_mu = make_lock("coord.plane:Coordinator._save_io_mu")
         self._stop = threading.Event()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
@@ -138,29 +139,37 @@ class Coordinator:
         doc = self._persist.load()
         if not doc:
             return
-        self._epoch = int(doc.get("epoch", 0))
-        now = self._clock()
-        for pid_s, m in (doc.get("members") or {}).items():
-            self._members[int(pid_s)] = {
-                "devices": tuple(int(d) for d in m.get("devices", ())),
-                # a fresh lease window: live members re-heartbeat within
-                # one lease, dead ones expire exactly like a lost member
-                "last_seen": now,
-                "lease_s": float(m.get("lease_s", self.lease_s)),
-            }
-        self._handoff = {int(p): list(v) for p, v in
-                         (doc.get("handoff") or {}).items()}
-        # the restart itself is a membership event: renumber once so
-        # every surviving worker rebuilds from the replayed broadcast
-        self._epoch += 1
-        if self.expect is not None and len(self._members) >= self.expect:
-            self._formed = True
+        # under the membership mutex: the RPC listener may already be
+        # serving registers while a reopened coordinator replays state,
+        # and an unlocked replay can clobber a concurrent join
+        with self._mu:
+            self._epoch = int(doc.get("epoch", 0))
+            now = self._clock()
+            for pid_s, m in (doc.get("members") or {}).items():
+                self._members[int(pid_s)] = {
+                    "devices": tuple(int(d)
+                                     for d in m.get("devices", ())),
+                    # a fresh lease window: live members re-heartbeat
+                    # within one lease, dead ones expire exactly like a
+                    # lost member
+                    "last_seen": now,
+                    "lease_s": float(m.get("lease_s", self.lease_s)),
+                }
+            self._handoff = {int(p): list(v) for p, v in
+                             (doc.get("handoff") or {}).items()}
+            # the restart itself is a membership event: renumber once so
+            # every surviving worker rebuilds from the replayed broadcast
+            self._epoch += 1
+            if self.expect is not None \
+                    and len(self._members) >= self.expect:
+                self._formed = True
+            epoch = self._epoch
+            self._save_locked()
         REGISTRY.inc("coord_state_replayed_total")
-        REGISTRY.set("coord_epoch", self._epoch)
+        REGISTRY.set("coord_epoch", epoch)
         # persist the renumbered epoch IMMEDIATELY: a second restart
         # before any membership change must replay strictly above THIS
         # incarnation's broadcasts, not re-issue the same epoch
-        self._save_dirty = True
         self._flush_state()
 
     def _save_locked(self):
@@ -436,6 +445,13 @@ class Coordinator:
                                 req.get("lease_s"))
             return self._resp(out["view"], handoff=out["handoff"])
         if cmd == "poll":
+            # heartbeat polls piggyback metric snapshots too (ISSUE 16
+            # satellite (d)): an idle worker with zero finished traces
+            # never sends a span batch, but must still appear in the
+            # coordinator's fleet view
+            m = req.get("metrics")
+            if m:
+                self.ingest_metrics(pid, m)
             return self._resp(self.poll(pid))
         if cmd == "report":
             return self._resp(self.report(pid, req.get("devices") or ()))
@@ -490,7 +506,7 @@ class LocalPlane:
     pid = 0
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("coord.plane:LocalPlane._mu")
         self._epoch = 1
         self._devices: Tuple[int, ...] = ()
         self._handoff: List[dict] = []
@@ -651,7 +667,7 @@ class WorkerPlane:
         self.lease_s = float(lease_s)
         self.heartbeat_s = heartbeat_s or max(self.lease_s / 3.0, 0.05)
         self.rpc_timeout_s = rpc_timeout_s
-        self._mu = threading.Lock()
+        self._mu = make_lock("coord.plane:WorkerPlane._mu")
         self._view = MembershipView(0, {}, formed=False)
         self._devices: Tuple[int, ...] = ()
         self._handoff_in: List[dict] = []
@@ -663,7 +679,7 @@ class WorkerPlane:
         # path.  Flushes trigger by SIZE (batch threshold) or AGE
         # (flush interval); drain/stop flushes whatever remains.
         self._span_q: List[str] = []
-        self._span_mu = threading.Lock()
+        self._span_mu = make_lock("coord.plane:WorkerPlane._span_mu")
         self._span_wake = threading.Event()
         self._span_thread: Optional[threading.Thread] = None
         self._span_batch = max(int(os.environ.get(
@@ -677,10 +693,6 @@ class WorkerPlane:
         self._metrics_interval_s = float(os.environ.get(
             "TIDB_TPU_COORD_METRICS_S", "2.0"))
         self._metrics_sent = 0.0
-        # TRACE_EXPORT_HOOK chaining (a continuous profiler may already
-        # hold the seam — both must run)
-        self._export_hook = None
-        self._prev_hook = None
 
     # ---- lifecycle ------------------------------------------------------
     def start(self, devices=()):
@@ -700,24 +712,12 @@ class WorkerPlane:
             target=self._span_flusher, daemon=True,
             name="tidb-tpu-coord-spans")
         self._span_thread.start()
-        # worker span trees rejoin the coordinator's trace ring.  CHAIN
-        # any already-installed hook (the continuous profiler): both the
-        # forwarder and the profiler must see every finished trace.
+        # worker span trees rejoin the coordinator's trace ring.  The
+        # recorder-level chain keeps any already-installed participant
+        # (the continuous profiler): both must see every finished trace.
         from ..trace import recorder
 
-        prev = recorder.TRACE_EXPORT_HOOK
-        self._prev_hook = prev
-
-        def hook(tr, _prev=prev, _plane=self):
-            _plane.forward_trace(tr)
-            if _prev is not None:
-                try:
-                    _prev(tr)
-                except Exception:
-                    pass
-
-        self._export_hook = hook
-        recorder.TRACE_EXPORT_HOOK = hook
+        recorder.chain_export_hook(self.forward_trace)
         return self
 
     def stop(self, leave: bool = False):
@@ -735,11 +735,10 @@ class WorkerPlane:
         self.flush_spans()
         from ..trace import recorder
 
-        if recorder.TRACE_EXPORT_HOOK is self._export_hook \
-                and self._export_hook is not None:
-            # restore the chained hook (profiler keeps folding)
-            recorder.TRACE_EXPORT_HOOK = self._prev_hook
-        self._export_hook = None
+        # list removal, not restore-if-top: the forwarder leaves the
+        # chain even when the profiler (or a later plane) chained after
+        # us; every other participant keeps running
+        recorder.unchain_export_hook(self.forward_trace)
 
     def leave(self):
         try:
@@ -796,9 +795,9 @@ class WorkerPlane:
         counters; a dead coordinator costs the flusher a short timeout,
         never a query failure."""
         if self._stop.is_set():
-            # stop() may fail to unchain this hook when something (the
-            # profiler, a later plane) chained on top of it — a stopped
-            # plane must not keep feeding a queue nobody drains
+            # a dispatch snapshot taken just before stop() unchained us
+            # can still deliver here — a stopped plane must not keep
+            # feeding a queue nobody drains
             return
         try:
             from ..trace.export import trace_payload
@@ -902,7 +901,19 @@ class WorkerPlane:
     def _heartbeat(self):
         while not self._stop.wait(self.heartbeat_s):
             try:
-                resp = self._rpc({"cmd": "poll", "pid": self.pid})
+                req = {"cmd": "poll", "pid": self.pid}
+                now = time.monotonic()
+                if now - self._metrics_sent >= self._metrics_interval_s:
+                    # piggyback a metric snapshot on the heartbeat: an
+                    # idle worker (no finished traces, so no span
+                    # batches) must still reach the fleet view
+                    try:
+                        req["metrics"] = _local_fleet_payload()
+                    except Exception:
+                        pass
+                resp = self._rpc(req)
+                if "metrics" in req:
+                    self._metrics_sent = now
                 view = _view_from_resp(resp)
                 if self.pid not in view.members:
                     # expired while alive (paused/partitioned): rejoin at

@@ -13,6 +13,7 @@ import os
 import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
+from ..util_concurrency import make_lock
 
 
 class _InFlight:
@@ -42,7 +43,7 @@ class ByteCapCache:
         # "how close did we get to the cap" gauge
         self.name = name
         self.hwm_bytes = 0
-        self._mu = threading.Lock()
+        self._mu = make_lock("copr.cache:ByteCapCache._mu")
         # value-weighted eviction policy (layout autotuner): priority_fn
         # ranks resident keys (lowest evicts first; None = FIFO) and
         # demote_fn gets each victim BEFORE it is dropped — the hook that
@@ -234,7 +235,7 @@ class ProgramCache:
         self.capacity = capacity if capacity is not None else int(
             os.environ.get("TIDB_TPU_PROGRAM_CACHE_SIZE", "256"))
         self._d: "OrderedDict" = OrderedDict()
-        self._mu = threading.Lock()
+        self._mu = make_lock("copr.cache:ProgramCache._mu")
         self.hits = self.misses = self.evictions = 0
         PROGRAM_CACHES.append(self)
 

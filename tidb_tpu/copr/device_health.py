@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..metrics import REGISTRY
+from ..util_concurrency import make_lock
 
 HEALTHY = "healthy"
 TRIPPED = "tripped"
@@ -142,7 +143,7 @@ class DeviceHealthRegistry:
         self.probe_after_s = probe_after_s
         self.max_cooldown_s = max_cooldown_s
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = make_lock("copr.device_health:DeviceHealthRegistry._mu")
         self._devices: Dict[int, DeviceState] = {}
         # coordination-plane epoch publication hook (tidb_tpu/coord):
         # invoked OUTSIDE the lock after any transition that changes the

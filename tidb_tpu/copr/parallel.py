@@ -35,6 +35,7 @@ from .. import ops  # noqa: F401  (configures x64)
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..util_concurrency import make_lock
 
 try:  # jax >= 0.4.35 stable API
     from jax import shard_map
@@ -67,7 +68,7 @@ _MESH: Optional[Mesh] = None
 #: a mismatch means some host changed the survivor set after we built,
 #: and dispatching anyway risks an XLA collective desync/hang
 _MESH_EPOCH: Optional[int] = None
-_MESH_LOCK = threading.Lock()
+_MESH_LOCK = make_lock("copr.parallel:_MESH_LOCK")
 _DIST_INIT = False
 
 # ONE collective program in flight per process: concurrent shard_map
@@ -78,7 +79,7 @@ _DIST_INIT = False
 # mesh is one shared resource — dispatches serialize on it, and the
 # serving layer's micro-batcher is the mechanism that turns that
 # serialization back into parallelism (N queries -> one dispatch).
-DISPATCH_LOCK = threading.Lock()
+DISPATCH_LOCK = make_lock("copr.parallel:DISPATCH_LOCK")
 
 
 def _maybe_init_multihost():

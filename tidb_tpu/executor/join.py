@@ -30,9 +30,10 @@ from ..expr.expression import Expression, eval_bool_mask
 from ..expr.vec import Vec
 from ..types import TypeKind, ty_bool
 from .base import ExecContext, Executor
+from ..util_concurrency import make_lock
 
 
-_STR_DICT_MU = threading.Lock()
+_STR_DICT_MU = make_lock("executor.join:_STR_DICT_MU")
 
 
 def _key_matrix(chunk: Chunk, keys: List[Expression],

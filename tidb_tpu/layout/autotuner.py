@@ -39,6 +39,7 @@ import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
+from ..util_concurrency import make_lock
 
 
 @dataclass
@@ -83,7 +84,7 @@ class LayoutEngine:
     """Process-global observation store + per-column layout decisions."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("layout.autotuner:LayoutEngine._mu")
         #: (store_uid, store_ci) -> ColumnObs
         self._obs: Dict[Tuple[int, int], ColumnObs] = {}
         #: (store_uid, store_ci) -> ColumnPlan (recomputed lazily)

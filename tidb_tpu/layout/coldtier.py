@@ -36,6 +36,7 @@ import numpy as np
 
 from ..copr.cache import ByteCapCache
 from ..types import TypeKind
+from ..util_concurrency import make_lock
 
 #: chaos site: armed actions fail the cold access; the loader falls back
 #: to the hot tier (parity-preserving, metric-counted)
@@ -97,7 +98,7 @@ class ColdColumn:
                 + int(self.operand.nbytes))
 
 
-_mu = threading.Lock()
+_mu = make_lock("layout.coldtier:_mu")
 #: (store_uid, base_version, store_ci) -> (Optional[PackInfo],
 #: Optional[unique-values vector]).  info=None means probed and not
 #: packable; the uniq vector is kept for 'unique' kinds so the probe's

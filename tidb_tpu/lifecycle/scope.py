@@ -38,6 +38,7 @@ from ..errors import (
     ServerShutdownError,
     TiDBTPUError,
 )
+from ..util_concurrency import make_lock
 
 #: termination reasons, in precedence order (first cancel wins)
 REASONS = ("killed", "timeout", "mem_quota", "overload", "shutdown")
@@ -59,12 +60,13 @@ class QueryScope:
         self.deadline = (self.start + timeout_s) if timeout_s else None
         self.cancel_event = threading.Event()
         self._reason: Optional[str] = None
-        self._mu = threading.Lock()
+        self._mu = make_lock("lifecycle.scope:QueryScope._mu")
 
     # ---- cancellation ---------------------------------------------------
     @property
     def reason(self) -> Optional[str]:
-        return self._reason
+        with self._mu:
+            return self._reason
 
     def cancel(self, reason: str):
         """Request termination; the statement unwinds at its next
@@ -113,7 +115,7 @@ class QueryScope:
 
     def error(self) -> TiDBTPUError:
         """The typed MySQL-coded error for this scope's termination."""
-        r = self._reason or "killed"
+        r = self.reason or "killed"
         if r == "timeout":
             return MaxExecutionTimeExceeded()
         if r == "shutdown":

@@ -89,6 +89,7 @@ PASS_RULES = {
     "plan": ("plan-schema",),
     "kernel": ("kernel-contract",),
     "metric": ("metric-name",),
+    "concur": ("lock-rank", "lock-order", "lock-blocking", "lock-guard"),
 }
 
 
@@ -102,12 +103,16 @@ def run_all(repo_root: Optional[str] = None,
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-    passes = passes or ["purity", "plan", "kernel", "metric"]
+    passes = passes or ["purity", "plan", "kernel", "metric", "concur"]
     findings: List[Finding] = []
     if "purity" in passes:
         from .purity import lint_tree
 
         findings += lint_tree(repo_root)
+    if "concur" in passes:
+        from .concur import lint_tree as lint_concur
+
+        findings += lint_concur(repo_root)
     if "metric" in passes:
         from .metricnames import lint_tree as lint_metric_names
 

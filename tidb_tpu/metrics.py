@@ -18,6 +18,7 @@ import math
 import threading
 from collections import defaultdict
 from typing import Dict, Optional
+from .util_concurrency import make_lock
 
 #: log2 bucket range: upper edges 2**MIN_EXP .. 2**MAX_EXP.  Covers
 #: sub-microsecond ms values (2^-20 ms ~ 1ns) through byte counts in the
@@ -94,7 +95,7 @@ class Histogram:
 
 class Registry:
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("metrics:Registry._mu")
         self._counters: Dict[str, float] = defaultdict(float)
         self._hists: Dict[str, Histogram] = {}
 

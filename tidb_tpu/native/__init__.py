@@ -14,13 +14,14 @@ import threading
 from typing import Optional, Tuple
 
 import numpy as np
+from ..util_concurrency import make_lock
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "hashkit.cpp")
 _SO = os.path.join(_HERE, "_hashkit.so")
 
 _lib = None
-_lib_mu = threading.Lock()
+_lib_mu = make_lock("native:_lib_mu")
 _build_failed = False
 
 

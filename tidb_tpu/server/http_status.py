@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..metrics import REGISTRY
+from ..util_concurrency import witness_stats
 
 VERSION = "8.0.11-tidb-tpu-0.1.0"
 
@@ -260,6 +261,10 @@ class StatusServer:
                         # hosts, histograms bucket-merged, gauges
                         # per-host (LocalPlane = single-member fleet)
                         "fleet": _fleet_section(),
+                        # lock-order witness (ISSUE 16): guarded
+                        # acquisitions, max held depth, violations
+                        # (all zero with TIDB_TPU_LOCKCHECK unset)
+                        "lockcheck": witness_stats(),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

@@ -40,12 +40,13 @@ from typing import Dict
 
 from .buckets import shape_bucket, topn_budget  # noqa: F401
 from .params import hoist_conds  # noqa: F401
+from ..util_concurrency import make_lock
 
 #: sysvar names that feed the process-wide serving config
 _SYSVARS = ("tidb_tpu_shape_buckets", "tidb_tpu_microbatch_window_ms",
             "tidb_tpu_microbatch_max")
 
-_mu = threading.Lock()
+_mu = make_lock("serving:_mu")
 _CONFIG: Dict[str, float] = {
     # defaults mirror session/vars.py SYSVAR_DEFAULTS
     "shape_buckets": True,

@@ -35,6 +35,7 @@ import numpy as np
 from ..errors import TiDBTPUError
 from ..metrics import REGISTRY
 from ..store.fault import FAILPOINTS
+from ..util_concurrency import make_lock
 
 log = logging.getLogger("tidb_tpu.serving")
 
@@ -85,7 +86,7 @@ class MicroBatcher:
     event with scope-interruptible waits."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("serving.batcher:MicroBatcher._mu")
         self._groups: Dict[tuple, _Group] = {}
 
     def submit(self, key: tuple, member: _Member, window_s: float,

@@ -18,6 +18,7 @@ from .vars import SessionVars
 
 
 import re as _re
+from ..util_concurrency import make_rlock
 
 _NUM_RE = _re.compile(r"\b\d+(?:\.\d+)?\b")
 _STR_RE = _re.compile(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"")
@@ -56,7 +57,7 @@ class Domain:
         self.priv = PrivManager(data_dir)
         self.catalog.on_table_dropped = self.stats.drop
         self.global_vars: Dict[str, str] = {}
-        self._mu = threading.RLock()
+        self._mu = make_rlock("session.domain:Domain._mu")
         # ring buffer of recent log records -> information_schema.
         # cluster_log (executor/cluster_reader.go memtable role); ONE
         # process-wide handler — re-pointed at the newest Domain's ring so

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..errors import KVError, PrivilegeError
 from ..parser import ast
+from ..util_concurrency import make_rlock
 
 # statement privilege names (mysql.user column surface subset)
 DML_PRIVS = {"select", "insert", "update", "delete"}
@@ -72,7 +73,8 @@ def _stage2(password: str) -> str:
 class PrivManager:
     def __init__(self, data_dir: Optional[str] = None):
         self.data_dir = data_dir
-        self._mu = threading.RLock()  # server pool runs GRANTs concurrently
+        # server pool runs GRANTs concurrently
+        self._mu = make_rlock("session.priv:PrivManager._mu")
         self.users: Dict[str, dict] = {}
         if data_dir is not None:
             self._load()

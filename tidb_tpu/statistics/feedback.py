@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 from typing import Dict, Optional, Tuple
+from ..util_concurrency import make_lock
 
 
 def conds_digest(conds) -> Optional[str]:
@@ -39,7 +40,7 @@ class QueryFeedback:
 
     def __init__(self):
         self._fb: Dict[Tuple[int, str], Tuple[float, int]] = {}
-        self._mu = threading.Lock()
+        self._mu = make_lock("statistics.feedback:QueryFeedback._mu")
         # bumped only when a learned value MATERIALLY moves (new entry or
         # >1.5x shift): cached plans consult this generation, so stable
         # entries keep the plan cache hot while fresh learning re-plans

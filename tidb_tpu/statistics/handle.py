@@ -20,6 +20,7 @@ import numpy as np
 
 from ..types import TypeKind
 from .histogram import CMSketch, FMSketch, Histogram
+from ..util_concurrency import make_rlock
 
 
 @dataclass
@@ -50,7 +51,7 @@ class StatsHandle:
 
         self.storage = storage
         self._cache: Dict[int, TableStats] = {}
-        self._mu = threading.RLock()
+        self._mu = make_rlock("statistics.handle:StatsHandle._mu")
         self.auto_analyze_ratio = 0.5
         # learned whole-conjunction selectivities (statistics/feedback.go
         # role): consulted before histogram math in estimate_selectivity

@@ -31,6 +31,7 @@ import numpy as np
 from ..chunk import Chunk, Column
 from ..errors import KVError, LockedError, TxnConflictError
 from ..types import FieldType, TypeKind
+from ..util_concurrency import make_rlock
 
 BLOCK_SIZE = 1 << 16  # 65536 rows per block
 
@@ -78,7 +79,7 @@ class TableStore:
         self.delta: Dict[int, List[Version]] = {}
         self.locks: Dict[int, Lock] = {}
         self.next_handle = 0
-        self._mu = threading.RLock()
+        self._mu = make_rlock("store.blockstore:TableStore._mu")
         # bumped on bulk load / compact: device caches key on this
         self.base_version = 0
         self._col_stats: Dict[int, Tuple[int, int, bool]] = {}
@@ -160,12 +161,12 @@ class TableStore:
             # failure mid-loop would leave ragged columns (torn store)
             if dictionaries:
                 for ci, new_dict in dictionaries.items():
-                    self._validate_coded(ci, arrays[ci], new_dict)
+                    self._validate_coded_locked(ci, arrays[ci], new_dict)
             for ci, (meta, arr) in enumerate(zip(self.cols, arrays)):
                 valid = valids[ci] if valids else None
                 if meta.ftype.kind == TypeKind.STRING:
                     if dictionaries is not None and ci in dictionaries:
-                        arr = self._ingest_coded(ci, meta, arr,
+                        arr = self._ingest_coded_locked(ci, meta, arr,
                                                  dictionaries[ci])
                     else:
                         codes, dictionary = _dict_encode_merge(
@@ -175,7 +176,7 @@ class TableStore:
                         arr = codes
                 else:
                     arr = np.ascontiguousarray(arr, dtype=meta.ftype.np_dtype)
-                self._append_blocks(ci, arr, valid)
+                self._append_blocks_locked(ci, arr, valid)
             self.base_rows += n
             self.next_handle = max(self.next_handle, self.base_rows)
             self.base_ts = max(self.base_ts, ts)
@@ -187,7 +188,7 @@ class TableStore:
             if self.persister is not None:
                 self.persister.save_base(self)
 
-    def _validate_coded(self, ci: int, codes: np.ndarray, new_dict):
+    def _validate_coded_locked(self, ci: int, codes: np.ndarray, new_dict):
         """Pure validation for Arrow-style coded ingest (no mutation)."""
         if ci >= len(self.cols) or \
                 self.cols[ci].ftype.kind != TypeKind.STRING:
@@ -203,10 +204,10 @@ class TableStore:
             raise KVError(
                 "existing un-coded blocks: cannot attach a dictionary")
 
-    def _ingest_coded(self, ci: int, meta, codes: np.ndarray,
+    def _ingest_coded_locked(self, ci: int, meta, codes: np.ndarray,
                       new_dict) -> np.ndarray:
         """Pre-encoded string ingest (validated up front by
-        _validate_coded): merge with the existing dictionary, remapping
+        _validate_coded_locked): merge with the existing dictionary, remapping
         old blocks when code order shifts — same contract as
         _dict_encode_merge, minus the per-row encode."""
         new_dict = [str(x) for x in new_dict]
@@ -219,7 +220,7 @@ class TableStore:
         meta.dictionary = merged
         return to_merged[codes]
 
-    def _append_blocks(self, ci: int, arr: np.ndarray, valid: Optional[np.ndarray]):
+    def _append_blocks_locked(self, ci: int, arr: np.ndarray, valid: Optional[np.ndarray]):
         blocks, valids = self._blocks[ci], self._valids[ci]
         off = 0
         n = len(arr)
@@ -258,18 +259,25 @@ class TableStore:
     ) -> Iterator[Tuple[int, List[np.ndarray], List[Optional[np.ndarray]]]]:
         """Yield (handle_offset, [col arrays], [col valids]) for each base
         block slice intersecting [start, end)."""
-        end = min(end, self.base_rows)
-        if start >= end:
-            return
+        # snapshot the block lists under the lock, then iterate the
+        # locals: base blocks are append-only (compaction replaces the
+        # whole lists), so the slices stay valid without holding the
+        # mutex across yields
+        with self._mu:
+            end = min(end, self.base_rows)
+            if start >= end:
+                return
+            blocks = {ci: list(self._blocks[ci]) for ci in col_idx}
+            valids = {ci: list(self._valids[ci]) for ci in col_idx}
         b0, b1 = start // BLOCK_SIZE, (end - 1) // BLOCK_SIZE
         for b in range(b0, b1 + 1):
             lo = max(start - b * BLOCK_SIZE, 0)
             hi = min(end - b * BLOCK_SIZE, BLOCK_SIZE)
             arrs, vals = [], []
             for ci in col_idx:
-                blk = self._blocks[ci][b]
+                blk = blocks[ci][b]
                 arrs.append(blk[lo:hi])
-                v = self._valids[ci][b]
+                v = valids[ci][b]
                 vals.append(v[lo:hi] if v is not None else None)
             yield b * BLOCK_SIZE + lo, arrs, vals
 
@@ -308,10 +316,13 @@ class TableStore:
         blk_ids = handles // BLOCK_SIZE
         offs = handles % BLOCK_SIZE
         uniq_blocks = np.unique(blk_ids)
+        with self._mu:
+            snap = {ci: (list(self._blocks[ci]), list(self._valids[ci]))
+                    for ci in col_idx}
         cols: List[Column] = []
         for ci in col_idx:
             meta = self.cols[ci]
-            blocks, valids = self._blocks[ci], self._valids[ci]
+            blocks, valids = snap[ci]
             dt = blocks[0].dtype if blocks else meta.ftype.np_dtype
             data = np.zeros(n, dtype=dt)
             valid = np.ones(n, dtype=np.bool_)
@@ -395,10 +406,12 @@ class TableStore:
         see no longer exists, and every read path — copr scan, point get,
         index-side overlay — must surface that rather than returning
         empty/future rows (TiDB's 'snapshot is older than GC safe point')."""
-        if 0 < ts < self.base_ts:
+        with self._mu:
+            base_ts = self.base_ts
+        if 0 < ts < base_ts:
             raise KVError(
                 "snapshot is older than the compaction horizon "
-                f"(read ts {ts} < base ts {self.base_ts})")
+                f"(read ts {ts} < base ts {base_ts})")
 
     def read_row(self, handle: int, ts: int,
                  resolve_locks: bool = True) -> Optional[tuple]:
@@ -518,44 +531,46 @@ class TableStore:
         """(min, max, has_null) over base blocks for numeric/dict columns.
         Used by the device engine to bound group-code spaces and by the
         planner for range estimation.  Cached per base_version."""
-        cached = self._col_stats.get(ci)
-        if cached is not None:
-            return cached
-        meta = self.cols[ci]
-        lo, hi, has_null = 0, -1, False
-        if meta.ftype.kind == TypeKind.STRING:
-            lo, hi = 0, len(meta.dictionary or []) - 1
-            for v in self._valids[ci]:
-                if v is not None and not v.all():
-                    has_null = True
-                    break
-        else:
-            first = True
-            for blk, v in zip(self._blocks[ci], self._valids[ci]):
-                if v is None:
-                    vals = blk
-                else:
-                    if not v.all():
+        with self._mu:
+            cached = self._col_stats.get(ci)
+            if cached is not None:
+                return cached
+            meta = self.cols[ci]
+            lo, hi, has_null = 0, -1, False
+            if meta.ftype.kind == TypeKind.STRING:
+                lo, hi = 0, len(meta.dictionary or []) - 1
+                for v in self._valids[ci]:
+                    if v is not None and not v.all():
                         has_null = True
-                    vals = blk[v]
-                if len(vals) == 0:
-                    continue
-                bmin = int(np.floor(float(vals.min())))
-                bmax = int(np.ceil(float(vals.max())))
-                if first:
-                    lo, hi, first = bmin, bmax, False
-                else:
-                    lo, hi = min(lo, bmin), max(hi, bmax)
-        out = (lo, hi, has_null)
-        self._col_stats[ci] = out
-        return out
+                        break
+            else:
+                first = True
+                for blk, v in zip(self._blocks[ci], self._valids[ci]):
+                    if v is None:
+                        vals = blk
+                    else:
+                        if not v.all():
+                            has_null = True
+                        vals = blk[v]
+                    if len(vals) == 0:
+                        continue
+                    bmin = int(np.floor(float(vals.min())))
+                    bmax = int(np.ceil(float(vals.max())))
+                    if first:
+                        lo, hi, first = bmin, bmax, False
+                    else:
+                        lo, hi = min(lo, bmin), max(hi, bmax)
+            out = (lo, hi, has_null)
+            self._col_stats[ci] = out
+            return out
 
     def nbytes(self) -> int:
-        total = 0
-        for blocks in self._blocks:
-            for b in blocks:
-                total += b.nbytes if b.dtype != object else len(b) * 8
-        return total
+        with self._mu:
+            total = 0
+            for blocks in self._blocks:
+                for b in blocks:
+                    total += b.nbytes if b.dtype != object else len(b) * 8
+            return total
 
 
 def _decode_dict(codes: np.ndarray, dictionary: Optional[List[str]]) -> np.ndarray:

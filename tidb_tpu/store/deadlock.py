@@ -11,11 +11,12 @@ from __future__ import annotations
 
 import threading
 from typing import Dict, Set
+from ..util_concurrency import make_lock
 
 
 class DeadlockDetector:
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("store.deadlock:DeadlockDetector._mu")
         # waiter start_ts -> set of holder start_ts it waits for
         self._edges: Dict[int, Set[int]] = {}
 

@@ -11,11 +11,12 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
+from ..util_concurrency import make_lock
 
 
 class FailpointRegistry:
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("store.fault:FailpointRegistry._mu")
         self._points: Dict[str, Callable] = {}
 
     def enable(self, name: str, action: Callable):

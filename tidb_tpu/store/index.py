@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..types import TypeKind
+from ..util_concurrency import make_lock
 
 
 @dataclass
@@ -73,7 +74,7 @@ class IndexManager:
 
     def __init__(self):
         self._cache: Dict[tuple, SortedIndex] = {}
-        self._mu = threading.Lock()
+        self._mu = make_lock("store.index:IndexManager._mu")
 
     def get(self, store, col_offsets: Sequence[int]) -> SortedIndex:
         key = tuple(col_offsets)

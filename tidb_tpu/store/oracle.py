@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
+from ..util_concurrency import make_lock
 
 _LOGICAL_BITS = 18
 
@@ -23,7 +24,7 @@ def extract_physical(ts: int) -> int:
 
 class Oracle:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.oracle:Oracle._lock")
         self._last_physical = 0
         self._logical = 0
 

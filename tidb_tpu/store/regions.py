@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..errors import RegionError
 from .kv import KeyRange
+from ..util_concurrency import make_rlock
 
 
 @dataclass
@@ -41,7 +42,7 @@ class RegionManager:
     def __init__(self, n_stores: int = 1):
         self.n_stores = n_stores
         self._next_id = 1
-        self._mu = threading.RLock()
+        self._mu = make_rlock("store.regions:RegionManager._mu")
         # table_id -> list[Region] sorted by start, covering [0, INF)
         self._by_table: Dict[int, List[Region]] = {}
 

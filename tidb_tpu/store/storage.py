@@ -21,6 +21,7 @@ from .kv import CopRequest, CopResponse, KeyRange, Storage, StoreClient
 from .oracle import Oracle
 from .regions import RegionManager
 from .txn import Transaction
+from ..util_concurrency import make_rlock
 
 
 class BlockStorage(Storage):
@@ -40,7 +41,7 @@ class BlockStorage(Storage):
         self._pinned_reads: Dict[int, int] = {}
         self._pin_seq = 0
         self._tables: Dict[int, TableStore] = {}
-        self._mu = threading.RLock()
+        self._mu = make_rlock("store.storage:BlockStorage._mu")
         self._client = CoprClient(self)
         self.data_dir = data_dir
         self._data_version = 0

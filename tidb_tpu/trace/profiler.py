@@ -39,6 +39,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ..metrics import REGISTRY
+from ..util_concurrency import make_lock
 
 #: depth cap on folded stacks: deeper spans attribute to their ancestor
 #: path (flame views past ~32 frames are unreadable anyway)
@@ -61,34 +62,21 @@ class Profiler:
                                             "512"))
         self.enabled = (os.environ.get("TIDB_TPU_PROFILE", "1") != "0"
                         if enabled is None else bool(enabled))
-        self._mu = threading.Lock()
+        self._mu = make_lock("trace.profiler:Profiler._mu")
         self._windows: deque = deque(maxlen=max(self.n_windows, 1))
         self._installed = False
 
     # ---- hook install (chains, never replaces) --------------------------
     def install(self):
-        """Chain this profiler onto TRACE_EXPORT_HOOK.  Idempotent: the
-        Domain constructor calls it every time, and a coordination plane
-        installed before or after stays in the chain (WorkerPlane chains
-        too)."""
+        """Chain this profiler onto the trace export chain.  Idempotent:
+        the Domain constructor calls it every time, and a coordination
+        plane chained before or after stays in the chain (WorkerPlane
+        chains too; list-removal semantics mean either side can leave
+        without dropping the other)."""
         from . import recorder
 
         with self._mu:
-            if self._installed and recorder.TRACE_EXPORT_HOOK is not None:
-                # a None seam means something (coord.reset_plane) wiped
-                # the chain we were part of — fall through and re-chain
-                return
-            prev = recorder.TRACE_EXPORT_HOOK
-
-            def hook(tr, _prev=prev, _profiler=self):
-                if _prev is not None:
-                    try:
-                        _prev(tr)
-                    except Exception:
-                        pass
-                _profiler.fold(tr)
-
-            recorder.TRACE_EXPORT_HOOK = hook
+            recorder.chain_export_hook(self.fold)
             self._installed = True
 
     # ---- folding --------------------------------------------------------

@@ -24,6 +24,7 @@ from collections import deque
 from contextvars import ContextVar
 from dataclasses import dataclass
 from typing import Dict, List, Optional
+from ..util_concurrency import make_lock
 
 #: per-process statement-trace sequence: multi-controller SPMD runs the
 #: same statement stream in every process, so (sql crc, seq) — the qid —
@@ -94,7 +95,7 @@ class QueryTrace:
         self.sql = sql
         self.conn_id = conn_id
         self.start_time = time.time()
-        self._mu = threading.Lock()
+        self._mu = make_lock("trace.recorder:QueryTrace._mu")
         self.root = Span("session.execute", self)
         self.op_stats: Dict[int, OperatorStats] = {}
         self.finished = False
@@ -300,6 +301,63 @@ TRACE_RING: deque = deque(maxlen=32)
 #: trace ships to the coordinator at query end; None (the default)
 #: keeps finish_trace allocation-free
 TRACE_EXPORT_HOOK = None
+
+#: chain participants behind TRACE_EXPORT_HOOK (chain_export_hook /
+#: unchain_export_hook below).  While the list is empty the seam stays
+#: None so the disabled finish_trace path costs one global read.
+_EXPORT_CHAIN: list = []
+_EXPORT_MU = make_lock("trace.recorder:_EXPORT_MU")
+
+
+def _dispatch_export(tr):
+    """The single installed hook while any participant is chained: fan
+    the finished trace to every participant in chain order, isolating
+    failures (a broken forwarder must not starve the profiler, or vice
+    versa).  Dispatch runs on a snapshot, outside _EXPORT_MU, so a
+    participant may itself take locks freely."""
+    for fn in list(_EXPORT_CHAIN):
+        try:
+            fn(tr)
+        except Exception:
+            pass
+
+
+def chain_export_hook(fn):
+    """Add `fn` to the export chain (idempotent).  A hook installed
+    directly on TRACE_EXPORT_HOOK (tests, third parties) is adopted
+    into the chain rather than dropped."""
+    global TRACE_EXPORT_HOOK
+    with _EXPORT_MU:
+        cur = TRACE_EXPORT_HOOK
+        if (cur is not None and cur is not _dispatch_export
+                and cur not in _EXPORT_CHAIN):
+            _EXPORT_CHAIN.append(cur)
+        if fn not in _EXPORT_CHAIN:
+            _EXPORT_CHAIN.append(fn)
+        TRACE_EXPORT_HOOK = _dispatch_export
+
+
+def unchain_export_hook(fn):
+    """Remove `fn` wherever it sits in the chain — list removal, NOT
+    restore-if-top, so a stopped participant always leaves regardless
+    of install order.  Unknown hooks are a no-op."""
+    global TRACE_EXPORT_HOOK
+    with _EXPORT_MU:
+        try:
+            _EXPORT_CHAIN.remove(fn)
+        except ValueError:
+            pass
+        if not _EXPORT_CHAIN and TRACE_EXPORT_HOOK is _dispatch_export:
+            TRACE_EXPORT_HOOK = None
+
+
+def clear_export_hooks():
+    """Drop every chained participant and null the seam (plane reset /
+    test isolation)."""
+    global TRACE_EXPORT_HOOK
+    with _EXPORT_MU:
+        _EXPORT_CHAIN.clear()
+        TRACE_EXPORT_HOOK = None
 
 
 class _NoopSpan:

@@ -26,6 +26,7 @@ from collections import deque
 from typing import List, Optional
 
 from ..store.fault import FAILPOINTS
+from ..util_concurrency import make_lock
 
 #: column order of INFORMATION_SCHEMA.SLOW_QUERY (infoschema_tables.py)
 ENTRY_FIELDS = (
@@ -40,7 +41,7 @@ class SlowQueryLog:
     def __init__(self, path: Optional[str] = None, capacity: int = 256,
                  max_bytes: int = 0, keep: Optional[int] = None):
         self.path = path
-        self._mu = threading.Lock()
+        self._mu = make_lock("trace.slowlog:SlowQueryLog._mu")
         self._ring: deque = deque(maxlen=capacity)
         # size-capped rotation (ISSUE 13): when the active file crosses
         # max_bytes it renames to .1 (shifting .1->.2 .. up to `keep`
@@ -50,7 +51,8 @@ class SlowQueryLog:
         self.max_bytes = int(max_bytes)
         self.keep = max(int(keep if keep is not None else os.environ.get(
             "TIDB_TPU_SLOW_LOG_KEEP", "3")), 1)
-        self._io_mu = threading.Lock()  # append + rotate are one unit
+        # append + rotate are one unit
+        self._io_mu = make_lock("trace.slowlog:SlowQueryLog._io_mu")
         self._size = 0
         if path is not None:
             self._recover()
@@ -143,7 +145,11 @@ class SlowQueryLog:
             return
         if not raw:
             return
-        self._size = len(raw)
+        # _size is the append path's byte counter (guarded by _io_mu):
+        # recovery runs at construction but a shared-path second log
+        # could already be appending, so take the same lock
+        with self._io_mu:
+            self._size = len(raw)
         lines = raw.split(b"\n")
         torn = lines[-1] != b""  # no trailing newline: torn final record
         body, tail = (lines[:-1], lines[-1]) if torn else (lines[:-1], None)
@@ -156,7 +162,8 @@ class SlowQueryLog:
             try:
                 with open(self.path, "r+b") as f:
                     f.truncate(len(raw) - len(tail))
-                self._size = len(raw) - len(tail)
+                with self._io_mu:
+                    self._size = len(raw) - len(tail)
             except OSError:
                 pass
         with self._mu:

@@ -1,0 +1,182 @@
+"""Ranked locks + the opt-in lock-order witness (ISSUE 16).
+
+Every lock in the tree is constructed through `make_lock` / `make_rlock`
+with its registry name (`module:Owner.attr`, the key into
+`lint.concur.LOCK_RANKS`).  With `TIDB_TPU_LOCKCHECK` unset (the
+default, read once at construction) the factories return plain
+`threading.Lock` / `threading.RLock` objects — zero overhead, zero
+indirection on the hot path.  With `TIDB_TPU_LOCKCHECK=1` (the tier-1
+conftest sets it) they return a `RankedLock` wrapper that keeps a
+per-thread stack of held locks and raises `LockOrderError` on any
+acquisition that does not strictly increase the declared rank — the
+runtime half of the concurrency lint: the static pass
+(`lint/concur.py`) covers paths tests never take, the witness validates
+the declared order against real executions.
+
+Re-entry is permitted only for the SAME RLock object (rank equality
+against a different lock is still an error: two locks sharing a rank
+may not nest).  Witness bookkeeping (total guarded acquisitions, max
+held depth, violations) feeds `/status`'s "lockcheck" section and the
+`lockcheck` bench receipt.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition inverted the declared rank order (or used an
+    unregistered name).  Raised by the witness at the faulty
+    acquisition site — the stack trace IS the repro."""
+
+
+def lockcheck_enabled() -> bool:
+    """Witness mode, read at each construction site (module-import
+    time for globals — set the env var before importing tidb_tpu)."""
+    return os.environ.get("TIDB_TPU_LOCKCHECK", "0") not in ("", "0")
+
+
+# per-thread stack of currently-held RankedLocks (witness mode only)
+_held = threading.local()
+
+# witness counters; guarded by a plain internal lock that is itself
+# never held while acquiring a ranked lock (leaf by construction)
+_stats_mu = threading.Lock()
+_STATS = {"acquisitions": 0, "max_depth": 0, "violations": 0}
+
+
+def _ranks() -> Dict[str, int]:
+    # lazy: lint.concur imports nothing heavy, but keeping the import
+    # here lets plain (non-witness) processes never load the lint pkg
+    from .lint.concur import LOCK_RANKS
+
+    return LOCK_RANKS
+
+
+def _stack():
+    s = getattr(_held, "stack", None)
+    if s is None:
+        s = _held.stack = []
+    return s
+
+
+class RankedLock:
+    """Witness wrapper: a named, ranked lock enforcing that every
+    thread acquires locks in strictly increasing rank order."""
+
+    __slots__ = ("name", "rank", "reentrant", "_lock")
+
+    def __init__(self, name: str, lock, reentrant: bool):
+        ranks = _ranks()
+        if name not in ranks:
+            raise LockOrderError(
+                f"lock {name!r} is not in lint.concur.LOCK_RANKS — "
+                f"declare its rank before constructing it")
+        self.name = name
+        self.rank = ranks[name]
+        self.reentrant = reentrant
+        self._lock = lock
+
+    # ---- witness core ---------------------------------------------------
+    def _check(self):
+        stack = _stack()
+        if stack:
+            top = stack[-1]
+            if top is self or (self.reentrant
+                               and any(h is self for h in stack)):
+                return  # same-object RLock re-entry
+            if top.rank >= self.rank:
+                with _stats_mu:
+                    _STATS["violations"] += 1
+                held = " -> ".join(f"{h.name}({h.rank})" for h in stack)
+                raise LockOrderError(
+                    f"lock-order violation: acquiring {self.name!r} "
+                    f"(rank {self.rank}) while holding [{held}] — "
+                    f"ranks must strictly increase")
+
+    def _push(self):
+        stack = _stack()
+        stack.append(self)
+        with _stats_mu:
+            _STATS["acquisitions"] += 1
+            if len(stack) > _STATS["max_depth"]:
+                _STATS["max_depth"] = len(stack)
+
+    def _pop(self):
+        stack = _stack()
+        # LIFO in practice (`with` blocks); tolerate out-of-order
+        # release by removing the last matching entry by identity
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+    # ---- threading.Lock surface ----------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._check()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._push()
+        return ok
+
+    def release(self):
+        self._pop()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __repr__(self):  # pragma: no cover — diagnostics only
+        return f"<RankedLock {self.name} rank={self.rank}>"
+
+
+def make_lock(name: str):
+    """A `threading.Lock` registered under `name` (witness-wrapped when
+    `TIDB_TPU_LOCKCHECK=1`).  `name` must literal-match the site:
+    `module:Owner.attr` for instance locks, `module:GLOBAL` for module
+    globals — the static pass cross-checks the literal against the
+    construction site."""
+    lock = threading.Lock()
+    if not lockcheck_enabled():
+        return lock
+    return RankedLock(name, lock, reentrant=False)
+
+
+def make_rlock(name: str):
+    """`make_lock` for re-entrant locks: same-object re-entry is legal,
+    everything else follows the rank order."""
+    lock = threading.RLock()
+    if not lockcheck_enabled():
+        return lock
+    return RankedLock(name, lock, reentrant=True)
+
+
+def witness_stats() -> dict:
+    """Witness counters for /status ("lockcheck") and the bench
+    receipt.  All zeros (enabled=False) when the witness is off."""
+    with _stats_mu:
+        snap = dict(_STATS)
+    snap["enabled"] = lockcheck_enabled()
+    return snap
+
+
+def reset_witness_stats():
+    with _stats_mu:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def held_depth() -> int:
+    """Current thread's held-lock depth (0 when the witness is off)."""
+    return len(getattr(_held, "stack", ()))

@@ -12,6 +12,7 @@ import threading
 from typing import Callable, List, Optional
 
 from .errors import MemoryQuotaExceededError
+from .util_concurrency import make_lock
 
 
 class MemTracker:
@@ -24,17 +25,19 @@ class MemTracker:
         self.action = action  # cancel | log
         self._consumed = 0
         self._max = 0
-        self._mu = threading.Lock()
+        self._mu = make_lock("util_memory:MemTracker._mu")
         # spill callbacks registered by operators that can shed memory
         self._spill_hooks: List[Callable[[], int]] = []
 
     @property
     def consumed(self) -> int:
-        return self._consumed
+        with self._mu:
+            return self._consumed
 
     @property
     def max_consumed(self) -> int:
-        return self._max
+        with self._mu:
+            return self._max
 
     def register_spill(self, hook: Callable[[], int]):
         """hook() frees memory and returns bytes released.  Registration
@@ -49,10 +52,13 @@ class MemTracker:
             self._consumed += nbytes
             if self._consumed > self._max:
                 self._max = self._consumed
+            # quota decision on the in-lock snapshot: a racing release
+            # must not hide an exceed that was real when we booked it
+            over = bool(self.quota and self._consumed > self.quota)
         if self.parent is not None:
             self.parent.consume(nbytes)
             return
-        if self.quota and self._consumed > self.quota:
+        if over:
             self._on_exceed()
 
     def release(self, nbytes: int):
@@ -64,9 +70,9 @@ class MemTracker:
             hooks = list(self._spill_hooks)
         for hook in hooks:
             freed = hook()
-            if freed > 0 and self._consumed <= self.quota:
+            if freed > 0 and self.consumed <= self.quota:
                 return
-        if self._consumed <= self.quota:
+        if self.consumed <= self.quota:
             return
         if self.action == "cancel":
             # mark the statement scope first so sibling fan-out workers
@@ -74,7 +80,7 @@ class MemTracker:
             from .lifecycle import current_scope
 
             current_scope().cancel("mem_quota")
-            raise MemoryQuotaExceededError(self.quota, self._consumed)
+            raise MemoryQuotaExceededError(self.quota, self.consumed)
         # log action: keep going (the reference logs; we count it)
         from .metrics import REGISTRY
 
