@@ -11,6 +11,7 @@ accounting, no torn breaker state.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -97,6 +98,76 @@ def test_equal_rank_never_nests(monkeypatch):
 def test_unregistered_lock_name_raises():
     with pytest.raises(LockOrderError):
         make_lock("tests.concur:not-in-the-registry")
+
+
+def test_witness_trips_held_lock_wait(monkeypatch):
+    """Concurrency (a): blocking on a condition/event WAIT while holding
+    a ranked lock is banned outright — the notifier may need a lower-
+    ranked lock to run, so the wait is a deadlock waiting for load.
+    Counted under "wait_trips", NOT "violations" (the autouse fixture
+    must not fail this test for its own assertion)."""
+    from tidb_tpu.lint import concur
+    from tidb_tpu.util_concurrency import witness_wait_check
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:W", 5)
+    mu = make_lock("tests.concur:W")
+    s0 = witness_stats()
+    witness_wait_check("bare")  # no lock held: fine
+    with mu:
+        with pytest.raises(LockOrderError, match="held-lock wait"):
+            witness_wait_check("Cond.wait")
+    s1 = witness_stats()
+    assert s1["wait_trips"] == s0["wait_trips"] + 1
+    assert s1["violations"] == s0["violations"]
+    reset_witness_stats()
+
+
+def test_scope_wait_trips_under_held_lock(monkeypatch):
+    """QueryScope.wait — the seam every backoff and throttle poll rides
+    — calls the witness check, so a held-lock sleep anywhere in the
+    stack surfaces immediately under test."""
+    from tidb_tpu.lifecycle import QueryScope
+    from tidb_tpu.lint import concur
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:SW", 5)
+    mu = make_lock("tests.concur:SW")
+    sc = QueryScope()
+    assert sc.wait(0.001) is False  # unheld: a normal bounded sleep
+    with mu:
+        with pytest.raises(LockOrderError):
+            sc.wait(0.001)
+    reset_witness_stats()
+
+
+def test_contention_counters_per_lock(monkeypatch):
+    """Concurrency (c): contended acquisitions land in the per-lock
+    log2 wait-ms histogram; uncontended ones stay off the books."""
+    from tidb_tpu.lint import concur
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:CONT", 5)
+    mu = make_lock("tests.concur:CONT")
+    with mu:
+        pass  # uncontended: no table entry for this lock
+    assert "tests.concur:CONT" not in witness_stats()["locks"]
+
+    gate = threading.Event()
+
+    def holder():
+        with mu:
+            gate.set()
+            time.sleep(0.02)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    gate.wait()
+    with mu:  # blocks ~20ms behind the holder
+        pass
+    t.join()
+    rec = witness_stats()["locks"]["tests.concur:CONT"]
+    assert rec["contended"] >= 1
+    assert rec["wait_ms"] > 0
+    assert sum(rec["wait_ms_log2"]) == rec["contended"]
+    reset_witness_stats()
 
 
 # ---------------------------------------------------------------------------

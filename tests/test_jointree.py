@@ -304,3 +304,33 @@ def test_explain_analyze_attributes_tree_engine(sess):
     trees = [r for r in rows if "MPPJoinTree" in r[0]]
     assert trees, rows
     assert any("engine:mpp-tree" in str(r[4]) for r in trees), trees
+
+
+def test_kill_mid_rung_is_scope_bounded(sess):
+    """ISSUE 17: the rung ladder IS the chunk sequence on the MPP path.
+    A KILL landing inside rung 1's seam must stop the ladder there —
+    no later rung dispatches — and surface the typed scope error; a
+    re-run over the same ladder has full parity."""
+    from tidb_tpu.errors import QueryKilledError
+    from tidb_tpu.store.fault import failpoint
+
+    d = sess.domain
+    for q in (THREE_WAY, FOUR_WAY_AGG):
+        victim = d.new_session()
+        victim.execute("set tidb_enforce_mpp = 1")
+        hits = []
+
+        def action(**ctx):
+            if ctx.get("kind") != "mpp":
+                return
+            hits.append(ctx["chunk"])
+            if ctx["chunk"] == 1:
+                d.kill(victim.conn_id, True)
+
+        with failpoint("copr/chunk_dispatch", action):
+            with pytest.raises(QueryKilledError):
+                victim.query(q)
+        assert hits, f"mpp chunk failpoint never fired: {q}"
+        assert max(hits) <= 1, \
+            f"rungs kept dispatching after the kill: {hits}"
+        _rows_eq(_run_tree(victim, q), _cpu(sess, q), "post-kill rerun")

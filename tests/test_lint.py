@@ -529,8 +529,68 @@ def test_concur_catches_guarded_attr_read_bare():
         [("lock-guard", "tidb_tpu/mymod.py", 14, "x")]
 
 
+def test_concur_catches_wait_whose_notifier_needs_held_lock():
+    """ISSUE 17 concurrency (a): a `.wait()` under a held ranked lock
+    whose notifier acquires a lock ranked at or below the waiter's is
+    the classic condition-under-lock deadlock — the notifier blocks
+    behind the very lock the waiter holds, so the wait never wakes."""
+    from tidb_tpu.lint.concur import lint_source as lint_concur
+
+    src = textwrap.dedent("""
+        import threading
+
+        from tidb_tpu.util_concurrency import make_lock
+
+        class C:
+            def __init__(self):
+                self._mu = make_lock("mymod:C._mu")
+                self._cv = threading.Condition()
+
+            def consume(self):
+                with self._mu:
+                    with self._cv:
+                        self._cv.wait()
+
+            def produce(self):
+                with self._mu:
+                    with self._cv:
+                        self._cv.notify()
+    """)
+    fs = lint_concur(src, "tidb_tpu/mymod.py", ranks={"mymod:C._mu": 1})
+    waits = [(f.rule, f.line, f.token) for f in fs if f.rule == "lock-wait"]
+    assert waits == [("lock-wait", 14, "self._cv")]
+
+
+def test_concur_wait_clean_when_lock_released_first():
+    from tidb_tpu.lint.concur import lint_source as lint_concur
+
+    src = textwrap.dedent("""
+        import threading
+
+        from tidb_tpu.util_concurrency import make_lock
+
+        class C:
+            def __init__(self):
+                self._mu = make_lock("mymod:C._mu")
+                self._cv = threading.Condition()
+
+            def consume(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def produce(self):
+                with self._mu:
+                    pass
+                with self._cv:
+                    self._cv.notify()
+    """)
+    fs = lint_concur(src, "tidb_tpu/mymod.py", ranks={"mymod:C._mu": 1})
+    assert [f for f in fs if f.rule == "lock-wait"] == []
+
+
 def test_concur_pass_runs_in_cli_families():
     from tidb_tpu.lint import PASS_RULES
 
     assert PASS_RULES["concur"] == (
-        "lock-rank", "lock-order", "lock-blocking", "lock-guard")
+        "lock-rank", "lock-order", "lock-blocking", "lock-guard",
+        "lock-wait")

@@ -17,6 +17,7 @@ Tentpole coverage (ISSUE 4 acceptance):
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -497,3 +498,58 @@ def test_slow_log_rotation_rides_global_sysvar(tmp_path):
     import os
 
     assert os.path.exists(str(tmp_path / "slow_query.log.1"))
+
+
+def test_profiler_persists_windows_across_restart(env, tmp_path):
+    """ISSUE 17 trace (b): windows persist atomically on rotation and a
+    fresh Profiler (the restarted process) restores them at install —
+    /flame survives a rolling restart instead of starting cold."""
+    import os.path
+
+    from tidb_tpu.trace import Profiler, recorder
+
+    d, s = env
+    pdir = str(tmp_path / "prof")
+    p = Profiler(enabled=True, window_s=0.01, persist_dir=pdir)
+    s.query(Q1ISH)
+    p.fold(s.last_trace)
+    time.sleep(0.02)
+    s.query(Q1ISH)
+    p.fold(s.last_trace)  # rotates -> persists the closed window
+    assert os.path.exists(os.path.join(pdir, "profile_windows.json"))
+    before = p.folded()
+    assert before.strip()
+
+    # "restart": a new profiler over the same dir restores the windows
+    p2 = Profiler(enabled=True, window_s=0.01, persist_dir=pdir)
+    try:
+        p2.install()
+        assert p2.folded().strip()
+        assert set(p2.folded().splitlines()) & set(before.splitlines())
+        sec = p2.status_section()
+        assert sec["windows"], "restored windows missing from /status"
+    finally:
+        recorder.unchain_export_hook(p2.fold)
+
+    # persist_now drains the live window unconditionally (graceful stop)
+    p.persist_now()
+    p3 = Profiler(enabled=True, window_s=0.01, persist_dir=pdir)
+    try:
+        p3.install()
+        assert p3.folded().strip()
+    finally:
+        recorder.unchain_export_hook(p3.fold)
+
+
+def test_profiler_torn_persist_file_starts_fresh(env, tmp_path):
+    from tidb_tpu.trace import Profiler, recorder
+
+    pdir = tmp_path / "prof"
+    pdir.mkdir()
+    (pdir / "profile_windows.json").write_text('{"windows": [{"bad"')
+    p = Profiler(enabled=True, persist_dir=str(pdir))
+    try:
+        p.install()  # torn/foreign file: fresh start, no raise
+        assert p.folded() == ""
+    finally:
+        recorder.unchain_export_hook(p.fold)

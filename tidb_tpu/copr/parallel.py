@@ -27,6 +27,7 @@ Tests run this on 8 virtual CPU devices (tests/conftest.py); the driver's
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -1791,15 +1792,16 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
                               lvals=lvals,
                               split_label=plan.reason_label)
 
-    from ..lifecycle import scope_check
+    from ..lifecycle import dispatch_admission, scope_check
+    from .chunking import chunk_bounds, chunk_budget_rows, observe_chunk
 
     chunks: List[Chunk] = []
     agg_accum = None
     topn_parts: List[Chunk] = []
     if bounds:
-        # cancellation seam around the single fused dispatch (a dispatch
-        # in flight runs to completion; an expired statement must not
-        # proceed to the host merge)
+        # cancellation seam around the fused dispatch sequence (a
+        # dispatch in flight runs to completion; an expired statement
+        # must not start the next chunk or proceed to the host merge)
         scope_check()
         # deterministic mid-scan fault injection: the chaos harness kills
         # virtual device k / exhausts HBM exactly here, pre-dispatch
@@ -1809,46 +1811,78 @@ def _run_mesh_once(storage, req: CopRequest, tid: int,
         FAILPOINTS.hit("mesh/hbm_oom", kind=kind, start=bounds[0][0],
                        end=bounds[-1][1])
         _check_membership_epoch()
+        # interruptible chunked dispatch (ISSUE 17): re-launch the SAME
+        # compiled program over range-slot sub-bounds sized to the
+        # tidb_tpu_dispatch_chunk_ms budget — the chunk count rides the
+        # runtime operands only, never the fingerprint.  Partial states
+        # fold across chunks exactly as multi-range results always did:
+        # sort-agg chunks are root-merged partials, dense agg
+        # accumulates via _merge_mesh_agg, TopN keeps every chunk's
+        # device top-k candidates for the host's final pick.
+        sub_bounds = chunk_bounds(bounds, chunk_budget_rows(kind),
+                                  MESH_RANGE_SLOTS)
+        n_chunks = len(sub_bounds)
+
+        def _chunk_dispatch(ci, sub):
+            if ci:
+                # between-chunk seam: KILL/timeout/mem-quota/shutdown
+                # interrupt here, bounding latency by one chunk budget
+                scope_check()
+            FAILPOINTS.hit("copr/chunk_dispatch", kind=kind, chunk=ci,
+                           total=n_chunks, start=sub[0][0],
+                           end=sub[-1][1])
+            rows = sum(hi - lo for lo, hi in sub)
+            t0 = time.perf_counter()
+            with span("copr.chunk", kind=kind, chunk=ci, rows=rows):
+                # admission re-acquired per chunk: a depleted resource
+                # group yields the device at every chunk boundary
+                with dispatch_admission(DISPATCH_LOCK):
+                    out = fn(datas, valids, del_mask, sub, lvals, pargs)
+            observe_chunk(kind, (time.perf_counter() - t0) * 1000.0,
+                          rows)
+            return out
+
         if kind == "agg" and an.agg_mode == "sort":
             try:
-                with DISPATCH_LOCK:
-                    out = fn(datas, valids, del_mask, bounds, lvals,
-                             pargs)
-                chunks.extend(_sort_agg_chunks(out, table, an))
+                for ci, sub in enumerate(sub_bounds):
+                    out = _chunk_dispatch(ci, sub)
+                    chunks.extend(_sort_agg_chunks(out, table, an))
             except MeshAggOverflow as e:
                 # data-dependent, by-design: too many distinct groups per
                 # shard.  Re-enter the fused mesh with the AGG PEELED to
                 # the host tail (scan+selection stays device-resident and
                 # streamed) instead of dropping the whole fragment to the
                 # per-tile fan-out rung; fragments with no device-worthy
-                # head still take the old host-hash-agg demotion.
+                # head still take the old host-hash-agg demotion.  Any
+                # earlier chunks' partials are discarded with the local
+                # `chunks` list — the peel re-runs the WHOLE region.
                 peeled = _peel_agg_rerun(storage, req, tid, dag, str(e))
                 if peeled is not None:
                     return peeled
                 req.mesh_reject_reason = str(e)
                 return None
         elif kind == "agg":
-            with DISPATCH_LOCK:
-                gcount, results = fn(datas, valids, del_mask, bounds,
-                                     lvals, pargs)
-            # wrapped() already unpacked to numpy and merged shard partials
-            agg_accum = _merge_mesh_agg(
-                agg_accum, gcount, results, table, an,
-            )
-        elif kind == "topn":
-            with DISPATCH_LOCK:
-                gidx, cnts, k = fn(datas, valids, del_mask, bounds, lvals,
-                                   pargs)
-            picks = []
-            for s in range(S):
-                c = int(cnts[s])
-                if c:
-                    picks.append(gidx[s * k: s * k + c])
-            if picks:
-                handles = np.concatenate(picks)
-                topn_parts.append(
-                    table.gather_chunk(list(an.scan.columns), handles)
+            for ci, sub in enumerate(sub_bounds):
+                # wrapped() already unpacked to numpy and merged shard
+                # partials; the accumulator folds disjoint chunk ranges
+                gcount, results = _chunk_dispatch(ci, sub)
+                agg_accum = _merge_mesh_agg(
+                    agg_accum, gcount, results, table, an,
                 )
+        elif kind == "topn":
+            for ci, sub in enumerate(sub_bounds):
+                gidx, cnts, k = _chunk_dispatch(ci, sub)
+                picks = []
+                for s in range(S):
+                    c = int(cnts[s])
+                    if c:
+                        picks.append(gidx[s * k: s * k + c])
+                if picks:
+                    handles = np.concatenate(picks)
+                    topn_parts.append(
+                        table.gather_chunk(list(an.scan.columns),
+                                           handles)
+                    )
         scope_check()  # post-dispatch seam: expired statements stop here
 
     # delta rows (committed inserts/updates) go through the CPU engine
@@ -1888,46 +1922,69 @@ def _stream_filter(req, table, an, fn, datas, valids, del_mask, inserted,
     kv/kv.go:270).  When the fusion splitter peeled a host tail off the
     fragment, each streamed scan-layout chunk runs the tail through the
     CPU interpreter before it is yielded (copr/fusion.py ladder)."""
-    from ..lifecycle import scope_check
+    from ..lifecycle import dispatch_admission, scope_check
     from ..metrics import REGISTRY
+    from ..trace import span
+    from .chunking import chunk_bounds, chunk_budget_rows, observe_chunk
     from .fusion import run_tail
 
     remaining = an.limit
     if bounds:
-        scope_check()  # seam before the fused dispatch
+        scope_check()  # seam before the fused dispatch sequence
         FAILPOINTS.hit("mesh/device_error", kind="filter",
                        device_ids=mesh_ids, start=bounds[0][0],
                        end=bounds[-1][1])
         FAILPOINTS.hit("mesh/hbm_oom", kind="filter", start=bounds[0][0],
                        end=bounds[-1][1])
         _check_membership_epoch()
-        with DISPATCH_LOCK:
-            mask = fn(datas, valids, del_mask, bounds, lvals, pargs)
-        handles = np.flatnonzero(mask)
-        if remaining is not None:
-            handles = handles[:remaining]
         if tail:
             from .fusion import note_split
 
             note_split(split_label, type(tail[0]).__name__)
-        for off in range(0, len(handles), STREAM_ROWS):
-            scope_check()  # between streamed host gathers
-            sub = handles[off: off + STREAM_ROWS]
-            chunk = table.gather_chunk(list(an.scan.columns), sub)
-            if an.proj_exprs is not None:
-                # dict-rewritten exprs expect coded strings; gather
-                # decodes, so project from the original projection IR
-                chunk = Chunk([
-                    _eval_to_column(p, chunk)
-                    for p in an.projection.exprs
-                ])
-            if tail:
-                for tc in run_tail(dag, tail, [chunk], req.aux):
-                    REGISTRY.inc("mesh_stream_chunks_total")
-                    yield tc
-                continue
-            REGISTRY.inc("mesh_stream_chunks_total")
-            yield chunk
+        # interruptible chunked dispatch (ISSUE 17): the packed-mask
+        # program re-launches per sub-bound group — ranges stay
+        # ascending and disjoint, so per-chunk concatenation preserves
+        # handle order and the LIMIT decrements monotonically.
+        sub_bounds = chunk_bounds(bounds, chunk_budget_rows("filter"),
+                                  MESH_RANGE_SLOTS)
+        n_chunks = len(sub_bounds)
+        for ci, sub in enumerate(sub_bounds):
+            if ci:
+                scope_check()  # between-chunk cancellation seam
+            FAILPOINTS.hit("copr/chunk_dispatch", kind="filter",
+                           chunk=ci, total=n_chunks, start=sub[0][0],
+                           end=sub[-1][1])
+            crows = sum(hi - lo for lo, hi in sub)
+            t0 = time.perf_counter()
+            with span("copr.chunk", kind="filter", chunk=ci, rows=crows):
+                with dispatch_admission(DISPATCH_LOCK):
+                    mask = fn(datas, valids, del_mask, sub, lvals, pargs)
+            observe_chunk("filter", (time.perf_counter() - t0) * 1000.0,
+                          crows)
+            handles = np.flatnonzero(mask)
+            if remaining is not None:
+                handles = handles[:remaining]
+                remaining -= len(handles)
+            for off in range(0, len(handles), STREAM_ROWS):
+                scope_check()  # between streamed host gathers
+                hsub = handles[off: off + STREAM_ROWS]
+                chunk = table.gather_chunk(list(an.scan.columns), hsub)
+                if an.proj_exprs is not None:
+                    # dict-rewritten exprs expect coded strings; gather
+                    # decodes, so project from the original projection IR
+                    chunk = Chunk([
+                        _eval_to_column(p, chunk)
+                        for p in an.projection.exprs
+                    ])
+                if tail:
+                    for tc in run_tail(dag, tail, [chunk], req.aux):
+                        REGISTRY.inc("mesh_stream_chunks_total")
+                        yield tc
+                    continue
+                REGISTRY.inc("mesh_stream_chunks_total")
+                yield chunk
+            if remaining is not None and remaining <= 0:
+                break
     DEVICE_HEALTH.record_success(mesh_ids)
     res = _delta_chunk(req, None, an, inserted)
     if res is not None:

@@ -194,6 +194,24 @@ class ServerOverloadedError(TiDBTPUError):
         super().__init__(f"Server overloaded: {what}")
 
 
+class ResourceGroupThrottled(TiDBTPUError):
+    """Typed retriable admission rejection: the statement's resource
+    group has exhausted its RU (device-millisecond) budget and is not
+    burstable, and the bounded in-line wait for the next refill also
+    expired.  Clients retry with backoff — the group refills every
+    second, so the error is transient by construction (TiDB's
+    resource-control ErrResourceGroupThrottled analog)."""
+
+    code = 8252  # ErrResourceGroupQueryRunawayQuarantine family
+
+    def __init__(self, group: str, wait_ms: float = 0.0):
+        self.group = group
+        self.wait_ms = wait_ms
+        super().__init__(
+            f"Resource group '{group}' exhausted its RU budget "
+            f"(waited {wait_ms:.0f}ms for refill); retry with backoff")
+
+
 class MemoryQuotaExceededError(ExecutorError):
     """OOM action 'cancel' — reference util/memory/action.go PanicOnExceed."""
 

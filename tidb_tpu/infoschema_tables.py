@@ -564,6 +564,26 @@ def _tidb_tpu_device_health(domain, isc):
     return rows
 
 
+@_register("tidb_tpu_resource_groups", [
+    ("name", ty_string()), ("ru_per_sec", ty_int()),
+    ("burstable", ty_int()), ("query_limit_ms", ty_int()),
+    ("tokens", ty_float()), ("waiting", ty_int()),
+    ("consumed_ru", ty_float()), ("throttled", ty_int()),
+    ("users", ty_string()),
+])
+def _tidb_tpu_resource_groups(domain, isc):
+    """The resource-control plane (lifecycle/resgroup.py): one row per
+    group with its quota, live token balance, parked waiters, lifetime
+    RU (device-ms) and bound users — the operator view the reference
+    exposes as information_schema.resource_groups."""
+    return [
+        (g["name"], g["ru_per_sec"], int(g["burstable"]),
+         g["query_limit_ms"], g["tokens"], g["waiting"],
+         g["consumed_ru"], g["throttled"], ",".join(g["users"]))
+        for g in domain.resgroups.snapshot()
+    ]
+
+
 @_register("tidb_tpu_fusion_splits", [
     ("reason", ty_string()), ("splits", ty_int()),
 ])

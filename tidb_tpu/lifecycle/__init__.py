@@ -12,6 +12,13 @@ from .handoff import (  # noqa: F401
     replay_session_states,
     session_state,
 )
+from .resgroup import (  # noqa: F401
+    DEFAULT_GROUP,
+    ResourceGroup,
+    ResourceGroupRegistry,
+    chunk_admission,
+    dispatch_admission,
+)
 from .scope import (  # noqa: F401
     NULL_SCOPE,
     REASONS,

@@ -38,10 +38,11 @@ from ..errors import (
     ServerShutdownError,
     TiDBTPUError,
 )
-from ..util_concurrency import make_lock
+from ..util_concurrency import make_lock, witness_wait_check
 
 #: termination reasons, in precedence order (first cancel wins)
-REASONS = ("killed", "timeout", "mem_quota", "overload", "shutdown")
+REASONS = ("killed", "timeout", "mem_quota", "overload", "shutdown",
+           "resource_group")
 
 
 class QueryScope:
@@ -53,7 +54,8 @@ class QueryScope:
     racing a deadline reports deterministically.
     """
 
-    __slots__ = ("start", "deadline", "cancel_event", "_reason", "_mu")
+    __slots__ = ("start", "deadline", "cancel_event", "_reason", "_mu",
+                 "resgroup", "_device_ms")
 
     def __init__(self, timeout_s: Optional[float] = None):
         self.start = time.monotonic()
@@ -61,6 +63,12 @@ class QueryScope:
         self.cancel_event = threading.Event()
         self._reason: Optional[str] = None
         self._mu = make_lock("lifecycle.scope:QueryScope._mu")
+        # resource-group binding (lifecycle/resgroup.py): the session
+        # resolves the statement's group once at execute() and fan-out
+        # workers inherit it via attach_scope — the dispatcher charges
+        # device time against it per chunk
+        self.resgroup: Optional[str] = None
+        self._device_ms = 0.0
 
     # ---- cancellation ---------------------------------------------------
     @property
@@ -88,6 +96,19 @@ class QueryScope:
     def cancelled(self) -> bool:
         return self.cancel_event.is_set() or self._deadline_passed()
 
+    # ---- device-time accounting (resource groups) -----------------------
+    def charge_device_ms(self, ms: float) -> float:
+        """Accumulate measured device time for QUERY_LIMIT enforcement;
+        returns the statement's running total."""
+        with self._mu:
+            self._device_ms += ms
+            return self._device_ms
+
+    @property
+    def device_ms(self) -> float:
+        with self._mu:
+            return self._device_ms
+
     # ---- the seam API ---------------------------------------------------
     def check(self):
         """Raise the termination error if this scope is cancelled or past
@@ -103,6 +124,9 @@ class QueryScope:
         bounded latency instead of after the full expo sleep."""
         if timeout_s <= 0:
             return self.cancelled()
+        # held-lock waits deadlock under load (the canceller may need a
+        # lower-ranked lock to reach cancel()); the witness trips here
+        witness_wait_check("QueryScope.wait")
         if self.deadline is not None:
             timeout_s = min(timeout_s,
                             max(self.deadline - time.monotonic(), 0.0))

@@ -89,7 +89,8 @@ PASS_RULES = {
     "plan": ("plan-schema",),
     "kernel": ("kernel-contract",),
     "metric": ("metric-name",),
-    "concur": ("lock-rank", "lock-order", "lock-blocking", "lock-guard"),
+    "concur": ("lock-rank", "lock-order", "lock-blocking", "lock-guard",
+               "lock-wait"),
 }
 
 

@@ -46,6 +46,12 @@ RULE_RANK = "lock-rank"
 RULE_ORDER = "lock-order"
 RULE_BLOCKING = "lock-blocking"
 RULE_GUARD = "lock-guard"
+RULE_WAIT = "lock-wait"
+
+#: method names that park a thread on a condition/event until some
+#: notifier runs (the lock-wait rule pairs them with _NOTIFY_METHODS)
+_WAIT_METHODS = {"wait", "wait_for"}
+_NOTIFY_METHODS = {"notify", "notify_all", "set"}
 
 #: Global lock-rank table: every lock in the tree, keyed
 #: ``module:Owner.attr`` (instance locks) or ``module:GLOBAL`` (module
@@ -58,6 +64,9 @@ RULE_GUARD = "lock-guard"
 #: section documents the bands.
 LOCK_RANKS: Dict[str, int] = {
     # ---- outermost: global dispatch / mesh construction -----------------
+    # resource-group admission sits IN FRONT of the dispatch door: its
+    # registry mutex may be taken before DISPATCH_LOCK (never across it)
+    "lifecycle.resgroup:ResourceGroupRegistry._mu": 8,
     "copr.parallel:DISPATCH_LOCK": 10,
     "copr.parallel:_MESH_LOCK": 20,
     # ---- session / DDL coarse state -------------------------------------
@@ -175,7 +184,7 @@ class _Func:
     """Per-function facts gathered in one AST walk."""
 
     __slots__ = ("qual", "cls", "line", "acqs", "calls", "blocking",
-                 "attr_accesses")
+                 "attr_accesses", "waits", "notifies")
 
     def __init__(self, qual, cls, line):
         self.qual = qual
@@ -190,6 +199,14 @@ class _Func:
         self.blocking: List[tuple] = []
         # (attr, line, is_store, held_bool) for the guard pass
         self.attr_accesses: List[tuple] = []
+        # (receiver, line, held_keys_tuple) per `.wait()` under a held
+        # lock — the lock-wait rule pairs each with the receiver's
+        # notify sites
+        self.waits: List[tuple] = []
+        # (receiver, line, held_keys_tuple) per `.notify/.notify_all/
+        # .set()` — recorded regardless of held state (the notifier's
+        # lock REQUIREMENT also includes its lexical acquisitions)
+        self.notifies: List[tuple] = []
 
 
 class _Module:
@@ -408,6 +425,15 @@ class _BodyWalker:
 
     def _call(self, node, held):
         effective = held if held else self.base_held
+        if isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value)
+            if recv is not None:
+                if node.func.attr in _NOTIFY_METHODS:
+                    self.func.notifies.append(
+                        (recv, node.lineno, effective))
+                elif node.func.attr in _WAIT_METHODS and effective:
+                    self.func.waits.append(
+                        (recv, node.lineno, effective))
         if effective:
             tok = _blocking_token(node, self.jitted)
             if tok is not None:
@@ -672,6 +698,49 @@ def _blocking_findings(index: _Index) -> List[Finding]:
     return out
 
 
+def _wait_findings(index: _Index, ranks: Dict[str, int]) -> List[Finding]:
+    """lock-wait: a `.wait()` under a held ranked lock whose notifier —
+    any `.notify/.notify_all/.set()` on the same receiver in the same
+    class (self.*) or module — holds or lexically acquires a lock
+    ranked at or below the waiter's: the notifier can block behind the
+    very lock the waiter holds, so the wait never wakes (the classic
+    condition-under-lock inversion).  The runtime half is
+    util_concurrency.witness_wait_check."""
+    out: List[Finding] = []
+    notif: Dict[tuple, List[Set[str]]] = {}
+    for _fq, (mod, func) in index.funcs.items():
+        acq_keys = {k for k, _l, _h in func.acqs}
+        for recv, _line, held in func.notifies:
+            skey = ((mod.key, func.cls) if recv.startswith("self.")
+                    else (mod.key, None))
+            req = {h for h in held if h != "<caller-lock>"} | acq_keys
+            notif.setdefault((skey, recv), []).append(req)
+    for _fq, (mod, func) in index.funcs.items():
+        flagged: Set[tuple] = set()
+        for recv, line, held in func.waits:
+            held_ranked = [h for h in held if h in ranks]
+            if not held_ranked:
+                continue
+            min_held = min(ranks[h] for h in held_ranked)
+            skey = ((mod.key, func.cls) if recv.startswith("self.")
+                    else (mod.key, None))
+            for req in notif.get((skey, recv), ()):
+                bad = sorted(k for k in req
+                             if k in ranks and ranks[k] <= min_held)
+                if bad and (func.qual, recv, line) not in flagged:
+                    flagged.add((func.qual, recv, line))
+                    holder = min(held_ranked, key=lambda h: ranks[h])
+                    out.append(Finding(
+                        RULE_WAIT, mod.path, line, func.qual, recv,
+                        f"waits on {recv!r} while holding {holder!r} "
+                        f"(rank {min_held}) but its notifier needs "
+                        f"{bad[0]!r} (rank {ranks[bad[0]]}): the "
+                        f"notifier can block behind the held lock and "
+                        f"the wait never wakes"))
+                    break
+    return out
+
+
 def _guard_findings(index: _Index) -> List[Finding]:
     out: List[Finding] = []
     for mod in index.modules.values():
@@ -722,6 +791,7 @@ def _findings_for(modules: List[_Module],
         out += m.rank_findings
     out += _order_findings(index, ranks)
     out += _blocking_findings(index)
+    out += _wait_findings(index, ranks)
     out += _guard_findings(index)
     return out
 

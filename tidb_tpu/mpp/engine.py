@@ -861,6 +861,7 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
         return _bounds_args(st.bounds)
 
     from ..copr.parallel import DISPATCH_LOCK
+    from ..lifecycle import dispatch_admission
 
     args = (tuple(ps.datas), tuple(ps.valids), ps.del_mask,
             bounds_args(ps),
@@ -877,9 +878,11 @@ def _run_once(storage, spec: MPPJoinSpec, mode: str) -> List[Chunk]:
     # loop rebuilds from the new broadcast instead of launching into an
     # XLA collective whose participant set no longer matches other hosts
     _check_membership_epoch()
-    with DISPATCH_LOCK:
+    with dispatch_admission(DISPATCH_LOCK):
         # collective programs serialize per process (see parallel.py:
-        # concurrent shard_map launches deadlock at the rendezvous)
+        # concurrent shard_map launches deadlock at the rendezvous);
+        # admission charges the exchange's device time to the
+        # statement's resource group
         out = fn(*args)
     overflow, jover = int(out[0]), int(out[1])
     if overflow:

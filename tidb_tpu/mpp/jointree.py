@@ -648,7 +648,10 @@ def _clone_expr(e):
 def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
                    boosts: List[int]) -> List[Chunk]:
     import os as _os
+    import time as _time
 
+    from ..copr.chunking import observe_chunk
+    from ..lifecycle import dispatch_admission, scope_check
     from ..trace import annotate, span
 
     mesh = get_mesh()
@@ -754,6 +757,11 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
             _COMPILED.put(fp, fn)
         FAILPOINTS.hit(TREE_FAILPOINT, rung=r, mode=mode,
                        kind=rung.kind, device_ids=mesh_ids)
+        # the rung ladder IS the chunk sequence on the MPP path: each
+        # rung re-checks scope and resource-group admission, so KILL of
+        # a deep join tree lands between rungs (ISSUE 17)
+        FAILPOINTS.hit("copr/chunk_dispatch", kind="mpp", chunk=r,
+                       total=len(spec.rungs), start=0, end=0)
         if inter is None:
             args = (tuple(states[0].datas), tuple(states[0].valids),
                     states[0].del_mask, _bounds_args(states[0].bounds))
@@ -762,11 +770,15 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
         args = args + (tuple(bs.datas), tuple(bs.valids), bs.del_mask,
                        _bounds_args(bs.bounds))
         _check_membership_epoch()
+        scope_check()
+        t0 = _time.perf_counter()
         with span("mpp.rung", idx=r, rung=mode, kind=rung.kind,
                   build_table=bs.side.table_id):
-            with DISPATCH_LOCK:
+            with dispatch_admission(DISPATCH_LOCK):
                 overflow, jover, out_slots, keep = fn(*args)
             overflow, jover = int(overflow), int(jover)
+        observe_chunk("mpp", (_time.perf_counter() - t0) * 1000.0,
+                      OUT_CHUNK_ROWS)
         if overflow:
             raise MPPTreeOverflow(
                 r, "partition",
@@ -814,8 +826,9 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
             if rm is not None:
                 args = args + (jnp.asarray(rm.mapping),)
     _check_membership_epoch()
+    scope_check()
     with span("mpp.tree.final", grouped=grouped):
-        with DISPATCH_LOCK:
+        with dispatch_admission(DISPATCH_LOCK):
             out = fn(*args)
     if grouped:
         over_l, over_m = int(out[0]), int(np.max(out[1]))

@@ -556,6 +556,27 @@ class SetPasswordStmt(Stmt):
 
 
 @dataclass
+class ResourceGroupStmt(Stmt):
+    """CREATE/ALTER/DROP RESOURCE GROUP (TiDB resource control DDL).
+
+    For ALTER, None option fields mean "leave unchanged"."""
+    kind: str = "create"     # create | alter | drop
+    name: str = ""
+    ru_per_sec: Optional[int] = None
+    burstable: Optional[bool] = None
+    query_limit_ms: Optional[int] = None
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclass
+class AlterUserResourceGroupStmt(Stmt):
+    """ALTER USER u RESOURCE GROUP g — bind a user to a group."""
+    user: str = ""
+    group: str = ""
+
+
+@dataclass
 class LockTablesStmt(Stmt):
     items: List[Tuple[TableName, str]] = field(default_factory=list)  # (t, read|write)
 

@@ -692,6 +692,14 @@ class Parser:
             while self.accept_op(","):
                 roles.append(self._parse_user_name())
             return ast.CreateRoleStmt(roles, ine)
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            ine = self._if_not_exists()
+            st = ast.ResourceGroupStmt(
+                kind="create", name=self.ident("resource group"),
+                if_not_exists=ine)
+            self._parse_resgroup_options(st)
+            return st
         self.expect_kw("table")
         ine = self._if_not_exists()
         table = self._parse_table_name()
@@ -989,6 +997,12 @@ class Parser:
             while self.accept_op(","):
                 roles.append(self._parse_user_name())
             return ast.DropRoleStmt(roles, ie)
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            ie = self._if_exists()
+            return ast.ResourceGroupStmt(
+                kind="drop", name=self.ident("resource group"),
+                if_exists=ie)
         is_view = bool(self.accept_kw("view"))
         if not is_view:
             self.expect_kw("table")
@@ -1010,8 +1024,48 @@ class Parser:
         self.expect_kw("to")
         return ast.RenameTableStmt(old, self._parse_table_name())
 
+    def _parse_resgroup_options(self, st: "ast.ResourceGroupStmt"):
+        """RU_PER_SEC = n | BURSTABLE [= TRUE|FALSE] |
+        QUERY_LIMIT = n | QUERY_LIMIT = (EXEC_ELAPSED = n), in any
+        order, optionally comma-separated (TiDB resource-control
+        grammar, with the limit in device-milliseconds)."""
+        while True:
+            if self.accept_kw("ru_per_sec"):
+                self.accept_op("=")
+                st.ru_per_sec = int(self.next().value)
+            elif self.accept_kw("burstable"):
+                if self.accept_op("="):
+                    st.burstable = self.next().value.lower() in (
+                        "true", "1")
+                else:
+                    st.burstable = True
+            elif self.accept_kw("query_limit"):
+                self.accept_op("=")
+                if self.accept_op("("):
+                    self.expect_kw("exec_elapsed")
+                    self.expect_op("=")
+                    st.query_limit_ms = int(self.next().value)
+                    self.expect_op(")")
+                else:
+                    st.query_limit_ms = int(self.next().value)
+            else:
+                break
+            self.accept_op(",")
+
     def _parse_alter(self) -> ast.Stmt:
         self.expect_kw("alter")
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            st = ast.ResourceGroupStmt(
+                kind="alter", name=self.ident("resource group"))
+            self._parse_resgroup_options(st)
+            return st
+        if self.accept_kw("user"):
+            user = self._parse_user_name()
+            self.expect_kw("resource")
+            self.expect_kw("group")
+            return ast.AlterUserResourceGroupStmt(
+                user, self.ident("resource group"))
         self.expect_kw("table")
         table = self._parse_table_name()
         if self.accept_kw("add"):

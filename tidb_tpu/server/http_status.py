@@ -59,6 +59,24 @@ def _profile_section() -> dict:
         return {"error": repr(e)}
 
 
+def _resgroups_section(domain) -> dict:
+    """Resource-control plane (ISSUE 17): per-group token balance,
+    parked waiters, lifetime RU (device-ms) and throttle raises, plus
+    the fleet RU counters and throttle-wait quantiles."""
+    try:
+        from ..metrics import REGISTRY
+
+        out = {"groups": domain.resgroups.snapshot(),
+               "ru_consumed": REGISTRY.snapshot().get(
+                   "resgroup_ru_consumed_total", 0.0)}
+        hs = REGISTRY.hist_stats("resgroup_throttle_wait_ms")
+        if hs is not None:
+            out["throttle_wait_ms"] = hs
+        return out
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
 def _slo_section(domain) -> dict:
     """Per-statement-class SLO state (ISSUE 13): threshold, error-budget
     burn counters and latency quantiles from the log2 histograms."""
@@ -265,6 +283,9 @@ class StatusServer:
                         # acquisitions, max held depth, violations
                         # (all zero with TIDB_TPU_LOCKCHECK unset)
                         "lockcheck": witness_stats(),
+                        # resource groups (ISSUE 17): token balances,
+                        # waiters, lifetime RU and throttle counts
+                        "resgroups": _resgroups_section(domain),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

@@ -56,6 +56,12 @@ class Domain:
 
         self.priv = PrivManager(data_dir)
         self.catalog.on_table_dropped = self.stats.drop
+        # per-domain resource-control plane (ISSUE 17): named groups
+        # with device-time token buckets; statements resolve their
+        # group at scope-creation time (session.execute)
+        from ..lifecycle import ResourceGroupRegistry
+
+        self.resgroups = ResourceGroupRegistry()
         self.global_vars: Dict[str, str] = {}
         self._mu = make_rlock("session.domain:Domain._mu")
         # ring buffer of recent log records -> information_schema.

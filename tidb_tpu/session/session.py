@@ -160,6 +160,13 @@ class Session:
         if not scope_active():
             timeout_ms = self.vars.get_int("max_execution_time")
             sc = QueryScope(timeout_ms / 1000.0 if timeout_ms > 0 else None)
+            # per-statement resource group (ISSUE 17): resolved ONCE at
+            # scope creation (sysvar wins, then the user's ALTER USER
+            # binding, then default); the group OBJECT rides the scope
+            # so chunked dispatchers and fan-out workers never need a
+            # domain lookup
+            sc.resgroup = self.domain.resgroups.resolve(
+                self.user, self.vars.get("tidb_tpu_resource_group") or "")
         # one trace per top-level execute() call: slow-log-enabled
         # sessions trace every statement; nested executes record into the
         # outer trace
@@ -443,6 +450,15 @@ class Session:
             from . import priv
 
             return priv.handle(self, s)
+        if isinstance(s, ast.ResourceGroupStmt):
+            return self._run_resource_group(s)
+        if isinstance(s, ast.AlterUserResourceGroupStmt):
+            try:
+                self.domain.resgroups.bind_user(s.user, s.group)
+            except KeyError:
+                raise ExecutorError(
+                    f"unknown resource group {s.group!r}")
+            return ResultSet()
         if isinstance(s, ast.LockTablesStmt):
             return self._run_lock_tables(s)
         if isinstance(s, ast.UnlockTablesStmt):
@@ -798,11 +814,20 @@ class Session:
 
             ltr = current_trace()
             if ltr is not None and rows:
-                peak = ltr.phase_totals().get("hbm_peak_bytes", 0)
+                tot = ltr.phase_totals()
+                peak = tot.get("hbm_peak_bytes", 0)
                 if peak:
                     nm, est, task, info, extra = rows[0]
                     extra = (extra + " " if extra else "") \
                         + f"hbm_peak:{peak}"
+                    rows[0] = (nm, est, task, info, extra)
+                # chunked-dispatch visibility (ISSUE 17): how many
+                # device launches the statement's fragments split into
+                nchunks = tot.get("chunks", 0)
+                if nchunks:
+                    nm, est, task, info, extra = rows[0]
+                    extra = (extra + " " if extra else "") \
+                        + f"chunks: {nchunks}"
                     rows[0] = (nm, est, task, info, extra)
             return ResultSet(
                 headers=["id", "estRows", "task", "info", "execution info"],
@@ -877,6 +902,16 @@ class Session:
                 # serving knobs configure a process-wide resource (the
                 # batcher / bucket policy), mirroring max_connections
                 serving.refresh_from_vars(self.vars)
+            if name.lower() == "tidb_tpu_dispatch_chunk_ms":
+                # the dispatchers read a process knob (like the serving
+                # sysvars): GLOBAL or SESSION set both retarget it —
+                # chunking guards a shared device, not a session
+                from ..copr.chunking import set_dispatch_chunk_ms
+
+                try:
+                    set_dispatch_chunk_ms(float(value))
+                except (TypeError, ValueError):
+                    pass
         return ResultSet()
 
     def _snapshot_write_guard(self, s):
@@ -1470,6 +1505,28 @@ class Session:
     # ------------------------------------------------------------------
     _LOCK_EXEMPT_DBS = ("information_schema", "performance_schema",
                         "mysql")  # MySQL exempts these from LOCK TABLES
+
+    def _run_resource_group(self, s) -> ResultSet:
+        """CREATE/ALTER/DROP RESOURCE GROUP against the domain's
+        resource-control plane (lifecycle/resgroup.py)."""
+        reg = self.domain.resgroups
+        try:
+            if s.kind == "create":
+                reg.create(s.name, ru_per_sec=s.ru_per_sec or 0,
+                           burstable=bool(s.burstable),
+                           query_limit_ms=s.query_limit_ms or 0,
+                           if_not_exists=s.if_not_exists)
+            elif s.kind == "alter":
+                reg.alter(s.name, ru_per_sec=s.ru_per_sec,
+                          burstable=s.burstable,
+                          query_limit_ms=s.query_limit_ms)
+            else:
+                reg.drop(s.name, if_exists=s.if_exists)
+        except KeyError:
+            raise ExecutorError(f"unknown resource group {s.name!r}")
+        except ValueError as e:
+            raise ExecutorError(str(e))
+        return ResultSet()
 
     def _run_lock_tables(self, s) -> ResultSet:
         isc = self.domain.catalog.info_schema()

@@ -26,11 +26,14 @@ Knobs (env, read at construction): ``TIDB_TPU_PROFILE`` (0 disables),
 ``TIDB_TPU_PROFILE_WINDOW_S`` (rotation period, default 60),
 ``TIDB_TPU_PROFILE_WINDOWS`` (windows retained, default 5),
 ``TIDB_TPU_PROFILE_MAX_PATHS`` (distinct stacks per window; overflow
-folds into ``<other>``).
+folds into ``<other>``), ``TIDB_TPU_PROFILE_DIR`` (when set, windows
+persist atomically on rotation and reload at install — /flame survives
+a rolling restart, ISSUE 17).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -50,7 +53,8 @@ class Profiler:
     def __init__(self, window_s: Optional[float] = None,
                  n_windows: Optional[int] = None,
                  max_paths: Optional[int] = None,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 persist_dir: Optional[str] = None):
         self.window_s = float(window_s if window_s is not None else
                               os.environ.get("TIDB_TPU_PROFILE_WINDOW_S",
                                              "60"))
@@ -62,9 +66,13 @@ class Profiler:
                                             "512"))
         self.enabled = (os.environ.get("TIDB_TPU_PROFILE", "1") != "0"
                         if enabled is None else bool(enabled))
+        self.persist_dir = (persist_dir if persist_dir is not None else
+                            os.environ.get("TIDB_TPU_PROFILE_DIR",
+                                           "")) or None
         self._mu = make_lock("trace.profiler:Profiler._mu")
         self._windows: deque = deque(maxlen=max(self.n_windows, 1))
         self._installed = False
+        self._loaded = False  # persisted windows restored once
 
     # ---- hook install (chains, never replaces) --------------------------
     def install(self):
@@ -75,7 +83,18 @@ class Profiler:
         without dropping the other)."""
         from . import recorder
 
+        # restore persisted windows BEFORE taking the lock (file I/O is
+        # never performed under _mu — the lock-blocking lint's rule and
+        # the reason rotation snapshots then writes outside it too)
         with self._mu:
+            need_load = bool(self.persist_dir) and not self._loaded
+        restored = self._load() if need_load else None
+        with self._mu:
+            if not self._loaded:
+                self._loaded = True
+                if restored and not self._windows:
+                    for w in restored:
+                        self._windows.append(w)
             recorder.chain_export_hook(self.fold)
             self._installed = True
 
@@ -86,10 +105,18 @@ class Profiler:
             return
         now = time.time()
         with self._mu:
+            prev_start = (self._windows[-1]["start"] if self._windows
+                          else None)
             w = self._current_locked(now)
+            rotated = w["start"] != prev_start
             w["traces"] += 1
             self._walk(tr.root, "", w["paths"], 0)
         REGISTRY.inc("profile_traces_folded_total")
+        if rotated and self.persist_dir:
+            # persist on rotation, outside the lock: snapshot under _mu,
+            # then atomic tmp-write + os.replace so readers (and a
+            # restarted process) never observe a torn file
+            self._persist()
 
     def _current_locked(self, now: float) -> dict:
         if not self._windows or \
@@ -184,6 +211,49 @@ class Profiler:
     def reset(self):
         with self._mu:
             self._windows.clear()
+
+    # ---- persistence across restarts (ISSUE 17) -------------------------
+    def _file(self) -> str:
+        return os.path.join(self.persist_dir, "profile_windows.json")
+
+    def _persist(self):
+        with self._mu:
+            snap = [{"start": w["start"], "traces": w["traces"],
+                     "paths": {k: list(v) for k, v in w["paths"].items()}}
+                    for w in self._windows]
+        try:
+            os.makedirs(self.persist_dir, exist_ok=True)
+            tmp = self._file() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"window_s": self.window_s, "windows": snap}, f)
+            os.replace(tmp, self._file())
+        except OSError:  # pragma: no cover - disk-full etc.
+            pass
+
+    def persist_now(self):
+        """Flush the current windows unconditionally (graceful-drain
+        seam; rotation-driven persistence covers steady state)."""
+        if self.persist_dir:
+            self._persist()
+
+    def _load(self) -> Optional[list]:
+        try:
+            with open(self._file()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        out = []
+        for w in doc.get("windows", ())[-max(self.n_windows, 1):]:
+            try:
+                out.append({
+                    "start": float(w["start"]),
+                    "traces": int(w["traces"]),
+                    "paths": {str(k): [int(v[0]), int(v[1])]
+                              for k, v in w["paths"].items()},
+                })
+            except (KeyError, TypeError, ValueError, IndexError):
+                return None  # torn/foreign file: start fresh
+        return out
 
 
 #: process-global profiler (installed by the Domain constructor)

@@ -196,7 +196,7 @@ class QueryTrace:
             "transfer_ms": 0.0, "transfer_bytes": 0,
             "device_ms": 0.0, "readback_ms": 0.0, "readback_bytes": 0,
             "backoff_ms": 0.0, "exchange_ms": 0.0, "commit_ms": 0.0,
-            "backfill_ms": 0.0,
+            "backfill_ms": 0.0, "throttle_ms": 0.0, "chunks": 0,
             "compile_hits": 0, "compile_misses": 0, "cop_tasks": 0,
             "wire_bytes": 0, "result_rows": 0,
             "hbm_peak_bytes": 0,
@@ -236,6 +236,10 @@ class QueryTrace:
                 tot["readback_bytes"] += int(a.get("bytes", 0))
             elif n == "cop.task":
                 tot["cop_tasks"] += 1
+            elif n == "copr.chunk":
+                # chunked-dispatch visibility (ISSUE 17): per-statement
+                # device-launch count for EXPLAIN ANALYZE / slow log
+                tot["chunks"] += 1
             elif n.startswith("wire."):
                 tot["wire_bytes"] += int(a.get("bytes", 0))
             tot["wire_bytes"] += int(a.get("wire_read_bytes", 0))
@@ -284,6 +288,8 @@ PHASES = {
     "txn.commit": "commit_ms",
     # online DDL index builds (ddl.backfill spans per batch)
     "ddl.backfill": "backfill_ms",
+    # resource-group admission wait between chunked dispatches
+    "resgroup.throttle": "throttle_ms",
 }
 
 #: phases surfaced as /metrics histograms on every finished trace

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, Optional
 
 
@@ -44,8 +45,33 @@ _held = threading.local()
 
 # witness counters; guarded by a plain internal lock that is itself
 # never held while acquiring a ranked lock (leaf by construction)
+# "wait_trips" (held-lock waits, concurrency (a)) is deliberately a
+# separate key from "violations": the conftest fixture fails any test
+# that bumps violations, while wait trips have their own negative test.
 _stats_mu = threading.Lock()
-_STATS = {"acquisitions": 0, "max_depth": 0, "violations": 0}
+_STATS = {"acquisitions": 0, "max_depth": 0, "violations": 0,
+          "wait_trips": 0}
+
+# per-lock acquire contention (concurrency (c)): name -> [contended
+# acquisitions, total wait ms, log2 wait-ms bucket counts].  Buckets are
+# exponent-indexed at 2^(i-1)..2^i ms; index 0 holds sub-1ms waits.
+_CONTENTION_BUCKETS = 16
+_CONTENTION: Dict[str, list] = {}
+
+
+def _record_contention(name: str, wait_ms: float):
+    b = 0
+    ms = wait_ms
+    while ms >= 1.0 and b < _CONTENTION_BUCKETS - 1:
+        ms /= 2.0
+        b += 1
+    with _stats_mu:
+        rec = _CONTENTION.get(name)
+        if rec is None:
+            rec = _CONTENTION[name] = [0, 0.0, [0] * _CONTENTION_BUCKETS]
+        rec[0] += 1
+        rec[1] += wait_ms
+        rec[2][b] += 1
 
 
 def _ranks() -> Dict[str, int]:
@@ -117,7 +143,15 @@ class RankedLock:
     # ---- threading.Lock surface ----------------------------------------
     def acquire(self, blocking: bool = True, timeout: float = -1):
         self._check()
-        ok = self._lock.acquire(blocking, timeout)
+        # contention probe: an uncontended acquire stays a single
+        # non-blocking call; only a contended one pays for two clock
+        # reads, and only that wait lands in the per-lock histogram
+        ok = self._lock.acquire(False)
+        if not ok and blocking:
+            t0 = time.monotonic()
+            ok = self._lock.acquire(True, timeout)
+            _record_contention(self.name,
+                               (time.monotonic() - t0) * 1000.0)
         if ok:
             self._push()
         return ok
@@ -164,9 +198,18 @@ def make_rlock(name: str):
 
 def witness_stats() -> dict:
     """Witness counters for /status ("lockcheck") and the bench
-    receipt.  All zeros (enabled=False) when the witness is off."""
+    receipt.  All zeros (enabled=False) when the witness is off.
+    "locks" carries the per-lock contention table (concurrency (c)):
+    contended acquisitions, summed wait ms and the log2 wait-ms bucket
+    counts, keyed by the registered lock name."""
     with _stats_mu:
         snap = dict(_STATS)
+        snap["locks"] = {
+            name: {"contended": rec[0],
+                   "wait_ms": round(rec[1], 3),
+                   "wait_ms_log2": list(rec[2])}
+            for name, rec in sorted(_CONTENTION.items())
+        }
     snap["enabled"] = lockcheck_enabled()
     return snap
 
@@ -175,8 +218,31 @@ def reset_witness_stats():
     with _stats_mu:
         for k in _STATS:
             _STATS[k] = 0
+        _CONTENTION.clear()
 
 
 def held_depth() -> int:
     """Current thread's held-lock depth (0 when the witness is off)."""
     return len(getattr(_held, "stack", ()))
+
+
+def witness_wait_check(what: str):
+    """Witness half of concurrency (a): raise if this thread is about to
+    block on a condition/event WAIT while holding a ranked lock.  The
+    notifier of that wait must run to wake us; if waking requires any
+    lock ranked at or below what we hold, the wait IS a deadlock waiting
+    for load — so the witness bans held-lock waits outright (the static
+    pass in lint/concur.py applies the rank comparison; at runtime any
+    held ranked lock is grounds to trip).  Counted under "wait_trips",
+    not "violations", so the negative test doesn't fail itself via the
+    conftest violation fixture."""
+    stack = getattr(_held, "stack", None)
+    if not stack:
+        return
+    with _stats_mu:
+        _STATS["wait_trips"] += 1
+    held = " -> ".join(f"{h.name}({h.rank})" for h in stack)
+    raise LockOrderError(
+        f"held-lock wait: {what} would block while holding [{held}] — "
+        f"the notifier cannot be guaranteed to run without acquiring a "
+        f"lower-ranked lock; release before waiting")
