@@ -312,6 +312,12 @@ def _host_fallback_worker():
         out["kill_latency"] = kill_latency_bench(sess, n)
     except BaseException as e:  # noqa: BLE001
         out["kill_latency"] = {"error": repr(e)}
+    # sharded data-plane receipt (ISSUE 18): 1-host vs 2-host scan
+    # rows/s + exchange bytes, on the CPU harness
+    try:
+        out["dataplane_scan"] = dataplane_bench(n)
+    except BaseException as e:  # noqa: BLE001
+        out["dataplane_scan"] = {"error": repr(e)}
     print("FALLBACK_JSON " + json.dumps(out), flush=True)
 
 
@@ -998,6 +1004,107 @@ def kill_latency_bench(sess, n: int) -> dict:
     return out
 
 
+def dataplane_bench(n: int) -> dict:
+    """Sharded data-plane receipt (ISSUE 18): warm Q6 scan throughput
+    with the whole table resident on ONE member (LocalPlane degenerate
+    path) vs hash-sharded across TWO in-process members — coordinator
+    + worker planes over real loopback RPC, fragments for remotely
+    owned partitions fetched cross-host — plus the exchange bytes the
+    2-host leg actually moved."""
+    import tempfile
+
+    from tidb_tpu.coord import get_plane
+    from tidb_tpu.coord.plane import (Coordinator, CoordinatorPlane,
+                                      WorkerPlane)
+    from tidb_tpu.dataplane import activate_dataplane, deactivate_dataplane
+    from tidb_tpu.metrics import REGISTRY
+
+    n = min(n, 65_536)  # 3 extra table builds; keep the legs modest
+    reps = max(ITERS, 3)
+    out: dict = {"rows": n}
+
+    def _tid(sess):
+        return sess.domain.catalog.info_schema().table(
+            "test", "lineitem").id
+
+    def _leg(sess):
+        sess.execute("set tidb_use_tpu = 1")
+        sess.execute(Q6)  # warm: compile + partition materialization
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            sess.execute(Q6)
+        return (time.perf_counter() - t0) / reps
+
+    def _until(pred, timeout=20.0):
+        t0 = time.time()
+        while time.time() - t0 < timeout and not pred():
+            time.sleep(0.05)
+
+    with tempfile.TemporaryDirectory() as td:
+        # ---- 1-host leg: degenerate LocalPlane ownership -----------------
+        s1 = build_lineitem(n)
+        dp1 = activate_dataplane(s1.domain.storage, plane=get_plane(),
+                                 pid=0, data_dir=os.path.join(td, "one"),
+                                 serve=False)
+        dp1.shard_table(_tid(s1))
+        q0 = REGISTRY.get("dataplane_queries_total") or 0.0
+        try:
+            one_s = _leg(s1)
+            snap1 = dp1.snapshot()
+        finally:
+            deactivate_dataplane(s1.domain.storage)
+        served = (REGISTRY.get("dataplane_queries_total") or 0.0) - q0
+        out["one_host_s"] = round(one_s, 4)
+        out["one_host_rows_per_sec"] = round(n / one_s, 1)
+        out["n_parts"] = max((t["n_parts"]
+                              for t in snap1["tables"].values()),
+                             default=0)
+        if served <= 0:
+            out["error"] = "1-host leg bypassed the data plane"
+            return out
+
+        # ---- 2-host leg: coordinator + worker member over loopback ------
+        sA = build_lineitem(n)
+        sB = build_lineitem(n)
+        coord = Coordinator(port=0, lease_s=4.0, expect=2, self_pid=0)
+        host, port = coord.start()
+        cp = CoordinatorPlane(coord, pid=0).start((0,))
+        wp = WorkerPlane(f"{host}:{port}", 1, lease_s=4.0).start((1,))
+        _until(lambda: cp.view().formed and len(cp.view().members) == 2)
+        dpA = activate_dataplane(sA.domain.storage, plane=cp, pid=0,
+                                 data_dir=os.path.join(td, "a"))
+        dpB = activate_dataplane(sB.domain.storage, plane=wp, pid=1,
+                                 data_dir=os.path.join(td, "b"))
+        _until(lambda: len(cp.view().addrs) == 2
+               and len(wp.view().addrs) == 2)
+        dpA.shard_table(_tid(sA))
+        dpB.shard_table(_tid(sB))
+        b0 = REGISTRY.get("dataplane_exchange_bytes_total") or 0.0
+        f0 = REGISTRY.get("dataplane_remote_fragments_total") or 0.0
+        try:
+            two_s = _leg(sA)
+        finally:
+            deactivate_dataplane(sA.domain.storage)
+            deactivate_dataplane(sB.domain.storage)
+            try:
+                wp.stop(leave=True)
+            except Exception:  # noqa: BLE001 — lease may already be gone
+                pass
+            cp.stop()
+    out["two_host_s"] = round(two_s, 4)
+    out["two_host_rows_per_sec"] = round(n / two_s, 1)
+    out["exchange_bytes_per_query"] = round(
+        ((REGISTRY.get("dataplane_exchange_bytes_total") or 0.0) - b0)
+        / (reps + 1), 1)
+    out["remote_fragments"] = int(
+        (REGISTRY.get("dataplane_remote_fragments_total") or 0.0) - f0)
+    out["two_host_overhead_x"] = round(two_s / one_s, 2) if one_s else None
+    log(f"dataplane scan: 1-host {out['one_host_rows_per_sec']:.0f} "
+        f"rows/s vs 2-host {out['two_host_rows_per_sec']:.0f} rows/s, "
+        f"{out['exchange_bytes_per_query']:.0f} exchange B/query")
+    return out
+
+
 def trace_overhead_bench(sess, iters: int = None) -> dict:
     """Trace-overhead receipt (ISSUE 4, extended by ISSUE 13): steady-
     state Q1 untraced vs traced vs traced+profiled.  The continuous
@@ -1442,6 +1549,18 @@ def _run_inner(state: dict):
         except BaseException as e:  # noqa: BLE001
             state["kill_latency"] = {"error": repr(e)}
         state["phases"]["kill_latency_done"] = round(
+            time.perf_counter() - T0, 1)
+        persist_partial(state)
+
+    # sharded data plane (ISSUE 18): 1-host vs 2-host scan throughput
+    # plus the cross-host fragment bytes actually exchanged
+    if state.get("q1") and remaining() > 120:
+        try:
+            state["dataplane_scan"] = dataplane_bench(
+                state.get("loaded_rows", 65_536))
+        except BaseException as e:  # noqa: BLE001
+            state["dataplane_scan"] = {"error": repr(e)}
+        state["phases"]["dataplane_done"] = round(
             time.perf_counter() - T0, 1)
         persist_partial(state)
 

@@ -1,0 +1,138 @@
+"""Sharded-data-plane chaos worker: ONE process of the 2-process
+acceptance test (tests/test_dataplane_procs.py).
+
+Each worker builds the SAME deterministic lineitem table, joins the
+test-process Coordinator, activates the dataplane (fragment RPC server
+advertised through the membership broadcast) and shards the table —
+after which each process materializes ONLY its owned partitions and
+every scan scatters across the fleet.  Rounds print parity vs the
+CPU oracle AND a `dp=` marker proving the dataplane engine actually
+served the round (parity alone cannot: the local fallback answers
+identically from the full base table).  SIGKILL of the peer must show
+up as a bumped epoch, a survivor-side re-shard, and ok=1 rounds that
+keep carrying dp>=1.
+
+argv: [process_id, coordinator_port].  Env knobs: COORD_LEASE_S,
+COORD_WORKER_MAX_S, TIDB_TPU_DATAPLANE_DIR (shared replay directory).
+"""
+
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _approx(a, b):
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            return abs(float(a) - float(b)) <= 1e-6 * max(
+                1.0, abs(float(a)), abs(float(b)))
+        except (TypeError, ValueError):
+            return a == b
+    return a == b
+
+
+def _rows_match(got, want):
+    if len(got) != len(want):
+        return False
+    return all(len(g) == len(w) and all(_approx(x, y)
+               for x, y in zip(g, w))
+               for g, w in zip(got, want))
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("TIDB_TPU_TILE", "1024")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tidb_tpu.coord import activate_worker
+    from tidb_tpu.dataplane import activate_dataplane
+    from tidb_tpu.metrics import REGISTRY
+
+    lease_s = float(os.environ.get("COORD_LEASE_S", "1.5"))
+    max_s = float(os.environ.get("COORD_WORKER_MAX_S", "120"))
+    t0 = time.monotonic()
+
+    from tidb_tpu.tpch_data import build_lineitem
+
+    sess = build_lineitem(8192, regions=4)
+    dom = sess.domain
+    tid = dom.catalog.info_schema().table("test", "lineitem").id
+    # small unsharded dimension side for the join acceptance query
+    sess.execute("create table flags (f_flag varchar(1), f_ord bigint)")
+    sess.execute("insert into flags values ('A', 0), ('N', 1), ('R', 2)")
+
+    plane = activate_worker(("127.0.0.1", port), pid=pid,
+                            devices=[d.id for d in jax.devices()],
+                            lease_s=lease_s)
+    dp = activate_dataplane(dom.storage, plane=plane, pid=pid)
+
+    # shard once the fleet FORMED and both fragment endpoints are
+    # advertised — ownership derived pre-formation would flap
+    while time.monotonic() - t0 < 30:
+        v = plane.view()
+        if v.formed and len(v.members) >= 2 and len(v.addrs) >= 2:
+            break
+        time.sleep(0.05)
+    dp.shard_table(tid)
+    st = dp.lookup(tid)
+    print(f"SHARDED pid={pid} loaded={len(st.loaded)}/{st.n_parts}",
+          flush=True)
+
+    queries = [
+        ("q6", "select sum(l_extendedprice * l_discount) from lineitem"
+               " where l_discount between 0.05 and 0.07"
+               " and l_quantity < 24"),
+        ("q1", "select l_returnflag, l_linestatus, sum(l_quantity),"
+               " sum(l_extendedprice), count(*) from lineitem"
+               " where l_shipdate <= '1998-09-02'"
+               " group by l_returnflag, l_linestatus"
+               " order by l_returnflag, l_linestatus"),
+        ("agg", "select l_returnflag, count(*), sum(l_quantity)"
+                " from lineitem group by l_returnflag"
+                " order by l_returnflag"),
+        ("join", "select l_returnflag, count(*) from lineitem"
+                 " join flags on l_returnflag = f_flag"
+                 " where f_ord >= 0 group by l_returnflag"
+                 " order by l_returnflag"),
+    ]
+    sess.execute("set tidb_use_tpu = 0")
+    oracles = {name: sess.query(q) for name, q in queries}
+    sess.execute("set tidb_use_tpu = 1")
+
+    print(f"READY pid={pid}", flush=True)
+
+    stop = [False]
+    signal.signal(signal.SIGTERM, lambda *_a: stop.__setitem__(0, True))
+
+    rounds = 0
+    while not stop[0] and time.monotonic() - t0 < max_s:
+        d0 = REGISTRY.get("dataplane_queries_total") or 0
+        ok = 1
+        for name, q in queries:
+            if not _rows_match(sess.query(q), oracles[name]):
+                ok = 0
+                print(f"MISMATCH pid={pid} q={name}", flush=True)
+        dp_used = int((REGISTRY.get("dataplane_queries_total") or 0) - d0)
+        print(f"ROUND pid={pid} n={rounds} epoch={plane.current_epoch()} "
+              f"ok={ok} dp={dp_used}", flush=True)
+        rounds += 1
+        time.sleep(0.05)
+
+    plane.leave()
+    plane.stop()
+    print(f"DRAINED pid={pid} rounds={rounds}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -289,3 +289,78 @@ def test_coordinator_replay_races_register(tmp_path):
     members = c.view().members
     for pid in [1, 2] + list(range(10, 40)):
         assert pid in members, f"replay clobbered concurrent join {pid}"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18 concurrency (d): the wait-witness sweep — every remaining
+# Event.wait/Condition.wait site rides witness_wait_check, and each gets
+# a lint negative pinning the held-lock trip
+# ---------------------------------------------------------------------------
+
+def _assert_wait_trips(monkeypatch, fn):
+    """`fn` must pass with no lock held and trip the witness (wait_trips,
+    not violations) under a deliberately held ranked lock."""
+    from tidb_tpu.lint import concur
+
+    monkeypatch.setitem(concur.LOCK_RANKS, "tests.concur:WS", 5)
+    mu = make_lock("tests.concur:WS")
+    fn()  # unheld: a normal bounded wait
+    s0 = witness_stats()
+    with mu:
+        with pytest.raises(LockOrderError, match="held-lock wait"):
+            fn()
+    s1 = witness_stats()
+    assert s1["wait_trips"] == s0["wait_trips"] + 1
+    assert s1["violations"] == s0["violations"]
+    reset_witness_stats()
+
+
+def test_worker_plane_heartbeat_wait_covered(monkeypatch):
+    """WorkerPlane._heartbeat's lease park (coord/plane.py)."""
+    from tidb_tpu.coord.plane import WorkerPlane
+
+    wp = WorkerPlane("127.0.0.1:1", 99, heartbeat_s=0.001)
+    _assert_wait_trips(monkeypatch, wp._hb_wait)
+
+
+def test_worker_plane_span_flusher_wait_covered(monkeypatch):
+    """WorkerPlane._span_flusher's age-flush park (coord/plane.py)."""
+    from tidb_tpu.coord.plane import WorkerPlane
+
+    wp = WorkerPlane("127.0.0.1:1", 99, heartbeat_s=0.001)
+    wp._span_flush_s = 0.001
+    _assert_wait_trips(monkeypatch, wp._flusher_wait)
+
+
+def test_maintenance_idle_wait_covered(monkeypatch):
+    """MaintenanceWorker._loop's interval park (session/maintenance.py).
+    A GC/compaction daemon sleeping an INTERVAL with a ranked lock held
+    would starve that lock for seconds, not milliseconds."""
+    from tidb_tpu.session.maintenance import MaintenanceWorker
+
+    mw = MaintenanceWorker(domain=None, interval_s=0.001)
+    _assert_wait_trips(monkeypatch, mw._idle_wait)
+
+
+def test_batcher_window_wait_covered(monkeypatch):
+    """MicroBatcher's leader window park (serving/batcher.py)."""
+    from types import SimpleNamespace
+
+    from tidb_tpu.serving.batcher import MicroBatcher
+
+    b = MicroBatcher()
+    g = SimpleNamespace(full=threading.Event())
+    _assert_wait_trips(monkeypatch, lambda: b._window_wait(g, 0.001))
+
+
+def test_batcher_member_wait_covered(monkeypatch):
+    """MicroBatcher's parked-member poll tick (serving/batcher.py)."""
+    from types import SimpleNamespace
+
+    from tidb_tpu.serving.batcher import MicroBatcher
+
+    b = MicroBatcher()
+    ev = threading.Event()
+    ev.set()  # unheld path returns immediately
+    m = SimpleNamespace(event=ev)
+    _assert_wait_trips(monkeypatch, lambda: b._member_wait(m))

@@ -1,0 +1,136 @@
+"""2-OS-process dataplane acceptance (ISSUE 18): a table sharded across
+two real processes answers Q1/Q6/grouped-agg/join with parity vs the
+CPU oracle THROUGH the dataplane (dp>=N markers — parity alone cannot
+distinguish cross-host execution from the always-correct local
+fallback); SIGKILL of one process bumps the epoch via lease expiry and
+the survivor re-shards the orphaned partitions and keeps answering with
+parity at the new epoch, still through the dataplane."""
+
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from tidb_tpu.coord.plane import Coordinator
+from tidb_tpu.store.fault import FAILPOINTS
+
+
+def _spawn_worker(pid, port, dp_dir):
+    import os
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["COORD_LEASE_S"] = "1.5"
+    env["COORD_WORKER_MAX_S"] = "150"
+    env["TIDB_TPU_DATAPLANE_DIR"] = dp_dir
+    worker = os.path.join(os.path.dirname(__file__), "dataplane_worker.py")
+    p = subprocess.Popen(
+        [sys.executable, worker, str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, bufsize=1)
+    lines = []
+
+    def pump():
+        for line in p.stdout:
+            lines.append(line.strip())
+
+    threading.Thread(target=pump, daemon=True).start()
+    return p, lines
+
+
+def _wait_line(lines, pred, timeout_s, procs=()):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if any(pred(ln) for ln in list(lines)):
+            return True
+        if procs and all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.1)
+    return any(pred(ln) for ln in list(lines))
+
+
+def _wait(pred, timeout_s):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return pred()
+
+
+def _dp_round(s):
+    """A parity round that the dataplane actually served (every query
+    in the round went through the sharded path)."""
+    if not (s.startswith("ROUND") and "ok=1" in s):
+        return False
+    try:
+        return int(s.split("dp=")[1].split()[0]) >= 4
+    except (IndexError, ValueError):
+        return False
+
+
+def test_two_process_dataplane_shard_and_sigkill_reshard(tmp_path):
+    threads_before = {t.name for t in threading.enumerate()}
+    c = Coordinator(lease_s=1.5, expect=2)
+    c.start()
+    procs = []
+    dp_dir = str(tmp_path)
+    try:
+        w0, l0 = _spawn_worker(0, c.port, dp_dir)
+        procs.append(w0)
+        w1, l1 = _spawn_worker(1, c.port, dp_dir)
+        procs.append(w1)
+        assert _wait_line(l0, lambda s: s.startswith("READY"), 90,
+                          (w0,)), (l0[-10:], l1[-10:])
+        assert _wait_line(l1, lambda s: s.startswith("READY"), 90,
+                          (w1,)), (l0[-10:], l1[-10:])
+        v = c.view()
+        assert set(v.members) == {0, 1} and v.formed
+        # both advertised fragment endpoints through the broadcast
+        assert set(v.addrs) == {0, 1}, v.addrs
+        # each worker materialized a strict subset of the partitions —
+        # the table is actually SPLIT across the two processes
+        sh0 = next(s for s in list(l0) if s.startswith("SHARDED"))
+        sh1 = next(s for s in list(l1) if s.startswith("SHARDED"))
+        n0 = int(sh0.split("loaded=")[1].split("/")[0])
+        n1 = int(sh1.split("loaded=")[1].split("/")[0])
+        total = int(sh0.split("/")[1])
+        assert 0 < n0 < total and 0 < n1 < total and n0 + n1 == total, \
+            (sh0, sh1)
+
+        # parity rounds served by the dataplane, on BOTH members
+        assert _wait_line(l0, _dp_round, 60, (w0,)), l0[-5:]
+        assert _wait_line(l1, _dp_round, 60, (w1,)), l1[-5:]
+
+        # ---- SIGKILL one member mid-load -----------------------------
+        e_before = c.view().epoch
+        w1.kill()
+        assert _wait(lambda: 1 not in c.view().members, 15.0), \
+            "lease expiry did not evict the killed worker"
+        v_after = c.view()
+        assert v_after.epoch > e_before
+        # the survivor re-shards the orphaned partitions and keeps
+        # serving THROUGH the dataplane at the bumped epoch
+        assert _wait_line(
+            l0,
+            lambda s: _dp_round(s) and f"epoch={v_after.epoch}" in s,
+            45, (w0,)), l0[-5:]
+        assert not any("ok=0" in s for s in list(l0)), \
+            [s for s in l0 if "ok=0" in s]
+        assert not any(s.startswith("MISMATCH") for s in list(l0))
+
+        # ---- graceful drain ------------------------------------------
+        w0.send_signal(signal.SIGTERM)
+        assert _wait_line(l0, lambda s: s.startswith("DRAINED"), 30, (w0,))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        c.stop()
+    time.sleep(0.3)
+    leaked = {t.name for t in threading.enumerate()} - threads_before
+    leaked = {n for n in leaked
+              if n.startswith(("tidb-tpu-coord", "dataplane-rpc"))}
+    assert not leaked, leaked
+    assert FAILPOINTS.armed() == []

@@ -334,3 +334,51 @@ def test_kill_mid_rung_is_scope_bounded(sess):
         assert max(hits) <= 1, \
             f"rungs kept dispatching after the kill: {hits}"
         _rows_eq(_run_tree(victim, q), _cpu(sess, q), "post-kill rerun")
+
+
+def test_same_key_ladder_elides_reshuffle(sess):
+    """Jointree (e): a shuffle rung whose key slots match the
+    partitioning the previous shuffle rung left behind skips the
+    probe-side exchange — equal keys already co-reside.  A fact joined
+    to three dims all on f_k shuffles ONCE (rung 0); rungs 1 and 2 run
+    with elided=1 and bump mpp_tree_reshuffle_elided_total, with full
+    parity vs the CPU oracle."""
+    d = sess.domain
+    rng = np.random.default_rng(31)
+    sess.execute("create table fxf (f_k bigint, f_v bigint)")
+    for t in ("dza", "dzb", "dzc"):
+        sess.execute(f"create table {t} ({t}_k bigint primary key,"
+                     f" {t}_v bigint)")
+    ts = d.storage.current_ts()
+
+    def table(name):
+        return d.storage.table(d.catalog.info_schema().table(
+            "test", name).id)
+
+    n_dim, n_fact = 400, 3000
+    table("fxf").bulk_load_arrays([
+        rng.integers(0, n_dim, n_fact),
+        rng.integers(0, 1000, n_fact),
+    ], ts=ts)
+    for t in ("dza", "dzb", "dzc"):
+        table(t).bulk_load_arrays([
+            np.arange(n_dim, dtype=np.int64),
+            rng.integers(0, 100, n_dim),
+        ], ts=ts)
+    for t in ("fxf", "dza", "dzb", "dzc"):
+        sess.execute(f"analyze table {t}")
+
+    sql = ("select f_v, dza_v, dzb_v, dzc_v from fxf"
+           " join dza on f_k = dza_k"
+           " join dzb on f_k = dzb_k"
+           " join dzc on f_k = dzc_k")
+    e0 = _snap("mpp_tree_reshuffle_elided_total")[0]
+    got = _run_tree(sess, sql)
+    assert _snap("mpp_tree_reshuffle_elided_total")[0] == e0 + 2, \
+        "rungs 1 and 2 should both skip the probe re-shuffle"
+    _rows_eq(got, _cpu(sess, sql), "same-key-ladder")
+    sess.execute("trace " + sql)
+    rungs = _spans(sess, "mpp.rung")
+    assert [s.attrs.get("elided") for s in rungs] == [0, 1, 1], \
+        [s.attrs for s in rungs]
+    assert _snap("mpp_tree_reshuffle_elided_total")[0] == e0 + 4

@@ -366,3 +366,57 @@ def test_show_stats_healthy_and_analyze_status(d):
     s.execute("delete from sh where a < 4")
     h = [r for r in s.query("show stats_healthy") if r[1] == "sh"][0][3]
     assert h <= 50, h
+
+
+# ---------------------------------------------------------------------------
+# operator sampling into the profiler (ISSUE 18 trace (a))
+# ---------------------------------------------------------------------------
+
+def test_profiler_fold_explain_op_stacks():
+    """fold_explain turns a pre-order (depth, op_id, inclusive_ns) list
+    into op-id stacks weighted by SELF time (inclusive minus direct
+    children), matching the span-walk's attribution rules."""
+    from tidb_tpu.trace.profiler import Profiler
+
+    p = Profiler(enabled=True, window_s=3600, n_windows=2,
+                 max_paths=64, persist_dir="")
+    p.fold_explain([
+        (0, "Projection_7", 10_000_000),
+        (1, "HashAgg_3", 8_000_000),
+        (2, "TableReader_5", 5_000_000),
+        (1, "Limit_9", 1_000_000),
+    ])
+    got = dict(ln.rsplit(" ", 1) for ln in
+               p.folded().strip().splitlines())
+    assert got == {
+        # 10ms - (8ms + 1ms) children = 1ms self
+        "op:Projection_7": "1000",
+        "op:Projection_7;op:HashAgg_3": "3000",
+        "op:Projection_7;op:HashAgg_3;op:TableReader_5": "5000",
+        "op:Projection_7;op:Limit_9": "1000",
+    }
+
+
+def test_explain_analyze_samples_ops_into_profiler(d):
+    """EXPLAIN ANALYZE feeds its per-operator stats into the continuous
+    profiler: /flame stacks carry the plan's operator ids."""
+    import re
+
+    from tidb_tpu.metrics import REGISTRY
+    from tidb_tpu.trace.profiler import PROFILER
+
+    s = d.new_session()
+    s.execute("create table opprof (a bigint, g bigint)")
+    s.execute("insert into opprof values (1,1),(2,1),(3,2),(4,2)")
+    before = REGISTRY.snapshot().get("profile_op_samples_total", 0)
+    rows = s.query("explain analyze select g, sum(a) from opprof"
+                   " group by g")
+    assert rows  # the statement itself still explains
+    after = REGISTRY.snapshot().get("profile_op_samples_total", 0)
+    assert after == before + 1
+    op_lines = [ln for ln in PROFILER.folded().splitlines()
+                if ln.startswith("op:")]
+    assert op_lines, "no operator stacks reached the profiler"
+    # frames are operator IDS (name_id), root-to-leaf chains
+    assert any(re.search(r"op:\w+_\d+;op:\w+_\d+", ln)
+               for ln in op_lines), op_lines

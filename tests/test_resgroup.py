@@ -471,3 +471,203 @@ def test_status_and_snapshot_sections(sess):
 
     sec = _resgroups_section(sess.domain)
     assert "groups" in sec and "error" not in sec
+
+
+# ---------------------------------------------------------------------------
+# PRIORITY: weighted-fair admission order (ISSUE 18 lifecycle (c))
+# ---------------------------------------------------------------------------
+
+def test_priority_ddl_and_infoschema():
+    d = Domain()
+    s = d.new_session()
+    s.execute("create resource group rg_prio ru_per_sec = 500 "
+              "priority = 4")
+    g = d.resgroups.get("rg_prio")
+    assert g.priority == 4
+    s.execute("alter resource group rg_prio priority = 2")
+    assert g.priority == 2
+    rows = s.query("select name, priority from information_schema."
+                   "tidb_tpu_resource_groups where name = 'rg_prio'")
+    assert rows == [("rg_prio", 2)]
+    # default group keeps weight 1; priority floor clamps to 1
+    assert d.resgroups.get("default").priority == 1
+    s.execute("alter resource group rg_prio priority = 0")
+    assert g.priority == 1
+    s.execute("drop resource group rg_prio")
+
+
+def test_priority_gate_inert_without_differing_contention():
+    """A group running alone — or against equal-priority peers — pays
+    nothing for the gate: admission stays the original token behavior."""
+    from tidb_tpu.lifecycle import ResourceGroupRegistry
+
+    reg = ResourceGroupRegistry()
+    hi = reg.create("solo_hi", priority=8)
+    sc = QueryScope()
+    sc.resgroup = hi
+    for _ in range(50):
+        assert hi.admit(sc) == 0.0  # no contender: instant every time
+    reg = ResourceGroupRegistry()  # fresh: solo_hi is still "recent"
+    eq_a = reg.create("eq_a", priority=3)
+    eq_b = reg.create("eq_b", priority=3)
+    sa, sb = QueryScope(), QueryScope()
+    sa.resgroup, sb.resgroup = eq_a, eq_b
+    for _ in range(50):
+        assert eq_a.admit(sa) == 0.0
+        assert eq_b.admit(sb) == 0.0  # same weight: gate never engages
+
+
+def test_priority_two_to_one_admission_under_contention():
+    """Sustained contention between a PRIORITY=2 and a PRIORITY=1 group
+    admits chunks ~2:1 — the weighted-fair finish tags advance at
+    1/priority per admitted chunk, so the device boundary crossings
+    track the weights."""
+    from tidb_tpu.lifecycle import ResourceGroupRegistry
+
+    reg = ResourceGroupRegistry()
+    hi = reg.create("wfq_hi", priority=2)
+    lo = reg.create("wfq_lo", priority=1)
+    counts = {"wfq_hi": 0, "wfq_lo": 0}
+    stop = threading.Event()
+
+    def pump(g):
+        sc = QueryScope()
+        sc.resgroup = g
+        while not stop.is_set():
+            g.admit(sc)
+            counts[g.name] += 1
+
+    threads = [threading.Thread(target=pump, args=(g,))
+               for g in (hi, lo)]
+    for t in threads:
+        t.start()
+    # measure AFTER both groups are engaged: until the second thread's
+    # first arrival the gate is rightly inert (no contention) and the
+    # first group tight-loops ungated — that ramp is not contention
+    time.sleep(0.15)
+    base = dict(counts)
+    time.sleep(0.7)
+    delta = {k: counts[k] - base[k] for k in counts}
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert delta["wfq_lo"] >= 30, delta  # no starvation, real contention
+    ratio = delta["wfq_hi"] / delta["wfq_lo"]
+    assert 1.4 <= ratio <= 2.8, delta
+
+
+def test_priority_never_throttles_on_priority_alone(monkeypatch):
+    """A low-priority group held back ONLY by the weighted-fair gate
+    passes through at the bounded wait instead of raising
+    ResourceGroupThrottled — priority shapes order, not quota."""
+    from tidb_tpu.lifecycle import ResourceGroupRegistry
+
+    monkeypatch.setenv("TIDB_TPU_RESGROUP_MAX_WAIT_MS", "50")
+    reg = ResourceGroupRegistry()
+    hi = reg.create("rush_hi", priority=64)
+    lo = reg.create("rush_lo", priority=1)
+    stop = threading.Event()
+
+    def flood():
+        sc = QueryScope()
+        sc.resgroup = hi
+        while not stop.is_set():
+            hi.admit(sc)
+
+    t = threading.Thread(target=flood)
+    t.start()
+    try:
+        sc = QueryScope()
+        sc.resgroup = lo
+        for _ in range(5):
+            lo.admit(sc)  # must NEVER raise: tokens are unlimited
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert lo.snapshot()["throttled"] == 0
+
+
+# ---------------------------------------------------------------------------
+# definition replication through the coord plane (ISSUE 18 lifecycle (e))
+# ---------------------------------------------------------------------------
+
+def test_resgroup_defs_replicate_over_local_plane():
+    """Two domains attached to one plane converge on the same
+    definitions: CREATE/ALTER/bind/DROP on one side shows up on the
+    other at its next resolve(), preserving live token balances."""
+    from tidb_tpu.coord.plane import LocalPlane
+
+    plane = LocalPlane()
+    dA, dB = Domain(), Domain()
+    dA.resgroups.attach_plane(plane)
+    dB.resgroups.attach_plane(plane)
+    sA = dA.new_session()
+    sA.execute("create resource group silver ru_per_sec = 800 "
+               "burstable priority = 3, query_limit = 1200")
+    sA.execute("create user 'dave' identified by 'pw'")
+    sA.execute("alter user 'dave' resource group silver")
+    # the replica adopts the definitions at resolve time
+    g = dB.resgroups.resolve("dave@%")
+    assert (g.name, g.ru_per_sec, g.burstable, g.priority,
+            g.query_limit_ms) == ("silver", 800, True, 3, 1200)
+    # ALTER replicates without resetting the replica's live balance
+    sc = QueryScope()
+    sc.resgroup = g
+    g.charge(300.0, sc)
+    tokens_before = g.snapshot()["tokens"]
+    sA.execute("alter resource group silver priority = 5, "
+               "query_limit = 900")
+    g2 = dB.resgroups.resolve("dave@%")
+    assert g2 is g  # updated in place, not replaced
+    assert g.priority == 5 and g.query_limit_ms == 900
+    assert g.snapshot()["tokens"] == pytest.approx(
+        tokens_before, abs=50.0)  # balance survived (modulo refill)
+    # DROP replicates; the binding falls back to default
+    sA.execute("drop resource group silver")
+    assert dB.resgroups.resolve("dave@%").name == "default"
+    # a DETACHED domain never syncs from the plane
+    dC = Domain()
+    sA.execute("create resource group silver ru_per_sec = 1")
+    assert dC.resgroups.get("silver") is None
+
+
+def test_resgroup_defs_replicate_over_rpc_plane():
+    """The worker-plane path: definitions published on the coordinator
+    member ride the membership broadcast (shared store piggyback) and a
+    worker-side domain adopts them without any direct RPC of its own."""
+    from tidb_tpu.coord.plane import (
+        Coordinator, CoordinatorPlane, WorkerPlane)
+
+    coord = Coordinator(port=0, lease_s=4.0, expect=2, self_pid=0)
+    host, port = coord.start()
+    cp = CoordinatorPlane(coord, pid=0).start((0,))
+    wp = WorkerPlane(f"{host}:{port}", 1, lease_s=4.0,
+                     heartbeat_s=0.05).start((1,))
+    try:
+        _wait_for(lambda: cp.view().formed and wp.view().formed)
+        dA, dB = Domain(), Domain()
+        dA.resgroups.attach_plane(cp)
+        dB.resgroups.attach_plane(wp)
+        sA = dA.new_session()
+        sA.execute("create resource group fleetwide ru_per_sec = 250 "
+                   "priority = 7")
+        # the worker's local shared cache fills from the heartbeat
+        _wait_for(lambda: wp.shared_version("resgroups") >= 1)
+        g = dB.resgroups.resolve("", "fleetwide")
+        assert (g.name, g.ru_per_sec, g.priority) == \
+            ("fleetwide", 250, 7)
+    finally:
+        try:
+            wp.stop(leave=True)
+        except Exception:
+            pass
+        cp.stop()
+
+
+def _wait_for(pred, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError("condition not reached")

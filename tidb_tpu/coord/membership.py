@@ -33,6 +33,10 @@ class MembershipView:
     epoch: int
     members: Dict[int, Tuple[int, ...]] = field(default_factory=dict)
     formed: bool = True
+    #: per-member data-plane RPC address ("host:port"), present only for
+    #: members that registered one (the dataplane subsystem, ISSUE 18);
+    #: membership-only deployments carry an empty dict
+    addrs: Dict[int, str] = field(default_factory=dict)
 
     def device_ids(self) -> FrozenSet[int]:
         out = set()

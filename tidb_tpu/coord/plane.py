@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from ..metrics import REGISTRY
 from ..store.fault import FAILPOINTS
 from .membership import MembershipView
-from ..util_concurrency import make_lock, make_rlock
+from ..util_concurrency import make_lock, make_rlock, witness_wait_check
 
 
 def _span_cap_bytes() -> int:
@@ -82,6 +82,8 @@ def _view_from_resp(resp: dict) -> MembershipView:
         members={int(p): tuple(int(d) for d in ids)
                  for p, ids in (resp.get("members") or {}).items()},
         formed=bool(resp.get("formed", True)),
+        addrs={int(p): str(a)
+               for p, a in (resp.get("addrs") or {}).items()},
     )
 
 
@@ -110,6 +112,12 @@ class Coordinator:
         self._formed = expect is None
         self._members: Dict[int, dict] = {}
         self._handoff: Dict[int, List[dict]] = {}
+        # versioned shared payloads (ISSUE 18): small JSON documents a
+        # member publishes for the whole fleet (resource-group
+        # definitions today) — piggybacked on EVERY response, so any
+        # heartbeat delivers the latest version to every worker.
+        # key -> {"v": monotonically increasing int, "doc": payload}
+        self._shared: Dict[str, dict] = {}
         # fleet metric snapshots (ISSUE 13): workers piggyback their
         # registry exports on span batches; in-memory only (a restarted
         # coordinator re-learns them within one snapshot interval)
@@ -154,9 +162,13 @@ class Coordinator:
                     # lost member
                     "last_seen": now,
                     "lease_s": float(m.get("lease_s", self.lease_s)),
+                    "addr": m.get("addr") or None,
                 }
             self._handoff = {int(p): list(v) for p, v in
                              (doc.get("handoff") or {}).items()}
+            self._shared = {str(k): {"v": int(s.get("v", 0)),
+                                     "doc": s.get("doc")}
+                            for k, s in (doc.get("shared") or {}).items()}
             # the restart itself is a membership event: renumber once so
             # every surviving worker rebuilds from the replayed broadcast
             self._epoch += 1
@@ -203,10 +215,13 @@ class Coordinator:
                     "epoch": self._epoch,
                     "members": {str(p): {"devices": list(m["devices"]),
                                          "lease_s": m.get("lease_s",
-                                                          self.lease_s)}
+                                                          self.lease_s),
+                                         "addr": m.get("addr")}
                                 for p, m in self._members.items()},
                     "handoff": {str(p): list(v)
                                 for p, v in self._handoff.items()},
+                    "shared": {k: {"v": s["v"], "doc": s["doc"]}
+                               for k, s in self._shared.items()},
                 }
             try:
                 self._persist.save(doc)
@@ -270,20 +285,27 @@ class Coordinator:
             m["last_seen"] = self._clock()
 
     def register(self, pid: int, devices,
-                 lease_s: Optional[float] = None) -> dict:
+                 lease_s: Optional[float] = None,
+                 addr: Optional[str] = None) -> dict:
         """A process joins (or REJOINS after a restart) with its healthy
         local device ids; any parked handoff state for this pid rides
-        back in the response, consumed exactly once."""
+        back in the response, consumed exactly once.  `addr` is the
+        member's data-plane RPC endpoint (ISSUE 18), broadcast with the
+        membership so peers can exchange partition fragments."""
         devices = tuple(int(d) for d in devices)
         with self._mu:
             self._expire_locked()
             prev = self._members.get(pid)
+            if addr is None and prev is not None:
+                addr = prev.get("addr")  # re-register keeps the endpoint
             self._members[pid] = {
                 "devices": devices,
                 "last_seen": self._clock(),
                 "lease_s": float(lease_s or self.lease_s),
+                "addr": addr,
             }
-            if prev is None or prev["devices"] != devices:
+            if prev is None or prev["devices"] != devices \
+                    or prev.get("addr") != addr:
                 self._bump_locked(f"member {pid} joined")
             if self.expect is not None \
                     and len(self._members) >= self.expect:
@@ -395,12 +417,44 @@ class Coordinator:
             epoch=self._epoch,
             members={p: m["devices"] for p, m in self._members.items()},
             formed=self._formed,
+            addrs={p: m["addr"] for p, m in self._members.items()
+                   if m.get("addr")},
         )
 
     def view(self) -> MembershipView:
         with self._mu:
             self._expire_locked()
             return self._view_locked()
+
+    # ---- shared fleet payloads (ISSUE 18) -------------------------------
+    def shared_put(self, key: str, doc) -> int:
+        """Publish one fleet-wide document under `key`; returns the new
+        version.  Versions are per-key monotonic; publication is NOT a
+        membership change (no epoch bump) — workers pick the new version
+        off any subsequent response."""
+        with self._mu:
+            cur = self._shared.get(key)
+            ver = (cur["v"] if cur else 0) + 1
+            self._shared[key] = {"v": ver, "doc": doc}
+            self._save_locked()
+        self._flush_state()
+        REGISTRY.inc("coord_shared_puts_total")
+        return ver
+
+    def shared_get(self, key: str):
+        """(doc, version) for `key`; (None, 0) when never published."""
+        with self._mu:
+            cur = self._shared.get(key)
+            return (cur["doc"], cur["v"]) if cur else (None, 0)
+
+    def shared_version(self, key: str) -> int:
+        with self._mu:
+            cur = self._shared.get(key)
+            return cur["v"] if cur else 0
+
+    def _shared_locked(self) -> dict:
+        return {k: {"v": s["v"], "doc": s["doc"]}
+                for k, s in self._shared.items()}
 
     # ---- wire -----------------------------------------------------------
     def _serve(self):
@@ -442,8 +496,13 @@ class Coordinator:
         pid = int(req.get("pid", -1))
         if cmd == "register":
             out = self.register(pid, req.get("devices") or (),
-                                req.get("lease_s"))
+                                req.get("lease_s"), addr=req.get("addr"))
             return self._resp(out["view"], handoff=out["handoff"])
+        if cmd == "shared_put":
+            ver = self.shared_put(str(req.get("key")), req.get("doc"))
+            with self._mu:
+                self._touch_locked(pid)
+            return self._resp(self.view(), version=ver)
         if cmd == "poll":
             # heartbeat polls piggyback metric snapshots too (ISSUE 16
             # satellite (d)): an idle worker with zero finished traces
@@ -484,11 +543,14 @@ class Coordinator:
             return self._resp(self.view(), outcome=outcome)
         return {"ok": False, "error": f"unknown cmd {cmd!r}"}
 
-    @staticmethod
-    def _resp(view: MembershipView, **extra) -> dict:
+    def _resp(self, view: MembershipView, **extra) -> dict:
+        with self._mu:
+            shared = self._shared_locked()
         d = {"ok": True, "epoch": view.epoch, "formed": view.formed,
              "members": {str(p): list(ids)
-                         for p, ids in view.members.items()}}
+                         for p, ids in view.members.items()},
+             "addrs": {str(p): a for p, a in view.addrs.items()},
+             "shared": shared}
         d.update(extra)
         return d
 
@@ -510,11 +572,37 @@ class LocalPlane:
         self._epoch = 1
         self._devices: Tuple[int, ...] = ()
         self._handoff: List[dict] = []
+        self._shared: Dict[str, dict] = {}
+        self._dp_addr: Optional[str] = None
 
     def view(self) -> MembershipView:
         with self._mu:
             members = {0: self._devices} if self._devices else {}
-            return MembershipView(self._epoch, members, formed=True)
+            addrs = {0: self._dp_addr} if self._dp_addr else {}
+            return MembershipView(self._epoch, members, formed=True,
+                                  addrs=addrs)
+
+    # ---- shared fleet payloads (degenerate single-member fleet) ---------
+    def advertise_addr(self, addr: Optional[str]):
+        with self._mu:
+            self._dp_addr = addr
+
+    def shared_put(self, key: str, doc) -> int:
+        with self._mu:
+            cur = self._shared.get(key)
+            ver = (cur["v"] if cur else 0) + 1
+            self._shared[key] = {"v": ver, "doc": doc}
+            return ver
+
+    def shared_get(self, key: str):
+        with self._mu:
+            cur = self._shared.get(key)
+            return (cur["doc"], cur["v"]) if cur else (None, 0)
+
+    def shared_version(self, key: str) -> int:
+        with self._mu:
+            cur = self._shared.get(key)
+            return cur["v"] if cur else 0
 
     def current_epoch(self) -> int:
         with self._mu:
@@ -586,6 +674,19 @@ class CoordinatorPlane:
         out = self.coord.register(self.pid, self._devices)
         self._handoff_in = list(out["handoff"])
         return self
+
+    # ---- shared fleet payloads ------------------------------------------
+    def advertise_addr(self, addr: Optional[str]):
+        self.coord.register(self.pid, self._devices, addr=addr)
+
+    def shared_put(self, key: str, doc) -> int:
+        return self.coord.shared_put(key, doc)
+
+    def shared_get(self, key: str):
+        return self.coord.shared_get(key)
+
+    def shared_version(self, key: str) -> int:
+        return self.coord.shared_version(key)
 
     def view(self) -> MembershipView:
         return self.coord.view()
@@ -671,6 +772,9 @@ class WorkerPlane:
         self._view = MembershipView(0, {}, formed=False)
         self._devices: Tuple[int, ...] = ()
         self._handoff_in: List[dict] = []
+        # shared fleet payloads cached off every response (ISSUE 18)
+        self._shared: Dict[str, dict] = {}
+        self._dp_addr: Optional[str] = None
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
         # batched span forwarding (ISSUE 11 / coord follow-up (c)): a
@@ -699,7 +803,8 @@ class WorkerPlane:
         self._devices = tuple(int(d) for d in devices)
         resp = self._rpc({"cmd": "register", "pid": self.pid,
                           "devices": list(self._devices),
-                          "lease_s": self.lease_s},
+                          "lease_s": self.lease_s,
+                          "addr": self._dp_addr},
                          retries=40, retry_sleep=0.25)
         self._apply(resp)
         with self._mu:
@@ -760,7 +865,39 @@ class WorkerPlane:
         with self._mu:
             self._view = MembershipView(self._view.epoch + 1,
                                         self._view.members,
-                                        self._view.formed)
+                                        self._view.formed,
+                                        self._view.addrs)
+
+    # ---- shared fleet payloads ------------------------------------------
+    def advertise_addr(self, addr: Optional[str]):
+        """Publish this worker's data-plane endpoint: re-register with
+        the addr (an addr change is a membership change — epoch bumps)."""
+        self._dp_addr = addr
+        try:
+            resp = self._rpc({"cmd": "register", "pid": self.pid,
+                              "devices": list(self._devices),
+                              "lease_s": self.lease_s, "addr": addr})
+            with self._mu:
+                self._handoff_in += list(resp.get("handoff") or [])
+            self._apply(resp)
+        except Exception:
+            REGISTRY.inc("coord_rpc_errors_total")
+
+    def shared_put(self, key: str, doc) -> int:
+        resp = self._rpc({"cmd": "shared_put", "pid": self.pid,
+                          "key": key, "doc": doc})
+        self._apply(resp)
+        return int(resp.get("version", 0))
+
+    def shared_get(self, key: str):
+        with self._mu:
+            cur = self._shared.get(key)
+            return (cur["doc"], cur["v"]) if cur else (None, 0)
+
+    def shared_version(self, key: str) -> int:
+        with self._mu:
+            cur = self._shared.get(key)
+            return cur["v"] if cur else 0
 
     def publish_local(self, device_ids):
         pass  # membership truth flows through register/report
@@ -821,9 +958,16 @@ class WorkerPlane:
         """Background worker: flush the span queue when the batch
         threshold fills (size) or the flush interval lapses (age)."""
         while not self._stop.is_set():
-            self._span_wake.wait(self._span_flush_s)
+            self._flusher_wait()
             self._span_wake.clear()
             self.flush_spans()
+
+    def _flusher_wait(self):
+        """The flusher's age-trigger wait, witness-checked (concurrency
+        (d)): blocking here while holding a ranked lock would stall the
+        only thread that drains the span queue."""
+        witness_wait_check("WorkerPlane._span_wake.wait")
+        self._span_wake.wait(self._span_flush_s)
 
     def flush_spans(self):
         """Drain the span queue now (the flusher's body; also the
@@ -896,10 +1040,27 @@ class WorkerPlane:
         with self._mu:
             if view.epoch >= self._view.epoch:
                 self._view = view
+            # shared payloads ride every response; per-key versions are
+            # monotonic so a stale response can never roll one back
+            for k, s in (resp.get("shared") or {}).items():
+                try:
+                    ver = int(s.get("v", 0))
+                except (TypeError, AttributeError, ValueError):
+                    continue
+                cur = self._shared.get(k)
+                if cur is None or ver > cur["v"]:
+                    self._shared[k] = {"v": ver, "doc": s.get("doc")}
         REGISTRY.set("coord_epoch", view.epoch)
 
+    def _hb_wait(self) -> bool:
+        """One heartbeat-interval wait, witness-checked (concurrency
+        (d)): the heartbeat thread must never sleep on the stop event
+        while holding a ranked lock."""
+        witness_wait_check("WorkerPlane._stop.wait")
+        return self._stop.wait(self.heartbeat_s)
+
     def _heartbeat(self):
-        while not self._stop.wait(self.heartbeat_s):
+        while not self._hb_wait():
             try:
                 req = {"cmd": "poll", "pid": self.pid}
                 now = time.monotonic()
@@ -920,7 +1081,8 @@ class WorkerPlane:
                     # the new epoch; any parked handoff rides back
                     resp = self._rpc({"cmd": "register", "pid": self.pid,
                                       "devices": list(self._devices),
-                                      "lease_s": self.lease_s})
+                                      "lease_s": self.lease_s,
+                                      "addr": self._dp_addr})
                     with self._mu:
                         self._handoff_in += list(resp.get("handoff") or [])
                 self._apply(resp)

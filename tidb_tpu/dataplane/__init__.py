@@ -1,0 +1,33 @@
+"""Multi-host sharded data plane (ISSUE 18).
+
+Base tables shard into hash partitions owned per membership epoch
+(`partition.PartitionMap` — a pure function of the coord plane's
+broadcast, renumbered with the epoch).  Each host materializes only its
+owned partitions as real attached `TableStore`s (`shard.Dataplane`),
+answers fragment RPCs for them (`rpc.DataplaneServer`), and scatters
+its own scans across the owners (`engine.try_run_dataplane`), falling
+back to the local full-table path on any mid-flight failure.  Host loss
+= epoch bump = re-shard from persisted packed base blocks onto the
+survivors, with in-flight dispatches retried under the new map via the
+typed `PartitionMapMismatch` — `CoordEpochMismatch`, one layer up.
+"""
+
+from .engine import (activate_dataplane, deactivate_dataplane,
+                     get_dataplane, try_run_dataplane)
+from .partition import (PartitionMap, PartitionMapMismatch,
+                        build_partition_map, default_parts)
+from .shard import Dataplane, ShardedTable, partition_tid
+
+__all__ = [
+    "Dataplane",
+    "PartitionMap",
+    "PartitionMapMismatch",
+    "ShardedTable",
+    "activate_dataplane",
+    "build_partition_map",
+    "deactivate_dataplane",
+    "default_parts",
+    "get_dataplane",
+    "partition_tid",
+    "try_run_dataplane",
+]

@@ -1,0 +1,230 @@
+"""Dataplane dispatch: scatter a coprocessor request over partition
+owners, gather per-partition results in handle order.
+
+The dispatch contract mirrors the mesh engine's: `try_run_dataplane`
+returns chunks or None, and None ALWAYS has a correct fallback — every
+host still holds the full pre-shard base table, so the per-region local
+path answers identically (tests that must prove cross-host execution
+assert the `dataplane_queries_total` delta, not just row parity).
+
+Epoch discipline, end to end:
+
+  1. `sync()` re-derives the partition map from the CURRENT broadcast
+     (re-sharding if the epoch moved) before any fragment is built.
+  2. Every remote fragment carries the map's epoch; the owner re-checks
+     against ITS broadcast and answers a typed epoch error on skew.
+  3. After the gather, the epoch is re-checked once more — results
+     that straddle a membership change are discarded and the whole
+     dispatch re-runs under the new map (`PartitionMapMismatch` is
+     retriable exactly like `CoordEpochMismatch`).
+
+Remote fragments are charged to the statement's resource group through
+the same `chunk_admission` seam the per-tile device loop uses — an
+exchange is a dispatch, fleet quotas must see it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import TiDBTPUError
+from ..metrics import REGISTRY
+from .partition import PartitionMap, PartitionMapMismatch
+from .rpc import DataplaneServer, PeerClient
+from .shard import Dataplane, ShardedTable, partition_tid
+
+log = logging.getLogger("tidb_tpu.dataplane")
+
+#: id(storage) -> (Dataplane, Optional[DataplaneServer])
+_ACTIVE: Dict[int, Tuple[Dataplane, Optional[DataplaneServer]]] = {}
+
+
+class _PeerLost(RuntimeError):
+    """A fragment owner went unreachable mid-dispatch (likely a host
+    loss the lease hasn't expired yet) — fall back locally; the next
+    epoch bump re-shards."""
+
+
+def activate_dataplane(storage, plane=None, pid: Optional[int] = None,
+                       data_dir: Optional[str] = None,
+                       n_parts: Optional[int] = None,
+                       serve: bool = True) -> Dataplane:
+    """Stand up the data plane on this host: shard manager + fragment
+    server, with the server's address advertised through the membership
+    broadcast so peers can find us without a second discovery system."""
+    from ..coord import get_plane
+
+    plane = plane or get_plane()
+    if pid is None:
+        pid = getattr(plane, "pid", 0)
+    dp = Dataplane(storage, plane, pid, data_dir=data_dir,
+                   n_parts=n_parts)
+    server = None
+    if serve:
+        server = DataplaneServer(storage, dp)
+        plane.advertise_addr(server.addr)
+    _ACTIVE[id(storage)] = (dp, server)
+    return dp
+
+
+def get_dataplane(storage) -> Optional[Dataplane]:
+    entry = _ACTIVE.get(id(storage))
+    return entry[0] if entry else None
+
+
+def deactivate_dataplane(storage):
+    entry = _ACTIVE.pop(id(storage), None)
+    if entry is None:
+        return
+    dp, server = entry
+    if server is not None:
+        server.close()
+    dp.close()
+
+
+def try_run_dataplane(storage, req) -> Optional[List]:
+    """Serve `req` over the sharded data plane, or None when the
+    request is not dataplane-eligible (unsharded table, stale shard
+    snapshot, runtime payloads) or on any mid-flight failure — the
+    caller's local path is always a correct fallback."""
+    entry = _ACTIVE.get(id(storage))
+    if entry is None:
+        return None
+    dp, _server = entry
+    tids = {kr.table_id for kr in req.ranges}
+    if len(tids) != 1:
+        return None
+    tid = tids.pop()
+    st = dp.lookup(tid)
+    if st is None:
+        return None
+    if req.aux:
+        # runtime probe payloads (index-join inners) stay on the local
+        # per-region path — shipping them per partition would multiply
+        # the exchange for no partitioning win
+        REGISTRY.inc("dataplane_bypass_total")
+        return None
+    if not storage.has_table(tid):
+        return None
+    src = storage.table(tid)
+    if src.delta or src.base_version != st.base_version:
+        # committed DML / bulk load since the shard snapshot: partitions
+        # no longer cover the table — bypass until re-sharded
+        REGISTRY.inc("dataplane_bypass_total")
+        return None
+    for attempt in range(3):
+        try:
+            pmap = dp.sync()
+            if pmap is None:
+                return None  # broadcast not formed yet
+            out = _scatter_gather(dp, st, pmap, req)
+            REGISTRY.inc("dataplane_queries_total")
+            return out
+        except PartitionMapMismatch:
+            # membership moved mid-dispatch: rebuild the map (sync()
+            # re-shards at the top of the loop) and re-run — the
+            # CoordEpochMismatch retry ladder, one layer up
+            REGISTRY.inc("dataplane_epoch_retries_total")
+            continue
+        except _PeerLost:
+            REGISTRY.inc("dataplane_peer_lost_total")
+            return None
+        except TiDBTPUError:
+            raise  # semantic errors (kill, quota) surface unchanged
+        except Exception:
+            REGISTRY.inc("dataplane_errors_total")
+            log.warning("dataplane dispatch failed; falling back to the "
+                        "local path", exc_info=True)
+            return None
+    REGISTRY.inc("dataplane_errors_total")
+    return None
+
+
+def _scatter_gather(dp: Dataplane, st: ShardedTable, pmap: PartitionMap,
+                    req) -> List:
+    """Fan the request's ranges over partition owners; gather chunks in
+    partition (== handle) order so keep_order consumers and per-region
+    partial-agg merging behave exactly as on the region path."""
+    from ..lifecycle import chunk_admission
+    from ..store.kv import CopRequest, KeyRange
+
+    # partition -> list of LOCAL (start, end) clips within the partition
+    frags: Dict[int, List[Tuple[int, int]]] = {}
+    for kr in req.ranges:
+        for p in range(st.n_parts):
+            lo, hi = st.part_range(p)
+            s, e = max(kr.start, lo), min(kr.end, hi)
+            if s < e:
+                frags.setdefault(p, []).append((s - lo, e - lo))
+    if not frags:
+        return []
+
+    view = dp.plane.view()
+    pmap.check(view.epoch)
+    results: Dict[int, List] = {}
+    remote_by_owner: Dict[int, List[int]] = {}
+    with dp._mu:
+        loaded = dict(st.loaded)
+    for p in sorted(frags):
+        owner = pmap.owner(p)
+        if owner == dp.pid or p in loaded:
+            # locally materialized: run through the host's own client
+            # (per-tile device path, delta overlay, failpoints — the
+            # whole existing region pipeline, on the partition store)
+            ptid = loaded.get(p)
+            if ptid is None:
+                raise PartitionMapMismatch(pmap.epoch, view.epoch)
+            sub = CopRequest(
+                dag=req.dag,
+                ranges=[KeyRange(ptid, s, e) for s, e in frags[p]],
+                ts=req.ts, concurrency=1, keep_order=True,
+                engine=req.engine, backoff_budget_ms=req.backoff_budget_ms)
+            chunks = []
+            for resp in dp.storage.get_client().send(sub):
+                chunks.extend(resp.chunks)
+            results[p] = chunks
+            REGISTRY.inc("dataplane_local_fragments_total")
+        else:
+            remote_by_owner.setdefault(owner, []).append(p)
+
+    for owner, parts in remote_by_owner.items():
+        addr = view.addrs.get(owner)
+        if not addr:
+            # owner never advertised a fragment endpoint: the fleet is
+            # membership-only on that host — nothing to exchange with
+            raise _PeerLost(f"pid {owner} has no dataplane address")
+        client = None
+        try:
+            client = PeerClient(addr)
+            for p in parts:
+                ptid = partition_tid(st.table_id, p)
+                ranges = [(ptid, s, e) for s, e in frags[p]]
+                with chunk_admission():
+                    resp = client.exec_fragment(
+                        req.dag, ranges, req.ts, pmap.epoch,
+                        req.engine)
+                err = resp.get("err")
+                if err == "epoch":
+                    raise PartitionMapMismatch(
+                        resp.get("built_at"), resp.get("current"))
+                if err:
+                    raise _PeerLost(
+                        f"pid {owner} fragment failed: "
+                        f"{resp.get('msg', err)}")
+                results[p] = resp.get("chunks") or []
+        except (ConnectionError, OSError) as e:
+            raise _PeerLost(f"pid {owner} unreachable: {e}") from e
+        finally:
+            if client is not None:
+                client.close()
+
+    # the post-gather epoch re-check: results that straddle a
+    # membership change are discarded wholesale (partials from two maps
+    # must never be merged)
+    pmap.check(dp.plane.view().epoch)
+    out: List = []
+    for p in sorted(results):
+        out.extend(results[p])
+        REGISTRY.inc("dataplane_partitions_scanned_total")
+    return out

@@ -1,0 +1,117 @@
+"""Epoch-numbered partition ownership: the `PartitionMap` every host
+derives from the membership broadcast.
+
+The coordination plane (ISSUE 9) already agrees on WHO is in the fleet
+(epoch-numbered `MembershipView`); the data plane needs to agree on WHO
+OWNS WHAT.  Rather than broadcasting a second document (and creating a
+second thing that can desync), the partition map is a PURE FUNCTION of
+the membership view: `build_partition_map(view)` runs on every host and
+produces byte-identical ownership, renumbered with the epoch for free.
+Ownership uses rendezvous (highest-random-weight) hashing, so a member
+loss moves ONLY the dead member's partitions — survivors keep theirs,
+which is what makes re-sharding replay-sized instead of rebuild-sized.
+
+A dispatch that observes a map built at a stale epoch raises the typed
+retriable `PartitionMapMismatch` — the exact contract of
+`CoordEpochMismatch` one layer down: rebuild from the current broadcast
+and re-run, never desync a collective or return partial rows.
+
+jax-free by contract, like the rest of the control plane: plain ints
+and tuples only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+#: partitions per sharded table (hash-space width).  More partitions =
+#: finer re-shard granularity but more fragments per scan; 8 keeps the
+#: 2-host acceptance test moving whole table-quarters on a loss.
+_PARTS_ENV = "TIDB_TPU_DATAPLANE_PARTS"
+DEFAULT_PARTS = 8
+
+
+def default_parts() -> int:
+    try:
+        return max(int(os.environ.get(_PARTS_ENV, DEFAULT_PARTS)), 1)
+    except ValueError:
+        return DEFAULT_PARTS
+
+
+class PartitionMapMismatch(RuntimeError):
+    """The membership epoch advanced between partition-map build and
+    dispatch (a host joined, left, or was lease-expired), so partition
+    ownership has been renumbered.  Typed and retriable BY DESIGN,
+    exactly like `CoordEpochMismatch`: the dispatcher re-derives the
+    map from the current broadcast, re-shards, and re-runs — instead of
+    scanning partitions a survivor no longer owns (missing rows) or
+    launching an exchange against a dead endpoint (a hang).  The
+    message avoids device-failure vocabulary so classify_failure never
+    mistakes a re-shard for a chip fault."""
+
+    def __init__(self, built_at, current):
+        super().__init__(
+            f"partition map epoch advanced {built_at} -> {current}; "
+            "re-sharding over the current member set")
+        self.built_at = built_at
+        self.current = current
+
+
+def _hrw_score(part: int, pid: int) -> int:
+    """Rendezvous weight for (partition, member): deterministic across
+    processes and Python runs (hashlib, not hash())."""
+    h = hashlib.blake2b(b"%d:%d" % (part, pid), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+@dataclass(frozen=True)
+class PartitionMap:
+    """Ownership of `n_parts` hash partitions at one membership epoch.
+
+    `owners[p]` is the pid that owns partition p; `members` is the pid
+    set the map was derived from (sorted).  Two hosts holding maps with
+    the same epoch hold byte-identical maps — the map is a deterministic
+    function of the broadcast."""
+
+    epoch: int
+    n_parts: int
+    owners: Tuple[int, ...]
+    members: Tuple[int, ...]
+
+    def owned_by(self, pid: int) -> Tuple[int, ...]:
+        return tuple(p for p, o in enumerate(self.owners) if o == pid)
+
+    def owner(self, part: int) -> int:
+        return self.owners[part]
+
+    def by_owner(self) -> Dict[int, Tuple[int, ...]]:
+        out: Dict[int, list] = {}
+        for p, o in enumerate(self.owners):
+            out.setdefault(o, []).append(p)
+        return {o: tuple(ps) for o, ps in out.items()}
+
+    def check(self, current_epoch: int):
+        """Every dispatch re-checks: a map built at a stale epoch is a
+        typed retriable error, never a silent partial scan."""
+        if current_epoch != self.epoch:
+            raise PartitionMapMismatch(self.epoch, current_epoch)
+
+
+def build_partition_map(view, n_parts: int = 0) -> PartitionMap:
+    """Derive the ownership map from a membership view.  Requires a
+    FORMED view with at least one member — before formation ownership
+    would flap as members trickle in, so callers wait (or stay on the
+    degenerate single-owner path)."""
+    pids = tuple(sorted(view.members))
+    if not pids:
+        raise PartitionMapMismatch(-1, view.epoch)
+    n = n_parts or default_parts()
+    owners = []
+    for p in range(n):
+        # max score wins; ties (2^-64) break toward the lower pid
+        owners.append(max(pids, key=lambda pid: (_hrw_score(p, pid), -pid)))
+    return PartitionMap(epoch=view.epoch, n_parts=n,
+                        owners=tuple(owners), members=pids)

@@ -1,0 +1,198 @@
+"""Cross-host fragment execution: the data plane's exchange transport.
+
+One verb: `exec` — run this DAG over these partition ranges at this
+snapshot, AT this partition-map epoch.  The epoch rides every request
+and the owner re-checks it against its own broadcast before running, so
+a fragment addressed under a stale map comes back as a typed epoch
+error (never partial rows from a host that no longer owns the range) —
+the wire-level twin of `RegionManager.check_epoch`.
+
+Transport is length-framed pickle over TCP.  Pickle is acceptable here
+for the same reason it is in `jax`'s own host-transfer layer: both ends
+are the SAME trusted binary inside one fleet (the coord plane already
+speaks newline-JSON on an adjacent port); chunks are numpy columns +
+FieldType dataclasses, which pickle round-trips losslessly without
+inventing a columnar wire format.
+
+Exchange volume is metered on BOTH directions into
+`dataplane_exchange_bytes_total` — the bench receipt's headline number.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Optional
+
+from ..metrics import REGISTRY
+
+_HDR = struct.Struct(">Q")
+#: frame cap (1 GiB): a corrupt header must not look like an allocation
+_MAX_FRAME = 1 << 30
+
+
+class DataplaneRPCError(RuntimeError):
+    """Remote fragment failed for a non-epoch reason (the caller's
+    fallback ladder decides whether to retry or run locally)."""
+
+
+def _send_obj(sock: socket.socket, obj) -> int:
+    buf = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(buf)) + buf)
+    return len(buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        got = sock.recv(n - len(out))
+        if not got:
+            raise ConnectionError("dataplane peer closed mid-frame")
+        out.extend(got)
+    return bytes(out)
+
+
+def _recv_obj(sock: socket.socket):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"dataplane frame too large: {n}")
+    buf = _recv_exact(sock, n)
+    return pickle.loads(buf), n
+
+
+class DataplaneServer:
+    """Owner-side fragment executor: one listener thread + one thread
+    per connection (connections are long-lived — the engine keeps one
+    per peer and multiplexes fragments over it sequentially)."""
+
+    def __init__(self, storage, dataplane, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.storage = storage
+        self.dataplane = dataplane
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(16)
+        # a blocked accept() is not reliably woken by close() on Linux;
+        # poll with a short timeout so close() always reclaims the thread
+        self._lsock.settimeout(0.25)
+        self.addr = "%s:%d" % self._lsock.getsockname()
+        self._stop = threading.Event()
+        self._threads = []
+        self._conns = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="dataplane-rpc-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            conn.settimeout(None)
+            self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="dataplane-rpc-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                try:
+                    req, n_in = _recv_obj(conn)
+                except (ConnectionError, OSError, EOFError):
+                    return
+                REGISTRY.inc("dataplane_exchange_bytes_total", n_in)
+                resp = self._handle(req)
+                try:
+                    n_out = _send_obj(conn, resp)
+                except OSError:
+                    return
+                REGISTRY.inc("dataplane_exchange_bytes_total", n_out)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: dict) -> dict:
+        from ..store.kv import CopRequest, KeyRange
+
+        try:
+            if req.get("cmd") != "exec":
+                return {"err": "bad_cmd"}
+            # epoch gate FIRST: a fragment addressed under a stale map
+            # must come back typed-retriable, not as partial rows
+            self.dataplane.sync()
+            view = self.dataplane.plane.view()
+            built_at = int(req.get("epoch", -1))
+            if built_at != view.epoch:
+                return {"err": "epoch", "built_at": built_at,
+                        "current": view.epoch}
+            ranges = [KeyRange(int(t), int(s), int(e))
+                      for t, s, e in req["ranges"]]
+            sub = CopRequest(
+                dag=req["dag"], ranges=ranges, ts=int(req["ts"]),
+                concurrency=1, keep_order=True,
+                engine=req.get("engine", "tpu"), aux=req.get("aux"))
+            chunks = []
+            for resp in self.storage.get_client().send(sub):
+                chunks.extend(resp.chunks)
+            REGISTRY.inc("dataplane_remote_fragments_total")
+            return {"chunks": chunks,
+                    "rows": sum(c.num_rows for c in chunks)}
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            REGISTRY.inc("dataplane_rpc_errors_total")
+            return {"err": "exec", "msg": f"{type(e).__name__}: {e}"}
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=2.0)
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+class PeerClient:
+    """Caller-side connection to one owner.  Fragments are sent
+    sequentially per peer (partition fan-out parallelism comes from
+    using one client per peer, not pipelining within a connection)."""
+
+    def __init__(self, addr: str, timeout_s: float = 30.0):
+        host, port = addr.rsplit(":", 1)
+        self.addr = addr
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def exec_fragment(self, dag: dict, ranges, ts: int, epoch: int,
+                      engine: str, aux: Optional[dict] = None) -> dict:
+        req = {"cmd": "exec", "dag": dag, "ranges": ranges, "ts": ts,
+               "epoch": epoch, "engine": engine, "aux": aux}
+        n_out = _send_obj(self._sock, req)
+        REGISTRY.inc("dataplane_exchange_bytes_total", n_out)
+        resp, n_in = _recv_obj(self._sock)
+        REGISTRY.inc("dataplane_exchange_bytes_total", n_in)
+        return resp
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

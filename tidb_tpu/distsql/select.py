@@ -240,6 +240,21 @@ class SelectResult:
     def _produce(self):
         try:
             if self.req.engine == "tpu":
+                # sharded data plane (tidb_tpu/dataplane): tables
+                # partitioned across the fleet scatter over partition
+                # owners and gather in handle order; None when the
+                # table is unsharded, the shard snapshot is stale, or
+                # any fragment fails (the local paths below hold the
+                # full base table, so the fallback is always correct)
+                from ..dataplane import try_run_dataplane
+
+                dpc = try_run_dataplane(self.storage, self.req)
+                if dpc is not None:
+                    self.scan_engine = "dataplane"
+                    for c in dpc:
+                        self._put(c)
+                    self._put(_DONE)
+                    return
                 # micro-batch rung (tidb_tpu/serving): identical-shape
                 # point/agg statements arriving within the batching
                 # window coalesce into one vmapped device dispatch; None

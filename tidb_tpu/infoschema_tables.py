@@ -567,21 +567,55 @@ def _tidb_tpu_device_health(domain, isc):
 @_register("tidb_tpu_resource_groups", [
     ("name", ty_string()), ("ru_per_sec", ty_int()),
     ("burstable", ty_int()), ("query_limit_ms", ty_int()),
+    ("priority", ty_int()),
     ("tokens", ty_float()), ("waiting", ty_int()),
     ("consumed_ru", ty_float()), ("throttled", ty_int()),
     ("users", ty_string()),
 ])
 def _tidb_tpu_resource_groups(domain, isc):
     """The resource-control plane (lifecycle/resgroup.py): one row per
-    group with its quota, live token balance, parked waiters, lifetime
-    RU (device-ms) and bound users — the operator view the reference
-    exposes as information_schema.resource_groups."""
+    group with its quota, weighted-fair priority, live token balance,
+    parked waiters, lifetime RU (device-ms) and bound users — the
+    operator view the reference exposes as
+    information_schema.resource_groups."""
     return [
         (g["name"], g["ru_per_sec"], int(g["burstable"]),
-         g["query_limit_ms"], g["tokens"], g["waiting"],
+         g["query_limit_ms"], g["priority"], g["tokens"], g["waiting"],
          g["consumed_ru"], g["throttled"], ",".join(g["users"]))
         for g in domain.resgroups.snapshot()
     ]
+
+
+@_register("tidb_tpu_partition_map", [
+    ("table_id", ty_int()), ("partition_id", ty_int()),
+    ("row_start", ty_int()), ("row_end", ty_int()),
+    ("owner_pid", ty_int()), ("epoch", ty_int()),
+    ("local", ty_int()), ("store_table_id", ty_int()),
+])
+def _tidb_tpu_partition_map(domain, isc):
+    """The sharded data plane's ownership map (ISSUE 18): one row per
+    (sharded table, partition) with its handle range, owning process,
+    the membership epoch the map was derived at, and — when this host
+    owns it — the synthetic table id of the materialized partition
+    store.  Empty when the data plane is inactive."""
+    from .dataplane import get_dataplane
+
+    dp = get_dataplane(domain.storage)
+    if dp is None:
+        return []
+    pmap = dp.current_map()
+    if pmap is None:
+        return []
+    rows = []
+    with dp._mu:
+        tables = {tid: (list(st.bounds), dict(st.loaded))
+                  for tid, st in dp._tables.items()}
+    for tid in sorted(tables):
+        bounds, loaded = tables[tid]
+        for p, (lo, hi) in enumerate(bounds):
+            rows.append((tid, p, lo, hi, pmap.owner(p), pmap.epoch,
+                         int(p in loaded), loaded.get(p, -1)))
+    return rows
 
 
 @_register("tidb_tpu_fusion_splits", [

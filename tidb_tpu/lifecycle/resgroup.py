@@ -59,6 +59,12 @@ _DEFAULT_MAX_WAIT_MS = 2000.0
 #: chunk-budget-bounded, long enough to not spin
 _POLL_S = 0.005
 
+#: a group counts as *contending* for the weighted-fair gate while a
+#: thread is parked at its admission OR it dispatched this recently —
+#: back-to-back chunk loops never park, so recency is what makes two
+#: busy statements visible to each other
+_CONTEND_S = 0.05
+
 
 def _max_wait_ms() -> float:
     try:
@@ -79,22 +85,26 @@ class ResourceGroup:
     """
 
     __slots__ = ("name", "ru_per_sec", "burstable", "query_limit_ms",
-                 "_reg", "_tokens", "_last_refill", "_waiting",
-                 "_consumed", "_throttled")
+                 "priority", "_reg", "_tokens", "_last_refill",
+                 "_waiting", "_consumed", "_throttled", "_vtime",
+                 "_last_arrival")
 
     def __init__(self, name: str, reg: "ResourceGroupRegistry",
                  ru_per_sec: int = 0, burstable: bool = False,
-                 query_limit_ms: int = 0):
+                 query_limit_ms: int = 0, priority: int = 1):
         self.name = name
         self._reg = reg
         self.ru_per_sec = int(ru_per_sec)
         self.burstable = bool(burstable)
         self.query_limit_ms = int(query_limit_ms)
+        self.priority = max(1, int(priority))
         self._tokens = float(self.ru_per_sec)  # start with 1s of budget
         self._last_refill = time.monotonic()
         self._waiting = 0  # threads parked at admission
         self._consumed = 0.0  # lifetime RU (device-ms)
         self._throttled = 0  # ResourceGroupThrottled raises
+        self._vtime = 0.0  # weighted-fair virtual finish tag
+        self._last_arrival = 0.0  # monotonic of the last admit attempt
 
     # ---- bucket (callers hold reg._mu) ----------------------------------
     def _refill_locked(self, now: float):
@@ -108,7 +118,7 @@ class ResourceGroup:
                                float(self.ru_per_sec))
         self._last_refill = now
 
-    def _admissible_locked(self, now: float) -> bool:
+    def _tokens_ok_locked(self, now: float) -> bool:
         self._refill_locked(now)
         if self.ru_per_sec <= 0:
             return True  # unlimited group
@@ -120,6 +130,19 @@ class ResourceGroup:
             return not self._reg._tokenful_waiters_locked(self)
         return False
 
+    def _admissible_locked(self, now: float,
+                           skip_priority: bool = False) -> bool:
+        if not self._tokens_ok_locked(now):
+            return False
+        if skip_priority:
+            # the bounded-wait pass-through: priority shapes the
+            # admission ORDER, it never becomes a quota of its own
+            tag = max(self._reg._vclock, self._vtime)
+            self._vtime = tag + 1.0 / self.priority
+            self._reg._vclock = tag
+            return True
+        return self._reg._priority_turn_locked(self, now)
+
     # ---- admission / charge ---------------------------------------------
     def admit(self, scope) -> float:
         """Block (interruptibly) until this group may dispatch one more
@@ -129,6 +152,7 @@ class ResourceGroup:
         mu = self._reg._mu
         now = time.monotonic()
         with mu:
+            self._last_arrival = now
             if self._admissible_locked(now):
                 return 0.0
             self._waiting += 1
@@ -140,11 +164,18 @@ class ResourceGroup:
                     scope.check()  # cancelled while throttled
                 now = time.monotonic()
                 with mu:
+                    self._last_arrival = now
                     if self._admissible_locked(now):
                         return (now - t0) * 1000.0
                 if now - t0 >= max_wait_s:
                     wait_ms = (now - t0) * 1000.0
                     with mu:
+                        # never throttle on priority alone: a group the
+                        # weighted-fair gate kept holding back passes
+                        # through at the wait bound if its tokens allow
+                        if self._admissible_locked(
+                                now, skip_priority=True):
+                            return wait_ms
                         self._throttled += 1
                     REGISTRY.inc("resgroup_throttled_total")
                     REGISTRY.inc(
@@ -182,6 +213,7 @@ class ResourceGroup:
                 "ru_per_sec": self.ru_per_sec,
                 "burstable": self.burstable,
                 "query_limit_ms": self.query_limit_ms,
+                "priority": self.priority,
                 "tokens": round(self._tokens, 3),
                 "waiting": self._waiting,
                 "consumed_ru": round(self._consumed, 3),
@@ -198,6 +230,9 @@ class ResourceGroupRegistry:
         self._groups: Dict[str, ResourceGroup] = {}
         self._bindings: Dict[str, str] = {}  # user -> group name
         self._groups[DEFAULT_GROUP] = ResourceGroup(DEFAULT_GROUP, self)
+        self._plane = None  # coord plane for definition replication
+        self._applied_version = 0  # last shared-store version applied
+        self._vclock = 0.0  # weighted-fair virtual clock (SFQ)
 
     # callers hold self._mu
     def _tokenful_waiters_locked(self, skip: ResourceGroup) -> bool:
@@ -208,9 +243,37 @@ class ResourceGroupRegistry:
                 return True
         return False
 
+    def _priority_turn_locked(self, g: ResourceGroup,
+                              now: float) -> bool:
+        """Weighted-fair admission order (start-time fair queueing over
+        unit chunks): a request's start tag is max(virtual clock, the
+        group's finish tag), each admitted chunk advances the finish
+        tag by 1/PRIORITY, and a group dispatches only while no
+        *contending* group holds a smaller start tag — so under
+        sustained contention admissions track the priority ratio, and a
+        group re-arriving after idling starts AT the clock (no banked
+        virtual credit).  The gate is inert unless some contending
+        group carries a DIFFERENT priority — equal-priority fleets keep
+        the original FIFO+token behavior bit-for-bit, and a group
+        running alone never pays the gate."""
+        contenders = [o for o in self._groups.values()
+                      if o is not g and (
+                          o._waiting > 0
+                          or now - o._last_arrival <= _CONTEND_S)]
+        if not any(o.priority != g.priority for o in contenders):
+            return True
+        tag = max(self._vclock, g._vtime)
+        for o in contenders:
+            if max(self._vclock, o._vtime) + 1e-9 < tag:
+                return False  # someone further behind goes first
+        g._vtime = tag + 1.0 / g.priority
+        self._vclock = tag
+        return True
+
     # ---- DDL surface -----------------------------------------------------
     def create(self, name: str, ru_per_sec: int = 0,
                burstable: bool = False, query_limit_ms: int = 0,
+               priority: int = 1,
                if_not_exists: bool = False) -> ResourceGroup:
         with self._mu:
             g = self._groups.get(name)
@@ -220,13 +283,14 @@ class ResourceGroupRegistry:
                 raise ValueError(
                     f"resource group {name!r} already exists")
             g = ResourceGroup(name, self, ru_per_sec, burstable,
-                              query_limit_ms)
+                              query_limit_ms, priority)
             self._groups[name] = g
             return g
 
     def alter(self, name: str, ru_per_sec: Optional[int] = None,
               burstable: Optional[bool] = None,
-              query_limit_ms: Optional[int] = None) -> ResourceGroup:
+              query_limit_ms: Optional[int] = None,
+              priority: Optional[int] = None) -> ResourceGroup:
         with self._mu:
             g = self._groups.get(name)
             if g is None:
@@ -241,6 +305,8 @@ class ResourceGroupRegistry:
                 g.burstable = bool(burstable)
             if query_limit_ms is not None:
                 g.query_limit_ms = int(query_limit_ms)
+            if priority is not None:
+                g.priority = max(1, int(priority))
             return g
 
     def drop(self, name: str, if_exists: bool = False):
@@ -261,6 +327,107 @@ class ResourceGroupRegistry:
                 raise KeyError(group)
             self._bindings[user] = group
 
+    # ---- coord-plane replication (ISSUE 18 lifecycle (e)) ----------------
+    def attach_plane(self, plane) -> None:
+        """Opt this registry into fleet-wide definition replication:
+        DDL publishes the full definition set into the coord plane's
+        versioned shared store (it rides the membership broadcast), and
+        `resolve` pulls newer versions before binding a statement.
+        Detached registries (the default, and every standalone test
+        domain) never touch the process-global plane."""
+        self._plane = plane
+
+    def defs_snapshot(self) -> dict:
+        """The replicable definition state: quotas and bindings only —
+        live token balances, debt and counters are per-host runtime
+        state and never travel."""
+        with self._mu:
+            return {
+                "groups": [
+                    {"name": g.name, "ru_per_sec": g.ru_per_sec,
+                     "burstable": g.burstable,
+                     "query_limit_ms": g.query_limit_ms,
+                     "priority": g.priority}
+                    for g in self._groups.values()],
+                "bindings": dict(self._bindings),
+            }
+
+    def publish(self) -> int:
+        """Push this registry's definitions into the shared store
+        (called from the DDL path after a successful mutation).  The
+        publisher immediately adopts the version it wrote so its own
+        next resolve() does not re-apply the echo."""
+        plane = self._plane
+        if plane is None:
+            return 0
+        doc = self.defs_snapshot()
+        ver = plane.shared_put("resgroups", doc)
+        with self._mu:
+            if ver > self._applied_version:
+                self._applied_version = ver
+        REGISTRY.inc("resgroup_defs_published_total")
+        return ver
+
+    def maybe_sync(self) -> None:
+        """Adopt newer fleet definitions if any arrived.  The common
+        path is one integer compare against the plane's local shared
+        cache — no RPC, no registry lock — so calling this on every
+        statement-scope bind is free."""
+        plane = self._plane
+        if plane is None:
+            return
+        with self._mu:
+            applied = self._applied_version
+        try:
+            if plane.shared_version("resgroups") <= applied:
+                return
+            doc, ver = plane.shared_get("resgroups")
+        except Exception:
+            REGISTRY.inc("resgroup_sync_errors_total")
+            return
+        if not isinstance(doc, dict):
+            return
+        with self._mu:
+            if ver <= self._applied_version:
+                return  # raced another sync
+            self._apply_defs_locked(doc)
+            self._applied_version = ver
+        REGISTRY.inc("resgroup_defs_applied_total")
+
+    def _apply_defs_locked(self, doc: dict) -> None:
+        """Converge on the published definition set idempotently:
+        update-in-place preserves live token balances and debt (a
+        replicated ALTER must not hand every host a fresh bucket),
+        absent groups are dropped, the default group survives with its
+        replicated quota."""
+        seen = set()
+        for spec in doc.get("groups") or []:
+            name = str(spec.get("name") or "")
+            if not name:
+                continue
+            seen.add(name)
+            g = self._groups.get(name)
+            if g is None:
+                self._groups[name] = ResourceGroup(
+                    name, self, spec.get("ru_per_sec") or 0,
+                    bool(spec.get("burstable")),
+                    spec.get("query_limit_ms") or 0,
+                    spec.get("priority") or 1)
+                continue
+            new_ru = int(spec.get("ru_per_sec") or 0)
+            if new_ru != g.ru_per_sec:
+                g.ru_per_sec = new_ru
+                g._tokens = min(g._tokens, float(new_ru))
+                g._last_refill = time.monotonic()
+            g.burstable = bool(spec.get("burstable"))
+            g.query_limit_ms = int(spec.get("query_limit_ms") or 0)
+            g.priority = max(1, int(spec.get("priority") or 1))
+        seen.add(DEFAULT_GROUP)
+        for name in [n for n in self._groups if n not in seen]:
+            del self._groups[name]
+        self._bindings = {str(u): str(gn) for u, gn in
+                          (doc.get("bindings") or {}).items()}
+
     # ---- resolution ------------------------------------------------------
     def get(self, name: str) -> Optional[ResourceGroup]:
         with self._mu:
@@ -272,6 +439,7 @@ class ResourceGroupRegistry:
         the user binding, then default.  Unknown names fall back to
         default rather than failing the statement — a dropped group
         must not break every bound session."""
+        self.maybe_sync()  # adopt newer fleet definitions first
         with self._mu:
             name = sysvar or self._bindings.get(
                 user.split("@", 1)[0], "") or DEFAULT_GROUP

@@ -78,6 +78,10 @@ LOCK_RANKS: Dict[str, int] = {
     "catalog.catalog:Catalog._mu": 80,
     "statistics.handle:StatsHandle._mu": 90,
     "statistics.feedback:QueryFeedback._mu": 95,
+    # the shard manager sits IN FRONT of the storage band: re-shard
+    # attaches partition stores (rank 100/110) while holding it, and it
+    # is never held across a dispatch
+    "dataplane.shard:Dataplane._mu": 97,
     # ---- storage engine --------------------------------------------------
     "store.storage:BlockStorage._mu": 100,
     "store.blockstore:TableStore._mu": 110,

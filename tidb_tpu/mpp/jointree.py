@@ -180,11 +180,16 @@ def _slot_resolver(spec: MPPJoinTreeSpec, states, n_slots: int,
 
 def _build_rung_fn(spec: MPPJoinTreeSpec, r: int, states, mesh, mode: str,
                    n_in: int, cap_p: int, cap_b: int, cap_out: int,
-                   conds_rw):
+                   conds_rw, elide_probe: bool = False):
     """One rung's shard_map program.  Inputs: the intermediate arrays
     (rung 0 builds them inline from side 0's scan) + the build side's
     cached scan columns.  Outputs: the NEXT intermediate (still sharded,
-    still on device) + overflow scalars."""
+    still on device) + overflow scalars.
+
+    `elide_probe` (ISSUE 18 jointree (e)): the caller proved the
+    intermediate is ALREADY hash-partitioned by this rung's key slots
+    (the previous shuffle rung used the same ones), so the probe side
+    skips pack+all-to-all and only the build side exchanges."""
     rung = spec.rungs[r]
     S = len(mesh.devices.ravel())
     bs = states[rung.side]
@@ -241,13 +246,15 @@ def _build_rung_fn(spec: MPPJoinTreeSpec, r: int, states, mesh, mode: str,
         for d, v in slots:
             p_arrays.append(d)
             p_arrays.append(v)
-        if mode == "shuffle":
+        if mode == "shuffle" and not elide_probe:
             ppid = ex.partition_ids(jnp.where(kv, mix, 0), S)
             bucketed, pval, p_over = ex.pack_buckets(
                 ppid, psel, S, cap_p, p_arrays)
             recv = [ex.exchange(a) for a in bucketed]
             p_ok = ex.exchange(pval)
-        else:  # broadcast rung: the intermediate stays local
+        else:  # broadcast rung, or residency-elided re-shuffle: the
+            # intermediate stays local (for elision the build side
+            # below still exchanges — equal keys already co-reside)
             recv = p_arrays
             p_ok = psel
             p_over = jnp.int64(0)
@@ -726,16 +733,27 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
     inter = None     # flat (data, valid) arrays per slot
     mask = None
     n_in = states[0].n_local
+    # key-slot tuples the intermediate is hash-partitioned by (empty
+    # until the first shuffle rung: rung 0's input is range-partitioned)
+    residency: set = set()
     base_fp = (f"mpptree|S={S} devs={mesh_ids}"
                f"|base:{_fingerprint(states[0].an, 'filter')}"
                f"|Tl={states[0].Tl}|wire={states[0].wire_sig}")
     for r, rung in enumerate(spec.rungs):
         bs = states[rung.side]
         mode = modes[r]
+        # residency elision (ISSUE 18 jointree (e)): a shuffle rung
+        # whose key slots match the partitioning the PREVIOUS shuffle
+        # rung left behind skips the probe-side exchange entirely —
+        # equal keys (and bucket-0 NULL keys) already co-reside, so
+        # only the build side moves
+        elide = (mode == "shuffle" and inter is not None
+                 and tuple(rung.left_slots) in residency)
         cap_p = min(_pow2ceil(int(slack * n_in / S) + 1), max(n_in, 16))
         cap_b = min(_pow2ceil(int(slack * bs.n_local / S) + 1),
                     bs.n_local)
-        n_recv = S * cap_p if mode == "shuffle" else n_in
+        n_recv = (S * cap_p if mode == "shuffle" and not elide
+                  else n_in)
         # emission buffer sized by the planner's rung estimate (whole
         # result could land on ONE shard when the base side is a single
         # tile), then boosted ×4 per runtime overflow
@@ -746,14 +764,15 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
         fp = (base_fp
               + f"|r{r}|{mode}|{rung.kind}|n_in={n_in}"
               f"|caps={cap_p},{cap_b},{cap_out}"
-              f"|lk={rung.left_slots}"
+              f"|lk={rung.left_slots}|el={int(elide)}"
               f"|b:{_fingerprint(bs.an, 'filter')}|Tl={bs.Tl}"
               f"|k={rung.build_key_pos}|wire={bs.wire_sig}"
               f"|oc={conds_sig}")
         fn = _COMPILED.get(fp)
         if fn is None:
             fn = _build_rung_fn(spec, r, states, mesh, mode, n_in,
-                                cap_p, cap_b, cap_out, rung_conds[r])
+                                cap_p, cap_b, cap_out, rung_conds[r],
+                                elide_probe=elide)
             _COMPILED.put(fp, fn)
         FAILPOINTS.hit(TREE_FAILPOINT, rung=r, mode=mode,
                        kind=rung.kind, device_ids=mesh_ids)
@@ -773,7 +792,7 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
         scope_check()
         t0 = _time.perf_counter()
         with span("mpp.rung", idx=r, rung=mode, kind=rung.kind,
-                  build_table=bs.side.table_id):
+                  elided=int(elide), build_table=bs.side.table_id):
             with dispatch_admission(DISPATCH_LOCK):
                 overflow, jover, out_slots, keep = fn(*args)
             overflow, jover = int(overflow), int(jover)
@@ -794,6 +813,23 @@ def _run_tree_once(storage, spec: MPPJoinTreeSpec, modes: List[str],
         n_in = (n_recv if rung.kind in ("semi", "anti_semi")
                 else cap_out)
         REGISTRY.inc("mpp_tree_rungs_total")
+        if elide:
+            REGISTRY.inc("mpp_tree_reshuffle_elided_total")
+        if mode == "shuffle":
+            # rows now co-reside hashed by this rung's key; for inner
+            # rungs the appended build key columns carry the SAME
+            # values (the planner canonicalizes later rungs onto any
+            # member of the equality class), so they name the layout
+            # too.  NOT valid for left_outer — unmatched rows carry
+            # NULL build keys that a real shuffle would send to bucket
+            # 0.  Broadcast rungs never move the probe side, so any
+            # earlier residency still holds.
+            residency = {tuple(rung.left_slots)}
+            if rung.kind == "inner":
+                base = _slots_of_prefix(spec, r)
+                order = list(bs.col_order)
+                residency.add(tuple(base + order.index(kp)
+                                    for kp in rung.build_key_pos))
 
     from ..copr.device_health import DEVICE_HEALTH
 

@@ -565,6 +565,7 @@ class ResourceGroupStmt(Stmt):
     ru_per_sec: Optional[int] = None
     burstable: Optional[bool] = None
     query_limit_ms: Optional[int] = None
+    priority: Optional[int] = None
     if_not_exists: bool = False
     if_exists: bool = False
 

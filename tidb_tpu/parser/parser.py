@@ -1026,13 +1026,18 @@ class Parser:
 
     def _parse_resgroup_options(self, st: "ast.ResourceGroupStmt"):
         """RU_PER_SEC = n | BURSTABLE [= TRUE|FALSE] |
-        QUERY_LIMIT = n | QUERY_LIMIT = (EXEC_ELAPSED = n), in any
-        order, optionally comma-separated (TiDB resource-control
-        grammar, with the limit in device-milliseconds)."""
+        PRIORITY = n | QUERY_LIMIT = n |
+        QUERY_LIMIT = (EXEC_ELAPSED = n), in any order, optionally
+        comma-separated (TiDB resource-control grammar, with the limit
+        in device-milliseconds and the priority a weighted-fair
+        admission weight)."""
         while True:
             if self.accept_kw("ru_per_sec"):
                 self.accept_op("=")
                 st.ru_per_sec = int(self.next().value)
+            elif self.accept_kw("priority"):
+                self.accept_op("=")
+                st.priority = int(self.next().value)
             elif self.accept_kw("burstable"):
                 if self.accept_op("="):
                     st.burstable = self.next().value.lower() in (

@@ -77,6 +77,41 @@ def _resgroups_section(domain) -> dict:
         return {"error": repr(e)}
 
 
+def _dataplane_section(domain) -> dict:
+    """Sharded data plane (ISSUE 18): the host's partition map (epoch,
+    owners, members), per-table shard state, and the exchange/re-shard
+    counters that the 2-host bench receipt reads."""
+    try:
+        from ..dataplane import get_dataplane
+        from ..metrics import REGISTRY
+
+        dp = get_dataplane(domain.storage)
+        snap = REGISTRY.snapshot()
+        out = {"active": dp is not None}
+        if dp is not None:
+            out.update(dp.snapshot())
+        out["metrics"] = {
+            name: snap.get(name, 0)
+            for name in (
+                "dataplane_queries_total",
+                "dataplane_local_fragments_total",
+                "dataplane_remote_fragments_total",
+                "dataplane_exchange_bytes_total",
+                "dataplane_partitions_scanned_total",
+                "dataplane_partitions_loaded_total",
+                "dataplane_partitions_moved_total",
+                "dataplane_reshards_total",
+                "dataplane_epoch_retries_total",
+                "dataplane_bypass_total",
+                "dataplane_peer_lost_total",
+                "dataplane_errors_total",
+            )
+        }
+        return out
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": repr(e)}
+
+
 def _slo_section(domain) -> dict:
     """Per-statement-class SLO state (ISSUE 13): threshold, error-budget
     burn counters and latency quantiles from the log2 histograms."""
@@ -286,6 +321,9 @@ class StatusServer:
                         # resource groups (ISSUE 17): token balances,
                         # waiters, lifetime RU and throttle counts
                         "resgroups": _resgroups_section(domain),
+                        # sharded data plane (ISSUE 18): partition map,
+                        # shard state, exchange/re-shard counters
+                        "dataplane": _dataplane_section(domain),
                     }).encode()
                     self._send(200, body, "application/json")
                     return

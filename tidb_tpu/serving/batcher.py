@@ -35,7 +35,7 @@ import numpy as np
 from ..errors import TiDBTPUError
 from ..metrics import REGISTRY
 from ..store.fault import FAILPOINTS
-from ..util_concurrency import make_lock
+from ..util_concurrency import make_lock, witness_wait_check
 
 log = logging.getLogger("tidb_tpu.serving")
 
@@ -124,7 +124,7 @@ class MicroBatcher:
             left = deadline - time.monotonic()
             if left <= 0:
                 break
-            g.full.wait(min(left, 0.02))
+            self._window_wait(g, min(left, 0.02))
         with self._mu:
             g.closed = True
             if self._groups.get(key) is g:
@@ -153,10 +153,22 @@ class MicroBatcher:
                 m.event.set()
         return self._await(member, t0)
 
+    def _window_wait(self, g: "_Group", timeout_s: float):
+        """The leader's batching-window park: the registry mutex (or any
+        ranked lock) held here would stall every statement sharing the
+        lock for a full window — the wait-witness trips instead."""
+        witness_wait_check("MicroBatcher group.full.wait")
+        g.full.wait(timeout_s)
+
+    def _member_wait(self, member: "_Member") -> bool:
+        """One poll tick of a parked member (scope-interruptible)."""
+        witness_wait_check("MicroBatcher member.event.wait")
+        return member.event.wait(0.02)
+
     def _await(self, member: _Member, t0: int):
         # scope-interruptible park: a killed/deadline member unblocks at
         # the next poll tick instead of waiting out the batch
-        while not member.event.wait(0.02):
+        while not self._member_wait(member):
             if member.scope.cancelled():
                 member.wait_ns = time.perf_counter_ns() - t0
                 raise member.scope.error()

@@ -21,6 +21,7 @@ import time
 
 from ..metrics import REGISTRY
 from ..store.oracle import compose_ts
+from ..util_concurrency import witness_wait_check
 
 log = logging.getLogger("tidb_tpu.maintenance")
 
@@ -49,8 +50,16 @@ class MaintenanceWorker:
             self._thread.join(timeout=2)
             self._thread = None
 
+    def _idle_wait(self) -> bool:
+        """Park until the next tick or stop.  A held-lock park would
+        starve whoever needs that lock for a whole interval, so the
+        wait-witness guards the site (tests call this directly under a
+        deliberately held lock to pin the negative)."""
+        witness_wait_check("MaintenanceWorker._stop.wait")
+        return self._stop.wait(self.interval_s)
+
     def _loop(self):
-        while not self._stop.wait(self.interval_s):
+        while not self._idle_wait():
             try:
                 self.tick()
             except Exception:

@@ -458,6 +458,7 @@ class Session:
             except KeyError:
                 raise ExecutorError(
                     f"unknown resource group {s.group!r}")
+            self.domain.resgroups.publish()  # bindings replicate too
             return ResultSet()
         if isinstance(s, ast.LockTablesStmt):
             return self._run_lock_tables(s)
@@ -798,6 +799,7 @@ class Session:
             if auto:
                 self.commit()
             rows = []
+            op_samples = []
             for nm, est, task, info in phys.explain_tree():
                 st = ctx.stats.get(_plan_id_of(nm))
                 extra = ""
@@ -806,7 +808,16 @@ class Session:
                              f"time:{st.time_ns/1e6:.2f}ms")
                     if st.engine:
                         extra += f" engine:{st.engine}"
+                    op_id = nm.lstrip(" ").lstrip("└─")
+                    depth = (len(nm) - len(nm.lstrip(" "))) // 2
+                    op_samples.append((depth, op_id, st.time_ns))
                 rows.append((nm, est, task, info, extra))
+            # operator sampling (ISSUE 18): EXPLAIN ANALYZE runs feed
+            # their per-operator self-times into the continuous
+            # profiler, so flame frames carry plan operator ids
+            from ..trace.profiler import PROFILER
+
+            PROFILER.fold_explain(op_samples)
             # per-statement HBM high-water attribution (ISSUE 13): the
             # dispatch sites stamp resident device bytes on the execute
             # spans; surface the peak on the root operator's line
@@ -1515,17 +1526,23 @@ class Session:
                 reg.create(s.name, ru_per_sec=s.ru_per_sec or 0,
                            burstable=bool(s.burstable),
                            query_limit_ms=s.query_limit_ms or 0,
+                           priority=s.priority or 1,
                            if_not_exists=s.if_not_exists)
             elif s.kind == "alter":
                 reg.alter(s.name, ru_per_sec=s.ru_per_sec,
                           burstable=s.burstable,
-                          query_limit_ms=s.query_limit_ms)
+                          query_limit_ms=s.query_limit_ms,
+                          priority=s.priority)
             else:
                 reg.drop(s.name, if_exists=s.if_exists)
         except KeyError:
             raise ExecutorError(f"unknown resource group {s.name!r}")
         except ValueError as e:
             raise ExecutorError(str(e))
+        # fleet replication (ISSUE 18): a registry attached to the
+        # coord plane pushes the new definition set into the shared
+        # store so every member's next resolve() adopts it
+        reg.publish()
         return ResultSet()
 
     def _run_lock_tables(self, s) -> ResultSet:

@@ -144,21 +144,56 @@ class Profiler:
             self_ns = dur
         self_us = self_ns // 1000
         if self_us > 0 or not s.children:
-            key = stack
-            rec = paths.get(key)
-            if rec is None:
-                if len(paths) >= self.max_paths:
-                    # bounded path table: long-tail stacks fold into one
-                    # overflow frame instead of growing without limit
-                    key = "<other>"
-                    rec = paths.setdefault(key, [0, 0])
-                else:
-                    rec = paths[key] = [0, 0]
-            rec[0] += self_us
-            rec[1] += 1
+            self._bump_locked(paths, stack, self_us)
         if recurse:
             for c in s.children:
                 self._walk(c, stack, paths, depth + 1)
+
+    def _bump_locked(self, paths: dict, key: str, us: int):
+        rec = paths.get(key)
+        if rec is None:
+            if len(paths) >= self.max_paths:
+                # bounded path table: long-tail stacks fold into one
+                # overflow frame instead of growing without limit
+                key = "<other>"
+                rec = paths.setdefault(key, [0, 0])
+            else:
+                rec = paths[key] = [0, 0]
+        rec[0] += us
+        rec[1] += 1
+
+    # ---- operator sampling (ISSUE 18 trace (a)) -------------------------
+    def fold_explain(self, ops):
+        """Fold one EXPLAIN ANALYZE run's operator stats into the
+        current window: `ops` is [(depth, operator_id, inclusive_ns)]
+        in pre-order, stacks become root-to-operator id chains
+        (``op:HashAgg_3;op:TableReader_5``) weighted by SELF time
+        (inclusive minus direct children) — so /flame and the profile
+        memtable carry the planner's operator ids alongside the
+        span-path stacks, attributing window time to plan shape."""
+        if not self.enabled or not ops:
+            return
+        n = len(ops)
+        frames: List[str] = []
+        now = time.time()
+        with self._mu:
+            w = self._current_locked(now)
+            for i, (depth, op_id, inc_ns) in enumerate(ops):
+                del frames[depth:]
+                frames.append(f"op:{op_id}")
+                child_ns = 0
+                for d2, _o2, ns2 in ops[i + 1:n]:
+                    if d2 <= depth:
+                        break
+                    if d2 == depth + 1:
+                        child_ns += ns2
+                self_us = max(inc_ns - child_ns, 0) // 1000
+                is_leaf = i + 1 >= n or ops[i + 1][0] <= depth
+                if self_us > 0 or is_leaf:
+                    self._bump_locked(
+                        w["paths"], ";".join(frames[:MAX_STACK_DEPTH]),
+                        self_us)
+        REGISTRY.inc("profile_op_samples_total")
 
     # ---- reads ----------------------------------------------------------
     def _merged_locked(self) -> Dict[str, list]:
