@@ -1102,6 +1102,68 @@ def dataplane_bench(n: int) -> dict:
     log(f"dataplane scan: 1-host {out['one_host_rows_per_sec']:.0f} "
         f"rows/s vs 2-host {out['two_host_rows_per_sec']:.0f} rows/s, "
         f"{out['exchange_bytes_per_query']:.0f} exchange B/query")
+
+    # ---- kill-recovery leg (ISSUE 20): RF=1 cold replay vs RF=2 ---------
+    # replica promotion.  One member leaves mid-steady-state; the
+    # receipt is the survivor's first post-loss query (re-shard
+    # included) — the time replication buys back on the critical path.
+    n_k = min(n, 16_384)
+    out["kill_recovery"] = {"rows": n_k}
+    for rf in (1, 2):
+        with tempfile.TemporaryDirectory() as td:
+            sA = build_lineitem(n_k)
+            sB = build_lineitem(n_k)
+            coord = Coordinator(port=0, lease_s=4.0, expect=2, self_pid=0)
+            host, port = coord.start()
+            cp = CoordinatorPlane(coord, pid=0).start((0,))
+            wp = WorkerPlane(f"{host}:{port}", 1, lease_s=4.0).start((1,))
+            _until(lambda: cp.view().formed
+                   and len(cp.view().members) == 2)
+            dpA = activate_dataplane(sA.domain.storage, plane=cp, pid=0,
+                                     data_dir=os.path.join(td, "k"),
+                                     rf=rf)
+            dpB = activate_dataplane(sB.domain.storage, plane=wp, pid=1,
+                                     data_dir=os.path.join(td, "k"),
+                                     rf=rf)
+            _until(lambda: len(cp.view().addrs) == 2
+                   and len(wp.view().addrs) == 2)
+            dpA.shard_table(_tid(sA))
+            dpB.shard_table(_tid(sB))
+            try:
+                sA.execute("set tidb_use_tpu = 1")
+                sA.execute(Q6)  # warm steady state
+                p0 = REGISTRY.get(
+                    "dataplane_replica_promotions_total") or 0.0
+                c0 = REGISTRY.get("dataplane_cold_reloads_total") or 0.0
+                wp.stop(leave=True)
+                deactivate_dataplane(sB.domain.storage)
+                _until(lambda: 1 not in cp.view().members)
+                t0 = time.perf_counter()
+                sA.execute(Q6)  # triggers the survivor's re-shard
+                rec_s = time.perf_counter() - t0
+            finally:
+                deactivate_dataplane(sA.domain.storage)
+                try:
+                    wp.stop(leave=True)
+                except Exception:  # noqa: BLE001 — already left
+                    pass
+                cp.stop()
+            out["kill_recovery"][f"rf{rf}"] = {
+                "recovery_s": round(rec_s, 4),
+                "promotions": int((REGISTRY.get(
+                    "dataplane_replica_promotions_total") or 0.0) - p0),
+                "cold_reloads": int((REGISTRY.get(
+                    "dataplane_cold_reloads_total") or 0.0) - c0),
+            }
+    kr = out["kill_recovery"]
+    if kr.get("rf1") and kr.get("rf2") and kr["rf2"]["recovery_s"]:
+        kr["rf2_speedup_x"] = round(
+            kr["rf1"]["recovery_s"] / kr["rf2"]["recovery_s"], 2)
+    log(f"dataplane kill-recovery: rf1 {kr['rf1']['recovery_s']*1e3:.0f}ms"
+        f" ({kr['rf1']['cold_reloads']} cold) vs rf2 "
+        f"{kr['rf2']['recovery_s']*1e3:.0f}ms "
+        f"({kr['rf2']['promotions']} promotions, "
+        f"{kr['rf2']['cold_reloads']} cold)")
     return out
 
 

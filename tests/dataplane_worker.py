@@ -76,11 +76,12 @@ def main() -> int:
                             lease_s=lease_s)
     dp = activate_dataplane(dom.storage, plane=plane, pid=pid)
 
-    # shard once the fleet FORMED and both fragment endpoints are
+    # shard once the fleet FORMED and every fragment endpoint is
     # advertised — ownership derived pre-formation would flap
+    expect = int(os.environ.get("COORD_EXPECT", "2"))
     while time.monotonic() - t0 < 30:
         v = plane.view()
-        if v.formed and len(v.members) >= 2 and len(v.addrs) >= 2:
+        if v.formed and len(v.members) >= expect and len(v.addrs) >= expect:
             break
         time.sleep(0.05)
     dp.shard_table(tid)
@@ -123,8 +124,12 @@ def main() -> int:
                 ok = 0
                 print(f"MISMATCH pid={pid} q={name}", flush=True)
         dp_used = int((REGISTRY.get("dataplane_queries_total") or 0) - d0)
+        promote = int(REGISTRY.get("dataplane_replica_promotions_total")
+                      or 0)
+        cold = int(REGISTRY.get("dataplane_cold_reloads_total") or 0)
         print(f"ROUND pid={pid} n={rounds} epoch={plane.current_epoch()} "
-              f"ok={ok} dp={dp_used}", flush=True)
+              f"ok={ok} dp={dp_used} promote={promote} cold={cold}",
+              flush=True)
         rounds += 1
         time.sleep(0.05)
 

@@ -339,6 +339,39 @@ def test_chaos_sweep_write_and_ddl_path():
     _assert_no_leaks(d)
 
 
+def test_chaos_2pc_decision_point_runs_to_completion():
+    """Past 2pc/before_commit_primary the transaction is DECIDED: a
+    kill landing at that seam must not abort phase 2 — the primary and
+    every secondary (2pc/commit_secondary) still commit, primary
+    first."""
+    from tidb_tpu.errors import QueryKilledError
+
+    d = Domain()
+    d.maintenance.stop()
+    s = d.new_session()
+    s.execute("create table p2 (a bigint primary key, b bigint)")
+    order = []
+
+    def at_decision(**ctx):
+        order.append("decide")
+        s.cancel_query("killed")  # lands AT the decision point: too late
+
+    def at_secondary(**ctx):
+        order.append("secondary")
+
+    with failpoint("2pc/before_commit_primary", at_decision):
+        with failpoint("2pc/commit_secondary", at_secondary):
+            try:
+                s.execute("insert into p2 values (1,10), (2,20), (3,30)")
+            except QueryKilledError:
+                pass  # the statement may unwind at a LATER seam...
+    # ...but the commit itself ran to completion, in decision order
+    assert order == ["decide", "secondary", "secondary"], order
+    assert s.query("select a, b from p2 order by a") == \
+        [(1, 10), (2, 20), (3, 30)]
+    _assert_no_leaks(d)
+
+
 # ---------------------------------------------------------------------------
 # mpp/exchange: the eighth chaos site (device failure mid-shuffle)
 # ---------------------------------------------------------------------------

@@ -179,8 +179,7 @@ def test_dataplane_bypasses_on_dml_delta():
 # two in-process members: real exchange, survivor re-shard, chaos site
 # ---------------------------------------------------------------------------
 
-@pytest.fixture
-def two_member_fleet(tmp_path):
+def _fleet(tmp_path, rf=None):
     """Coordinator member (pid 0) + worker member (pid 1), each with its
     own Domain holding the SAME deterministic lineitem build — the
     in-process model of two hosts that loaded the same base table."""
@@ -192,9 +191,9 @@ def two_member_fleet(tmp_path):
     wp = WorkerPlane(f"{host}:{port}", 1, lease_s=4.0).start((1,))
     _wait(lambda: cp.view().formed and len(cp.view().members) == 2)
     dpA = activate_dataplane(sA.domain.storage, plane=cp, pid=0,
-                             data_dir=str(tmp_path))
+                             data_dir=str(tmp_path), rf=rf)
     dpB = activate_dataplane(sB.domain.storage, plane=wp, pid=1,
-                             data_dir=str(tmp_path))
+                             data_dir=str(tmp_path), rf=rf)
     _wait(lambda: len(cp.view().addrs) == 2 and len(wp.view().addrs) == 2)
     try:
         yield sA, sB, cp, wp, dpA, dpB
@@ -206,6 +205,18 @@ def two_member_fleet(tmp_path):
         except Exception:
             pass
         cp.stop()
+
+
+@pytest.fixture
+def two_member_fleet(tmp_path):
+    yield from _fleet(tmp_path)
+
+
+@pytest.fixture
+def two_member_fleet_rf1(tmp_path):
+    """RF=1 fleet: the PR-18 behavior — no warm replicas, so a member
+    loss MUST replay orphaned partitions from the cold tier."""
+    yield from _fleet(tmp_path, rf=1)
 
 
 def _wait(pred, timeout=10.0):
@@ -224,10 +235,15 @@ def test_two_member_exchange_parity_and_survivor_reshard(two_member_fleet):
     oracle1 = _oracle(sA, Q1)
     stA = dpA.shard_table(tid)
     stB = dpB.shard_table(tid)
-    # ownership is a partition (disjoint cover) across the two members
-    assert set(stA.loaded).isdisjoint(stB.loaded)
-    assert sorted(set(stA.loaded) | set(stB.loaded)) == \
-        list(range(stA.n_parts))
+    # PRIMARY ownership is a partition (disjoint cover) across the two
+    # members; each member materializes every chain slot it holds (at
+    # RF=2 over 2 hosts, that is everything — warm replicas, not owners)
+    pmap = dpA.sync()
+    primA, primB = set(pmap.owned_by(0)), set(pmap.owned_by(1))
+    assert primA.isdisjoint(primB)
+    assert sorted(primA | primB) == list(range(stA.n_parts))
+    assert sorted(stA.loaded) == sorted(pmap.replica_of(0))
+    assert sorted(stB.loaded) == sorted(pmap.replica_of(1))
 
     before_remote = _cnt("dataplane_remote_fragments_total")
     before_bytes = _cnt("dataplane_exchange_bytes_total")
@@ -249,11 +265,17 @@ def test_two_member_exchange_parity_and_survivor_reshard(two_member_fleet):
     assert cp.view().epoch > epoch_before
     before_reshard = _cnt("dataplane_reshards_total")
     before_q = _cnt("dataplane_queries_total")
+    before_promote = _cnt("dataplane_replica_promotions_total")
+    before_cold = _cnt("dataplane_cold_reloads_total")
     assert sA.execute(Q6)[0].rows == oracle6
     assert _cnt("dataplane_reshards_total") == before_reshard + 1
     assert _cnt("dataplane_queries_total") == before_q + 1
-    # the survivor now owns (and materialized) every partition
+    # the survivor now owns (and materialized) every partition — and at
+    # RF=2 it already HELD the dead member's partitions as warm
+    # replicas, so the takeover is pure promotion: zero cold reloads
     assert sorted(stA.loaded) == list(range(stA.n_parts))
+    assert _cnt("dataplane_replica_promotions_total") > before_promote
+    assert _cnt("dataplane_cold_reloads_total") == before_cold
     assert sA.execute(Q1)[0].rows == oracle1
 
 
@@ -282,8 +304,9 @@ def test_reshard_chaos_site_falls_back_then_converges(two_member_fleet):
         list(range(dpA.lookup(tid).n_parts))
 
 
-def test_survivor_reshard_replays_persisted_packed_blocks(two_member_fleet):
-    sA, sB, cp, wp, dpA, dpB = two_member_fleet
+def test_survivor_reshard_replays_persisted_packed_blocks(
+        two_member_fleet_rf1):
+    sA, sB, cp, wp, dpA, dpB = two_member_fleet_rf1
     tid = sA.domain.catalog.info_schema().table("test", "lineitem").id
     oracle = _oracle(sA, GROUPED)
     dpA.shard_table(tid)

@@ -16,7 +16,7 @@ from tidb_tpu.coord.plane import Coordinator
 from tidb_tpu.store.fault import FAILPOINTS
 
 
-def _spawn_worker(pid, port, dp_dir):
+def _spawn_worker(pid, port, dp_dir, rf=1, expect=2):
     import os
 
     env = {k: v for k, v in os.environ.items()
@@ -24,6 +24,8 @@ def _spawn_worker(pid, port, dp_dir):
     env["COORD_LEASE_S"] = "1.5"
     env["COORD_WORKER_MAX_S"] = "150"
     env["TIDB_TPU_DATAPLANE_DIR"] = dp_dir
+    env["TIDB_TPU_DATAPLANE_RF"] = str(rf)
+    env["COORD_EXPECT"] = str(expect)
     worker = os.path.join(os.path.dirname(__file__), "dataplane_worker.py")
     p = subprocess.Popen(
         [sys.executable, worker, str(pid), str(port)],
@@ -123,6 +125,97 @@ def test_two_process_dataplane_shard_and_sigkill_reshard(tmp_path):
         # ---- graceful drain ------------------------------------------
         w0.send_signal(signal.SIGTERM)
         assert _wait_line(l0, lambda s: s.startswith("DRAINED"), 30, (w0,))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        c.stop()
+    time.sleep(0.3)
+    leaked = {t.name for t in threading.enumerate()} - threads_before
+    leaked = {n for n in leaked
+              if n.startswith(("tidb-tpu-coord", "dataplane-rpc"))}
+    assert not leaked, leaked
+    assert FAILPOINTS.armed() == []
+
+
+def _round_counter(s, key):
+    try:
+        return int(s.split(f"{key}=")[1].split()[0])
+    except (IndexError, ValueError):
+        return -1
+
+
+def test_three_process_sigkill_promotes_replica_no_cold_reload(tmp_path):
+    """ISSUE 20 acceptance: RF=2 over 3 processes.  SIGKILL one member
+    mid-query; lease expiry bumps the epoch and the survivors take over
+    its partitions by PROMOTING their warm replicas — promotions > 0,
+    cold reloads == 0 on every survivor — while rounds keep answering
+    with parity THROUGH the dataplane at the bumped epoch."""
+    threads_before = {t.name for t in threading.enumerate()}
+    c = Coordinator(lease_s=1.5, expect=3)
+    c.start()
+    procs = []
+    dp_dir = str(tmp_path)
+    try:
+        workers = []
+        for pid in range(3):
+            w, lines = _spawn_worker(pid, c.port, dp_dir, rf=2, expect=3)
+            procs.append(w)
+            workers.append((w, lines))
+        for w, lines in workers:
+            assert _wait_line(lines, lambda s: s.startswith("READY"), 120,
+                              (w,)), lines[-10:]
+        v = c.view()
+        assert set(v.members) == {0, 1, 2} and v.formed
+        assert set(v.addrs) == {0, 1, 2}, v.addrs
+        # every member materialized MORE than its primaries (replica
+        # slots) but the union still covers the table
+        loads = {}
+        for _w, lines in workers:
+            sh = next(s for s in list(lines) if s.startswith("SHARDED"))
+            loads[int(sh.split("pid=")[1].split()[0])] = (
+                int(sh.split("loaded=")[1].split("/")[0]))
+        total = 8
+        assert all(0 < n <= total for n in loads.values()), loads
+        assert sum(loads.values()) >= total + 1, loads  # replication > 1x
+
+        # dataplane-served parity rounds on every member
+        for w, lines in workers:
+            assert _wait_line(lines, _dp_round, 60, (w,)), lines[-5:]
+
+        # ---- SIGKILL one member mid-query ----------------------------
+        e_before = c.view().epoch
+        procs[2].kill()
+        assert _wait(lambda: 2 not in c.view().members, 15.0), \
+            "lease expiry did not evict the killed worker"
+        v_after = c.view()
+        assert v_after.epoch > e_before
+
+        survivors = workers[:2]
+        for w, lines in survivors:
+            assert _wait_line(
+                lines,
+                lambda s: _dp_round(s) and f"epoch={v_after.epoch}" in s,
+                60, (w,)), lines[-5:]
+            assert not any("ok=0" in s for s in list(lines)), \
+                [s for s in lines if "ok=0" in s]
+            assert not any(s.startswith("MISMATCH") for s in list(lines))
+        # the takeover was replica PROMOTION, not a cold-tier reload:
+        # at least one survivor promoted, and NOBODY reloaded cold
+        post = []
+        for _w, lines in survivors:
+            rounds = [s for s in list(lines)
+                      if _dp_round(s) and f"epoch={v_after.epoch}" in s]
+            post.append(rounds[-1])
+        assert sum(_round_counter(s, "promote") for s in post) > 0, post
+        assert all(_round_counter(s, "cold") == 0 for s in post), post
+
+        # ---- graceful drain ------------------------------------------
+        for w, lines in survivors:
+            w.send_signal(signal.SIGTERM)
+        for w, lines in survivors:
+            assert _wait_line(lines, lambda s: s.startswith("DRAINED"),
+                              30, (w,))
     finally:
         for p in procs:
             if p.poll() is None:

@@ -529,6 +529,80 @@ def test_concur_catches_guarded_attr_read_bare():
         [("lock-guard", "tidb_tpu/mymod.py", 14, "x")]
 
 
+def test_concur_cross_object_guard_catches_unheld_store():
+    """ISSUE 20 satellite: a class declaring `_guarded_by_` puts its
+    instance state under ANOTHER object's lock — plain stores and
+    container-mutator calls through a ctor-typed local must hold it."""
+    from tidb_tpu.lint.concur import lint_source as lint_concur
+
+    src = textwrap.dedent("""
+        from tidb_tpu.util_concurrency import make_lock
+
+        class _Job:
+            _guarded_by_ = "mymod:Plane._mu"
+
+            def __init__(self):
+                self.items = []
+                self.closed = False
+
+        class Plane:
+            def __init__(self):
+                self._mu = make_lock("mymod:Plane._mu")
+                self._jobs = {}
+
+            def good(self, key):
+                with self._mu:
+                    j = _Job()
+                    j.items.append(key)
+                    self._jobs[key] = j
+
+            def bad(self, key):
+                j = _Job()
+                j.closed = True
+                j.items.append(key)
+                with self._mu:
+                    self._jobs[key] = j
+    """)
+    fs = lint_concur(src, "tidb_tpu/mymod.py",
+                     ranks={"mymod:Plane._mu": 1})
+    hits = sorted((f.rule, f.line, f.token) for f in fs)
+    assert hits == [("lock-guard", 24, "_Job.closed"),
+                    ("lock-guard", 25, "_Job.items")], fs
+
+
+def test_concur_cross_object_guard_allows_lockfree_loads():
+    """Loads through a guarded-typed local (the batcher's lock-free
+    Event handshake) never flag; annotated helper args are typed too,
+    and *_locked helpers of the lock's owner count as held."""
+    from tidb_tpu.lint.concur import lint_source as lint_concur
+
+    src = textwrap.dedent("""
+        import threading
+
+        from tidb_tpu.util_concurrency import make_lock
+
+        class _Job:
+            _guarded_by_ = "mymod:Plane._mu"
+
+            def __init__(self):
+                self.items = []
+                self.done = threading.Event()
+
+        class Plane:
+            def __init__(self):
+                self._mu = make_lock("mymod:Plane._mu")
+
+            def peek(self, j: "_Job"):
+                return len(j.items), j.done.is_set()
+
+            def _push_locked(self, j: "_Job", key):
+                j.items.append(key)
+    """)
+    fs = lint_concur(src, "tidb_tpu/mymod.py",
+                     ranks={"mymod:Plane._mu": 1})
+    assert [f for f in fs if f.rule == "lock-guard"] == [], fs
+
+
 def test_concur_catches_wait_whose_notifier_needs_held_lock():
     """ISSUE 17 concurrency (a): a `.wait()` under a held ranked lock
     whose notifier acquires a lock ranked at or below the waiter's is
@@ -594,3 +668,53 @@ def test_concur_pass_runs_in_cli_families():
     assert PASS_RULES["concur"] == (
         "lock-rank", "lock-order", "lock-blocking", "lock-guard",
         "lock-wait")
+
+
+def test_chaoscover_flags_untested_failpoints(tmp_path):
+    """ISSUE 20 satellite: every FAILPOINTS.hit site name must appear
+    in at least one test — literal names, module-level constants and
+    cross-module *_FAILPOINT imports all resolve; computed names are
+    themselves findings."""
+    from tidb_tpu.lint.chaoscover import lint_tree as lint_chaos
+
+    pkg = tmp_path / "tidb_tpu"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "names.py").write_text(
+        'SHARED_FAILPOINT = "store/shared_site"\n')
+    (pkg / "sub" / "mod.py").write_text(textwrap.dedent("""
+        from ..names import SHARED_FAILPOINT
+
+        LOCAL_FP = "store/local_site"
+
+        def f(x):
+            FAILPOINTS.hit("store/covered_site", a=1)
+            FAILPOINTS.hit("store/orphan_site")
+            FAILPOINTS.hit(LOCAL_FP)
+            FAILPOINTS.hit(SHARED_FAILPOINT)
+            FAILPOINTS.hit("x/" + x)
+    """))
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_mod.py").write_text(
+        '# arms store/covered_site and store/shared_site\n')
+    fs = lint_chaos(str(tmp_path))
+    by_token = {f.token: f for f in fs}
+    assert "store/orphan_site" in by_token
+    assert "store/local_site" in by_token  # constant resolved, untested
+    assert "store/covered_site" not in by_token
+    assert "store/shared_site" not in by_token  # cross-module resolved
+    # the computed name is flagged as unresolvable
+    unresolved = [f for f in fs if "not statically" in f.message]
+    assert len(unresolved) == 1
+    # rule family is registered for CLI/baseline staleness
+    from tidb_tpu.lint import PASS_RULES
+
+    assert PASS_RULES["chaos"] == ("chaos-cover",)
+
+
+def test_chaoscover_clean_on_real_tree():
+    """Every failpoint in the shipped tree is swept by some test — the
+    acceptance the chaos archetype rides on (no baseline debt)."""
+    from tidb_tpu.lint.chaoscover import lint_tree as lint_chaos
+
+    assert lint_chaos() == []

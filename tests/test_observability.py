@@ -348,6 +348,73 @@ def test_slo_burn_counters_ride_sysvars(d):
     assert REGISTRY.get("slo_point_breach_total") == b2
 
 
+def test_slo_auto_windows_unit(monkeypatch):
+    """The rolling tracker (ISSUE 20 satellite): min-sample gate,
+    headroom x merged-window p99, window rotation ages samples out."""
+    monkeypatch.setenv("TIDB_TPU_SLO_AUTO_WINDOW_S", "0.1")
+    monkeypatch.setenv("TIDB_TPU_SLO_AUTO_MIN_SAMPLES", "10")
+    monkeypatch.setenv("TIDB_TPU_SLO_AUTO_HEADROOM", "2.0")
+    from tidb_tpu.trace.slo import (
+        SloAutoWindows, is_auto, resolve_threshold_ms)
+
+    w = SloAutoWindows()
+    for _ in range(9):
+        w.observe("point", 4.0)
+    assert w.threshold_ms("point") == 0.0  # under the sample floor
+    w.observe("point", 4.0)
+    # p99 bucket upper edge of 4.0 is 4.0; headroom doubles it
+    assert w.threshold_ms("point") == pytest.approx(8.0)
+    snap = w.snapshot("point")
+    assert snap["samples"] == 10 and snap["p99_ms"] == pytest.approx(4.0)
+    # two rotations (cur -> prev -> gone) age the baseline out
+    import time as _time
+
+    _time.sleep(0.12)
+    w.observe("point", 4.0)  # rotation 1: the 10 samples move to prev
+    assert w.threshold_ms("point") == pytest.approx(8.0)  # still merged
+    _time.sleep(0.12)
+    w.observe("point", 4.0)  # rotation 2: they are gone
+    assert w.threshold_ms("point") == 0.0  # 2 samples < floor
+    # the sysvar-value helpers
+    assert is_auto(" AUTO ") and not is_auto("100")
+    assert resolve_threshold_ms("250", "point") == 250.0
+    assert resolve_threshold_ms("garbage", "point") == 0.0
+
+
+def test_slo_auto_mode_end_to_end(d, monkeypatch):
+    """`set global tidb_tpu_slo_point_ms = 'auto'`: burn accounting
+    stays off during warmup, then breaches against the derived
+    rolling-p99 threshold; /status reports the auto baseline."""
+    monkeypatch.setenv("TIDB_TPU_SLO_AUTO_MIN_SAMPLES", "5")
+    from tidb_tpu.metrics import REGISTRY
+    from tidb_tpu.server.http_status import _slo_section
+    from tidb_tpu.trace.slo import SLO_AUTO
+
+    SLO_AUTO.reset()
+    s = d.new_session()
+    s.execute("set global tidb_tpu_slo_point_ms = 'auto'")
+    b0 = REGISTRY.get("slo_point_breach_total")
+    ok0 = REGISTRY.get("slo_point_ok_total")
+    try:
+        s.query("select 1")  # warmup: under the sample floor
+        assert REGISTRY.get("slo_point_breach_total") == b0
+        assert REGISTRY.get("slo_point_ok_total") == ok0
+        for _ in range(6):  # build the fast baseline past the floor
+            s.query("select 1")
+        ok1 = REGISTRY.get("slo_point_ok_total")
+        assert ok1 > ok0, "warm auto baseline stopped counting ok"
+        sec = _slo_section(d)
+        assert sec["point"]["mode"] == "auto"
+        assert sec["point"]["auto"]["samples"] >= 5
+        assert sec["point"]["threshold_ms"] > 0
+        # a statement far beyond 2x the rolling p99 burns budget
+        s.query("select sleep(0.3)")
+        assert REGISTRY.get("slo_point_breach_total") == b0 + 1
+    finally:
+        s.execute("set global tidb_tpu_slo_point_ms = 100")
+        SLO_AUTO.reset()
+
+
 def test_show_stats_healthy_and_analyze_status(d):
     import time as _time
 

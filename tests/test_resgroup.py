@@ -269,6 +269,67 @@ def test_resgroup_charge_and_refill():
         "resgroup_bronze_ru_consumed_total", 0) >= 400.0
 
 
+def test_dispatch_admission_bills_device_time_not_lock_wait():
+    """RU accounting (ISSUE 20 satellite): the charge clock starts
+    INSIDE the DISPATCH_LOCK — a tenant stuck behind another tenant's
+    chunk in the lock queue is not billed for the queue time."""
+    from tidb_tpu.lifecycle import ResourceGroupRegistry
+    from tidb_tpu.lifecycle.resgroup import dispatch_admission
+    from tidb_tpu.lifecycle.scope import attach_scope
+
+    reg = ResourceGroupRegistry()
+    g = reg.create("metered", ru_per_sec=0)  # unlimited: admit is free
+    sc = QueryScope()
+    sc.resgroup = g
+    lock = threading.Lock()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hog():
+        with lock:
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hog)
+    t.start()
+    assert entered.wait(5.0)
+    timer = threading.Timer(0.25, release.set)
+    timer.start()
+    try:
+        with attach_scope(sc):
+            with dispatch_admission(lock):
+                time.sleep(0.02)  # the "device" body
+    finally:
+        release.set()
+        t.join()
+        timer.cancel()
+    consumed = g.snapshot()["consumed_ru"]
+    # billed the ~20ms body, never the ~250ms queue wait
+    assert 5.0 <= consumed < 150.0, consumed
+    assert sc.device_ms == pytest.approx(consumed, abs=0.01)
+
+
+def test_dispatch_admission_charges_on_exception_without_lock_wait():
+    """An exception inside the locked body still charges only the time
+    spent holding the lock — never a bogus absolute timestamp."""
+    from tidb_tpu.lifecycle import ResourceGroupRegistry
+    from tidb_tpu.lifecycle.resgroup import dispatch_admission
+    from tidb_tpu.lifecycle.scope import attach_scope
+
+    reg = ResourceGroupRegistry()
+    g = reg.create("metered_exc", ru_per_sec=0)
+    sc = QueryScope()
+    sc.resgroup = g
+    lock = threading.Lock()
+    with pytest.raises(RuntimeError):
+        with attach_scope(sc):
+            with dispatch_admission(lock):
+                time.sleep(0.01)
+                raise RuntimeError("device fault")
+    consumed = g.snapshot()["consumed_ru"]
+    assert 1.0 <= consumed < 150.0, consumed
+
+
 def test_resgroup_throttled_typed_error(monkeypatch):
     from tidb_tpu.lifecycle import ResourceGroupRegistry
 

@@ -10,24 +10,45 @@ back to the local full-table path on any mid-flight failure.  Host loss
 = epoch bump = re-shard from persisted packed base blocks onto the
 survivors, with in-flight dispatches retried under the new map via the
 typed `PartitionMapMismatch` — `CoordEpochMismatch`, one layer up.
+
+Replicated (ISSUE 20): HRW scores rank ALL members per partition into
+an ordered replica chain (`TIDB_TPU_DATAPLANE_RF`, default 2) — rank 0
+is the primary that serves steady-state reads, higher ranks are warm
+standbys every chain member materializes.  Member loss PROMOTES the
+surviving rank-1 replica instead of replaying packed blocks
+(`dataplane_replica_promotions_total` vs `dataplane_cold_reloads_total`)
+and reads survive the pre-epoch loss window via per-attempt deadlines,
+a failover ladder (primary -> next replica -> local bypass), dedup-keyed
+idempotent fragments, optional hedging (`TIDB_TPU_DATAPLANE_HEDGE_MS`)
+and pooled health-checked peer sockets (`rpc.PeerPool`).
 """
 
 from .engine import (activate_dataplane, deactivate_dataplane,
-                     get_dataplane, try_run_dataplane)
+                     get_dataplane, hedge_delay_s, try_run_dataplane)
 from .partition import (PartitionMap, PartitionMapMismatch,
-                        build_partition_map, default_parts)
+                        build_partition_map, default_parts, default_rf)
+from .rpc import (DataplaneRPCError, PeerDeadlineExceeded,
+                  PeerWaitCancelled, POOL, PeerClient, PeerPool)
 from .shard import Dataplane, ShardedTable, partition_tid
 
 __all__ = [
     "Dataplane",
+    "DataplaneRPCError",
+    "POOL",
     "PartitionMap",
     "PartitionMapMismatch",
+    "PeerClient",
+    "PeerDeadlineExceeded",
+    "PeerPool",
+    "PeerWaitCancelled",
     "ShardedTable",
     "activate_dataplane",
     "build_partition_map",
     "deactivate_dataplane",
     "default_parts",
+    "default_rf",
     "get_dataplane",
+    "hedge_delay_s",
     "partition_tid",
     "try_run_dataplane",
 ]

@@ -33,12 +33,26 @@ from typing import Dict, Tuple
 _PARTS_ENV = "TIDB_TPU_DATAPLANE_PARTS"
 DEFAULT_PARTS = 8
 
+#: replication factor: length of each partition's ordered replica
+#: chain, clamped to the fleet size.  RF=2 is the smallest chain where
+#: a member loss leaves a warm replica to promote (zero cold-tier
+#: reloads on the critical path); RF=1 reproduces the PR-18 behavior.
+_RF_ENV = "TIDB_TPU_DATAPLANE_RF"
+DEFAULT_RF = 2
+
 
 def default_parts() -> int:
     try:
         return max(int(os.environ.get(_PARTS_ENV, DEFAULT_PARTS)), 1)
     except ValueError:
         return DEFAULT_PARTS
+
+
+def default_rf() -> int:
+    try:
+        return max(int(os.environ.get(_RF_ENV, DEFAULT_RF)), 1)
+    except ValueError:
+        return DEFAULT_RF
 
 
 class PartitionMapMismatch(RuntimeError):
@@ -71,21 +85,43 @@ def _hrw_score(part: int, pid: int) -> int:
 class PartitionMap:
     """Ownership of `n_parts` hash partitions at one membership epoch.
 
-    `owners[p]` is the pid that owns partition p; `members` is the pid
-    set the map was derived from (sorted).  Two hosts holding maps with
-    the same epoch hold byte-identical maps — the map is a deterministic
+    `chains[p]` is partition p's ordered replica chain — the member
+    pids sorted by descending rendezvous score, truncated to the
+    replication factor.  `owners[p]` (== `chains[p][0]`) is the
+    PRIMARY; later chain entries are the failover ladder's rungs.
+    Because the chain IS the HRW ranking, losing a member deletes it
+    from every chain in place: the old secondary becomes the new
+    primary (a promotion, never a cold reload) and ownership of
+    everything else does not move.  `members` is the pid set the map
+    was derived from (sorted).  Two hosts holding maps with the same
+    epoch hold byte-identical maps — the map is a deterministic
     function of the broadcast."""
 
     epoch: int
     n_parts: int
     owners: Tuple[int, ...]
     members: Tuple[int, ...]
+    #: ordered replica chain per partition; chains[p][0] == owners[p]
+    chains: Tuple[Tuple[int, ...], ...] = ()
 
     def owned_by(self, pid: int) -> Tuple[int, ...]:
         return tuple(p for p, o in enumerate(self.owners) if o == pid)
 
+    def replica_of(self, pid: int) -> Tuple[int, ...]:
+        """Partitions where `pid` appears ANYWHERE in the chain (what
+        this member must be able to serve, primary or failover)."""
+        return tuple(p for p, ch in enumerate(self.chains) if pid in ch)
+
     def owner(self, part: int) -> int:
         return self.owners[part]
+
+    def chain(self, part: int) -> Tuple[int, ...]:
+        if self.chains:
+            return self.chains[part]
+        return (self.owners[part],)
+
+    def rf(self) -> int:
+        return max((len(ch) for ch in self.chains), default=1)
 
     def by_owner(self) -> Dict[int, Tuple[int, ...]]:
         out: Dict[int, list] = {}
@@ -100,18 +136,28 @@ class PartitionMap:
             raise PartitionMapMismatch(self.epoch, current_epoch)
 
 
-def build_partition_map(view, n_parts: int = 0) -> PartitionMap:
+def build_partition_map(view, n_parts: int = 0,
+                        rf: int = 0) -> PartitionMap:
     """Derive the ownership map from a membership view.  Requires a
     FORMED view with at least one member — before formation ownership
     would flap as members trickle in, so callers wait (or stay on the
-    degenerate single-owner path)."""
+    degenerate single-owner path).  `rf` is clamped to the fleet size;
+    0 reads `TIDB_TPU_DATAPLANE_RF` (default 2)."""
     pids = tuple(sorted(view.members))
     if not pids:
         raise PartitionMapMismatch(-1, view.epoch)
     n = n_parts or default_parts()
+    depth = min(max(rf or default_rf(), 1), len(pids))
     owners = []
+    chains = []
     for p in range(n):
-        # max score wins; ties (2^-64) break toward the lower pid
-        owners.append(max(pids, key=lambda pid: (_hrw_score(p, pid), -pid)))
+        # descending score; ties (2^-64) break toward the lower pid —
+        # the head of the ranking is exactly the old single-owner pick
+        ranked = sorted(pids,
+                        key=lambda pid: (_hrw_score(p, pid), -pid),
+                        reverse=True)
+        chains.append(tuple(ranked[:depth]))
+        owners.append(ranked[0])
     return PartitionMap(epoch=view.epoch, n_parts=n,
-                        owners=tuple(owners), members=pids)
+                        owners=tuple(owners), members=pids,
+                        chains=tuple(chains))

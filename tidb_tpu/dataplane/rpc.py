@@ -14,28 +14,87 @@ speaks newline-JSON on an adjacent port); chunks are numpy columns +
 FieldType dataclasses, which pickle round-trips losslessly without
 inventing a columnar wire format.
 
-Exchange volume is metered on BOTH directions into
-`dataplane_exchange_bytes_total` — the bench receipt's headline number.
+Chaos hardening (ISSUE 20):
+
+- Every request carries a per-fragment DEADLINE derived from the query
+  scope; the client waits in short slices and re-checks cancellation,
+  so `KILL` during a stalled peer returns within the scope's bounded
+  wait instead of a 30 s socket-timeout tail.
+- Connections are POOLED per peer (`PeerPool`): dial once, reuse with
+  a health-checked reconnect, close on member-leave so a dead peer
+  cannot hold fds.
+- Requests are IDEMPOTENT via a dedup key: the owner caches recent
+  fragment results, so a retry (or the losing half of a hedged pair
+  that landed on the same server) never double-executes side effects.
+- `dataplane/peer_stall` and `dataplane/peer_error` are the server-side
+  chaos sites the seeded sweep arms.
+
+Byte metering: the server meters both directions of everything it
+serves into `dataplane_served_bytes_total`; the CLIENT meters only the
+exchange the query actually consumed into
+`dataplane_exchange_bytes_total` (the bench receipt's headline number),
+so failover retries and hedge losers land in
+`dataplane_rpc_wasted_bytes_total` instead of double-counting the
+per-query exchange.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import threading
-from typing import Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..metrics import REGISTRY
+from ..store.fault import FAILPOINTS
+from ..util_concurrency import make_lock
 
 _HDR = struct.Struct(">Q")
 #: frame cap (1 GiB): a corrupt header must not look like an allocation
 _MAX_FRAME = 1 << 30
 
+#: per-fragment deadline cap (seconds) when the scope carries no
+#: deadline of its own; the scope's remaining time clamps it down
+_FRAG_TIMEOUT_ENV = "TIDB_TPU_DATAPLANE_FRAG_TIMEOUT_S"
+DEFAULT_FRAG_TIMEOUT_S = 10.0
+
+#: socket-wait slice: the cancellation poll period (bounds how long a
+#: KILL waits behind a stalled peer read)
+_POLL_S = 0.2
+
+#: pooled connections older than this re-verify with a ping before
+#: reuse (a dead peer's half-open socket must fail fast, not mid-scan)
+_HEALTH_AGE_S = 15.0
+
+#: owner-side dedup cache entries (fragment results kept for retries)
+_DEDUP_CAP = 64
+
+
+def default_frag_timeout_s() -> float:
+    try:
+        return max(float(os.environ.get(_FRAG_TIMEOUT_ENV,
+                                        DEFAULT_FRAG_TIMEOUT_S)), 0.05)
+    except ValueError:
+        return DEFAULT_FRAG_TIMEOUT_S
+
 
 class DataplaneRPCError(RuntimeError):
     """Remote fragment failed for a non-epoch reason (the caller's
-    fallback ladder decides whether to retry or run locally)."""
+    failover ladder decides whether to retry, hop to the next replica,
+    or run locally)."""
+
+
+class PeerDeadlineExceeded(DataplaneRPCError):
+    """The per-fragment deadline elapsed waiting on a peer — the
+    failover ladder treats it exactly like a connection error."""
+
+
+class PeerWaitCancelled(DataplaneRPCError):
+    """The caller's cancel hook fired mid-wait (statement KILL, or the
+    losing half of a hedged pair being called off)."""
 
 
 def _send_obj(sock: socket.socket, obj) -> int:
@@ -62,10 +121,37 @@ def _recv_obj(sock: socket.socket):
     return pickle.loads(buf), n
 
 
+def _recv_exact_sliced(sock: socket.socket, n: int, deadline: float,
+                       cancel: Optional[Callable[[], bool]]) -> bytes:
+    """Receive exactly n bytes, waiting in `_POLL_S` slices so the
+    overall deadline AND the caller's cancel hook are honored with
+    bounded latency (no flat socket-timeout tail)."""
+    out = bytearray()
+    while len(out) < n:
+        if cancel is not None and cancel():
+            raise PeerWaitCancelled("fragment wait cancelled")
+        if time.monotonic() >= deadline:
+            raise PeerDeadlineExceeded(
+                "fragment deadline exceeded waiting on peer")
+        try:
+            got = sock.recv(n - len(out))
+        except socket.timeout:
+            continue
+        if not got:
+            raise ConnectionError("dataplane peer closed mid-frame")
+        out.extend(got)
+    return bytes(out)
+
+
 class DataplaneServer:
     """Owner-side fragment executor: one listener thread + one thread
-    per connection (connections are long-lived — the engine keeps one
-    per peer and multiplexes fragments over it sequentially)."""
+    per connection (connections are long-lived — clients pool one per
+    peer and multiplex fragments over it sequentially).
+
+    Fragment requests carrying a `frag` dedup key are idempotent: the
+    result of a recent execution is cached and replayed, so a client
+    retry after a timeout (or the second half of a hedged pair landing
+    here) never re-executes the fragment's side effects."""
 
     def __init__(self, storage, dataplane, host: str = "127.0.0.1",
                  port: int = 0):
@@ -82,6 +168,11 @@ class DataplaneServer:
         self._stop = threading.Event()
         self._threads = []
         self._conns = []
+        # dedup key -> ("inflight", Event) | ("done", resp)
+        self._dedup_mu = make_lock(
+            "dataplane.rpc:DataplaneServer._dedup_mu")
+        self._dedup: Dict[str, tuple] = {}
+        self._dedup_order: List[str] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="dataplane-rpc-accept",
             daemon=True)
@@ -110,18 +201,61 @@ class DataplaneServer:
                     req, n_in = _recv_obj(conn)
                 except (ConnectionError, OSError, EOFError):
                     return
-                REGISTRY.inc("dataplane_exchange_bytes_total", n_in)
-                resp = self._handle(req)
+                REGISTRY.inc("dataplane_served_bytes_total", n_in)
+                resp = self._dispatch(req)
                 try:
                     n_out = _send_obj(conn, resp)
                 except OSError:
                     return
-                REGISTRY.inc("dataplane_exchange_bytes_total", n_out)
+                REGISTRY.inc("dataplane_served_bytes_total", n_out)
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+
+    # ------------------------------------------------------------------
+    # idempotent dispatch (dedup-keyed)
+    # ------------------------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        if req.get("cmd") == "ping":
+            return {"ok": 1}
+        key = req.get("frag")
+        if not key:
+            return self._handle(req)
+        with self._dedup_mu:
+            ent = self._dedup.get(key)
+            if ent is None:
+                self._dedup[key] = ("inflight", threading.Event())
+                self._dedup_order.append(key)
+                while len(self._dedup_order) > _DEDUP_CAP:
+                    old = self._dedup_order.pop(0)
+                    if old != key:
+                        self._dedup.pop(old, None)
+        if ent is not None:
+            state, val = ent
+            if state == "done":
+                REGISTRY.inc("dataplane_dedup_hits_total")
+                return val
+            # a twin of this fragment is executing right now: wait for
+            # its result instead of double-executing (slices keep the
+            # server responsive to close())
+            while not self._stop.is_set():
+                if val.wait(_POLL_S):
+                    break
+            with self._dedup_mu:
+                ent = self._dedup.get(key)
+            if ent is not None and ent[0] == "done":
+                REGISTRY.inc("dataplane_dedup_hits_total")
+                return ent[1]
+            return {"err": "exec", "msg": "twin fragment never finished"}
+        resp = self._handle(req)
+        with self._dedup_mu:
+            prev = self._dedup.get(key)
+            self._dedup[key] = ("done", resp)
+        if prev is not None and prev[0] == "inflight":
+            prev[1].set()
+        return resp
 
     def _handle(self, req: dict) -> dict:
         from ..store.kv import CopRequest, KeyRange
@@ -129,6 +263,11 @@ class DataplaneServer:
         try:
             if req.get("cmd") != "exec":
                 return {"err": "bad_cmd"}
+            # the chaos sites: a stalled peer (the action sleeps) and a
+            # flaky peer (the action raises -> a transient exec error
+            # the client's failover ladder must absorb)
+            FAILPOINTS.hit("dataplane/peer_stall", frag=req.get("frag"))
+            FAILPOINTS.hit("dataplane/peer_error", frag=req.get("frag"))
             # epoch gate FIRST: a fragment addressed under a stale map
             # must come back typed-retriable, not as partial rows
             self.dataplane.sync()
@@ -171,28 +310,143 @@ class DataplaneServer:
 
 class PeerClient:
     """Caller-side connection to one owner.  Fragments are sent
-    sequentially per peer (partition fan-out parallelism comes from
-    using one client per peer, not pipelining within a connection)."""
+    sequentially per connection (fan-out parallelism and hedging come
+    from using separate pooled connections, not pipelining within
+    one)."""
 
-    def __init__(self, addr: str, timeout_s: float = 30.0):
+    def __init__(self, addr: str, timeout_s: float = 5.0):
         host, port = addr.rsplit(":", 1)
         self.addr = addr
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(_POLL_S)
+        self.last_used = time.monotonic()
+        self.broken = False
+
+    def call(self, req: dict, deadline_s: float,
+             cancel: Optional[Callable[[], bool]] = None
+             ) -> Tuple[dict, int]:
+        """One request/response round trip under a deadline; returns
+        (response, bytes moved).  Any failure marks the connection
+        broken — a half-read frame cannot be resumed, so the pool
+        discards it."""
+        deadline = time.monotonic() + max(deadline_s, 0.05)
+        try:
+            n_out = _send_obj(self._sock, req)
+            (n,) = _HDR.unpack(_recv_exact_sliced(
+                self._sock, _HDR.size, deadline, cancel))
+            if n > _MAX_FRAME:
+                raise ConnectionError(f"dataplane frame too large: {n}")
+            buf = _recv_exact_sliced(self._sock, n, deadline, cancel)
+        except BaseException:
+            self.broken = True
+            raise
+        self.last_used = time.monotonic()
+        return pickle.loads(buf), n_out + n
 
     def exec_fragment(self, dag: dict, ranges, ts: int, epoch: int,
-                      engine: str, aux: Optional[dict] = None) -> dict:
+                      engine: str, aux: Optional[dict] = None,
+                      frag: Optional[str] = None,
+                      deadline_s: Optional[float] = None,
+                      cancel: Optional[Callable[[], bool]] = None
+                      ) -> Tuple[dict, int]:
         req = {"cmd": "exec", "dag": dag, "ranges": ranges, "ts": ts,
-               "epoch": epoch, "engine": engine, "aux": aux}
-        n_out = _send_obj(self._sock, req)
-        REGISTRY.inc("dataplane_exchange_bytes_total", n_out)
-        resp, n_in = _recv_obj(self._sock)
-        REGISTRY.inc("dataplane_exchange_bytes_total", n_in)
-        return resp
+               "epoch": epoch, "engine": engine, "aux": aux,
+               "frag": frag}
+        return self.call(req, deadline_s if deadline_s is not None
+                         else default_frag_timeout_s(), cancel)
+
+    def ping(self, deadline_s: float = 1.0) -> bool:
+        try:
+            resp, _n = self.call({"cmd": "ping"}, deadline_s)
+            return bool(resp.get("ok"))
+        except Exception:
+            return False
 
     def close(self):
+        self.broken = True
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+class PeerPool:
+    """Pooled peer connections: one dial per peer reused across
+    dispatches, with a health-checked reconnect (stale sockets ping
+    before reuse) and explicit pruning on member-leave so a dead peer
+    cannot hold fds.  The pool lock is never held across a dial or any
+    socket I/O."""
+
+    def __init__(self, per_addr: int = 2):
+        self._mu = make_lock("dataplane.rpc:PeerPool._mu")
+        self._idle: Dict[str, List[PeerClient]] = {}
+        self.per_addr = per_addr
+
+    def acquire(self, addr: str,
+                connect_timeout_s: float = 5.0) -> PeerClient:
+        while True:
+            with self._mu:
+                conns = self._idle.get(addr)
+                conn = conns.pop() if conns else None
+            if conn is None:
+                client = PeerClient(addr, timeout_s=connect_timeout_s)
+                REGISTRY.inc("dataplane_conn_dials_total")
+                return client
+            if time.monotonic() - conn.last_used > _HEALTH_AGE_S:
+                REGISTRY.inc("dataplane_conn_health_checks_total")
+                if not conn.ping():
+                    conn.close()
+                    REGISTRY.inc("dataplane_conn_evictions_total")
+                    continue
+            REGISTRY.inc("dataplane_conn_reuse_total")
+            return conn
+
+    def release(self, conn: PeerClient):
+        """Return a connection after use; broken connections (any error
+        or an abandoned in-flight response) are discarded — a pooled
+        socket must always be at a frame boundary."""
+        if conn.broken:
+            conn.close()
+            REGISTRY.inc("dataplane_conn_evictions_total")
+            return
+        drop = None
+        with self._mu:
+            conns = self._idle.setdefault(conn.addr, [])
+            if len(conns) >= self.per_addr:
+                drop = conn
+            else:
+                conns.append(conn)
+        if drop is not None:
+            drop.close()
+            REGISTRY.inc("dataplane_conn_evictions_total")
+
+    def prune(self, live_addrs) -> int:
+        """Close idle connections to peers no longer in the membership
+        broadcast (member-leave / lease expiry)."""
+        live = set(live_addrs)
+        dead: List[PeerClient] = []
+        with self._mu:
+            for addr in [a for a in self._idle if a not in live]:
+                dead.extend(self._idle.pop(addr) or ())
+        for c in dead:
+            c.close()
+            REGISTRY.inc("dataplane_conn_evictions_total")
+        return len(dead)
+
+    def close_all(self):
+        with self._mu:
+            conns = [c for cs in self._idle.values() for c in cs]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+    def idle_count(self) -> int:
+        with self._mu:
+            return sum(len(cs) for cs in self._idle.values())
+
+
+#: process-global pool (one fleet per process; tests reset via
+#: deactivate_dataplane -> close_all)
+POOL = PeerPool()

@@ -31,9 +31,14 @@ from ..store.blockstore import TableStore
 from ..store.fault import FAILPOINTS
 from ..types import TypeKind
 from ..util_concurrency import make_lock
-from .partition import PartitionMap, build_partition_map, default_parts
+from .partition import (PartitionMap, build_partition_map, default_parts,
+                        default_rf)
 
 _DIR_ENV = "TIDB_TPU_DATAPLANE_DIR"
+#: "1" defers secondary-replica materialization to first touch (the
+#: failover rung that needs it); default is eager — secondaries load at
+#: shard/re-shard time so a promotion never touches the cold tier
+_LAZY_ENV = "TIDB_TPU_DATAPLANE_LAZY_REPLICAS"
 
 #: synthetic table-id namespace for partition stores — far above any
 #: catalog id (catalogs number from 100) and wide enough that
@@ -133,12 +138,17 @@ class Dataplane:
 
     def __init__(self, storage, plane, pid: int,
                  data_dir: Optional[str] = None,
-                 n_parts: Optional[int] = None):
+                 n_parts: Optional[int] = None,
+                 rf: Optional[int] = None,
+                 lazy_replicas: Optional[bool] = None):
         self.storage = storage
         self.plane = plane
         self.pid = pid
         self.data_dir = data_dir or os.environ.get(_DIR_ENV) or None
         self.n_parts = n_parts or default_parts()
+        self.rf = rf if rf is not None else default_rf()
+        self.lazy_replicas = (lazy_replicas if lazy_replicas is not None
+                              else os.environ.get(_LAZY_ENV) == "1")
         self._mu = make_lock("dataplane.shard:Dataplane._mu")
         self._tables: Dict[int, ShardedTable] = {}
         self._map: Optional[PartitionMap] = None
@@ -151,12 +161,14 @@ class Dataplane:
     def shard_table(self, table_id: int) -> ShardedTable:
         """Snapshot the table's base blocks into hash partitions: persist
         every partition's packed form (so ANY host can replay it later),
-        then materialize the ones this host owns under the current map."""
+        then materialize every partition this host appears in the chain
+        for — primaries always, secondaries unless `lazy_replicas`
+        defers them to first touch."""
         src = self.storage.table(table_id)
         view = self.plane.view()
         if not view.members:
             view = _SoloView(view.epoch, self.pid)
-        pmap = build_partition_map(view, self.n_parts)
+        pmap = build_partition_map(view, self.n_parts, rf=self.rf)
         st = ShardedTable(table_id, [(c.name, c.ftype) for c in src.cols],
                           src.base_rows, src.base_ts, src.base_version,
                           self.n_parts)
@@ -167,11 +179,16 @@ class Dataplane:
         if self.data_dir:
             for p in range(st.n_parts):
                 self._persist_partition(src, st, p, cols, valids)
+        primary = set(pmap.owned_by(self.pid))
+        secondary = set(pmap.replica_of(self.pid)) - primary
         with self._mu:
             self._map = pmap
             self._tables[table_id] = st
-            for p in pmap.owned_by(self.pid):
+            for p in sorted(primary):
                 self._load_partition_locked(st, p, src=(cols, valids))
+            if not self.lazy_replicas:
+                for p in sorted(secondary):
+                    self._fill_replica_locked(st, p, src=(cols, valids))
         REGISTRY.inc("dataplane_tables_sharded_total")
         return st
 
@@ -202,38 +219,58 @@ class Dataplane:
     # re-shard (epoch bump: host joined or died)
     # ------------------------------------------------------------------
     def re_shard(self, view) -> PartitionMap:
-        """Install the ownership map for `view`'s epoch: replay newly
-        owned partitions (persisted packed codes first, live source
-        slice as fallback) and detach partitions that moved away."""
-        pmap = build_partition_map(view, self.n_parts)
+        """Install the ownership map for `view`'s epoch.  Partitions
+        whose chain no longer includes this host detach; partitions
+        newly PRIMARY here either promote (a surviving replica is
+        already materialized — `dataplane_replica_promotions_total`,
+        zero cold-tier work) or replay from the cold tier
+        (`dataplane_cold_reloads_total`: persisted packed codes first,
+        live source slice as fallback); new secondary-replica slots
+        fill eagerly (or defer to first touch under `lazy_replicas`)."""
+        pmap = build_partition_map(view, self.n_parts, rf=self.rf)
         with self._mu:
             old = self._map
             tables = dict(self._tables)
-        if old is not None and old.owners == pmap.owners:
+        if old is not None and old.owners == pmap.owners \
+                and old.chains == pmap.chains:
             with self._mu:
                 self._map = pmap
             return pmap  # same ownership, only the epoch moved
+        old_primary = set(old.owned_by(self.pid)) if old else set()
         moved = 0
         try:
             for tid, st in tables.items():
-                mine = set(pmap.owned_by(self.pid))
+                mine_primary = set(pmap.owned_by(self.pid))
+                mine_any = set(pmap.replica_of(self.pid))
                 with self._mu:
                     have = set(st.loaded)
-                for p in sorted(have - mine):
+                for p in sorted(have - mine_any):
                     with self._mu:
                         ptid = st.loaded.pop(p, None)
                     if ptid is not None:
                         self.storage.drop_table(ptid)
                         moved += 1
-                for p in sorted(mine - have):
+                for p in sorted(mine_primary - old_primary):
                     # the chaos site: armed failures surface here, mid
                     # re-shard, and the retry ladder above must converge
                     # to parity anyway
                     FAILPOINTS.hit("dataplane/reshard", table_id=tid,
                                    part=p, epoch=pmap.epoch)
-                    with self._mu:
-                        self._load_partition_locked(st, p)
+                    if p in have:
+                        # a live replica survives the loss: promote it —
+                        # the whole point of RF>=2 (no cold-tier decode
+                        # on the recovery's critical path)
+                        REGISTRY.inc("dataplane_replica_promotions_total")
+                    else:
+                        with self._mu:
+                            self._load_partition_locked(st, p)
+                        REGISTRY.inc("dataplane_cold_reloads_total")
                     moved += 1
+                if not self.lazy_replicas:
+                    for p in sorted(mine_any - mine_primary - have):
+                        with self._mu:
+                            if self._fill_replica_locked(st, p):
+                                moved += 1
         except Exception:
             # a torn re-shard must not look installed: clear the map so
             # the NEXT sync() replays the whole transition (loads are
@@ -253,6 +290,43 @@ class Dataplane:
     # ------------------------------------------------------------------
     # partition materialization
     # ------------------------------------------------------------------
+    def _fill_replica_locked(self, st: ShardedTable, part: int,
+                             src=None) -> bool:
+        """Materialize a SECONDARY replica (called with `_mu` held).
+        Non-fatal by design: a replica is availability headroom, not
+        correctness — on failure the partition simply stays cold here
+        (the failover ladder's later rungs and the local bypass still
+        answer) and the next touch retries.  `dataplane/replica_load`
+        is the chaos site."""
+        if part in st.loaded:
+            return False
+        try:
+            FAILPOINTS.hit("dataplane/replica_load",
+                           table_id=st.table_id, part=part)
+            self._load_partition_locked(st, part, src=src)
+        except Exception:
+            REGISTRY.inc("dataplane_replica_fill_errors_total")
+            return False
+        REGISTRY.inc("dataplane_replica_fills_total")
+        return True
+
+    def ensure_replica(self, table_id: int, part: int) -> Optional[int]:
+        """First-touch materialization for lazy secondaries: when this
+        host is in `part`'s chain but has not loaded it yet, load it
+        now and return the partition store's table id (None when the
+        fill failed or this host is not a replica)."""
+        with self._mu:
+            st = self._tables.get(table_id)
+            pmap = self._map
+            if st is None or pmap is None:
+                return None
+            if part in st.loaded:
+                return st.loaded[part]
+            if self.pid not in pmap.chain(part):
+                return None
+            self._fill_replica_locked(st, part)
+            return st.loaded.get(part)
+
     def _load_partition_locked(self, st: ShardedTable, part: int,
                                src=None):
         if part in st.loaded:
@@ -313,7 +387,11 @@ class Dataplane:
             if v is not None:
                 payload[f"c{ci}_valid"] = np.packbits(v[lo:hi])
         path = self._part_path(st, part)
-        tmp = path + ".tmp"
+        # tmp name is per-process: every member persists every partition
+        # of the same deterministic build into the SHARED replay dir, so
+        # concurrent writers must never collide on the staging file (the
+        # final rename is last-writer-wins over identical bytes)
+        tmp = "%s.%d.tmp" % (path, os.getpid())
         np.savez(tmp, **payload)
         # numpy appends .npz to names without it
         os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", path)
@@ -367,6 +445,8 @@ class Dataplane:
             "epoch": pmap.epoch if pmap else None,
             "members": list(pmap.members) if pmap else [],
             "owners": list(pmap.owners) if pmap else [],
+            "chains": [list(ch) for ch in pmap.chains] if pmap else [],
+            "rf": self.rf,
             "tables": tables,
         }
 

@@ -24,6 +24,10 @@ BACKOFF_TYPES: Dict[str, tuple] = {
     "region_miss": (2, 500),
     "task_error": (5, 1000),
     "device_error": (10, 2000),
+    # transient dataplane peer failures (flaky RPC, stalled owner): short
+    # base so the failover ladder re-probes quickly, capped well under a
+    # fragment deadline so backoff never dominates the rung budget
+    "peer_error": (5, 400),
 }
 
 

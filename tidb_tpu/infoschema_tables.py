@@ -591,13 +591,15 @@ def _tidb_tpu_resource_groups(domain, isc):
     ("row_start", ty_int()), ("row_end", ty_int()),
     ("owner_pid", ty_int()), ("epoch", ty_int()),
     ("local", ty_int()), ("store_table_id", ty_int()),
+    ("replicas", ty_string()),
 ])
 def _tidb_tpu_partition_map(domain, isc):
-    """The sharded data plane's ownership map (ISSUE 18): one row per
+    """The sharded data plane's ownership map (ISSUE 18/20): one row per
     (sharded table, partition) with its handle range, owning process,
-    the membership epoch the map was derived at, and — when this host
-    owns it — the synthetic table id of the materialized partition
-    store.  Empty when the data plane is inactive."""
+    the membership epoch the map was derived at, the synthetic table id
+    of the locally materialized partition store (when held), and the
+    ordered replica chain (primary first — the failover ladder's
+    rungs).  Empty when the data plane is inactive."""
     from .dataplane import get_dataplane
 
     dp = get_dataplane(domain.storage)
@@ -614,7 +616,8 @@ def _tidb_tpu_partition_map(domain, isc):
         bounds, loaded = tables[tid]
         for p, (lo, hi) in enumerate(bounds):
             rows.append((tid, p, lo, hi, pmap.owner(p), pmap.epoch,
-                         int(p in loaded), loaded.get(p, -1)))
+                         int(p in loaded), loaded.get(p, -1),
+                         ",".join(str(r) for r in pmap.chain(p))))
     return rows
 
 

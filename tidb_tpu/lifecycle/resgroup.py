@@ -474,20 +474,30 @@ def dispatch_admission(lock):
 
     The registry mutex is never held while waiting or while acquiring
     `lock`, and charging happens after the lock is released, so no new
-    lock-order edges appear."""
+    lock-order edges appear.
+
+    The clock starts INSIDE the lock: the tenant is billed for measured
+    device time on its chunk, never for sitting in the DISPATCH_LOCK
+    queue behind other tenants' chunks — queue time is the scheduler's
+    cost, and billing it would make one tenant's burst drain everyone
+    else's RU budget."""
     from .scope import current_scope
 
     scope = current_scope()
     group = scope_group(scope)
     if group is not None:
         _throttled_admit(group, scope)
-    t0 = time.perf_counter()
+    elapsed_ms = 0.0
     try:
         with lock:
-            yield
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                elapsed_ms = (time.perf_counter() - t0) * 1000.0
     finally:
         if group is not None:
-            group.charge((time.perf_counter() - t0) * 1000.0, scope)
+            group.charge(elapsed_ms, scope)
 
 
 @contextmanager

@@ -91,6 +91,7 @@ PASS_RULES = {
     "metric": ("metric-name",),
     "concur": ("lock-rank", "lock-order", "lock-blocking", "lock-guard",
                "lock-wait"),
+    "chaos": ("chaos-cover",),
 }
 
 
@@ -104,7 +105,8 @@ def run_all(repo_root: Optional[str] = None,
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-    passes = passes or ["purity", "plan", "kernel", "metric", "concur"]
+    passes = passes or ["purity", "plan", "kernel", "metric", "concur",
+                        "chaos"]
     findings: List[Finding] = []
     if "purity" in passes:
         from .purity import lint_tree
@@ -114,6 +116,10 @@ def run_all(repo_root: Optional[str] = None,
         from .concur import lint_tree as lint_concur
 
         findings += lint_concur(repo_root)
+    if "chaos" in passes:
+        from .chaoscover import lint_tree as lint_chaos_cover
+
+        findings += lint_chaos_cover(repo_root)
     if "metric" in passes:
         from .metricnames import lint_tree as lint_metric_names
 

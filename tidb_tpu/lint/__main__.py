@@ -30,7 +30,8 @@ def _pin_host_platform():
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tidb_tpu.lint")
-    ap.add_argument("--passes", default="purity,plan,kernel,metric,concur",
+    ap.add_argument("--passes",
+                    default="purity,plan,kernel,metric,concur,chaos",
                     help="comma list of pass families to run")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")

@@ -27,7 +27,16 @@ lock-guard     instance attributes written under a ``self`` lock in any
                non-``__init__`` method are GUARDED: reading or writing
                them without the lock elsewhere in the class is a race.
                ``*_locked`` helper methods count as lock-held context
-               (the pervasive repo convention).
+               (the pervasive repo convention).  CROSS-OBJECT form
+               (ISSUE 20): a class may declare ``_guarded_by_ =
+               "<lock key>"`` — its instances' state then belongs to
+               ANOTHER object's lock (the batcher's ``_Group`` rides
+               ``MicroBatcher._mu``).  Any store to such an instance's
+               attributes (plain assignment or a mutating container
+               call: append/pop/extend/...) through a local constructed
+               from — or annotated with — the class, without the
+               declared lock held, fails.  Loads stay free: the
+               lock-free ``Event`` handshakes are the point.
 
 The static pass covers paths tests never execute; the runtime witness
 (`util_concurrency.RankedLock`, ``TIDB_TPU_LOCKCHECK=1``) validates the
@@ -52,6 +61,13 @@ RULE_WAIT = "lock-wait"
 #: notifier runs (the lock-wait rule pairs them with _NOTIFY_METHODS)
 _WAIT_METHODS = {"wait", "wait_for"}
 _NOTIFY_METHODS = {"notify", "notify_all", "set"}
+
+#: the class attribute declaring the cross-object guard, and the
+#: container-mutator method names that count as STORES through it
+GUARDED_BY_ATTR = "_guarded_by_"
+_MUTATOR_METHODS = {"append", "pop", "extend", "clear", "add", "remove",
+                    "insert", "update", "setdefault", "popitem",
+                    "appendleft", "discard"}
 
 #: Global lock-rank table: every lock in the tree, keyed
 #: ``module:Owner.attr`` (instance locks) or ``module:GLOBAL`` (module
@@ -82,6 +98,10 @@ LOCK_RANKS: Dict[str, int] = {
     # attaches partition stores (rank 100/110) while holding it, and it
     # is never held across a dispatch
     "dataplane.shard:Dataplane._mu": 97,
+    # leaf locks of the chaos-hardened RPC layer: held only around dict
+    # bookkeeping, never across a dial, socket I/O, or another lock
+    "dataplane.rpc:PeerPool._mu": 242,
+    "dataplane.rpc:DataplaneServer._dedup_mu": 244,
     # ---- storage engine --------------------------------------------------
     "store.storage:BlockStorage._mu": 100,
     "store.blockstore:TableStore._mu": 110,
@@ -112,6 +132,8 @@ LOCK_RANKS: Dict[str, int] = {
     "trace.profiler:Profiler._mu": 280,
     "trace.recorder:_EXPORT_MU": 282,
     "trace.recorder:QueryTrace._mu": 285,
+    # SLO AUTO rolling-window tracker: leaf, bucket arithmetic only
+    "trace.slo:SloAutoWindows._mu": 287,
     "metrics:Registry._mu": 290,
 }
 
@@ -188,7 +210,7 @@ class _Func:
     """Per-function facts gathered in one AST walk."""
 
     __slots__ = ("qual", "cls", "line", "acqs", "calls", "blocking",
-                 "attr_accesses", "waits", "notifies")
+                 "attr_accesses", "waits", "notifies", "obj_stores")
 
     def __init__(self, qual, cls, line):
         self.qual = qual
@@ -211,11 +233,16 @@ class _Func:
         # .set()` — recorded regardless of held state (the notifier's
         # lock REQUIREMENT also includes its lexical acquisitions)
         self.notifies: List[tuple] = []
+        # (clsref, attr, line, held_keys_tuple) per store through a
+        # ctor/annotation-typed local — filtered in the global pass to
+        # classes declaring _guarded_by_
+        self.obj_stores: List[tuple] = []
 
 
 class _Module:
     __slots__ = ("key", "path", "is_pkg", "class_locks", "module_locks",
-                 "funcs", "from_imports", "rank_findings", "jitted")
+                 "funcs", "from_imports", "rank_findings", "jitted",
+                 "guarded_classes")
 
     def __init__(self, key, path, is_pkg):
         self.key = key
@@ -224,6 +251,8 @@ class _Module:
         # (class, attr) -> _Lock ; global name -> _Lock
         self.class_locks: Dict[Tuple[str, str], _Lock] = {}
         self.module_locks: Dict[str, _Lock] = {}
+        # class name -> declared cross-object guard lock key
+        self.guarded_classes: Dict[str, str] = {}
         self.funcs: Dict[str, _Func] = {}
         # local name -> (resolved module key, original name)
         self.from_imports: Dict[str, Tuple[str, str]] = {}
@@ -304,6 +333,13 @@ def _collect_defs(tree: ast.Module, mod: _Module):
                 for sub in ast.walk(meth):
                     scan_assign(sub, cls_node.name,
                                 meth.name == "__init__")
+            elif isinstance(meth, ast.Assign) \
+                    and len(meth.targets) == 1 \
+                    and isinstance(meth.targets[0], ast.Name) \
+                    and meth.targets[0].id == GUARDED_BY_ATTR \
+                    and isinstance(meth.value, ast.Constant) \
+                    and isinstance(meth.value.value, str):
+                mod.guarded_classes[cls_node.name] = meth.value.value
 
 
 def _check_registry(mod: _Module, ranks: Dict[str, int]) -> List[Finding]:
@@ -376,12 +412,26 @@ class _BodyWalker:
     """Walks one function body tracking the held-lock stack."""
 
     def __init__(self, mod: _Module, func: _Func, resolve_lock,
-                 jitted: Set[str], base_held: Tuple[str, ...]):
+                 jitted: Set[str], base_held: Tuple[str, ...],
+                 arg_types: Optional[Dict[str, str]] = None):
         self.mod = mod
         self.func = func
         self.resolve_lock = resolve_lock
         self.jitted = jitted
         self.base_held = base_held
+        # local var -> "modkey:ClassName" for ctor-typed / annotated
+        # locals (the cross-object guard pass consumes the stores)
+        self.types: Dict[str, str] = dict(arg_types or {})
+
+    def _clsref(self, name: str) -> Optional[str]:
+        """Resolve a bare class-looking Name to 'modkey:ClassName'."""
+        stem = name.lstrip("_")
+        if not stem or not stem[0].isupper():
+            return None
+        if name in self.mod.from_imports:
+            m, orig = self.mod.from_imports[name]
+            return f"{m}:{orig}"
+        return f"{self.mod.key}:{name}"
 
     def walk(self, body, held: Tuple[str, ...]):
         for stmt in body:
@@ -391,6 +441,21 @@ class _BodyWalker:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return  # nested defs execute later, with their own stack
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            # ctor-typed local binding (x = ClassName(...)); any other
+            # re-assignment of the name drops the binding
+            self._expr(node.value, held)
+            tgt = node.targets[0].id
+            ref = None
+            if isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name):
+                ref = self._clsref(node.value.func.id)
+            if ref is not None:
+                self.types[tgt] = ref
+            else:
+                self.types.pop(tgt, None)
+            return
         if isinstance(node, (ast.With, ast.AsyncWith)):
             add: List[str] = []
             for item in node.items:
@@ -426,6 +491,12 @@ class _BodyWalker:
             self.func.attr_accesses.append(
                 (node.attr, node.lineno, is_store,
                  bool(held) or bool(self.base_held)))
+        elif isinstance(node.value, ast.Name) \
+                and node.value.id in self.types \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.func.obj_stores.append(
+                (self.types[node.value.id], node.attr, node.lineno,
+                 held if held else self.base_held))
 
     def _call(self, node, held):
         effective = held if held else self.base_held
@@ -438,6 +509,21 @@ class _BodyWalker:
                 elif node.func.attr in _WAIT_METHODS and effective:
                     self.func.waits.append(
                         (recv, node.lineno, effective))
+            if node.func.attr in _MUTATOR_METHODS:
+                # g.items.append(x) mutates guarded attribute `items`;
+                # g.append(x) mutates the guarded object itself
+                inner = node.func.value
+                if isinstance(inner, ast.Attribute) \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id in self.types:
+                    self.func.obj_stores.append(
+                        (self.types[inner.value.id], inner.attr,
+                         node.lineno, effective))
+                elif isinstance(inner, ast.Name) \
+                        and inner.id in self.types:
+                    self.func.obj_stores.append(
+                        (self.types[inner.id], node.func.attr,
+                         node.lineno, effective))
         if effective:
             tok = _blocking_token(node, self.jitted)
             if tok is not None:
@@ -509,6 +595,19 @@ def _analyze_module(tree: ast.Module, relpath: str,
                         else ())
                 walker = _BodyWalker(mod, func, resolve_lock,
                                      mod.jitted, base)
+                for a in (node.args.args + node.args.kwonlyargs
+                          + node.args.posonlyargs):
+                    ann = a.annotation
+                    name = None
+                    if isinstance(ann, ast.Name):
+                        name = ann.id
+                    elif isinstance(ann, ast.Constant) \
+                            and isinstance(ann.value, str):
+                        name = ann.value.strip("'\"")
+                    if name:
+                        ref = walker._clsref(name)
+                        if ref is not None:
+                            walker.types[a.arg] = ref
                 walker.walk(node.body, ())
                 # nested defs (closures, hook functions) get their own
                 # empty-stack analysis under the enclosing qualname
@@ -783,6 +882,42 @@ def _guard_findings(index: _Index) -> List[Finding]:
     return out
 
 
+def _xguard_findings(index: _Index) -> List[Finding]:
+    """Cross-object lock-guard (ISSUE 20): stores to instances of a
+    class declaring ``_guarded_by_ = "<lock key>"`` must hold THAT lock
+    — the declared key lexically, or the caller-lock convention when
+    the key is one of the enclosing class's own locks (so a batcher
+    ``*_locked`` helper mutating a _Group stays legal)."""
+    out: List[Finding] = []
+    guarded: Dict[str, str] = {}
+    for m in index.modules.values():
+        for cls, lockkey in m.guarded_classes.items():
+            guarded[f"{m.key}:{cls}"] = lockkey
+    if not guarded:
+        return out
+    for _fq, (mod, func) in index.funcs.items():
+        class_keys = ({lk.key for (c, _a), lk in mod.class_locks.items()
+                       if c == func.cls} if func.cls else set())
+        flagged: Set[tuple] = set()
+        for clsref, attr, line, held in func.obj_stores:
+            lockkey = guarded.get(clsref)
+            if lockkey is None:
+                continue
+            if lockkey in held or ("<caller-lock>" in held
+                                   and lockkey in class_keys):
+                continue
+            cname = clsref.rsplit(":", 1)[-1]
+            if (clsref, attr) in flagged:
+                continue
+            flagged.add((clsref, attr))
+            out.append(Finding(
+                RULE_GUARD, mod.path, line, func.qual,
+                f"{cname}.{attr}",
+                f"{cname} declares _guarded_by_ {lockkey!r}: this "
+                f"store to .{attr} does not hold it"))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -797,6 +932,7 @@ def _findings_for(modules: List[_Module],
     out += _blocking_findings(index)
     out += _wait_findings(index, ranks)
     out += _guard_findings(index)
+    out += _xguard_findings(index)
     return out
 
 

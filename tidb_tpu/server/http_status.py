@@ -105,6 +105,23 @@ def _dataplane_section(domain) -> dict:
                 "dataplane_bypass_total",
                 "dataplane_peer_lost_total",
                 "dataplane_errors_total",
+                # replication & failover (ISSUE 20)
+                "dataplane_replica_promotions_total",
+                "dataplane_cold_reloads_total",
+                "dataplane_replica_fills_total",
+                "dataplane_replica_fill_errors_total",
+                "dataplane_replica_reads_total",
+                "dataplane_failovers_total",
+                "dataplane_failover_bypass_total",
+                "dataplane_hedged_fragments_total",
+                "dataplane_hedge_wins_total",
+                "dataplane_hedge_wasted_bytes_total",
+                "dataplane_rpc_wasted_bytes_total",
+                "dataplane_served_bytes_total",
+                "dataplane_dedup_hits_total",
+                "dataplane_conn_dials_total",
+                "dataplane_conn_reuse_total",
+                "dataplane_conn_evictions_total",
             )
         }
         return out
@@ -114,10 +131,13 @@ def _dataplane_section(domain) -> dict:
 
 def _slo_section(domain) -> dict:
     """Per-statement-class SLO state (ISSUE 13): threshold, error-budget
-    burn counters and latency quantiles from the log2 histograms."""
+    burn counters and latency quantiles from the log2 histograms.  An
+    ``auto`` class (ISSUE 20) additionally reports the rolling-window
+    baseline its derived threshold comes from."""
     try:
         from ..metrics import REGISTRY, STMT_CLASSES
         from ..session.vars import SessionVars
+        from ..trace.slo import SLO_AUTO, is_auto, resolve_threshold_ms
 
         # the SAME read Session._observe_slo acts on (global scope with
         # SYSVAR_DEFAULTS fallback) — the reported threshold must never
@@ -126,12 +146,16 @@ def _slo_section(domain) -> dict:
         snap = REGISTRY.snapshot()
         out = {}
         for cls in STMT_CLASSES:
-            thr = gvars.get_global_int(f"tidb_tpu_slo_{cls}_ms", 0)
+            raw = gvars.get_global_str(f"tidb_tpu_slo_{cls}_ms", "0")
+            thr = resolve_threshold_ms(raw, cls)
             ok = snap.get(f"slo_{cls}_ok_total", 0)
             breach = snap.get(f"slo_{cls}_breach_total", 0)
             total = ok + breach
             sec = {"threshold_ms": thr, "ok": ok, "breach": breach,
                    "burn": round(breach / total, 6) if total else 0.0}
+            if is_auto(raw):
+                sec["mode"] = "auto"
+                sec["auto"] = SLO_AUTO.snapshot(cls)
             hs = REGISTRY.hist_stats(f"stmt_latency_{cls}_ms")
             if hs is not None:
                 sec.update({"count": hs["count"], "p50_ms": hs["p50"],

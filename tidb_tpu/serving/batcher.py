@@ -72,7 +72,13 @@ class _Member:
 
 
 class _Group:
+    """One key's open batch.  Its queue state (`members`, `closed`)
+    belongs to the BATCHER's mutex, not a lock of its own — declared
+    for lint.concur's cross-object guard rule.  `full` is the lock-free
+    leader-wakeup Event: reads/waits on it never need the mutex."""
+
     __slots__ = ("members", "closed", "full")
+    _guarded_by_ = "serving.batcher:MicroBatcher._mu"
 
     def __init__(self):
         self.members: List[_Member] = []

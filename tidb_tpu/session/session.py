@@ -260,17 +260,28 @@ class Session:
         """Per-statement-class end-to-end latency histogram + SLO
         error-budget burn counters (ISSUE 13): the class threshold rides
         `tidb_tpu_slo_<class>_ms` sysvars (0 disables burn accounting;
-        the histogram always records)."""
+        the histogram always records).  The value ``auto`` (ISSUE 20
+        satellite) derives the threshold from the rolling-window p99
+        (trace.slo) instead of a fixed constant."""
         try:
             from ..metrics import REGISTRY
             from ..trace import stmt_class
+            from ..trace.slo import SLO_AUTO, resolve_threshold_ms
 
             cls = stmt_class(sql)
             dur_ms = tr.duration_ms()
             REGISTRY.observe_hist(f"stmt_latency_{cls}_ms", dur_ms)
             # GLOBAL scope only: the burn counters are fleet-wide and
-            # must agree with the threshold /status reports
-            thr = self.vars.get_global_int(f"tidb_tpu_slo_{cls}_ms", 0)
+            # must agree with the threshold /status reports.  Resolve
+            # BEFORE feeding the windows: a statement is judged against
+            # the baseline of statements that preceded it — an outlier
+            # must not dilate its own threshold
+            thr = resolve_threshold_ms(
+                self.vars.get_global_str(f"tidb_tpu_slo_{cls}_ms", "0"),
+                cls)
+            # fixed-threshold classes feed the rolling windows too, so
+            # flipping a class to 'auto' acts on an already-warm baseline
+            SLO_AUTO.observe(cls, dur_ms)
             if thr > 0:
                 if dur_ms > thr:
                     REGISTRY.inc(f"slo_{cls}_breach_total")

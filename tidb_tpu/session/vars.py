@@ -88,7 +88,10 @@ SYSVAR_DEFAULTS = {
     # `stmt_latency_<class>_ms` and, when its class threshold is > 0,
     # bumps `slo_<class>_{ok,breach}_total` — the error-budget burn
     # counters the /status "slo" section reports.  0 disables burn
-    # accounting for a class (the histogram still records).
+    # accounting for a class (the histogram still records).  The string
+    # 'auto' (GLOBAL scope) derives the threshold from the observed
+    # rolling p99 instead (trace.slo: headroom x merged-window p99,
+    # inert until the windows hold enough samples).
     "tidb_tpu_slo_point_ms": ("100", "int"),
     "tidb_tpu_slo_agg_ms": ("1000", "int"),
     "tidb_tpu_slo_join_ms": ("5000", "int"),
@@ -190,6 +193,19 @@ class SessionVars:
             return int(v)
         except (TypeError, ValueError):
             return default
+
+    def get_global_str(self, name: str, default: str = "") -> str:
+        """GLOBAL-scope raw read (skips session overrides, no type
+        coercion): for sysvars carrying sentinel strings on an int-kind
+        knob — `tidb_tpu_slo_<class>_ms = 'auto'` selects the derived
+        rolling-p99 threshold (trace.slo) and must read the same on
+        every session and on /status."""
+        name = name.lower()
+        v = self._globals.get(name)
+        if v is None:
+            d = SYSVAR_DEFAULTS.get(name)
+            v = d[0] if d else None
+        return v if v is not None else default
 
     def get_bool(self, name: str) -> bool:
         v = self.get(name)

@@ -1,0 +1,168 @@
+"""SLO AUTO mode: per-class thresholds derived from observed p99.
+
+Reference: TiDB's expensive-query threshold is a static knob; real
+fleets instead alert on a *rolling* latency baseline.  Setting a
+`tidb_tpu_slo_<class>_ms` sysvar to the string ``auto`` (ISSUE 20
+satellite) derives that class's breach threshold from the statement
+latencies actually observed, instead of a hand-tuned constant:
+
+* every finished traced statement feeds a per-class **rotating window
+  pair** of bounded log2-bucket histograms (the same structure as
+  `metrics.Histogram`, a few hundred bytes per class).  The current
+  window rotates out after `TIDB_TPU_SLO_AUTO_WINDOW_S` seconds
+  (default 60); the previous window is kept so the estimate always
+  spans between one and two windows of traffic and a rotation never
+  empties the baseline;
+* the AUTO threshold is the merged windows' p99 multiplied by
+  `TIDB_TPU_SLO_AUTO_HEADROOM` (default 2.0) — a statement is a breach
+  when it exceeds twice the recent p99, i.e. the SLO tracks the
+  workload's own tail instead of a guess made at deploy time;
+* until `TIDB_TPU_SLO_AUTO_MIN_SAMPLES` observations (default 50) have
+  landed in the windows, the threshold is 0 and burn accounting stays
+  off — a cold server must not mark its first queries as breaches of a
+  baseline that does not exist yet.
+
+The tracker is process-global (like the metrics REGISTRY) because the
+burn counters it gates are process-global; fixed-threshold classes feed
+it too, so flipping a class to ``auto`` acts on an already-warm
+baseline.  Its mutex is a leaf: held only around bucket arithmetic.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict
+
+from ..metrics import Histogram
+from ..util_concurrency import make_lock
+
+_WINDOW_ENV = "TIDB_TPU_SLO_AUTO_WINDOW_S"
+_MIN_SAMPLES_ENV = "TIDB_TPU_SLO_AUTO_MIN_SAMPLES"
+_HEADROOM_ENV = "TIDB_TPU_SLO_AUTO_HEADROOM"
+_DEFAULT_WINDOW_S = 60.0
+_DEFAULT_MIN_SAMPLES = 50
+_DEFAULT_HEADROOM = 2.0
+
+#: the sysvar value that selects AUTO mode (case-insensitive)
+AUTO = "auto"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _ClassWindows:
+    """One statement class's rotating window pair (mutated under the
+    owning tracker's mutex; never locked on its own)."""
+
+    __slots__ = ("cur", "prev", "cur_start")
+
+    def __init__(self, now: float):
+        self.cur = Histogram()
+        self.prev = Histogram()
+        self.cur_start = now
+
+    def rotate_if_due_locked(self, now: float, window_s: float):
+        if now - self.cur_start >= window_s:
+            # one rotation even after a long idle gap: the stale
+            # previous window ages out, the (possibly stale) current
+            # one becomes the baseline until fresh traffic lands
+            self.prev = self.cur
+            self.cur = Histogram()
+            self.cur_start = now
+
+    def merged_locked(self) -> Histogram:
+        m = Histogram()
+        m.counts = [a + b for a, b in zip(self.cur.counts,
+                                          self.prev.counts)]
+        m.sum = self.cur.sum + self.prev.sum
+        m.count = self.cur.count + self.prev.count
+        return m
+
+
+class SloAutoWindows:
+    """Per-class rotating latency windows + the derived AUTO threshold."""
+
+    def __init__(self):
+        self._mu = make_lock("trace.slo:SloAutoWindows._mu")
+        self._classes: Dict[str, _ClassWindows] = {}
+
+    def _window_s(self) -> float:
+        return max(_env_float(_WINDOW_ENV, _DEFAULT_WINDOW_S), 0.05)
+
+    def _min_samples(self) -> int:
+        return max(int(_env_float(_MIN_SAMPLES_ENV,
+                                  _DEFAULT_MIN_SAMPLES)), 1)
+
+    def _headroom(self) -> float:
+        return max(_env_float(_HEADROOM_ENV, _DEFAULT_HEADROOM), 1.0)
+
+    def observe(self, cls: str, dur_ms: float) -> None:
+        now = time.monotonic()
+        with self._mu:
+            w = self._classes.get(cls)
+            if w is None:
+                w = self._classes[cls] = _ClassWindows(now)
+            w.rotate_if_due_locked(now, self._window_s())
+            w.cur.observe(float(dur_ms))
+
+    def threshold_ms(self, cls: str) -> float:
+        """The derived breach threshold: headroom x rolling p99, or 0.0
+        while the windows hold fewer than the minimum samples."""
+        now = time.monotonic()
+        with self._mu:
+            w = self._classes.get(cls)
+            if w is None:
+                return 0.0
+            w.rotate_if_due_locked(now, self._window_s())
+            m = w.merged_locked()
+        if m.count < self._min_samples():
+            return 0.0
+        return m.quantile(0.99) * self._headroom()
+
+    def snapshot(self, cls: str) -> dict:
+        """Observability read for /status: window occupancy + the
+        rolling p99 the threshold derives from."""
+        now = time.monotonic()
+        with self._mu:
+            w = self._classes.get(cls)
+            if w is None:
+                return {"samples": 0, "p99_ms": 0.0}
+            w.rotate_if_due_locked(now, self._window_s())
+            m = w.merged_locked()
+        return {
+            "samples": m.count,
+            "p99_ms": m.quantile(0.99),
+            "min_samples": self._min_samples(),
+            "headroom": self._headroom(),
+            "window_s": self._window_s(),
+        }
+
+    def reset(self) -> None:
+        """Test seam: drop all windows."""
+        with self._mu:
+            self._classes.clear()
+
+
+SLO_AUTO = SloAutoWindows()
+
+
+def is_auto(raw: str) -> bool:
+    """Does a `tidb_tpu_slo_<class>_ms` sysvar value select AUTO mode?"""
+    return isinstance(raw, str) and raw.strip().lower() == AUTO
+
+
+def resolve_threshold_ms(raw: str, cls: str) -> float:
+    """The effective breach threshold for one class given the sysvar's
+    raw GLOBAL value: ``auto`` derives from the rolling windows, an
+    integer is itself, anything unparseable disables burn accounting."""
+    if is_auto(raw):
+        return SLO_AUTO.threshold_ms(cls)
+    try:
+        return float(int(str(raw).strip() or 0))
+    except ValueError:
+        return 0.0
